@@ -1,0 +1,16 @@
+"""Catalog: the framework's data + control plane.
+
+Replaces the reference's MongoDB-as-everything design (dataset store,
+metadata/lineage store, and job-status bus in one; SURVEY §L5) with:
+
+- a SQLite metadata/document index (collection registry, ``_id=0``
+  metadata documents, append-only execution documents, change feed),
+- a Parquet/Arrow columnar dataset store (replacing one-document-per-row
+  collections, reference database_api_image/database.py:130-136),
+- a typed binary artifact store (replacing the dill/SavedModel shared
+  volumes, reference binary_executor_image/utils.py:195-247).
+"""
+
+from learningorchestra_tpu.catalog.store import Catalog  # noqa: F401
+from learningorchestra_tpu.catalog.artifacts import ArtifactStore  # noqa: F401
+from learningorchestra_tpu.catalog import documents  # noqa: F401

@@ -1,0 +1,177 @@
+"""Document schemas and field names.
+
+The reference keeps a reserved document ``_id: 0`` per collection as
+metadata/lineage (binary_executor_image/utils.py:73-97,
+projection_image/utils.py:10-30) and appends execution documents with
+incrementing ``_id`` per re-run (utils.py:112-136). We preserve the
+exact field vocabulary so API responses are shape-compatible, but make
+creation/update atomic (the reference allocates execution ids with a
+read-max-then-insert race, utils.py:116-131 — fixed here by doing it
+in one SQL transaction).
+"""
+
+from __future__ import annotations
+
+import datetime
+from typing import Any, Dict, List, Optional
+
+# --- field names (reference binary_executor_image/constants.py:1-79) ---
+ID = "_id"
+METADATA_ID = 0
+
+TYPE_FIELD = "type"
+NAME_FIELD = "name"
+FINISHED_FIELD = "finished"
+TIME_CREATED_FIELD = "timeCreated"
+PARENT_NAME_FIELD = "parentName"
+PARENT_DATASET_NAME_FIELD = "parentDatasetName"
+MODULE_PATH_FIELD = "modulePath"
+CLASS_FIELD = "class"
+CLASS_PARAMETERS_FIELD = "classParameters"
+METHOD_FIELD = "method"
+METHOD_PARAMETERS_FIELD = "methodParameters"
+FIELDS_FIELD = "fields"
+DESCRIPTION_FIELD = "description"
+EXCEPTION_FIELD = "exception"
+FUNCTION_FIELD = "function"
+FUNCTION_PARAMETERS_FIELD = "functionParameters"
+FUNCTION_MESSAGE_FIELD = "functionMessage"
+
+# --- artifact type strings (reference constants.py:41-76 + krakend routes) ---
+DATASET_CSV_TYPE = "dataset/csv"
+DATASET_GENERIC_TYPE = "dataset/generic"
+MODEL_TENSORFLOW_TYPE = "model/tensorflow"
+MODEL_SCIKITLEARN_TYPE = "model/scikitlearn"
+TRAIN_TENSORFLOW_TYPE = "train/tensorflow"
+TRAIN_SCIKITLEARN_TYPE = "train/scikitlearn"
+TUNE_TENSORFLOW_TYPE = "tune/tensorflow"
+TUNE_SCIKITLEARN_TYPE = "tune/scikitlearn"
+EVALUATE_TENSORFLOW_TYPE = "evaluate/tensorflow"
+EVALUATE_SCIKITLEARN_TYPE = "evaluate/scikitlearn"
+# The reference gateway itself contains the typo "sckitlearn" for the
+# evaluate backend (krakend.json evaluate routes); accept it as alias.
+EVALUATE_SCIKITLEARN_TYPO = "evaluate/sckitlearn"
+PREDICT_TENSORFLOW_TYPE = "predict/tensorflow"
+PREDICT_SCIKITLEARN_TYPE = "predict/scikitlearn"
+EXPLORE_TENSORFLOW_TYPE = "explore/tensorflow"
+EXPLORE_SCIKITLEARN_TYPE = "explore/scikitlearn"
+EXPLORE_HISTOGRAM_TYPE = "explore/histogram"
+TRANSFORM_TENSORFLOW_TYPE = "transform/tensorflow"
+TRANSFORM_SCIKITLEARN_TYPE = "transform/scikitlearn"
+TRANSFORM_PROJECTION_TYPE = "transform/projection"
+TRANSFORM_DATATYPE_TYPE = "transform/dataType"
+FUNCTION_PYTHON_TYPE = "function/python"
+BUILDER_SPARKML_TYPE = "builder/sparkml"
+# JAX-native tool alias: everywhere the reference accepts "tensorflow"
+# the rebuild also accepts "jax" with identical semantics.
+MODEL_JAX_TYPE = "model/jax"
+TRAIN_JAX_TYPE = "train/jax"
+TUNE_JAX_TYPE = "tune/jax"
+EVALUATE_JAX_TYPE = "evaluate/jax"
+PREDICT_JAX_TYPE = "predict/jax"
+EXPLORE_JAX_TYPE = "explore/jax"
+TRANSFORM_JAX_TYPE = "transform/jax"
+
+DATASET_TYPES = (DATASET_CSV_TYPE, DATASET_GENERIC_TYPE)
+
+# Types whose artifact is a live Python/JAX object persisted to the
+# artifact store (vs. tabular output persisted as rows).
+OBJECT_TYPES_PREFIXES = ("model/", "train/", "tune/", "transform/", "function/")
+
+TABULAR_OUTPUT_TYPES = (
+    TRANSFORM_PROJECTION_TYPE,
+    TRANSFORM_DATATYPE_TYPE,
+    EXPLORE_HISTOGRAM_TYPE,
+    BUILDER_SPARKML_TYPE,
+)
+
+
+def normalize_type(type_string: str) -> str:
+    """Map reference typos/aliases onto canonical type strings."""
+    if type_string == EVALUATE_SCIKITLEARN_TYPO:
+        return EVALUATE_SCIKITLEARN_TYPE
+    return type_string
+
+
+def now_iso() -> str:
+    """Fresh per-document timestamp.
+
+    (The reference freezes one timestamp at service construction so all
+    documents of a service share it, utils.py:69-77 — a bug we fix.)
+    """
+    return datetime.datetime.now().strftime("%Y-%m-%dT%H-%M-%S")
+
+
+def metadata_document(name: str, type_string: str,
+                      extra: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
+    """Build the reserved ``_id: 0`` metadata document
+    (reference binary_executor_image/utils.py:79-97)."""
+    doc: Dict[str, Any] = {
+        ID: METADATA_ID,
+        NAME_FIELD: name,
+        TYPE_FIELD: normalize_type(type_string),
+        FINISHED_FIELD: False,
+        TIME_CREATED_FIELD: now_iso(),
+    }
+    if extra:
+        doc.update(extra)
+    return doc
+
+
+def execution_document(description: str,
+                       parameters: Optional[Dict[str, Any]] = None,
+                       exception: Optional[str] = None,
+                       extra: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
+    """Append-only run-history document (reference utils.py:112-136)."""
+    doc: Dict[str, Any] = {
+        DESCRIPTION_FIELD: description,
+        METHOD_PARAMETERS_FIELD: parameters,
+        EXCEPTION_FIELD: exception,
+        TIME_CREATED_FIELD: now_iso(),
+    }
+    if extra:
+        doc.update(extra)
+    return doc
+
+
+def matches_query(doc: Dict[str, Any], query: Optional[Dict[str, Any]]) -> bool:
+    """Tiny Mongo-style filter evaluator for document reads.
+
+    Supports equality and {$gt,$gte,$lt,$lte,$ne,$in} — covering the
+    reference's pass-through ``query`` parameter on reads
+    (database_api_image/database.py:19-28).
+    """
+    if not query:
+        return True
+    for key, cond in query.items():
+        value = doc.get(key)
+        if isinstance(cond, dict):
+            for op, rhs in cond.items():
+                try:
+                    if op == "$gt" and not value > rhs:
+                        return False
+                    elif op == "$gte" and not value >= rhs:
+                        return False
+                    elif op == "$lt" and not value < rhs:
+                        return False
+                    elif op == "$lte" and not value <= rhs:
+                        return False
+                    elif op == "$ne" and not value != rhs:
+                        return False
+                    elif op == "$in" and value not in rhs:
+                        return False
+                    elif op not in ("$gt", "$gte", "$lt", "$lte", "$ne", "$in"):
+                        raise ValueError(f"unsupported query operator: {op}")
+                except TypeError:
+                    return False
+        else:
+            if value != cond:
+                return False
+    return True
+
+
+def project_fields(doc: Dict[str, Any],
+                   fields: Optional[List[str]]) -> Dict[str, Any]:
+    if not fields:
+        return doc
+    return {k: v for k, v in doc.items() if k in fields or k == ID}

@@ -1,0 +1,469 @@
+"""The Catalog: SQLite metadata/document index + Parquet dataset store.
+
+One ``Catalog`` instance replaces, at full capability, the reference's
+three uses of MongoDB (SURVEY §L5):
+
+1. *Dataset store* — reference stores one document per CSV row with an
+   integer ``_id`` row counter (database_api_image/database.py:130-136)
+   and pays one network round-trip per row (database.py:144). Here
+   tabular data is columnar Parquet appended in record batches — the
+   row->document view (with ``_id``) is reconstructed on read, so the
+   REST read API stays shape-compatible while ingest is O(chunks) not
+   O(rows).
+2. *Metadata/lineage store* — the reserved ``_id: 0`` document per
+   collection (utils.py:73-97) lives in SQLite with atomic updates.
+3. *Job-status bus* — the ``finished`` flag clients poll plus a change
+   feed (seq-numbered, long-pollable) standing in for MongoDB change
+   streams that power the reference's Observe service (README.md:81).
+
+Thread-safety: connection-per-thread, WAL journal, short transactions.
+Execution-document ids are allocated inside a single INSERT..SELECT
+transaction (the reference's read-max-then-insert is racy,
+binary_executor_image/utils.py:116-131).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import sqlite3
+import threading
+import time
+from typing import Any, Dict, Iterable, List, Optional, Sequence, Tuple
+
+import pyarrow as pa
+import pyarrow.parquet as pq
+
+from learningorchestra_tpu.catalog import documents as D
+from learningorchestra_tpu.catalog.artifacts import validate_safe_name
+
+_SCHEMA = """
+CREATE TABLE IF NOT EXISTS collections (
+    name TEXT PRIMARY KEY,
+    type TEXT NOT NULL,
+    created REAL NOT NULL
+);
+CREATE TABLE IF NOT EXISTS docs (
+    collection TEXT NOT NULL,
+    id INTEGER NOT NULL,
+    body TEXT NOT NULL,
+    PRIMARY KEY (collection, id)
+);
+CREATE TABLE IF NOT EXISTS changes (
+    seq INTEGER PRIMARY KEY AUTOINCREMENT,
+    collection TEXT NOT NULL,
+    op TEXT NOT NULL,
+    ts REAL NOT NULL
+);
+CREATE INDEX IF NOT EXISTS idx_collections_type ON collections(type);
+"""
+
+
+class CollectionExists(Exception):
+    pass
+
+
+class CollectionNotFound(Exception):
+    pass
+
+
+class Catalog:
+    def __init__(self, db_path: str, datasets_dir: str):
+        self._db_path = db_path
+        self._datasets_dir = datasets_dir
+        os.makedirs(datasets_dir, exist_ok=True)
+        os.makedirs(os.path.dirname(db_path) or ".", exist_ok=True)
+        self._local = threading.local()
+        self._change_cond = threading.Condition()
+        with self._conn() as conn:
+            conn.executescript(_SCHEMA)
+
+    # ------------------------------------------------------------------
+    # connection handling
+    # ------------------------------------------------------------------
+    def _conn(self) -> sqlite3.Connection:
+        conn = getattr(self._local, "conn", None)
+        if conn is None:
+            conn = sqlite3.connect(self._db_path, timeout=30.0)
+            conn.execute("PRAGMA journal_mode=WAL")
+            conn.execute("PRAGMA synchronous=NORMAL")
+            self._local.conn = conn
+        return conn
+
+    def close(self) -> None:
+        conn = getattr(self._local, "conn", None)
+        if conn is not None:
+            conn.close()
+            self._local.conn = None
+
+    def _record_change(self, conn: sqlite3.Connection, collection: str,
+                       op: str) -> None:
+        conn.execute(
+            "INSERT INTO changes (collection, op, ts) VALUES (?, ?, ?)",
+            (collection, op, time.time()))
+
+    def _notify(self) -> None:
+        with self._change_cond:
+            self._change_cond.notify_all()
+
+    # ------------------------------------------------------------------
+    # collection & metadata-document API
+    # ------------------------------------------------------------------
+    def create_collection(self, name: str, type_string: str,
+                          metadata_extra: Optional[Dict[str, Any]] = None,
+                          ) -> Dict[str, Any]:
+        """Register a collection and write its ``_id: 0`` metadata doc
+        with ``finished: False`` (reference utils.py:79-97)."""
+        validate_safe_name(name)
+        type_string = D.normalize_type(type_string)
+        meta = D.metadata_document(name, type_string, metadata_extra)
+        conn = self._conn()
+        try:
+            with conn:
+                conn.execute(
+                    "INSERT INTO collections (name, type, created) "
+                    "VALUES (?, ?, ?)",
+                    (name, type_string, time.time()))
+                conn.execute(
+                    "INSERT INTO docs (collection, id, body) VALUES (?, 0, ?)",
+                    (name, json.dumps(meta)))
+                self._record_change(conn, name, "create")
+        except sqlite3.IntegrityError:
+            raise CollectionExists(name)
+        self._notify()
+        return meta
+
+    def exists(self, name: str) -> bool:
+        cur = self._conn().execute(
+            "SELECT 1 FROM collections WHERE name = ?", (name,))
+        return cur.fetchone() is not None
+
+    def get_type(self, name: str) -> Optional[str]:
+        cur = self._conn().execute(
+            "SELECT type FROM collections WHERE name = ?", (name,))
+        row = cur.fetchone()
+        return row[0] if row else None
+
+    def get_metadata(self, name: str) -> Optional[Dict[str, Any]]:
+        cur = self._conn().execute(
+            "SELECT body FROM docs WHERE collection = ? AND id = 0", (name,))
+        row = cur.fetchone()
+        return json.loads(row[0]) if row else None
+
+    def update_metadata(self, name: str, updates: Dict[str, Any]) -> None:
+        conn = self._conn()
+        with conn:
+            cur = conn.execute(
+                "SELECT body FROM docs WHERE collection = ? AND id = 0",
+                (name,))
+            row = cur.fetchone()
+            if row is None:
+                raise CollectionNotFound(name)
+            body = json.loads(row[0])
+            body.update(updates)
+            body[D.ID] = 0
+            conn.execute(
+                "UPDATE docs SET body = ? WHERE collection = ? AND id = 0",
+                (json.dumps(body), name))
+            self._record_change(conn, name, "update")
+        self._notify()
+
+    def mark_finished(self, name: str,
+                      extra: Optional[Dict[str, Any]] = None) -> None:
+        """Flip the universal job-status flag clients poll
+        (reference utils.py:104-110)."""
+        updates = {D.FINISHED_FIELD: True}
+        if extra:
+            updates.update(extra)
+        self.update_metadata(name, updates)
+
+    def list_collections(self, type_string: Optional[str] = None,
+                         ) -> List[Dict[str, Any]]:
+        """Catalog listing = all metadata docs, optionally by type
+        (reference Storage.get_metadata_files, database.py:30-44)."""
+        conn = self._conn()
+        if type_string is not None:
+            type_string = D.normalize_type(type_string)
+            cur = conn.execute(
+                "SELECT d.body FROM docs d JOIN collections c "
+                "ON d.collection = c.name "
+                "WHERE d.id = 0 AND c.type = ? ORDER BY c.created",
+                (type_string,))
+        else:
+            cur = conn.execute(
+                "SELECT d.body FROM docs d JOIN collections c "
+                "ON d.collection = c.name WHERE d.id = 0 ORDER BY c.created")
+        return [json.loads(r[0]) for r in cur.fetchall()]
+
+    def delete_collection(self, name: str) -> bool:
+        conn = self._conn()
+        with conn:
+            cur = conn.execute(
+                "DELETE FROM collections WHERE name = ?", (name,))
+            conn.execute("DELETE FROM docs WHERE collection = ?", (name,))
+            deleted = cur.rowcount > 0
+            if deleted:
+                self._record_change(conn, name, "delete")
+        ds_dir = self._dataset_dir(name)
+        if os.path.isdir(ds_dir):
+            shutil.rmtree(ds_dir, ignore_errors=True)
+        if deleted:
+            self._notify()
+        return deleted
+
+    # ------------------------------------------------------------------
+    # execution documents (append-only run history)
+    # ------------------------------------------------------------------
+    def append_document(self, name: str, body: Dict[str, Any]) -> int:
+        """Append a document with the next integer id, atomically
+        (fixes reference race at utils.py:116-131)."""
+        if not self.exists(name):
+            raise CollectionNotFound(name)
+        conn = self._conn()
+        with conn:
+            cur = conn.execute(
+                "INSERT INTO docs (collection, id, body) "
+                "SELECT ?, COALESCE(MAX(id), 0) + 1, ? FROM docs "
+                "WHERE collection = ? RETURNING id",
+                (name, json.dumps({}), name))
+            new_id = cur.fetchone()[0]
+            body = dict(body)
+            body[D.ID] = new_id
+            conn.execute(
+                "UPDATE docs SET body = ? WHERE collection = ? AND id = ?",
+                (json.dumps(body), name, new_id))
+            self._record_change(conn, name, "doc")
+        self._notify()
+        return new_id
+
+    def get_documents(self, name: str) -> List[Dict[str, Any]]:
+        cur = self._conn().execute(
+            "SELECT body FROM docs WHERE collection = ? ORDER BY id", (name,))
+        return [json.loads(r[0]) for r in cur.fetchall()]
+
+    # ------------------------------------------------------------------
+    # tabular data (Parquet dataset store)
+    # ------------------------------------------------------------------
+    def _dataset_dir(self, name: str) -> str:
+        return os.path.join(self._datasets_dir, name)
+
+    def has_rows(self, name: str) -> bool:
+        d = self._dataset_dir(name)
+        return os.path.isdir(d) and any(
+            f.endswith(".parquet") for f in os.listdir(d))
+
+    def dataset_writer(self, name: str) -> "DatasetWriter":
+        return DatasetWriter(self, name)
+
+    def _dataset_files(self, name: str) -> List[str]:
+        d = self._dataset_dir(name)
+        if not os.path.isdir(d):
+            return []
+        return sorted(
+            os.path.join(d, f) for f in os.listdir(d)
+            if f.endswith(".parquet"))
+
+    def count_rows(self, name: str) -> int:
+        return sum(pq.ParquetFile(f).metadata.num_rows
+                   for f in self._dataset_files(name))
+
+    def read_table(self, name: str,
+                   columns: Optional[Sequence[str]] = None) -> pa.Table:
+        files = self._dataset_files(name)
+        if not files:
+            raise CollectionNotFound(f"{name} has no tabular data")
+        tables = [pq.read_table(f, columns=list(columns) if columns else None)
+                  for f in files]
+        # permissive promotion: schemaless (Mongo-parity) datasets may
+        # have parts with differing columns; missing values become null
+        return pa.concat_tables(tables, promote_options="permissive")
+
+    def read_dataframe(self, name: str,
+                       columns: Optional[Sequence[str]] = None):
+        """Full-collection read as pandas (the DSL's ``$name`` load,
+        reference utils.py:318-326)."""
+        return self.read_table(name, columns).to_pandas()
+
+    def write_dataframe(self, name: str, df) -> int:
+        with self.dataset_writer(name) as w:
+            w.write_batch(pa.Table.from_pandas(df, preserve_index=False))
+        return self.count_rows(name)
+
+    def dataset_fields(self, name: str) -> List[str]:
+        files = self._dataset_files(name)
+        if not files:
+            return []
+        return [f for f in pq.ParquetFile(files[0]).schema_arrow.names]
+
+    def read_rows(self, name: str, skip: int = 0,
+                  limit: Optional[int] = None,
+                  query: Optional[Dict[str, Any]] = None,
+                  columns: Optional[Sequence[str]] = None,
+                  ) -> List[Dict[str, Any]]:
+        """Paged/queried row read reconstructing the reference's
+        row-as-document view with ``_id`` (database.py:19-28). Uses
+        per-file row counts so paging without a query reads only the
+        needed files.
+        """
+        files = self._dataset_files(name)
+        if not files:
+            return []
+        out: List[Dict[str, Any]] = []
+        base = 0
+        remaining = limit if limit is not None else float("inf")
+        if remaining <= 0:
+            return out
+        want_cols = list(columns) if columns else None
+        for f in files:
+            nrows = pq.ParquetFile(f).metadata.num_rows
+            if query is None and skip >= nrows:
+                base += nrows
+                skip -= nrows
+                continue
+            table = pq.read_table(f, columns=want_cols)
+            batch_rows = table.to_pylist()
+            for i, row in enumerate(batch_rows):
+                row[D.ID] = base + i + 1  # reference rows start at _id 1
+                if query is not None and not D.matches_query(row, query):
+                    continue
+                if skip > 0:
+                    skip -= 1
+                    continue
+                out.append(row)
+                remaining -= 1
+                if remaining <= 0:
+                    return out
+            base += nrows
+        return out
+
+    # ------------------------------------------------------------------
+    # combined read (the universal GET in the reference routes all
+    # artifact reads through one endpoint, krakend.json:722-757)
+    # ------------------------------------------------------------------
+    def read_entries(self, name: str, skip: int = 0,
+                     limit: Optional[int] = None,
+                     query: Optional[Dict[str, Any]] = None,
+                     ) -> List[Dict[str, Any]]:
+        """Documents (metadata at ``_id`` 0 + execution docs) followed
+        by tabular rows, paged as one logical sequence."""
+        if not self.exists(name):
+            raise CollectionNotFound(name)
+        docs = [d for d in self.get_documents(name)
+                if D.matches_query(d, query)]
+        out: List[Dict[str, Any]] = []
+        for d in docs:
+            if skip > 0:
+                skip -= 1
+                continue
+            out.append(d)
+            if limit is not None and len(out) >= limit:
+                return out
+        row_limit = None if limit is None else limit - len(out)
+        if row_limit == 0:
+            return out
+        out.extend(self.read_rows(name, skip=skip, limit=row_limit,
+                                  query=query))
+        return out
+
+    # ------------------------------------------------------------------
+    # change feed (Observe support; replica-set change streams in the
+    # reference, docker-compose.yml:42-56 + README.md:81)
+    # ------------------------------------------------------------------
+    def latest_seq(self) -> int:
+        cur = self._conn().execute("SELECT COALESCE(MAX(seq), 0) FROM changes")
+        return cur.fetchone()[0]
+
+    def changes_since(self, seq: int,
+                      collection: Optional[str] = None,
+                      ) -> List[Dict[str, Any]]:
+        conn = self._conn()
+        if collection is not None:
+            cur = conn.execute(
+                "SELECT seq, collection, op, ts FROM changes "
+                "WHERE seq > ? AND collection = ? ORDER BY seq",
+                (seq, collection))
+        else:
+            cur = conn.execute(
+                "SELECT seq, collection, op, ts FROM changes "
+                "WHERE seq > ? ORDER BY seq", (seq,))
+        return [{"seq": s, "collection": c, "op": o, "ts": t}
+                for (s, c, o, t) in cur.fetchall()]
+
+    def watch(self, seq: int, collection: Optional[str] = None,
+              timeout: float = 30.0) -> List[Dict[str, Any]]:
+        """Blocking long-poll for changes after ``seq``."""
+        deadline = time.monotonic() + timeout
+        while True:
+            changes = self.changes_since(seq, collection)
+            if changes:
+                return changes
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                return []
+            with self._change_cond:
+                self._change_cond.wait(min(remaining, 1.0))
+
+
+class DatasetWriter:
+    """Chunked Parquet appender for one collection.
+
+    Replaces the reference's per-row ``insert_one`` hot loop
+    (database.py:144) with record-batch appends. One writer per ingest;
+    files are numbered continuing from any existing parts.
+    """
+
+    def __init__(self, catalog: Catalog, name: str):
+        self._catalog = catalog
+        self._name = name
+        self._dir = catalog._dataset_dir(name)
+        os.makedirs(self._dir, exist_ok=True)
+        existing = catalog._dataset_files(name)
+        self._part = len(existing)
+        # Appending to an existing dataset adopts its schema so every
+        # part stays concat-compatible; a brand-new dataset takes its
+        # schema from the first batch (heterogeneous columns across
+        # *intentionally* schemaless appends still work via
+        # read_rows' per-file path, but same-column appends are
+        # reconciled by order/type here).
+        self._schema: Optional[pa.Schema] = (
+            pq.ParquetFile(existing[0]).schema_arrow if existing else None)
+        self._writer: Optional[pq.ParquetWriter] = None
+        self._path: Optional[str] = None
+        self._rows = 0
+
+    def write_batch(self, batch) -> None:
+        if isinstance(batch, dict):
+            batch = pa.Table.from_pydict(batch)
+        elif isinstance(batch, pa.RecordBatch):
+            batch = pa.Table.from_batches([batch])
+        if self._schema is not None and set(batch.schema.names) == set(
+                self._schema.names):
+            batch = batch.select(self._schema.names).cast(self._schema)
+        if self._writer is None:
+            # a schemaless append (different columns) starts this
+            # session on its own schema
+            self._schema = batch.schema
+            self._path = os.path.join(
+                self._dir, f"part-{self._part:05d}.parquet")
+            self._writer = pq.ParquetWriter(self._path, batch.schema)
+        self._writer.write_table(batch)
+        self._rows += batch.num_rows
+
+    @property
+    def rows_written(self) -> int:
+        return self._rows
+
+    def fields(self) -> List[str]:
+        return list(self._schema.names) if self._writer is not None else []
+
+    def close(self) -> None:
+        if self._writer is not None:
+            self._writer.close()
+            self._writer = None
+
+    def __enter__(self) -> "DatasetWriter":
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        self.close()

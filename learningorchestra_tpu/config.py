@@ -1,0 +1,136 @@
+"""Configuration system.
+
+The reference configures everything through env vars baked into
+Dockerfiles plus per-image ``Constants`` classes (reference
+binary_executor_image/Dockerfile:7-12, constants.py:1-79) — no CLI
+flags, no files, no reload. We keep env-var override semantics but add
+a single typed config object, an optional JSON config file, and
+programmatic overrides, shared by every component.
+
+Env vars use the ``LO_`` prefix: ``LO_HOME``, ``LO_PORT``,
+``LO_MESH_SHAPE`` etc.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import threading
+from pathlib import Path
+from typing import Any, Optional
+
+
+@dataclasses.dataclass
+class Config:
+    """Global framework configuration (one instance per process)."""
+
+    # Storage root: catalog db, parquet datasets, binary artifacts,
+    # checkpoints all live under here (replaces the reference's 7
+    # shared Docker volumes, docker-compose.yml:325-333).
+    home: str = dataclasses.field(
+        default_factory=lambda: os.environ.get(
+            "LO_HOME", os.path.join(os.getcwd(), ".lo_store")))
+
+    # REST server bind (replaces KrakenD:80 + 9 Flask ports).
+    host: str = dataclasses.field(
+        default_factory=lambda: os.environ.get("LO_HOST", "127.0.0.1"))
+    port: int = dataclasses.field(
+        default_factory=lambda: int(os.environ.get("LO_PORT", "5000")))
+
+    # API prefix kept identical to the reference gateway contract.
+    api_prefix: str = "/api/learningOrchestra/v1"
+
+    # Job manager.
+    max_workers: int = dataclasses.field(
+        default_factory=lambda: int(os.environ.get("LO_MAX_WORKERS", "8")))
+    # Max concurrent jobs holding the accelerator mesh (a TPU mesh is
+    # an exclusive resource, unlike the reference's forgiving threads).
+    mesh_leases: int = dataclasses.field(
+        default_factory=lambda: int(os.environ.get("LO_MESH_LEASES", "1")))
+
+    # Device mesh defaults: axis names follow the scaling-book
+    # convention. Shape 'auto' = 1D data-parallel over all devices.
+    mesh_shape: str = dataclasses.field(
+        default_factory=lambda: os.environ.get("LO_MESH_SHAPE", "auto"))
+
+    # Training defaults.
+    default_batch_size: int = 128
+    compute_dtype: str = dataclasses.field(
+        default_factory=lambda: os.environ.get("LO_COMPUTE_DTYPE", "bfloat16"))
+
+    # Ingest pipeline.
+    ingest_chunk_rows: int = dataclasses.field(
+        default_factory=lambda: int(os.environ.get("LO_INGEST_CHUNK", "65536")))
+    ingest_queue_depth: int = 8
+
+    # Function / '#' DSL sandboxing: 'restricted' (namespace jail) or
+    # 'trusted' (plain exec, reference-equivalent behavior,
+    # code_execution.py:169-196).
+    sandbox_mode: str = dataclasses.field(
+        default_factory=lambda: os.environ.get("LO_SANDBOX", "restricted"))
+
+    # Observability.
+    log_level: str = dataclasses.field(
+        default_factory=lambda: os.environ.get("LO_LOG_LEVEL", "INFO"))
+
+    def ensure_dirs(self) -> None:
+        for sub in ("datasets", "artifacts", "checkpoints", "tmp"):
+            Path(self.home, sub).mkdir(parents=True, exist_ok=True)
+
+    @property
+    def datasets_dir(self) -> str:
+        return os.path.join(self.home, "datasets")
+
+    @property
+    def artifacts_dir(self) -> str:
+        return os.path.join(self.home, "artifacts")
+
+    @property
+    def checkpoints_dir(self) -> str:
+        return os.path.join(self.home, "checkpoints")
+
+    @property
+    def catalog_path(self) -> str:
+        return os.path.join(self.home, "catalog.sqlite")
+
+    @classmethod
+    def from_file(cls, path: str) -> "Config":
+        with open(path) as f:
+            data = json.load(f)
+        cfg = cls()
+        for key, value in data.items():
+            if not hasattr(cfg, key):
+                raise KeyError(f"unknown config key: {key}")
+            setattr(cfg, key, value)
+        return cfg
+
+    def replace(self, **kwargs: Any) -> "Config":
+        return dataclasses.replace(self, **kwargs)
+
+
+_lock = threading.Lock()
+_config: Optional[Config] = None
+
+
+def get_config() -> Config:
+    global _config
+    with _lock:
+        if _config is None:
+            _config = Config()
+            _config.ensure_dirs()
+        return _config
+
+
+def set_config(config: Config) -> Config:
+    global _config
+    with _lock:
+        _config = config
+        _config.ensure_dirs()
+    return config
+
+
+def reset_config() -> None:
+    global _config
+    with _lock:
+        _config = None

@@ -1,0 +1,41 @@
+"""Test config: force an 8-device CPU mesh before jax import.
+
+SURVEY §4: the reference has no tests at all; our strategy is unit
+tests per component with the JAX CPU backend and
+``--xla_force_host_platform_device_count=8`` so all mesh/sharding logic
+(DP/TP/PP/SP/EP) is exercised multi-device without a TPU.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+
+import pytest
+
+
+@pytest.fixture()
+def tmp_config(tmp_path, monkeypatch):
+    """Fresh framework config rooted in a tmp dir."""
+    from learningorchestra_tpu import config as config_mod
+    cfg = config_mod.Config(home=str(tmp_path / "lo_home"))
+    config_mod.set_config(cfg)
+    yield cfg
+    config_mod.reset_config()
+
+
+@pytest.fixture()
+def catalog(tmp_config):
+    from learningorchestra_tpu.catalog import Catalog
+    cat = Catalog(tmp_config.catalog_path, tmp_config.datasets_dir)
+    yield cat
+    cat.close()
+
+
+@pytest.fixture()
+def artifacts(tmp_config):
+    from learningorchestra_tpu.catalog import ArtifactStore
+    return ArtifactStore(tmp_config.artifacts_dir)

@@ -1,0 +1,19 @@
+"""Importable helper for artifact native-protocol tests (must live in a
+real module so ArtifactStore can re-import it by path)."""
+
+import json
+import os
+
+
+class NativeThing:
+    def __init__(self, value):
+        self.value = value
+
+    def __lo_save__(self, path):
+        with open(os.path.join(path, "v.json"), "w") as f:
+            json.dump({"value": self.value}, f)
+
+    @classmethod
+    def __lo_load__(cls, path):
+        with open(os.path.join(path, "v.json")) as f:
+            return cls(json.load(f)["value"])

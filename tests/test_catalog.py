@@ -1,0 +1,215 @@
+"""Catalog unit tests: metadata docs, execution docs, parquet rows,
+paging/query parity with the reference read API, change feed."""
+
+import threading
+
+import pandas as pd
+import pytest
+
+from learningorchestra_tpu.catalog import documents as D
+from learningorchestra_tpu.catalog.store import (
+    Catalog, CollectionExists, CollectionNotFound)
+
+
+def test_create_and_metadata(catalog):
+    meta = catalog.create_collection("ds1", "dataset/csv", {"url": "http://x"})
+    assert meta[D.ID] == 0
+    assert meta[D.FINISHED_FIELD] is False
+    got = catalog.get_metadata("ds1")
+    assert got["url"] == "http://x"
+    assert got[D.TYPE_FIELD] == "dataset/csv"
+    assert catalog.exists("ds1")
+    assert not catalog.exists("nope")
+
+
+def test_duplicate_collection_raises(catalog):
+    catalog.create_collection("dup", "dataset/csv")
+    with pytest.raises(CollectionExists):
+        catalog.create_collection("dup", "dataset/csv")
+
+
+def test_mark_finished_and_list_by_type(catalog):
+    catalog.create_collection("a", "dataset/csv")
+    catalog.create_collection("b", "model/tensorflow")
+    catalog.mark_finished("a", {D.FIELDS_FIELD: ["x", "y"]})
+    metas = catalog.list_collections("dataset/csv")
+    assert [m[D.NAME_FIELD] for m in metas] == ["a"]
+    assert metas[0][D.FINISHED_FIELD] is True
+    assert metas[0][D.FIELDS_FIELD] == ["x", "y"]
+    assert len(catalog.list_collections()) == 2
+
+
+def test_evaluate_typo_normalized(catalog):
+    # the reference gateway ships type=evaluate/sckitlearn (sic)
+    catalog.create_collection("ev", "evaluate/sckitlearn")
+    assert catalog.get_type("ev") == "evaluate/scikitlearn"
+    assert catalog.list_collections("evaluate/sckitlearn")
+
+
+def test_execution_documents_increment(catalog):
+    catalog.create_collection("job", "train/tensorflow")
+    id1 = catalog.append_document("job", D.execution_document("first run"))
+    id2 = catalog.append_document("job", D.execution_document("second run"))
+    assert (id1, id2) == (1, 2)
+    docs = catalog.get_documents("job")
+    assert [d[D.ID] for d in docs] == [0, 1, 2]
+    assert docs[2][D.DESCRIPTION_FIELD] == "second run"
+
+
+def test_append_document_concurrent_ids_unique(catalog):
+    catalog.create_collection("j", "train/tensorflow")
+    ids = []
+    lock = threading.Lock()
+
+    def worker():
+        for _ in range(20):
+            i = catalog.append_document("j", {"d": 1})
+            with lock:
+                ids.append(i)
+
+    threads = [threading.Thread(target=worker) for _ in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert len(ids) == 80
+    assert len(set(ids)) == 80
+
+
+def test_rows_roundtrip_and_paging(catalog):
+    catalog.create_collection("ds", "dataset/csv")
+    df = pd.DataFrame({"a": range(100), "b": [f"s{i}" for i in range(100)]})
+    catalog.write_dataframe("ds", df)
+    assert catalog.count_rows("ds") == 100
+    assert catalog.dataset_fields("ds") == ["a", "b"]
+
+    rows = catalog.read_rows("ds", skip=10, limit=5)
+    assert [r["a"] for r in rows] == [10, 11, 12, 13, 14]
+    # rows get 1-based _id like the reference row counter
+    assert rows[0][D.ID] == 11
+
+    rows = catalog.read_rows("ds", query={"a": {"$gte": 95}})
+    assert [r["a"] for r in rows] == [95, 96, 97, 98, 99]
+
+
+def test_multi_part_paging(catalog):
+    catalog.create_collection("ds", "dataset/csv")
+    with catalog.dataset_writer("ds") as w:
+        w.write_batch({"x": list(range(50))})
+    with catalog.dataset_writer("ds") as w:
+        w.write_batch({"x": list(range(50, 100))})
+    rows = catalog.read_rows("ds", skip=48, limit=4)
+    assert [r["x"] for r in rows] == [48, 49, 50, 51]
+
+
+def test_read_entries_metadata_then_rows(catalog):
+    catalog.create_collection("ds", "dataset/csv")
+    catalog.write_dataframe("ds", pd.DataFrame({"v": [1, 2, 3]}))
+    catalog.mark_finished("ds")
+    entries = catalog.read_entries("ds", limit=2)
+    assert entries[0][D.ID] == 0  # metadata document first
+    assert entries[1]["v"] == 1
+    entries = catalog.read_entries("ds", skip=1)
+    assert [e["v"] for e in entries] == [1, 2, 3]
+    with pytest.raises(CollectionNotFound):
+        catalog.read_entries("missing")
+
+
+def test_delete_collection(catalog):
+    catalog.create_collection("ds", "dataset/csv")
+    catalog.write_dataframe("ds", pd.DataFrame({"v": [1]}))
+    assert catalog.delete_collection("ds")
+    assert not catalog.exists("ds")
+    assert not catalog.has_rows("ds")
+    assert not catalog.delete_collection("ds")
+
+
+def test_change_feed(catalog):
+    seq0 = catalog.latest_seq()
+    catalog.create_collection("w", "dataset/csv")
+    catalog.mark_finished("w")
+    changes = catalog.changes_since(seq0)
+    assert [c["op"] for c in changes] == ["create", "update"]
+    assert all(c["collection"] == "w" for c in changes)
+    # watch returns immediately when changes exist
+    assert catalog.watch(seq0, timeout=0.5)
+    # and times out cleanly when nothing new
+    assert catalog.watch(catalog.latest_seq(), timeout=0.05) == []
+
+
+def test_paging_past_first_part(catalog):
+    # regression: whole-file fast-skip must consume `skip`
+    catalog.create_collection("ds", "dataset/csv")
+    with catalog.dataset_writer("ds") as w:
+        w.write_batch({"x": list(range(50))})
+    with catalog.dataset_writer("ds") as w:
+        w.write_batch({"x": list(range(50, 100))})
+    rows = catalog.read_rows("ds", skip=60, limit=5)
+    assert [r["x"] for r in rows] == [60, 61, 62, 63, 64]
+    assert catalog.read_rows("ds", limit=0) == []
+
+
+def test_append_document_missing_collection(catalog):
+    with pytest.raises(CollectionNotFound):
+        catalog.append_document("ghost", {"d": 1})
+
+
+def test_append_adopts_existing_schema(catalog):
+    import pandas as pd
+    catalog.create_collection("ds", "dataset/csv")
+    catalog.write_dataframe("ds", pd.DataFrame({"a": [1], "b": [2.0]}))
+    # second append: different column order + int b — must reconcile
+    catalog.write_dataframe("ds", pd.DataFrame({"b": [3], "a": [4]}))
+    df = catalog.read_dataframe("ds")
+    assert df["a"].tolist() == [1, 4]
+    assert df["b"].tolist() == [2.0, 3.0]
+
+
+def test_path_traversal_rejected(catalog, artifacts):
+    with pytest.raises(ValueError):
+        catalog.create_collection("../evil", "dataset/csv")
+    with pytest.raises(ValueError):
+        artifacts.save({"x": 1}, "../../escape", "model/jax")
+    with pytest.raises(ValueError):
+        artifacts.save_bytes(b"x", "ok", "model/../../etc")
+
+
+def test_query_evaluator():
+    doc = {"a": 5, "b": "x"}
+    assert D.matches_query(doc, None)
+    assert D.matches_query(doc, {"a": 5})
+    assert not D.matches_query(doc, {"a": 6})
+    assert D.matches_query(doc, {"a": {"$gt": 4, "$lte": 5}})
+    assert D.matches_query(doc, {"b": {"$in": ["x", "y"]}})
+    assert not D.matches_query(doc, {"c": 1})
+
+
+def test_artifact_store_roundtrip(artifacts):
+    obj = {"weights": [1, 2, 3], "name": "m"}
+    artifacts.save(obj, "m1", "model/scikitlearn")
+    assert artifacts.exists("m1", "model/scikitlearn")
+    assert artifacts.load("m1", "model/scikitlearn") == obj
+    # lookup by name only (cross-service read)
+    assert artifacts.find("m1") == "model/scikitlearn"
+    assert artifacts.load("m1") == obj
+    assert artifacts.list("model/scikitlearn") == ["m1"]
+    assert artifacts.delete("m1")
+    assert not artifacts.exists("m1", "model/scikitlearn")
+
+
+def test_artifact_bytes(artifacts):
+    artifacts.save_bytes(b"\x89PNG...", "plot1", "explore/tensorflow",
+                         filename="image.png", content_type="image/png")
+    path, ctype = artifacts.bytes_path("plot1", "explore/tensorflow")
+    assert ctype == "image/png"
+    with open(path, "rb") as f:
+        assert f.read() == b"\x89PNG..."
+    assert artifacts.load("plot1", "explore/tensorflow") == b"\x89PNG..."
+
+
+def test_artifact_native_protocol(artifacts):
+    from tests.helpers_native import NativeThing
+    artifacts.save(NativeThing(7), "nt", "train/tensorflow")
+    loaded = artifacts.load("nt", "train/tensorflow")
+    assert isinstance(loaded, NativeThing)
+    assert loaded.value == 7
