@@ -122,11 +122,22 @@ def get_config() -> Config:
         return _config
 
 
+def _reset_mesh() -> None:
+    # the default mesh is derived from config.mesh_shape; a config
+    # swap must invalidate it or engines keep computing on a stale mesh
+    try:
+        from learningorchestra_tpu.runtime import mesh as mesh_lib
+        mesh_lib.reset_default_mesh()
+    except ImportError:  # jax not importable in this context
+        pass
+
+
 def set_config(config: Config) -> Config:
     global _config
     with _lock:
         _config = config
         _config.ensure_dirs()
+    _reset_mesh()
     return config
 
 
@@ -134,3 +145,4 @@ def reset_config() -> None:
     global _config
     with _lock:
         _config = None
+    _reset_mesh()

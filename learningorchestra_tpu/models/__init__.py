@@ -1,0 +1,19 @@
+"""Model layer.
+
+The reference trains whatever class the user names by module path —
+``tensorflow.keras.applications.ResNet50``,
+``sklearn.linear_model.LogisticRegression`` — via reflection
+(model_image/model.py:133-162). Capability parity here:
+
+- sklearn classes work as-is (CPU, in-process — same as reference);
+- ``tensorflow.keras.*`` module paths resolve to :mod:`.tf_compat`, a
+  keras-compatible API surface backed entirely by JAX/flax/optax and
+  the mesh-sharded engine (real TensorFlow is not a dependency);
+- :mod:`.neural` is the native API those shims produce — a
+  config-serializable ``NeuralModel`` with compile/fit/evaluate/predict
+  whose artifacts persist as JSON config + msgpack params (no pickles);
+- :mod:`.sequential_module` is the flax implementation;
+- :mod:`.resnet` / :mod:`.transformer` are the larger architectures.
+"""
+
+from learningorchestra_tpu.models.neural import NeuralModel  # noqa: F401

@@ -1,0 +1,339 @@
+"""NeuralModel: the framework's native trainable model object.
+
+Plays the role of the live Keras model instance the reference stores
+as the root of every train lineage (model_image/model.py:133-162 makes
+the instance; binary_executor calls methods on it,
+binary_execution.py:177-189). The API is keras-shaped on purpose —
+``compile`` / ``fit`` / ``evaluate`` / ``predict`` with the same kwarg
+names — because those method names and kwargs ARE the reference's REST
+contract (``method: "fit"``, ``methodParameters: {...}``).
+
+Underneath: flax module + optax optimizer + the mesh-sharded jit
+engine (runtime/engine.py). Persistence is JSON config + msgpack
+params via the artifact store's native protocol — never a pickle.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from learningorchestra_tpu.models import sequential_module as seq_lib
+from learningorchestra_tpu.runtime import data as data_lib
+from learningorchestra_tpu.runtime import engine as engine_lib
+from learningorchestra_tpu.runtime import mesh as mesh_lib
+
+
+def build_optimizer(spec: Dict[str, Any]) -> optax.GradientTransformation:
+    kind = spec.get("kind", "adam").lower()
+    lr = spec.get("learning_rate", spec.get("lr", 1e-3))
+    if kind == "adam":
+        return optax.adam(lr, b1=spec.get("beta_1", 0.9),
+                          b2=spec.get("beta_2", 0.999))
+    if kind == "adamw":
+        return optax.adamw(lr, weight_decay=spec.get("weight_decay", 1e-4))
+    if kind == "sgd":
+        return optax.sgd(lr, momentum=spec.get("momentum", 0.0),
+                         nesterov=spec.get("nesterov", False))
+    if kind == "rmsprop":
+        return optax.rmsprop(lr, decay=spec.get("rho", 0.9),
+                             momentum=spec.get("momentum", 0.0))
+    if kind == "adagrad":
+        return optax.adagrad(lr)
+    raise ValueError(f"unknown optimizer: {kind!r}")
+
+
+_LOSSES = {
+    "sparse_categorical_crossentropy": engine_lib.sparse_softmax_loss,
+    "categorical_crossentropy": engine_lib.sparse_softmax_loss,
+    "binary_crossentropy": engine_lib.sigmoid_binary_loss,
+    "mse": engine_lib.mse_loss,
+    "mean_squared_error": engine_lib.mse_loss,
+}
+
+_METRICS = {
+    "accuracy": engine_lib.accuracy_metric,
+    "acc": engine_lib.accuracy_metric,
+}
+
+
+class NeuralModel:
+    """Config-driven JAX model with a keras-shaped method surface."""
+
+    def __init__(self, layer_configs: Sequence[Dict[str, Any]],
+                 name: str = "neural_model"):
+        self.name = name
+        self.layer_configs: List[Dict[str, Any]] = [
+            dict(c) for c in layer_configs]
+        self.optimizer_spec: Dict[str, Any] = {"kind": "adam",
+                                               "learning_rate": 1e-3}
+        self.loss_name: str = "sparse_categorical_crossentropy"
+        self.metric_names: List[str] = ["accuracy"]
+        self.params: Any = None
+        self.model_state: Any = {}
+        self.input_shape: Optional[List[int]] = None  # without batch dim
+        self.input_dtype: str = "float32"
+        self.history: List[Dict[str, Any]] = []
+        self.seed: int = 0
+        self._engine: Optional[engine_lib.Engine] = None
+        self._state: Optional[engine_lib.TrainState] = None
+
+    # ------------------------------------------------------------------
+    def add(self, layer_config: Dict[str, Any]) -> None:
+        self.layer_configs.append(dict(layer_config))
+        self.params = None  # built params are stale
+
+    def compile(self, optimizer: Any = "adam", loss: Any = None,
+                metrics: Optional[Sequence[Any]] = None, **_: Any) -> None:
+        """keras-compatible compile; accepts strings, spec dicts, or
+        shim objects carrying a ``spec`` attribute."""
+        if isinstance(optimizer, str):
+            self.optimizer_spec = {"kind": optimizer}
+        elif isinstance(optimizer, dict):
+            self.optimizer_spec = dict(optimizer)
+        elif hasattr(optimizer, "spec"):
+            self.optimizer_spec = dict(optimizer.spec)
+        else:
+            raise TypeError(f"unsupported optimizer: {optimizer!r}")
+        if loss is not None:
+            if hasattr(loss, "spec"):
+                loss = loss.spec
+            if loss not in _LOSSES:
+                raise ValueError(f"unknown loss: {loss!r}")
+            self.loss_name = loss
+        if metrics is not None:
+            names = []
+            for m in metrics:
+                m = getattr(m, "spec", m)
+                if m not in _METRICS:
+                    raise ValueError(f"unknown metric: {m!r}")
+                names.append(m)
+            self.metric_names = names
+        self._engine = None
+
+    # ------------------------------------------------------------------
+    @property
+    def module(self):
+        return seq_lib.SequentialModule(tuple(
+            _freeze(c) for c in self.layer_configs))
+
+    @property
+    def output_activation(self) -> str:
+        return seq_lib.output_activation_of(self.layer_configs)
+
+    def _apply_fn(self, params, model_state, batch, train, rng):
+        variables = {"params": params, **(model_state or {})}
+        mutable = list(model_state or {}) if train else False
+        if mutable == []:
+            mutable = False
+        rngs = {"dropout": rng} if (train and rng is not None) else None
+        out = self.module.apply(variables, batch["x"], train=train,
+                                rngs=rngs, mutable=mutable)
+        if mutable:
+            y, new_vars = out
+            return y, dict(new_vars)
+        return out, model_state
+
+    def _build_params(self, sample_x: np.ndarray) -> None:
+        rng = jax.random.PRNGKey(self.seed)
+        small = jnp.asarray(sample_x[:1])
+        variables = self.module.init(rng, small, train=False)
+        variables = dict(variables)
+        self.params = variables.pop("params")
+        self.model_state = variables  # e.g. {'batch_stats': ...}
+        self.input_shape = list(sample_x.shape[1:])
+        self.input_dtype = str(sample_x.dtype)
+
+    def _get_engine(self) -> engine_lib.Engine:
+        if self._engine is None:
+            from learningorchestra_tpu.config import get_config
+            dtype = jnp.bfloat16 \
+                if get_config().compute_dtype == "bfloat16" else jnp.float32
+            self._engine = engine_lib.Engine(
+                apply_fn=self._apply_fn,
+                loss_fn=_LOSSES[self.loss_name],
+                optimizer=build_optimizer(self.optimizer_spec),
+                mesh=mesh_lib.get_default_mesh(),
+                metrics={n: _METRICS[n] for n in self.metric_names},
+                compute_dtype=dtype)
+        return self._engine
+
+    # ------------------------------------------------------------------
+    def _coerce_x(self, x) -> np.ndarray:
+        if hasattr(x, "to_numpy"):  # DataFrame from the $ DSL
+            x = data_lib.dataframe_to_arrays(x)["x"]
+        x = np.asarray(x)
+        needs_int = self.layer_configs and \
+            self.layer_configs[0]["kind"] == "embedding"
+        if needs_int:
+            return x.astype(np.int32)
+        return x.astype(np.float32)
+
+    def _coerce_y(self, y) -> np.ndarray:
+        if hasattr(y, "to_numpy"):
+            y = y.to_numpy()
+        y = np.asarray(y)
+        if y.ndim > 1 and y.shape[-1] > 1 and \
+                self.loss_name in ("categorical_crossentropy",):
+            y = np.argmax(y, axis=-1)  # one-hot -> sparse
+        return np.squeeze(y) if y.ndim > 1 and y.shape[-1] == 1 else y
+
+    def _batcher(self, x, y=None, batch_size: Optional[int] = None,
+                 shuffle: bool = False) -> data_lib.ArrayBatcher:
+        from learningorchestra_tpu.config import get_config
+        mesh = mesh_lib.get_default_mesh()
+        arrays = {"x": self._coerce_x(x)}
+        if y is not None:
+            arrays["y"] = self._coerce_y(y)
+        return data_lib.ArrayBatcher(
+            arrays, batch_size or get_config().default_batch_size,
+            shuffle=shuffle, seed=self.seed,
+            dp_multiple=mesh_lib.data_parallel_size(mesh))
+
+    # ------------------------------------------------------------------
+    def fit(self, x=None, y=None, batch_size: Optional[int] = None,
+            epochs: int = 1, verbose: int = 0,
+            validation_data: Optional[Tuple] = None,
+            shuffle: bool = True, checkpointer=None,
+            log_fn=None, **_: Any) -> "History":
+        batcher = self._batcher(x, y, batch_size, shuffle=shuffle)
+        if self.params is None:
+            self._build_params(batcher.array("x"))
+        eng = self._get_engine()
+        state = eng.init_state(self.params, self.model_state)
+        state, history = eng.fit(state, batcher, epochs=epochs,
+                                 seed=self.seed, checkpointer=checkpointer,
+                                 log_fn=log_fn)
+        if validation_data is not None:
+            vx, vy = validation_data[0], validation_data[1]
+            val = eng.evaluate(state, self._batcher(vx, vy, batch_size))
+            for k, v in val.items():
+                history[-1][f"val_{k}"] = v
+        self._state = state
+        self.params = jax.tree_util.tree_map(np.asarray, state.params)
+        self.model_state = jax.tree_util.tree_map(
+            np.asarray, state.model_state)
+        self.history.extend(history)
+        return History(history)
+
+    def evaluate(self, x=None, y=None, batch_size: Optional[int] = None,
+                 **_: Any) -> Dict[str, float]:
+        self._require_built()
+        eng = self._get_engine()
+        state = self._state or eng.init_state(self.params, self.model_state)
+        return eng.evaluate(state, self._batcher(x, y, batch_size))
+
+    def predict(self, x=None, batch_size: Optional[int] = None,
+                **_: Any) -> np.ndarray:
+        self._require_built()
+        eng = self._get_engine()
+        state = self._state or eng.init_state(self.params, self.model_state)
+        logits = eng.predict(state, self._batcher(x, None, batch_size))
+        act = self.output_activation
+        if act == "softmax":
+            e = np.exp(logits - logits.max(axis=-1, keepdims=True))
+            return e / e.sum(axis=-1, keepdims=True)
+        if act == "sigmoid":
+            return 1.0 / (1.0 + np.exp(-logits))
+        return logits
+
+    def _require_built(self) -> None:
+        if self.params is None:
+            raise RuntimeError(
+                "model has no parameters yet — call fit() first "
+                "(or load a trained artifact)")
+
+    # ------------------------------------------------------------------
+    def summary(self) -> str:
+        lines = [f"NeuralModel '{self.name}'"]
+        for i, cfg in enumerate(self.layer_configs):
+            lines.append(f"  [{i}] {json.dumps(cfg)}")
+        if self.params is not None:
+            n = sum(int(np.prod(p.shape))
+                    for p in jax.tree_util.tree_leaves(self.params))
+            lines.append(f"  params: {n:,}")
+        return "\n".join(lines)
+
+    def num_params(self) -> int:
+        if self.params is None:
+            return 0
+        return sum(int(np.prod(p.shape))
+                   for p in jax.tree_util.tree_leaves(self.params))
+
+    # ------------------------------------------------------------------
+    # artifact-store native protocol (catalog/artifacts.py)
+    # ------------------------------------------------------------------
+    def __lo_save__(self, path: str) -> None:
+        from learningorchestra_tpu.runtime import checkpoint as ckpt
+
+        config = {
+            "name": self.name,
+            "layer_configs": self.layer_configs,
+            "optimizer_spec": self.optimizer_spec,
+            "loss_name": self.loss_name,
+            "metric_names": self.metric_names,
+            "input_shape": self.input_shape,
+            "input_dtype": self.input_dtype,
+            "seed": self.seed,
+            "history": self.history,
+            "built": self.params is not None,
+        }
+        with open(os.path.join(path, "config.json"), "w") as f:
+            json.dump(config, f)
+        if self.params is not None:
+            ckpt.save_pytree(
+                {"params": self.params, "model_state": self.model_state},
+                os.path.join(path, "weights.msgpack"))
+
+    @classmethod
+    def __lo_load__(cls, path: str) -> "NeuralModel":
+        from learningorchestra_tpu.runtime import checkpoint as ckpt
+
+        with open(os.path.join(path, "config.json")) as f:
+            config = json.load(f)
+        model = cls(config["layer_configs"], name=config["name"])
+        model.optimizer_spec = config["optimizer_spec"]
+        model.loss_name = config["loss_name"]
+        model.metric_names = config["metric_names"]
+        model.input_shape = config["input_shape"]
+        model.input_dtype = config["input_dtype"]
+        model.seed = config["seed"]
+        model.history = config["history"]
+        if config["built"]:
+            sample = np.zeros([1] + config["input_shape"],
+                              config["input_dtype"])
+            model._build_params(sample)
+            restored = ckpt.load_pytree(
+                os.path.join(path, "weights.msgpack"),
+                {"params": model.params, "model_state": model.model_state})
+            model.params = restored["params"]
+            model.model_state = restored["model_state"]
+        return model
+
+
+class History:
+    """keras-compatible fit() return value."""
+
+    def __init__(self, records: List[Dict[str, Any]]):
+        self.history: Dict[str, List[Any]] = {}
+        for rec in records:
+            for k, v in rec.items():
+                self.history.setdefault(k, []).append(v)
+
+
+def _freeze(cfg: Dict[str, Any]):
+    """Layer configs must be hashable for flax module equality."""
+    return _FrozenDict(cfg)
+
+
+class _FrozenDict(dict):
+    def __hash__(self):  # type: ignore[override]
+        return hash(tuple(sorted(
+            (k, tuple(v) if isinstance(v, list) else v)
+            for k, v in self.items())))

@@ -1,0 +1,69 @@
+"""ResNet-50 in flax (bottleneck-v1.5).
+
+Backs the reference's transfer-learn config
+(``tensorflow.keras.applications.ResNet50``, BASELINE.md config 5).
+Standard architecture — 7x7 stem, four bottleneck stages (3/4/6/3),
+global average pool + dense head — written TPU-first: NHWC layout,
+``strides in the 3x3`` (v1.5, better MXU utilization than v1), batch
+norm with running stats in a mutable collection.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Sequence, Tuple
+
+import jax.numpy as jnp
+from flax import linen as nn
+
+
+class Bottleneck(nn.Module):
+    filters: int
+    strides: Tuple[int, int] = (1, 1)
+    project: bool = False
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        norm = lambda name: nn.BatchNorm(  # noqa: E731
+            use_running_average=not train, momentum=0.9, name=name)
+        residual = x
+        y = nn.Conv(self.filters, (1, 1), use_bias=False, name="conv1")(x)
+        y = nn.relu(norm("bn1")(y))
+        y = nn.Conv(self.filters, (3, 3), strides=self.strides,
+                    padding="SAME", use_bias=False, name="conv2")(y)
+        y = nn.relu(norm("bn2")(y))
+        y = nn.Conv(self.filters * 4, (1, 1), use_bias=False, name="conv3")(y)
+        y = norm("bn3")(y)
+        if self.project or residual.shape[-1] != self.filters * 4:
+            residual = nn.Conv(self.filters * 4, (1, 1),
+                               strides=self.strides, use_bias=False,
+                               name="proj")(x)
+            residual = norm("bn_proj")(residual)
+        return nn.relu(y + residual)
+
+
+class ResNet50(nn.Module):
+    num_classes: int = 1000
+    include_top: bool = True
+    stage_sizes: Sequence[int] = (3, 4, 6, 3)
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = nn.Conv(64, (7, 7), strides=(2, 2), padding=[(3, 3), (3, 3)],
+                    use_bias=False, name="stem_conv")(x)
+        x = nn.BatchNorm(use_running_average=not train, momentum=0.9,
+                         name="stem_bn")(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
+        filters = 64
+        for stage, blocks in enumerate(self.stage_sizes):
+            for block in range(blocks):
+                strides = (2, 2) if stage > 0 and block == 0 else (1, 1)
+                x = Bottleneck(filters, strides=strides,
+                               project=(block == 0),
+                               name=f"stage{stage}_block{block}")(
+                    x, train=train)
+            filters *= 2
+        x = jnp.mean(x, axis=(1, 2))
+        if self.include_top:
+            x = nn.Dense(self.num_classes, name="head")(x)
+        return x

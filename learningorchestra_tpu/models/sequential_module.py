@@ -1,0 +1,152 @@
+"""Flax module built from JSON layer configs.
+
+The layer vocabulary covers what reference pipelines build with
+``tensorflow.keras`` through the generic executor (MNIST CNN, IMDb
+LSTM, dense heads — BASELINE.md configs). Configs are plain dicts so a
+model artifact is JSON + weights, never a pickle.
+
+TPU notes: convs/matmuls map to the MXU; LSTM runs as ``nn.RNN``
+(``lax.scan`` under jit — no Python loop); everything is static-shape.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+_ACTIVATIONS = {
+    "relu": nn.relu, "tanh": jnp.tanh, "sigmoid": nn.sigmoid,
+    "gelu": nn.gelu, "elu": nn.elu, "softplus": nn.softplus,
+    "leaky_relu": nn.leaky_relu, "silu": nn.silu, "swish": nn.silu,
+    "softmax": nn.softmax,
+    "linear": lambda x: x, None: lambda x: x,
+}
+
+# output-layer activations that the loss consumes in logits space: the
+# module SKIPS them on the FINAL layer only and NeuralModel applies
+# them at predict time; in hidden positions they run as ordinary
+# nonlinearities.
+OUTPUT_ACTIVATIONS = ("softmax", "sigmoid")
+
+
+def activation(name, is_output: bool = False):
+    if is_output and name in OUTPUT_ACTIVATIONS:
+        return lambda x: x  # applied outside the loss path
+    if name not in _ACTIVATIONS:
+        raise ValueError(f"unknown activation: {name!r}")
+    return _ACTIVATIONS[name]
+
+
+def _output_layer_index(layer_configs) -> int:
+    """Index of the layer whose activation is the model's output
+    activation (the last dense/activation layer) — must mirror
+    :func:`output_activation_of`."""
+    for i in range(len(layer_configs) - 1, -1, -1):
+        if layer_configs[i].get("kind") in ("dense", "activation"):
+            return i
+    return -1
+
+
+class SequentialModule(nn.Module):
+    """Executes a tuple of layer-config dicts in order."""
+
+    layer_configs: Tuple[Dict[str, Any], ...]
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        out_idx = _output_layer_index(self.layer_configs)
+        for i, cfg in enumerate(self.layer_configs):
+            kind = cfg["kind"]
+            name = f"{kind}_{i}"
+            if kind == "dense":
+                x = nn.Dense(cfg["units"], name=name)(x)
+                x = activation(cfg.get("activation"),
+                               is_output=(i == out_idx))(x)
+            elif kind == "conv2d":
+                x = nn.Conv(cfg["filters"], tuple(cfg.get("kernel", (3, 3))),
+                            strides=tuple(cfg.get("strides", (1, 1))),
+                            padding=cfg.get("padding", "SAME"),
+                            name=name)(x)
+                x = activation(cfg.get("activation"))(x)
+            elif kind == "maxpool2d":
+                pool = tuple(cfg.get("pool", (2, 2)))
+                x = nn.max_pool(x, pool,
+                                strides=tuple(cfg.get("strides", pool)))
+            elif kind == "avgpool2d":
+                pool = tuple(cfg.get("pool", (2, 2)))
+                x = nn.avg_pool(x, pool,
+                                strides=tuple(cfg.get("strides", pool)))
+            elif kind == "globalavgpool2d":
+                x = jnp.mean(x, axis=(1, 2))
+            elif kind == "globalavgpool1d":
+                x = jnp.mean(x, axis=1)
+            elif kind == "globalmaxpool1d":
+                x = jnp.max(x, axis=1)
+            elif kind == "flatten":
+                x = x.reshape((x.shape[0], -1))
+            elif kind == "reshape":
+                x = x.reshape((x.shape[0],) + tuple(cfg["shape"]))
+            elif kind == "dropout":
+                x = nn.Dropout(cfg.get("rate", 0.5), name=name)(
+                    x, deterministic=not train)
+            elif kind == "batchnorm":
+                x = nn.BatchNorm(use_running_average=not train,
+                                 momentum=cfg.get("momentum", 0.99),
+                                 epsilon=cfg.get("epsilon", 1e-3),
+                                 name=name)(x)
+            elif kind == "layernorm":
+                x = nn.LayerNorm(name=name)(x)
+            elif kind == "embedding":
+                x = nn.Embed(cfg["vocab"], cfg["dim"], name=name)(
+                    x.astype(jnp.int32))
+            elif kind == "lstm":
+                units = cfg["units"]
+                rnn = nn.RNN(nn.OptimizedLSTMCell(units), name=name)
+                x = rnn(x)
+                if not cfg.get("return_sequences", False):
+                    x = x[:, -1, :]
+            elif kind == "gru":
+                units = cfg["units"]
+                rnn = nn.RNN(nn.GRUCell(units), name=name)
+                x = rnn(x)
+                if not cfg.get("return_sequences", False):
+                    x = x[:, -1, :]
+            elif kind in ("bidirectional_lstm", "bidirectional_gru"):
+                units = cfg["units"]
+                make_cell = (nn.GRUCell if kind.endswith("gru")
+                             else nn.OptimizedLSTMCell)
+                fwd = nn.RNN(make_cell(units), name=f"{name}_fwd")
+                bwd = nn.RNN(make_cell(units), reverse=True,
+                             keep_order=True, name=f"{name}_bwd")
+                seq = jnp.concatenate([fwd(x), bwd(x)], axis=-1)
+                x = seq if cfg.get("return_sequences", False) \
+                    else seq[:, -1, :]
+            elif kind == "activation":
+                x = activation(cfg.get("fn"), is_output=(i == out_idx))(x)
+            elif kind == "input":
+                pass  # shape hint only
+            elif kind == "resnet50":
+                from learningorchestra_tpu.models.resnet import ResNet50
+                x = ResNet50(num_classes=cfg.get("classes", 1000),
+                             include_top=cfg.get("include_top", True),
+                             name=name)(x, train=train)
+            else:
+                raise ValueError(f"unknown layer kind: {kind!r}")
+        return x
+
+
+def output_activation_of(layer_configs: Sequence[Dict[str, Any]]) -> str:
+    """The activation NeuralModel applies at predict time (stripped
+    from the module so losses get logits — numerically stable softmax
+    cross-entropy on the device)."""
+    for cfg in reversed(layer_configs):
+        act = cfg.get("activation") if cfg.get("kind") == "dense" else (
+            cfg.get("fn") if cfg.get("kind") == "activation" else None)
+        if act is not None:
+            return act if act in OUTPUT_ACTIVATIONS else "linear"
+        if cfg.get("kind") in ("dense", "activation"):
+            return "linear"
+    return "linear"

@@ -1,0 +1,17 @@
+"""``tensorflow`` compatibility shim (JAX-backed).
+
+The reference's REST contract names TensorFlow classes by module path
+— ``modulePath: "tensorflow.keras.models"``, ``class: "Sequential"``
+(model_image/model.py:136-137) — and its ``#`` DSL evaluates
+expressions like ``#tensorflow.keras.optimizers.Adam(0.001)``
+(binary_execution.py:52-64). Real TensorFlow is NOT a dependency of
+this framework; instead the reflection executors and the sandbox route
+any ``tensorflow.*`` import here (services/sandbox.py:resolve_module),
+where the keras API surface is implemented on flax/optax and the
+mesh-sharded engine. User pipelines written against the reference keep
+working, now compiled by XLA for TPU.
+"""
+
+from learningorchestra_tpu.models.tf_compat import keras  # noqa: F401
+
+__version__ = "2.0-learningorchestra-jax"

@@ -1,0 +1,7 @@
+"""``tensorflow.keras`` shim — models/layers/optimizers/losses/
+applications implemented on the JAX stack."""
+
+from learningorchestra_tpu.models.tf_compat.keras import (  # noqa: F401
+    applications, layers, losses, models, optimizers)
+from learningorchestra_tpu.models.tf_compat.keras.models import (  # noqa: F401
+    Model, Sequential)

@@ -1,0 +1,34 @@
+"""``tensorflow.keras.applications`` shim.
+
+The reference's north-star tune config loads
+``tensorflow.keras.applications.ResNet50`` by module path
+(BASELINE.md config 5). Here ResNet50 is a flax implementation
+(models/resnet.py). Pretrained ImageNet weights cannot be downloaded
+in this offline environment — ``weights="imagenet"`` falls back to
+random init with a warning (transfer-learning parity is the API shape
++ fine-tune path, not the weight values).
+"""
+
+from __future__ import annotations
+
+import warnings
+from typing import Any, Optional, Sequence
+
+from learningorchestra_tpu.models.neural import NeuralModel
+
+
+def ResNet50(include_top: bool = True, weights: Optional[str] = None,
+             classes: int = 1000,
+             input_shape: Optional[Sequence[int]] = None,
+             **_: Any) -> NeuralModel:
+    if weights == "imagenet":
+        warnings.warn(
+            "pretrained ImageNet weights are unavailable offline; "
+            "ResNet50 initialized randomly", stacklevel=2)
+    model = NeuralModel(
+        [{"kind": "resnet50", "classes": int(classes),
+          "include_top": bool(include_top)}],
+        name="resnet50")
+    if input_shape:
+        model.input_shape = list(input_shape)
+    return model

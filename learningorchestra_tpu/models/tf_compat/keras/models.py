@@ -1,0 +1,49 @@
+"""``tensorflow.keras.models`` shim: Sequential / Model.
+
+``Sequential`` IS a :class:`NeuralModel` — the object the Model
+service instantiates and stores (reference model.py:158-162), then the
+binary executor calls ``fit``/``evaluate``/``predict`` on
+(binary_execution.py:177-189). Same method surface, JAX underneath.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Iterable, Optional
+
+from learningorchestra_tpu.models.neural import NeuralModel
+from learningorchestra_tpu.models.tf_compat.keras.layers import Layer
+
+
+class Sequential(NeuralModel):
+    def __init__(self, layers: Optional[Iterable[Any]] = None,
+                 name: str = "sequential", **_: Any):
+        configs = []
+        for layer in layers or []:
+            cfg = self._layer_config(layer)
+            if cfg["kind"] == "input":
+                continue  # shape hint only; NeuralModel builds lazily
+            configs.append(cfg)
+        super().__init__(configs, name=name)
+
+    @staticmethod
+    def _layer_config(layer: Any) -> dict:
+        if isinstance(layer, Layer):
+            return dict(layer.config)
+        if isinstance(layer, dict) and "kind" in layer:
+            return dict(layer)
+        raise TypeError(f"unsupported layer: {layer!r}")
+
+    def add(self, layer: Any) -> None:  # type: ignore[override]
+        cfg = self._layer_config(layer)
+        if cfg["kind"] != "input":
+            super().add(cfg)
+
+
+# Functional-API models are out of scope for the shim; the reference's
+# pipelines drive Sequential/applications. Model aliases Sequential so
+# `tensorflow.keras.models.Model` resolves to something usable.
+Model = Sequential
+
+
+def load_model(path: str) -> NeuralModel:
+    return NeuralModel.__lo_load__(path)

@@ -1,0 +1,69 @@
+"""Checkpointing.
+
+The reference has NO mid-training checkpointing — persistence is the
+final artifact only, and a failed job is simply re-run from its stored
+parent (SURVEY §5: binary_executor utils.py:195-208, server.py:74-118).
+Here training jobs checkpoint per-epoch/step via Orbax and can resume,
+and pytree artifacts are serialized with msgpack (flax.serialization)
+instead of pickles.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Optional
+
+import jax
+import numpy as np
+from flax import serialization
+
+
+class Checkpointer:
+    """Thin Orbax wrapper: save(step, pytree) / latest() / restore."""
+
+    def __init__(self, directory: str, max_to_keep: int = 3):
+        import orbax.checkpoint as ocp
+
+        self._dir = os.path.abspath(directory)
+        os.makedirs(self._dir, exist_ok=True)
+        self._mgr = ocp.CheckpointManager(
+            self._dir,
+            options=ocp.CheckpointManagerOptions(
+                max_to_keep=max_to_keep, create=True),
+        )
+
+    def save(self, step: int, tree: Any) -> None:
+        import orbax.checkpoint as ocp
+
+        self._mgr.save(step, args=ocp.args.StandardSave(tree))
+
+    def latest_step(self) -> Optional[int]:
+        return self._mgr.latest_step()
+
+    def restore(self, target: Any, step: Optional[int] = None) -> Any:
+        import orbax.checkpoint as ocp
+
+        if step is None:
+            step = self._mgr.latest_step()
+        if step is None:
+            return None
+        return self._mgr.restore(step, args=ocp.args.StandardRestore(target))
+
+    def close(self) -> None:
+        self._mgr.wait_until_finished()
+        self._mgr.close()
+
+
+# ----------------------------------------------------------------------
+# msgpack pytree IO for artifact persistence (no pickle of jax arrays)
+# ----------------------------------------------------------------------
+def save_pytree(tree: Any, path: str) -> None:
+    host_tree = jax.tree_util.tree_map(np.asarray, tree)
+    with open(path, "wb") as f:
+        f.write(serialization.to_bytes(host_tree))
+
+
+def load_pytree(path: str, target: Any) -> Any:
+    with open(path, "rb") as f:
+        data = f.read()
+    return serialization.from_bytes(target, data)

@@ -1,0 +1,127 @@
+"""Device-mesh manager.
+
+Axis-name conventions (scaling-book style), used consistently by the
+parallelism library and every sharded engine:
+
+==========  =====================================================
+axis        meaning
+==========  =====================================================
+``dp``      data parallel (batch dim; gradients all-reduced)
+``fsdp``    fully-sharded data parallel (params sharded over it too)
+``tp``      tensor parallel (weight matrices split; activations
+            all-gathered / reduce-scattered by XLA)
+``pp``      pipeline parallel (layer stages; shard_map + ppermute)
+``sp``      sequence/context parallel (ring attention over seq dim)
+``ep``      expert parallel (MoE experts)
+==========  =====================================================
+
+The reference has no device concept at all — its "cluster" is Docker
+Swarm placement (SURVEY §2.4). Here the mesh is the cluster.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Dict, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+DP, FSDP, TP, PP, SP, EP = "dp", "fsdp", "tp", "pp", "sp", "ep"
+KNOWN_AXES = (DP, FSDP, TP, PP, SP, EP)
+
+
+def parse_mesh_spec(spec: str) -> Dict[str, int]:
+    """Parse ``"dp=2,tp=4"`` into an ordered axis->size dict."""
+    out: Dict[str, int] = {}
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        m = re.fullmatch(r"([a-z_]+)\s*=\s*(-?\d+)", part)
+        if not m:
+            raise ValueError(f"bad mesh spec element: {part!r}")
+        out[m.group(1)] = int(m.group(2))
+    if not out:
+        raise ValueError(f"empty mesh spec: {spec!r}")
+    return out
+
+
+def build_mesh(spec: str = "auto",
+               devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
+    """Build the global mesh.
+
+    ``"auto"`` = 1-D data-parallel over all devices. An explicit spec
+    like ``"dp=2,tp=4"`` may leave one axis as ``-1`` to absorb the
+    remaining devices.
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    n = len(devices)
+    # AxisType.Auto = classic GSPMD propagation: we annotate inputs /
+    # outputs, XLA infers internals and inserts collectives. (Newer
+    # JAX defaults to Explicit, which demands out_shardings on every
+    # ambiguous gather/scatter — wrong trade-off for a framework that
+    # runs arbitrary user models.)
+    auto = (jax.sharding.AxisType.Auto,)
+    if spec == "auto":
+        return jax.make_mesh((n,), (DP,), auto, devices=devices)
+    sizes = parse_mesh_spec(spec)
+    unknown = [a for a, s in sizes.items() if s == -1]
+    if len(unknown) > 1:
+        raise ValueError("at most one -1 axis allowed")
+    known = int(np.prod([s for s in sizes.values() if s != -1]))
+    if unknown:
+        if n % known:
+            raise ValueError(f"{n} devices not divisible by {known}")
+        sizes[unknown[0]] = n // known
+    total = int(np.prod(list(sizes.values())))
+    if total != n:
+        raise ValueError(
+            f"mesh {sizes} needs {total} devices, have {n}")
+    return jax.make_mesh(tuple(sizes.values()), tuple(sizes.keys()),
+                         auto * len(sizes), devices=devices)
+
+
+_default_mesh: Optional[Mesh] = None
+
+
+def get_default_mesh() -> Mesh:
+    """Process-wide mesh built from config (cached; the mesh is the
+    cluster, and there is one per process)."""
+    global _default_mesh
+    if _default_mesh is None:
+        from learningorchestra_tpu.config import get_config
+        _default_mesh = build_mesh(get_config().mesh_shape)
+    return _default_mesh
+
+
+def reset_default_mesh() -> None:
+    global _default_mesh
+    _default_mesh = None
+
+
+def data_axes(mesh: Mesh) -> Tuple[str, ...]:
+    """Axes the batch dimension is sharded over (dp and fsdp both
+    shard data)."""
+    return tuple(a for a in (DP, FSDP) if a in mesh.axis_names)
+
+
+def batch_sharding(mesh: Mesh) -> NamedSharding:
+    axes = data_axes(mesh)
+    return NamedSharding(mesh, P(axes if axes else None))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def data_parallel_size(mesh: Mesh) -> int:
+    size = 1
+    for a in data_axes(mesh):
+        size *= mesh.shape[a]
+    return size
+
+
+def pad_to_multiple(n: int, multiple: int) -> int:
+    return ((n + multiple - 1) // multiple) * multiple

@@ -1,0 +1,18 @@
+"""Service layer: the behavioral contract of the reference's 11
+microservices, collapsed into one process (SURVEY §7: "One Python
+framework — library + single REST server").
+
+- ``context``   — shared wiring (catalog, artifacts, jobs, runtime)
+- ``jobs``      — async job manager (validate → record metadata →
+                  spawn → poll ``finished``; the reference's universal
+                  execution model, binary_executor_image/server.py:65-71)
+- ``params``    — the ``$``/``#``/``.`` parameter-resolution DSL
+- ``validators``— request validation with reference status codes
+- ``sandbox``   — restricted exec for ``#`` expressions / Function code
+- per-service executors: dataset, model, binary (train/tune/evaluate/
+  predict), dbexec (explore/transform), histogram, projection,
+  datatype, function, builder
+- ``server``    — the REST front end with the krakend.json URI contract
+"""
+
+from learningorchestra_tpu.services.context import ServiceContext  # noqa: F401

@@ -1,0 +1,47 @@
+"""Shared service wiring.
+
+The reference constructs a singleton Database/Metadata/UserRequest/
+storage stack at import time in every one of its 9 ``server.py`` files
+(e.g. binary_executor_image/server.py:10-21) and shares binaries via
+cross-mounted volumes. Here one ``ServiceContext`` owns the catalog,
+artifact store, job manager, parameter resolver and (lazily) the JAX
+runtime, and every executor takes it by injection — also what lets
+tests run fully in-process with a tmp-dir store.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from learningorchestra_tpu.config import Config, get_config
+from learningorchestra_tpu.catalog.store import Catalog
+from learningorchestra_tpu.catalog.artifacts import ArtifactStore
+
+
+class ServiceContext:
+    def __init__(self, config: Optional[Config] = None):
+        from learningorchestra_tpu.services.jobs import JobManager
+        from learningorchestra_tpu.services.params import ParameterResolver
+
+        self.config = config or get_config()
+        self.config.ensure_dirs()
+        self.catalog = Catalog(self.config.catalog_path,
+                               self.config.datasets_dir)
+        self.artifacts = ArtifactStore(self.config.artifacts_dir)
+        self.jobs = JobManager(self.catalog,
+                               max_workers=self.config.max_workers,
+                               mesh_leases=self.config.mesh_leases)
+        self.params = ParameterResolver(self)
+
+    @property
+    def mesh(self):
+        """The process-wide device mesh (exclusive accelerator
+        resource; jobs lease it through ``jobs.mesh_lease``). Shared
+        with the model layer's ``get_default_mesh`` so the context and
+        the engines always compute on the same mesh."""
+        from learningorchestra_tpu.runtime import mesh as mesh_lib
+        return mesh_lib.get_default_mesh()
+
+    def close(self) -> None:
+        self.jobs.shutdown()
+        self.catalog.close()

@@ -1,0 +1,129 @@
+"""Async job manager.
+
+The reference's execution model, shared by every service
+(SURVEY §L2): the POST handler validates synchronously, writes a
+metadata document with ``finished: False``, submits the pipeline to a
+``ThreadPoolExecutor`` and returns 201 immediately; clients poll the
+``finished`` flag (binary_executor_image/binary_execution.py:118-175).
+On success the flag flips and an execution document is appended; on
+failure the flag stays False and the execution document records
+``repr(exception)`` (binary_execution.py:160-175).
+
+Beyond the reference (its in-flight jobs are simply lost on failure,
+README.md:194-198):
+
+- **Device leasing.** A TPU mesh is an exclusive resource; jobs that
+  need it acquire a bounded lease so concurrent REST jobs queue
+  instead of fighting over HBM (SURVEY §7 hard part #1).
+- **Retry.** ``max_retries`` re-runs a failed pipeline; each attempt
+  appends its own execution document.
+- **Timing.** Every execution document records ``elapsedSeconds``
+  (superset of the reference's builder-only ``fitTime``,
+  builder.py:117-122) plus queue wait time for lease contention.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+import time
+import traceback
+from concurrent.futures import Future, ThreadPoolExecutor
+from typing import Any, Callable, Dict, Optional
+
+from learningorchestra_tpu.catalog import documents as D
+from learningorchestra_tpu.catalog.store import Catalog
+
+
+class JobManager:
+    def __init__(self, catalog: Catalog, max_workers: int = 8,
+                 mesh_leases: int = 1):
+        self._catalog = catalog
+        self._pool = ThreadPoolExecutor(max_workers=max_workers,
+                                        thread_name_prefix="lo-job")
+        self._mesh_sem = threading.BoundedSemaphore(mesh_leases)
+        self._futures: Dict[str, Future] = {}
+        self._lock = threading.Lock()
+
+    # ------------------------------------------------------------------
+    def mesh_lease(self):
+        """Context manager granting exclusive accelerator access (the
+        semaphore itself — ``with jobs.mesh_lease(): ...``)."""
+        return self._mesh_sem
+
+    # ------------------------------------------------------------------
+    def submit(self, name: str, fn: Callable[[], Any], *,
+               description: str = "",
+               parameters: Optional[Dict[str, Any]] = None,
+               needs_mesh: bool = False,
+               max_retries: int = 0,
+               on_success: Optional[Callable[[Any], None]] = None,
+               ) -> Future:
+        """Run ``fn`` asynchronously under the reference's
+        finished-flag contract for collection ``name`` (which must
+        already exist with ``finished: False``)."""
+
+        def run() -> Any:
+            submitted = time.monotonic()
+            attempts = max_retries + 1
+            for attempt in range(attempts):
+                lease = (self._mesh_sem if needs_mesh
+                         else contextlib.nullcontext())
+                with lease:
+                    queue_wait = time.monotonic() - submitted
+                    start = time.monotonic()
+                    try:
+                        result = fn()
+                        elapsed = time.monotonic() - start
+                        if on_success is not None:
+                            on_success(result)
+                        self._catalog.mark_finished(name)
+                        self._catalog.append_document(
+                            name, D.execution_document(
+                                description, parameters,
+                                extra={"elapsedSeconds": round(elapsed, 6),
+                                       "queueWaitSeconds": round(
+                                           queue_wait, 6),
+                                       "attempt": attempt + 1}))
+                        return result
+                    except Exception as exception:  # noqa: BLE001
+                        traceback.print_exc()
+                        elapsed = time.monotonic() - start
+                        self._catalog.append_document(
+                            name, D.execution_document(
+                                description, parameters,
+                                exception=repr(exception),
+                                extra={"elapsedSeconds": round(elapsed, 6),
+                                       "attempt": attempt + 1}))
+                        if attempt + 1 >= attempts:
+                            # finished stays False (reference parity)
+                            return None
+
+        future = self._pool.submit(run)
+        with self._lock:
+            self._futures[name] = future
+        return future
+
+    def resubmit(self, name: str, fn: Callable[[], Any],
+                 **kwargs: Any) -> Future:
+        """The PATCH verb: reset ``finished`` and re-run (reference
+        Execution.update, binary_execution.py:136-145)."""
+        self._catalog.update_metadata(name, {D.FINISHED_FIELD: False})
+        return self.submit(name, fn, **kwargs)
+
+    # ------------------------------------------------------------------
+    def wait(self, name: str, timeout: Optional[float] = None) -> Any:
+        """Block until job ``name`` completes (test/CLI convenience —
+        REST clients poll the ``finished`` flag instead)."""
+        with self._lock:
+            future = self._futures.get(name)
+        if future is None:
+            return None
+        return future.result(timeout=timeout)
+
+    def running(self) -> int:
+        with self._lock:
+            return sum(1 for f in self._futures.values() if not f.done())
+
+    def shutdown(self) -> None:
+        self._pool.shutdown(wait=False, cancel_futures=True)

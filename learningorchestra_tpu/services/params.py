@@ -1,0 +1,98 @@
+"""The ``$`` / ``#`` / ``.`` parameter-resolution DSL.
+
+This is the reference's pipeline glue (SURVEY §2.3) and the API
+contract is preserved sigil-for-sigil
+(binary_executor_image/binary_execution.py:18-89):
+
+- ``"$name"``   -> load artifact ``name``: tabular collection becomes a
+  ``pd.DataFrame``; object types load the stored live object
+  (utils.py:318-326 + the volume-type routing at utils.py:334-351).
+- ``"$name.X"`` -> load the object then index ``instance["X"]``
+  (utils.py:328-332) — e.g. the train split of a tuple stored by a
+  Function execution.
+- ``"#expr"``   -> evaluate a Python expression (sandboxed here;
+  ``tensorflow`` resolves to the JAX shim) and pass the live object —
+  optimizers, losses, layer stacks.
+- lists resolve element-wise (binary_execution.py:21-27).
+
+Detection quirk parity: the reference treats *any* string containing
+``$`` as a ref and any containing ``#`` as code (``__is_dataset``
+checks ``in``, not ``startswith``); we match that.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+from learningorchestra_tpu.catalog import documents as D
+from learningorchestra_tpu.services import sandbox
+
+# Artifact types whose "$name" resolves to the stored live object
+# rather than a DataFrame (reference __is_stored_in_volume,
+# binary_executor_image/utils.py:334-351).
+_OBJECT_TYPE_PREFIXES = ("model/", "tune/", "train/", "evaluate/",
+                        "predict/")
+_OBJECT_TYPES = ("function/python", "transform/scikitlearn",
+                 "transform/tensorflow", "transform/jax")
+
+
+def is_object_type(type_string: str) -> bool:
+    return (type_string.startswith(_OBJECT_TYPE_PREFIXES)
+            or type_string in _OBJECT_TYPES)
+
+
+class ParameterResolver:
+    def __init__(self, context: "ServiceContext"):  # noqa: F821
+        self._ctx = context
+
+    # -- public ---------------------------------------------------------
+    def treat(self, method_parameters: Optional[Dict[str, Any]],
+              ) -> Dict[str, Any]:
+        if not method_parameters:
+            return {}
+        out = {}
+        for name, value in method_parameters.items():
+            if isinstance(value, list):
+                out[name] = [self.resolve_value(v) for v in value]
+            else:
+                out[name] = self.resolve_value(value)
+        return out
+
+    def resolve_value(self, value: Any) -> Any:
+        if not isinstance(value, str):
+            return value
+        if "$" in value:
+            ref = value.replace("$", "")
+            if "." in ref:
+                artifact_name, key = ref.split(".", 1)
+                return self.load_object(artifact_name)[key]
+            return self.load_artifact(ref)
+        if "#" in value:
+            trusted = self._ctx.config.sandbox_mode == "trusted"
+            return sandbox.eval_hash_expression(value, trusted=trusted)
+        return value
+
+    # -- artifact loading ----------------------------------------------
+    def artifact_type(self, name: str) -> Optional[str]:
+        t = self._ctx.catalog.get_type(name)
+        if t is None:
+            t = self._ctx.artifacts.find(name)
+        return t
+
+    def load_artifact(self, name: str) -> Any:
+        """``$name``: object types -> live object; tabular types ->
+        DataFrame of the full collection (reference
+        get_dataset_content, utils.py:318-326)."""
+        t = self.artifact_type(name)
+        if t is None:
+            raise KeyError(f"unknown artifact: {name}")
+        if is_object_type(t):
+            return self._ctx.artifacts.load(name, t)
+        df = self._ctx.catalog.read_dataframe(name)
+        return df
+
+    def load_object(self, name: str) -> Any:
+        t = self.artifact_type(name)
+        if t is None:
+            raise KeyError(f"unknown artifact: {name}")
+        return self._ctx.artifacts.load(name, t)

@@ -1,0 +1,137 @@
+"""Restricted execution for user-supplied code.
+
+The reference runs user code with bare ``exec`` in-process in three
+places: the ``#`` parameter DSL (binary_execution.py:52-64), the
+Function service (code_execution.py:169-196), and Builder modeling
+code (builder.py:84-105). Capability is preserved here but behind a
+namespace jail (SURVEY §7 hard part #3):
+
+- builtins restricted to a safe subset (no open/eval/exec/__import__);
+- ``import`` routed through a whitelist of scientific modules;
+- ``import tensorflow`` resolves to the framework's JAX-backed
+  ``tensorflow`` compatibility shim
+  (:mod:`learningorchestra_tpu.models.tf_compat`) — real TF is not a
+  dependency, and user code written against the reference's executor
+  keeps working on TPU unchanged.
+
+``Config.sandbox_mode = "trusted"`` switches to plain exec
+(reference-equivalent trust model) for operators who want it.
+"""
+
+from __future__ import annotations
+
+import builtins as _builtins
+import importlib
+import io
+import sys
+from contextlib import redirect_stdout
+from typing import Any, Dict, Optional, Tuple
+
+_ALLOWED_MODULE_PREFIXES = (
+    "numpy", "pandas", "sklearn", "scipy", "math", "random", "json", "re",
+    "itertools", "functools", "collections", "statistics", "string",
+    "datetime", "time", "jax", "flax", "optax", "einops", "chex",
+    "learningorchestra_tpu", "pyarrow", "dataclasses", "typing",
+)
+
+# modules emulated by the framework (import name -> real module path)
+_SHIMMED_MODULES = {
+    "tensorflow": "learningorchestra_tpu.models.tf_compat",
+    "tensorflow.keras": "learningorchestra_tpu.models.tf_compat.keras",
+    "keras": "learningorchestra_tpu.models.tf_compat.keras",
+}
+
+_SAFE_BUILTIN_NAMES = [
+    "abs", "all", "any", "bool", "bytes", "callable", "chr", "dict",
+    "divmod", "enumerate", "filter", "float", "format", "frozenset",
+    "getattr", "hasattr", "hash", "hex", "int", "isinstance", "issubclass",
+    "iter", "len", "list", "map", "max", "min", "next", "object", "oct",
+    "ord", "pow", "print", "range", "repr", "reversed", "round", "set",
+    "setattr", "slice", "sorted", "str", "sum", "tuple", "type", "zip",
+    "ValueError", "TypeError", "KeyError", "IndexError", "AttributeError",
+    "RuntimeError", "StopIteration", "ArithmeticError", "ZeroDivisionError",
+    "Exception", "BaseException", "NotImplementedError", "OverflowError",
+    "FloatingPointError", "AssertionError", "True", "False", "None",
+    "__build_class__", "__name__", "staticmethod", "classmethod", "property",
+    "super", "vars", "id", "NameError", "LookupError",
+]
+
+
+def resolve_module(name: str):
+    """Import a module through the shim table (used by the reflection
+    executors so ``modulePath: "tensorflow.keras.layers"`` resolves to
+    the JAX-backed shim)."""
+    target = _SHIMMED_MODULES.get(name)
+    if target is not None:
+        return importlib.import_module(target)
+    shim_roots = [k for k in _SHIMMED_MODULES if name.startswith(k + ".")]
+    if shim_roots:
+        root = max(shim_roots, key=len)
+        target = _SHIMMED_MODULES[root] + name[len(root):]
+        return importlib.import_module(target)
+    return importlib.import_module(name)
+
+
+def _restricted_import(name: str, globals=None, locals=None, fromlist=(),
+                       level: int = 0):
+    if level != 0:
+        raise ImportError("relative imports are not allowed in sandbox")
+    root = name.split(".")[0]
+    if root in _SHIMMED_MODULES or name in _SHIMMED_MODULES:
+        module = resolve_module(root if root in _SHIMMED_MODULES else name)
+        if not fromlist and "." not in name:
+            return module
+        # emulate "import a.b" / "from a.b import c" against the shim
+        full = resolve_module(name)
+        return full if fromlist else module
+    if not any(root == p or root.startswith(p + ".")
+               for p in (_ALLOWED_MODULE_PREFIXES)):
+        raise ImportError(
+            f"module {name!r} is not allowed in sandboxed code")
+    return _builtins.__import__(name, globals, locals, fromlist, level)
+
+
+def make_sandbox_globals(extra: Optional[Dict[str, Any]] = None,
+                         trusted: bool = False) -> Dict[str, Any]:
+    if trusted:
+        g: Dict[str, Any] = {"__builtins__": _builtins}
+    else:
+        safe = {n: getattr(_builtins, n) for n in _SAFE_BUILTIN_NAMES
+                if hasattr(_builtins, n)}
+        safe["__import__"] = _restricted_import
+        g = {"__builtins__": safe}
+    g["__name__"] = "__lo_sandbox__"
+    if extra:
+        g.update(extra)
+    return g
+
+
+def run_user_code(code: str,
+                  parameters: Optional[Dict[str, Any]] = None,
+                  trusted: bool = False,
+                  inject_tensorflow: bool = True,
+                  ) -> Tuple[Dict[str, Any], str]:
+    """Execute user code with injected parameter globals, capturing
+    stdout (the Function-service contract: result left in a
+    ``response`` variable, prints captured as ``functionMessage``;
+    reference code_execution.py:169-196).
+
+    Returns (context_variables, captured_stdout).
+    """
+    g = make_sandbox_globals(parameters, trusted=trusted)
+    if inject_tensorflow and "tensorflow" not in g:
+        g["tensorflow"] = resolve_module("tensorflow")
+    stdout = io.StringIO()
+    with redirect_stdout(stdout):
+        exec(compile(code, "<lo-user-code>", "exec"), g)  # noqa: S102
+    return g, stdout.getvalue()
+
+
+def eval_hash_expression(class_code: str, trusted: bool = False) -> Any:
+    """The ``#`` DSL: ``"#<expr>"`` binds ``<expr>`` to a variable and
+    returns it, with ``tensorflow`` importable (reference
+    binary_execution.py:52-64 rewrites ``#`` to ``class_instance=``).
+    """
+    rewritten = class_code.replace("#", "class_instance=", 1)
+    g, _ = run_user_code(rewritten, trusted=trusted)
+    return g["class_instance"]

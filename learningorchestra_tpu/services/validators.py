@@ -1,0 +1,162 @@
+"""Synchronous request validation, with the reference's status codes.
+
+The reference validates every POST against the live library before
+accepting the job — importlib for module paths, getattr/getmembers for
+classes and methods, ``inspect.signature`` for kwargs
+(binary_executor_image/utils.py:138-184, model_image/utils.py:124-159,
+database_executor_image/utils.py:151-224) — and maps failures to
+409 (duplicate), 406 (invalid input), 404 (nonexistent target)
+(binary_executor_image/constants.py:21-25, server.py:145-248).
+"""
+
+from __future__ import annotations
+
+import inspect
+from typing import Any, Dict, List, Optional, Sequence
+
+from learningorchestra_tpu.catalog import documents as D
+from learningorchestra_tpu.catalog.artifacts import _NAME_RE
+from learningorchestra_tpu.services import sandbox
+
+HTTP_SUCCESS = 200
+HTTP_CREATED = 201
+HTTP_CONFLICT = 409
+HTTP_NOT_ACCEPTABLE = 406
+HTTP_NOT_FOUND = 404
+
+MESSAGE_DUPLICATE_FILE = "duplicated name"
+MESSAGE_INVALID_NAME = "invalid name"
+MESSAGE_INVALID_MODULE_PATH = "invalid module path name"
+MESSAGE_INVALID_CLASS = "invalid class name"
+MESSAGE_INVALID_CLASS_PARAMETER = "invalid class parameter"
+MESSAGE_INVALID_METHOD = "invalid method name"
+MESSAGE_INVALID_METHOD_PARAMETER = "invalid method parameter"
+MESSAGE_NONEXISTENT_FILE = "nonexistent file"
+MESSAGE_UNFINISHED_PARENT = "unfinished parent"
+MESSAGE_INVALID_FIELD = "invalid field"
+MESSAGE_MISSING_FIELD = "missing required field"
+
+
+class HttpError(Exception):
+    def __init__(self, status: int, message: str):
+        super().__init__(message)
+        self.status = status
+        self.message = message
+
+
+class RequestValidator:
+    """One validator instance per ServiceContext (the reference vendors
+    a ``UserRequest`` copy per image; SURVEY §2.1 cross-cutting)."""
+
+    def __init__(self, context: "ServiceContext"):  # noqa: F821
+        self._ctx = context
+
+    # -- names ----------------------------------------------------------
+    def safe_name(self, name: Any) -> str:
+        if not isinstance(name, str) or not _NAME_RE.match(name) \
+                or ".." in name or "/" in name or "\\" in name:
+            raise HttpError(HTTP_NOT_ACCEPTABLE,
+                            f"{MESSAGE_INVALID_NAME}: {name!r}")
+        return name
+
+    def not_duplicate(self, name: str) -> None:
+        if self._ctx.catalog.exists(name):
+            raise HttpError(HTTP_CONFLICT,
+                            f"{MESSAGE_DUPLICATE_FILE}: {name}")
+
+    def existing(self, name: str) -> Dict[str, Any]:
+        meta = self._ctx.catalog.get_metadata(name)
+        if meta is None:
+            raise HttpError(HTTP_NOT_FOUND,
+                            f"{MESSAGE_NONEXISTENT_FILE}: {name}")
+        return meta
+
+    def existing_finished(self, name: str,
+                          status: int = HTTP_NOT_ACCEPTABLE,
+                          ) -> Dict[str, Any]:
+        """Parent artifacts must exist and be finished before a
+        dependent job is accepted (reference server.py:162-181)."""
+        meta = self._ctx.catalog.get_metadata(name)
+        if meta is None:
+            raise HttpError(status, f"{MESSAGE_NONEXISTENT_FILE}: {name}")
+        if not meta.get(D.FINISHED_FIELD, False):
+            raise HttpError(status, f"{MESSAGE_UNFINISHED_PARENT}: {name}")
+        return meta
+
+    def required_fields(self, body: Dict[str, Any],
+                        fields: Sequence[str]) -> None:
+        for f in fields:
+            if f not in body:
+                raise HttpError(HTTP_NOT_ACCEPTABLE,
+                                f"{MESSAGE_MISSING_FIELD}: {f}")
+
+    # -- reflection targets --------------------------------------------
+    def valid_module(self, module_path: str):
+        try:
+            return sandbox.resolve_module(module_path)
+        except Exception:
+            raise HttpError(HTTP_NOT_ACCEPTABLE,
+                            f"{MESSAGE_INVALID_MODULE_PATH}: {module_path}")
+
+    def valid_class(self, module_path: str, class_name: str):
+        module = self.valid_module(module_path)
+        cls = getattr(module, class_name, None)
+        if cls is None:
+            raise HttpError(HTTP_NOT_ACCEPTABLE,
+                            f"{MESSAGE_INVALID_CLASS}: {class_name}")
+        return cls
+
+    def valid_class_parameters(self, cls, parameters: Dict[str, Any]) -> None:
+        """``inspect.signature(__init__)`` kwargs check (reference
+        model_image/utils.py:151-159). DSL-valued strings are checked
+        by name only — their resolved type is known only at run time.
+        """
+        self._check_kwargs(cls.__init__, parameters, skip_first=True,
+                           message=MESSAGE_INVALID_CLASS_PARAMETER)
+
+    def valid_method(self, target, method_name: str):
+        method = getattr(target, method_name, None)
+        if method is None or not callable(method):
+            raise HttpError(HTTP_NOT_ACCEPTABLE,
+                            f"{MESSAGE_INVALID_METHOD}: {method_name}")
+        return method
+
+    def valid_method_parameters(self, target, method_name: str,
+                                parameters: Dict[str, Any]) -> None:
+        method = getattr(target, method_name)
+        self._check_kwargs(method, parameters, skip_first=False,
+                           message=MESSAGE_INVALID_METHOD_PARAMETER)
+
+    def _check_kwargs(self, fn, parameters: Optional[Dict[str, Any]],
+                      skip_first: bool, message: str) -> None:
+        if not parameters:
+            return
+        try:
+            sig = inspect.signature(fn)
+        except (TypeError, ValueError):
+            return  # C-implemented callables: accept (reference behavior)
+        names = list(sig.parameters.keys())
+        if skip_first and names and names[0] in ("self", "cls"):
+            names = names[1:]
+        has_var_kw = any(
+            p.kind is inspect.Parameter.VAR_KEYWORD
+            for p in sig.parameters.values())
+        if has_var_kw:
+            return
+        for key in parameters:
+            if key not in names:
+                raise HttpError(HTTP_NOT_ACCEPTABLE, f"{message}: {key}")
+
+    # -- dataset fields -------------------------------------------------
+    def valid_fields(self, dataset_name: str,
+                     fields: Sequence[str]) -> None:
+        """Projection/histogram field check against the dataset's
+        metadata ``fields`` (reference projection_image/utils.py:103-114).
+        """
+        meta = self.existing(dataset_name)
+        known = meta.get(D.FIELDS_FIELD) or \
+            self._ctx.catalog.dataset_fields(dataset_name)
+        for f in fields:
+            if f not in known:
+                raise HttpError(HTTP_NOT_ACCEPTABLE,
+                                f"{MESSAGE_INVALID_FIELD}: {f}")

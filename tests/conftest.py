@@ -8,11 +8,19 @@ tests per component with the JAX CPU backend and
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8").strip()
+
+# A site hook may register an accelerator PJRT plugin at interpreter
+# start and force jax_platforms via jax.config (overriding the env
+# var), which would make every test hang on remote-device init.
+# Re-force the CPU backend through the same config channel.
+import jax
+
+jax.config.update("jax_platforms", "cpu")
 
 import pytest
 

@@ -1,0 +1,234 @@
+"""Jobs / params DSL / sandbox / validators tests."""
+
+import time
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from learningorchestra_tpu.catalog import documents as D
+
+
+@pytest.fixture()
+def ctx(tmp_config):
+    from learningorchestra_tpu.services.context import ServiceContext
+    c = ServiceContext(tmp_config)
+    yield c
+    c.close()
+
+
+# ----------------------------------------------------------------------
+# job manager
+# ----------------------------------------------------------------------
+def test_job_success_flips_finished(ctx):
+    ctx.catalog.create_collection("j1", "train/tensorflow")
+    ctx.jobs.submit("j1", lambda: 42, description="test job",
+                    parameters={"p": 1})
+    assert ctx.jobs.wait("j1", timeout=10) == 42
+    meta = ctx.catalog.get_metadata("j1")
+    assert meta[D.FINISHED_FIELD] is True
+    docs = ctx.catalog.get_documents("j1")
+    assert docs[-1][D.EXCEPTION_FIELD] is None
+    assert docs[-1]["elapsedSeconds"] >= 0
+    assert docs[-1][D.DESCRIPTION_FIELD] == "test job"
+
+
+def test_job_failure_keeps_finished_false(ctx):
+    ctx.catalog.create_collection("j2", "train/tensorflow")
+
+    def boom():
+        raise ValueError("exploded")
+
+    ctx.jobs.submit("j2", boom, description="failing")
+    ctx.jobs.wait("j2", timeout=10)
+    meta = ctx.catalog.get_metadata("j2")
+    assert meta[D.FINISHED_FIELD] is False  # reference parity
+    docs = ctx.catalog.get_documents("j2")
+    assert "ValueError" in docs[-1][D.EXCEPTION_FIELD]
+
+
+def test_job_retry_succeeds_second_attempt(ctx):
+    ctx.catalog.create_collection("j3", "train/tensorflow")
+    calls = []
+
+    def flaky():
+        calls.append(1)
+        if len(calls) < 2:
+            raise RuntimeError("transient")
+        return "ok"
+
+    ctx.jobs.submit("j3", flaky, max_retries=2)
+    assert ctx.jobs.wait("j3", timeout=10) == "ok"
+    assert ctx.catalog.get_metadata("j3")[D.FINISHED_FIELD] is True
+    docs = ctx.catalog.get_documents("j3")
+    # one failed attempt doc + one success doc
+    assert len([d for d in docs if d.get(D.EXCEPTION_FIELD)]) == 1
+
+
+def test_job_resubmit_resets_finished(ctx):
+    ctx.catalog.create_collection("j4", "train/tensorflow")
+    ctx.jobs.submit("j4", lambda: 1)
+    ctx.jobs.wait("j4")
+    assert ctx.catalog.get_metadata("j4")[D.FINISHED_FIELD] is True
+    ctx.jobs.resubmit("j4", lambda: 2)
+    ctx.jobs.wait("j4")
+    docs = ctx.catalog.get_documents("j4")
+    assert len(docs) == 3  # metadata + 2 runs
+
+
+def test_mesh_lease_serializes(ctx):
+    order = []
+
+    def job(tag):
+        def run():
+            with ctx.jobs.mesh_lease():
+                order.append(f"{tag}-in")
+                time.sleep(0.05)
+                order.append(f"{tag}-out")
+        return run
+
+    ctx.catalog.create_collection("a1", "train/tensorflow")
+    ctx.catalog.create_collection("a2", "train/tensorflow")
+    ctx.jobs.submit("a1", job("a"))
+    ctx.jobs.submit("a2", job("b"))
+    ctx.jobs.wait("a1"), ctx.jobs.wait("a2")
+    # leases never interleave
+    for i in range(0, len(order), 2):
+        assert order[i].split("-")[0] == order[i + 1].split("-")[0]
+
+
+# ----------------------------------------------------------------------
+# parameter DSL
+# ----------------------------------------------------------------------
+def test_dollar_resolves_dataframe(ctx):
+    ctx.catalog.create_collection("mnist", "dataset/csv")
+    ctx.catalog.write_dataframe("mnist", pd.DataFrame({"a": [1, 2]}))
+    out = ctx.params.treat({"data": "$mnist"})
+    assert list(out["data"]["a"]) == [1, 2]
+
+
+def test_dollar_dot_indexes_object(ctx):
+    ctx.catalog.create_collection("split", "function/python")
+    ctx.artifacts.save({"train": [1, 2], "test": [3]}, "split",
+                       "function/python")
+    out = ctx.params.treat({"xs": "$split.train", "ys": "$split.test"})
+    assert out["xs"] == [1, 2]
+    assert out["ys"] == [3]
+
+
+def test_dollar_object_type_loads_instance(ctx):
+    from sklearn.linear_model import LogisticRegression
+    ctx.catalog.create_collection("lr", "model/scikitlearn")
+    ctx.artifacts.save(LogisticRegression(max_iter=5), "lr",
+                       "model/scikitlearn")
+    out = ctx.params.treat({"model": "$lr"})
+    assert isinstance(out["model"], LogisticRegression)
+
+
+def test_hash_evaluates_expression(ctx):
+    out = ctx.params.treat({"n": "#1 + 2", "lst": ["#3*3", 5, "plain"]})
+    assert out["n"] == 3
+    assert out["lst"] == [9, 5, "plain"]
+
+
+def test_hash_resolves_tensorflow_shim(ctx):
+    out = ctx.params.treat(
+        {"opt": "#tensorflow.keras.optimizers.Adam(0.01)"})
+    assert out["opt"].spec == {"kind": "adam", "learning_rate": 0.01}
+
+
+def test_unknown_artifact_raises(ctx):
+    with pytest.raises(KeyError):
+        ctx.params.treat({"d": "$missing"})
+
+
+# ----------------------------------------------------------------------
+# sandbox
+# ----------------------------------------------------------------------
+def test_sandbox_blocks_dangerous_builtins(ctx):
+    from learningorchestra_tpu.services.sandbox import run_user_code
+    with pytest.raises(Exception):
+        run_user_code("open('/etc/passwd')")
+    with pytest.raises(ImportError):
+        run_user_code("import os")
+    with pytest.raises(ImportError):
+        run_user_code("import subprocess")
+
+
+def test_sandbox_allows_scientific_stack(ctx):
+    from learningorchestra_tpu.services.sandbox import run_user_code
+    g, out = run_user_code(
+        "import numpy as np\n"
+        "response = float(np.arange(4).sum())\n"
+        "print('computed', response)")
+    assert g["response"] == 6.0
+    assert "computed 6.0" in out
+
+
+def test_sandbox_tensorflow_import_is_shim(ctx):
+    from learningorchestra_tpu.services.sandbox import run_user_code
+    g, _ = run_user_code(
+        "import tensorflow as tf\n"
+        "response = tf.__version__")
+    assert "learningorchestra-jax" in g["response"]
+
+
+# ----------------------------------------------------------------------
+# validators
+# ----------------------------------------------------------------------
+def test_validator_status_codes(ctx):
+    from learningorchestra_tpu.services.validators import (
+        HttpError, RequestValidator)
+    v = RequestValidator(ctx)
+
+    ctx.catalog.create_collection("exists", "dataset/csv")
+    with pytest.raises(HttpError) as e:
+        v.not_duplicate("exists")
+    assert e.value.status == 409
+    with pytest.raises(HttpError) as e:
+        v.existing("missing")
+    assert e.value.status == 404
+    with pytest.raises(HttpError) as e:
+        v.existing_finished("exists")  # exists but not finished
+    assert e.value.status == 406
+    ctx.catalog.mark_finished("exists")
+    assert v.existing_finished("exists")[D.FINISHED_FIELD] is True
+    with pytest.raises(HttpError) as e:
+        v.safe_name("../evil")
+    assert e.value.status == 406
+
+
+def test_validator_reflection(ctx):
+    from learningorchestra_tpu.services.validators import (
+        HttpError, RequestValidator)
+    v = RequestValidator(ctx)
+
+    cls = v.valid_class("sklearn.linear_model", "LogisticRegression")
+    v.valid_class_parameters(cls, {"max_iter": 10})
+    with pytest.raises(HttpError):
+        v.valid_class_parameters(cls, {"not_a_param": 1})
+    with pytest.raises(HttpError):
+        v.valid_module("not.a.module")
+    with pytest.raises(HttpError):
+        v.valid_class("sklearn.linear_model", "NotAClass")
+
+    inst = cls(max_iter=10)
+    v.valid_method(inst, "fit")
+    with pytest.raises(HttpError):
+        v.valid_method(inst, "flyToTheMoon")
+
+    # tensorflow paths resolve through the shim
+    cls2 = v.valid_class("tensorflow.keras.models", "Sequential")
+    assert cls2.__name__ == "Sequential"
+
+
+def test_validator_fields(ctx):
+    from learningorchestra_tpu.services.validators import (
+        HttpError, RequestValidator)
+    v = RequestValidator(ctx)
+    ctx.catalog.create_collection("ds", "dataset/csv")
+    ctx.catalog.write_dataframe("ds", pd.DataFrame({"a": [1], "b": [2]}))
+    ctx.catalog.mark_finished("ds", {D.FIELDS_FIELD: ["a", "b"]})
+    v.valid_fields("ds", ["a"])
+    with pytest.raises(HttpError):
+        v.valid_fields("ds", ["nope"])
