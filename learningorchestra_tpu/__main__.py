@@ -1,0 +1,8 @@
+"""``python -m learningorchestra_tpu`` starts the REST server — the
+single-process replacement for the reference's ``bash run.sh`` Swarm
+deployment (reference run.sh:1-130)."""
+
+from learningorchestra_tpu.services.server import main
+
+if __name__ == "__main__":
+    main()

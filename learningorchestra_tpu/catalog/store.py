@@ -285,9 +285,36 @@ class Catalog:
         reference utils.py:318-326)."""
         return self.read_table(name, columns).to_pandas()
 
-    def write_dataframe(self, name: str, df) -> int:
-        with self.dataset_writer(name) as w:
-            w.write_batch(pa.Table.from_pandas(df, preserve_index=False))
+    def write_dataframe(self, name: str, df, replace: bool = True) -> int:
+        """Write a DataFrame as the dataset's rows. ``replace`` (the
+        default) swaps out any existing rows — the dataType service
+        rewrites datasets in place with changed column types. The swap
+        is write-then-rename so a failed write never destroys the rows
+        being replaced."""
+        d = self._dataset_dir(name)
+        if not replace or not os.path.isdir(d):
+            with self.dataset_writer(name) as w:
+                w.write_batch(pa.Table.from_pandas(df,
+                                                   preserve_index=False))
+            return self.count_rows(name)
+        staging = d + ".staging"
+        backup = d + ".old"
+        for leftover in (staging, backup):
+            if os.path.isdir(leftover):
+                shutil.rmtree(leftover)
+        os.makedirs(staging)
+        try:
+            table = pa.Table.from_pandas(df, preserve_index=False)
+            pq.write_table(table, os.path.join(staging,
+                                               "part-00000.parquet"))
+            os.rename(d, backup)
+            os.rename(staging, d)
+            shutil.rmtree(backup)
+        except BaseException:
+            shutil.rmtree(staging, ignore_errors=True)
+            if os.path.isdir(backup) and not os.path.isdir(d):
+                os.rename(backup, d)
+            raise
         return self.count_rows(name)
 
     def dataset_fields(self, name: str) -> List[str]:
@@ -304,22 +331,32 @@ class Catalog:
         """Paged/queried row read reconstructing the reference's
         row-as-document view with ``_id`` (database.py:19-28). Uses
         per-file row counts so paging without a query reads only the
-        needed files.
+        needed files. ``limit=0`` means unlimited (pymongo
+        ``cursor.limit(0)`` parity).
         """
+        return self._read_rows_ex(name, skip, limit, query, columns)[0]
+
+    def _read_rows_ex(self, name: str, skip: int = 0,
+                      limit: Optional[int] = None,
+                      query: Optional[Dict[str, Any]] = None,
+                      columns: Optional[Sequence[str]] = None,
+                      ) -> Tuple[List[Dict[str, Any]], int]:
+        """read_rows + how much of ``skip`` was consumed by matching
+        rows (read_entries needs it to page past the row section)."""
         files = self._dataset_files(name)
         if not files:
-            return []
+            return [], 0
         out: List[Dict[str, Any]] = []
         base = 0
-        remaining = limit if limit is not None else float("inf")
-        if remaining <= 0:
-            return out
+        skipped = 0
+        remaining = limit if limit else float("inf")  # 0/None: unlimited
         want_cols = list(columns) if columns else None
         for f in files:
             nrows = pq.ParquetFile(f).metadata.num_rows
             if query is None and skip >= nrows:
                 base += nrows
                 skip -= nrows
+                skipped += nrows
                 continue
             table = pq.read_table(f, columns=want_cols)
             batch_rows = table.to_pylist()
@@ -329,13 +366,14 @@ class Catalog:
                     continue
                 if skip > 0:
                     skip -= 1
+                    skipped += 1
                     continue
                 out.append(row)
                 remaining -= 1
                 if remaining <= 0:
-                    return out
+                    return out, skipped
             base += nrows
-        return out
+        return out, skipped
 
     # ------------------------------------------------------------------
     # combined read (the universal GET in the reference routes all
@@ -345,25 +383,48 @@ class Catalog:
                      limit: Optional[int] = None,
                      query: Optional[Dict[str, Any]] = None,
                      ) -> List[Dict[str, Any]]:
-        """Documents (metadata at ``_id`` 0 + execution docs) followed
-        by tabular rows, paged as one logical sequence."""
+        """One logical paged sequence in the reference's insertion
+        order (database.py:19-28 pages a Mongo find over the whole
+        collection): metadata document (``_id`` 0), tabular rows
+        (``_id`` 1..N), then appended execution documents (re-labelled
+        N+1.. — in the reference they get ``max(_id)+1`` on insert).
+        ``limit=0`` means unlimited (pymongo parity)."""
         if not self.exists(name):
             raise CollectionNotFound(name)
-        docs = [d for d in self.get_documents(name)
-                if D.matches_query(d, query)]
+        if limit == 0:
+            limit = None
+        all_docs = self.get_documents(name)
+        meta = [d for d in all_docs if d.get(D.ID) == D.METADATA_ID]
+        appended = [d for d in all_docs if d.get(D.ID) != D.METADATA_ID]
         out: List[Dict[str, Any]] = []
-        for d in docs:
+
+        def _take(doc) -> bool:
+            nonlocal skip
+            if not D.matches_query(doc, query):
+                return False
             if skip > 0:
                 skip -= 1
-                continue
-            out.append(d)
+                return False
+            out.append(doc)
+            return limit is not None and len(out) >= limit
+
+        for d in meta:
+            if _take(d):
+                return out
+        n_rows = self.count_rows(name)
+        row_limit = None if limit is None else limit - len(out)
+        if row_limit != 0 and n_rows:
+            rows, skip_consumed = self._read_rows_ex(
+                name, skip=skip, limit=row_limit, query=query)
+            out.extend(rows)
             if limit is not None and len(out) >= limit:
                 return out
-        row_limit = None if limit is None else limit - len(out)
-        if row_limit == 0:
-            return out
-        out.extend(self.read_rows(name, skip=skip, limit=row_limit,
-                                  query=query))
+            skip = max(0, skip - skip_consumed)
+        for d in appended:
+            relabelled = dict(d)
+            relabelled[D.ID] = n_rows + d.get(D.ID, 0)
+            if _take(relabelled):
+                return out
         return out
 
     # ------------------------------------------------------------------

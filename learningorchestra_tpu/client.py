@@ -1,0 +1,273 @@
+"""Python client for the REST API.
+
+Fills the role of the external ``learning-orchestra-client`` pip
+package (reference README.md:92-103: ``from learning_orchestra_client
+import *; Context(cluster_ip)``) against this framework's server. One
+``Context`` exposes a tool handle per (service, tool) route; every
+handle offers the same verbs the API does:
+
+    ctx = Context("http://127.0.0.1:5000")
+    ctx.dataset_csv.insert("titanic", "https://.../titanic.csv")
+    ctx.dataset_csv.wait("titanic")           # observe/long-poll
+    ctx.model_tensorflow.create(model_name="cnn", module_path=...,
+                                class_name=..., class_parameters={...})
+    ctx.train_tensorflow.run(name="cnn_t", model_name="cnn",
+                             method="fit", parameters={...})
+    ctx.train_tensorflow.wait("cnn_t")
+    ctx.evaluate_tensorflow.read("cnn_e")
+
+Stdlib-only (urllib), so the client file can be copied out and used
+standalone.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+import urllib.error
+import urllib.parse
+import urllib.request
+from typing import Any, Dict, List, Optional, Tuple
+
+API_PREFIX = "/api/learningOrchestra/v1"
+
+
+class ApiError(Exception):
+    def __init__(self, status: int, message: Any):
+        super().__init__(f"HTTP {status}: {message}")
+        self.status = status
+        self.message = message
+
+
+class _Http:
+    def __init__(self, base_url: str, timeout: float = 300.0):
+        self.base_url = base_url.rstrip("/")
+        self.timeout = timeout
+
+    def request(self, method: str, path: str,
+                body: Optional[Dict[str, Any]] = None,
+                params: Optional[Dict[str, Any]] = None,
+                ) -> Tuple[int, Any]:
+        url = self.base_url + path
+        if params:
+            url += "?" + urllib.parse.urlencode(
+                {k: v for k, v in params.items() if v is not None})
+        data = json.dumps(body).encode() if body is not None else None
+        req = urllib.request.Request(url, data=data, method=method)
+        if data is not None:
+            req.add_header("Content-Type", "application/json")
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+                raw = resp.read()
+                status = resp.status
+                ctype = resp.headers.get("Content-Type", "")
+        except urllib.error.HTTPError as e:
+            raw = e.read()
+            status = e.code
+            ctype = e.headers.get("Content-Type", "")
+        payload = json.loads(raw) if "json" in ctype else raw
+        if status >= 400:
+            raise ApiError(status, payload if isinstance(payload, bytes)
+                           else payload.get("result", payload))
+        return status, payload
+
+
+class Tool:
+    """Handle for one ``/{service}/{tool}`` route family."""
+
+    def __init__(self, http: _Http, service: str, tool: str):
+        self._http = http
+        self.service = service
+        self.tool = tool
+        self._base = f"{API_PREFIX}/{service}/{tool}"
+
+    # -- generic verbs --------------------------------------------------
+    def post(self, body: Dict[str, Any]) -> Any:
+        _, payload = self._http.request("POST", self._base, body)
+        return payload["result"]
+
+    def update(self, name: str, body: Dict[str, Any]) -> Any:
+        _, payload = self._http.request("PATCH", f"{self._base}/{name}",
+                                        body)
+        return payload["result"]
+
+    def search(self) -> List[Dict[str, Any]]:
+        """All metadata documents of this type (catalog listing)."""
+        _, payload = self._http.request("GET", self._base)
+        return payload["result"]
+
+    def read(self, name: str, skip: int = 0, limit: Optional[int] = None,
+             query: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
+        params: Dict[str, Any] = {"skip": skip or None,
+                                  "limit": limit}
+        if query is not None:
+            params["query"] = json.dumps(query)
+        _, payload = self._http.request("GET", f"{self._base}/{name}",
+                                        params=params)
+        return payload
+
+    def read_image(self, name: str) -> bytes:
+        """Raw plot bytes for explore artifacts."""
+        _, payload = self._http.request("GET", f"{self._base}/{name}")
+        if not isinstance(payload, bytes):
+            raise ApiError(406, f"{name} has no image payload")
+        return payload
+
+    def metadata(self, name: str) -> Dict[str, Any]:
+        return self.read(name, limit=1)["metadata"]
+
+    def delete(self, name: str) -> Any:
+        _, payload = self._http.request("DELETE", f"{self._base}/{name}")
+        return payload["result"]
+
+    def wait(self, name: str, timeout: float = 600.0,
+             poll_interval: float = 0.25) -> Dict[str, Any]:
+        """Block until ``finished`` is True (the platform's universal
+        job-completion idiom). Raises on timeout; surfacing job
+        exceptions is the caller's read of the execution documents."""
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            meta = self.metadata(name)
+            if meta.get("finished"):
+                return meta
+            time.sleep(poll_interval)
+        raise TimeoutError(f"{self.service}/{self.tool}/{name} "
+                           f"not finished after {timeout}s")
+
+    # -- per-service sugar ---------------------------------------------
+    def insert(self, dataset_name: str, url: str) -> Any:
+        """dataset ingest (POST body field names per reference
+        database_api constants.py:17-18)."""
+        return self.post({"datasetName": dataset_name, "datasetURI": url})
+
+    def create(self, model_name: str, module_path: str, class_name: str,
+               class_parameters: Optional[Dict[str, Any]] = None,
+               description: str = "") -> Any:
+        return self.post({
+            "modelName": model_name, "modulePath": module_path,
+            "class": class_name,
+            "classParameters": class_parameters or {},
+            "description": description})
+
+    def run(self, name: str, model_name: str, method: str,
+            parameters: Optional[Dict[str, Any]] = None,
+            description: str = "") -> Any:
+        """train/tune/evaluate/predict method execution."""
+        return self.post({
+            "name": name, "modelName": model_name, "method": method,
+            "methodParameters": parameters or {},
+            "description": description})
+
+    def run_class(self, name: str, module_path: str, class_name: str,
+                  class_parameters: Optional[Dict[str, Any]] = None,
+                  method: str = "", parameters: Optional[Dict] = None,
+                  description: str = "") -> Any:
+        """explore/transform reflection execution."""
+        return self.post({
+            "name": name, "modulePath": module_path, "class": class_name,
+            "classParameters": class_parameters or {},
+            "method": method, "methodParameters": parameters or {},
+            "description": description})
+
+    def run_function(self, name: str, function: str,
+                     parameters: Optional[Dict[str, Any]] = None,
+                     description: str = "") -> Any:
+        return self.post({
+            "name": name, "function": function,
+            "functionParameters": parameters or {},
+            "description": description})
+
+    def run_projection(self, input_dataset: str, output_dataset: str,
+                       fields: List[str]) -> Any:
+        return self.post({"inputDatasetName": input_dataset,
+                          "outputDatasetName": output_dataset,
+                          "names": fields})
+
+    run_histogram = run_projection
+
+    def run_datatype(self, dataset_name: str,
+                     types: Dict[str, str]) -> Any:
+        return self.post({"datasetName": dataset_name, "types": types})
+
+    def run_builder(self, train_dataset: str, test_dataset: str,
+                    modeling_code: str, classifiers: List[str]) -> Any:
+        return self.post({
+            "trainDatasetName": train_dataset,
+            "testDatasetName": test_dataset,
+            "modelingCode": modeling_code,
+            "classifiersList": classifiers})
+
+
+_TOOL_ROUTES = {
+    "dataset_csv": ("dataset", "csv"),
+    "dataset_generic": ("dataset", "generic"),
+    "model_tensorflow": ("model", "tensorflow"),
+    "model_scikitlearn": ("model", "scikitlearn"),
+    "model_jax": ("model", "jax"),
+    "train_tensorflow": ("train", "tensorflow"),
+    "train_scikitlearn": ("train", "scikitlearn"),
+    "train_jax": ("train", "jax"),
+    "tune_tensorflow": ("tune", "tensorflow"),
+    "tune_scikitlearn": ("tune", "scikitlearn"),
+    "tune_jax": ("tune", "jax"),
+    "evaluate_tensorflow": ("evaluate", "tensorflow"),
+    "evaluate_scikitlearn": ("evaluate", "scikitlearn"),
+    "evaluate_jax": ("evaluate", "jax"),
+    "predict_tensorflow": ("predict", "tensorflow"),
+    "predict_scikitlearn": ("predict", "scikitlearn"),
+    "predict_jax": ("predict", "jax"),
+    "explore_histogram": ("explore", "histogram"),
+    "explore_tensorflow": ("explore", "tensorflow"),
+    "explore_scikitlearn": ("explore", "scikitlearn"),
+    "transform_projection": ("transform", "projection"),
+    "transform_datatype": ("transform", "dataType"),
+    "transform_tensorflow": ("transform", "tensorflow"),
+    "transform_scikitlearn": ("transform", "scikitlearn"),
+    "function_python": ("function", "python"),
+    "builder_sparkml": ("builder", "sparkml"),
+}
+
+
+class Context:
+    """Entry point, mirroring the reference client's
+    ``Context(cluster_ip)`` (README.md:96-101). Accepts a full base URL
+    or a bare host/IP (port 5000 assumed, like the reference's
+    gateway-port convention)."""
+
+    def __init__(self, cluster: str, timeout: float = 300.0):
+        if not cluster.startswith("http"):
+            cluster = f"http://{cluster}:5000"
+        self._http = _Http(cluster, timeout=timeout)
+        for attr, (service, tool) in _TOOL_ROUTES.items():
+            setattr(self, attr, Tool(self._http, service, tool))
+
+    def tool(self, service: str, tool: str) -> Tool:
+        return Tool(self._http, service, tool)
+
+    def health(self) -> Dict[str, Any]:
+        _, payload = self._http.request("GET", "/health")
+        return payload
+
+    def observe(self, name: str, seq: int = 0,
+                timeout: float = 25.0) -> Dict[str, Any]:
+        """Long-poll the change feed for one collection (the Observe
+        service; reference README.md:81)."""
+        _, payload = self._http.request(
+            "GET", f"{API_PREFIX}/observe/{name}",
+            params={"seq": seq, "timeout": timeout})
+        return payload["result"]
+
+    def wait(self, name: str, timeout: float = 600.0) -> Dict[str, Any]:
+        """Observe-driven wait on any collection's ``finished`` flag
+        (event-driven; falls back to the poll in Tool.wait only through
+        the observe timeout loop)."""
+        deadline = time.time() + timeout
+        seq = 0
+        while time.time() < deadline:
+            result = self.observe(name, seq=seq,
+                                  timeout=min(25.0, deadline - time.time()))
+            meta = result.get("metadata")
+            if meta and meta.get("finished"):
+                return meta
+            seq = result["seq"]
+        raise TimeoutError(f"{name} not finished after {timeout}s")

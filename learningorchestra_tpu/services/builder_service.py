@@ -1,0 +1,196 @@
+"""Builder service: whole train-compare-predict pipeline in one call.
+
+Reference parity (builder_image/): POST body ``trainDatasetName``,
+``testDatasetName``, ``modelingCode``, ``classifiersList`` ⊆
+{LR, DT, RF, GB, NB} (server.py:26-29, utils.py:119-123). The modeling
+code runs with ``training_df``/``testing_df`` injected and must define
+``features_training``, ``features_testing``, ``features_evaluation``
+(builder.py:84-105). Each requested classifier is then fitted
+concurrently, auto-evaluated (F1 + accuracy), run over the test set,
+and its per-row predictions stored as a new collection named
+``{testDatasetName}{classifier}`` (builder.py:107-170,
+utils.py:43-44); per-classifier metadata records the classifier name
+and ``fitTime`` (utils.py:58-76, builder.py:117-122).
+
+TPU-native redesign: the reference fans each ``fit`` out to a Spark
+MLlib cluster capped at 3×1-core executors (server.py:57-59). Here the
+five classifier families map to in-process scikit-learn estimators
+fitted on threads (the data sizes this API serves are host-scale;
+accelerator-scale training belongs to the train service's sharded
+engine). ``features_*`` may be ``(X, y)`` tuples, DataFrames with a
+``label`` column, or plain arrays (test features need no label).
+"""
+
+from __future__ import annotations
+
+import time
+from concurrent.futures import ThreadPoolExecutor
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from learningorchestra_tpu.catalog import documents as D
+from learningorchestra_tpu.services import sandbox
+from learningorchestra_tpu.services import validators as V
+
+TRAIN_FIELD = "trainDatasetName"
+TEST_FIELD = "testDatasetName"
+MODELING_CODE_FIELD = "modelingCode"
+CLASSIFIERS_FIELD = "classifiersList"
+LABEL_COLUMN = "label"
+
+CLASSIFIER_NAMES = ("LR", "DT", "RF", "GB", "NB")
+
+
+def _make_classifier(name: str):
+    from sklearn.ensemble import (GradientBoostingClassifier,
+                                  RandomForestClassifier)
+    from sklearn.linear_model import LogisticRegression
+    from sklearn.naive_bayes import GaussianNB
+    from sklearn.tree import DecisionTreeClassifier
+
+    return {
+        "LR": lambda: LogisticRegression(max_iter=1000),
+        "DT": DecisionTreeClassifier,
+        "RF": RandomForestClassifier,
+        "GB": GradientBoostingClassifier,
+        "NB": GaussianNB,
+    }[name]()
+
+
+def _split_xy(features: Any, needs_label: bool,
+              ) -> Tuple[np.ndarray, Optional[np.ndarray]]:
+    """Normalize a ``features_*`` value into (X, y)."""
+    if features is None:
+        return None, None
+    if isinstance(features, tuple) and len(features) == 2:
+        x, y = features
+        return np.asarray(x), np.asarray(y)
+    if hasattr(features, "columns"):  # DataFrame
+        cols = [c for c in features.columns if c != "_id"]
+        if LABEL_COLUMN in cols:
+            y = features[LABEL_COLUMN].to_numpy()
+            x = features[[c for c in cols
+                          if c != LABEL_COLUMN]].to_numpy()
+            return x, y
+        if needs_label:
+            raise ValueError(
+                f"features need a {LABEL_COLUMN!r} column or (X, y) tuple")
+        return features[cols].to_numpy(), None
+    arr = np.asarray(features)
+    if needs_label:
+        raise ValueError(
+            f"labeled features must be (X, y) or have {LABEL_COLUMN!r}")
+    return arr, None
+
+
+class BuilderService:
+    def __init__(self, context):
+        self._ctx = context
+        self._validator = V.RequestValidator(context)
+
+    def create(self, body: Dict[str, Any], tool: str = "sparkml",
+               ) -> Tuple[int, Dict[str, Any]]:
+        self._validator.required_fields(
+            body, [TRAIN_FIELD, TEST_FIELD, MODELING_CODE_FIELD,
+                   CLASSIFIERS_FIELD])
+        train_name = body[TRAIN_FIELD]
+        test_name = body[TEST_FIELD]
+        code = body[MODELING_CODE_FIELD]
+        classifiers = body[CLASSIFIERS_FIELD]
+        self._validator.existing_finished(train_name)
+        self._validator.existing_finished(test_name)
+        if not isinstance(classifiers, list) or not classifiers:
+            raise V.HttpError(V.HTTP_NOT_ACCEPTABLE, "invalid classifier")
+        for c in classifiers:
+            if c not in CLASSIFIER_NAMES:
+                raise V.HttpError(V.HTTP_NOT_ACCEPTABLE,
+                                  f"invalid classifier name: {c}")
+        # one output collection per classifier, pre-replacing stale
+        # outputs (reference utils.py:58-76 drops them on POST)
+        outputs = {}
+        for c in classifiers:
+            out = f"{test_name}{c}"
+            if self._ctx.catalog.exists(out):
+                self._ctx.catalog.delete_collection(out)
+            self._ctx.catalog.create_collection(
+                out, D.BUILDER_SPARKML_TYPE, {
+                    "classifier": c,
+                    D.PARENT_NAME_FIELD: train_name,
+                    "testDatasetName": test_name})
+            outputs[c] = out
+        first = outputs[classifiers[0]]
+        self._ctx.jobs.submit(
+            first,
+            lambda: self._run(train_name, test_name, code, outputs),
+            description="builder pipeline",
+            parameters={CLASSIFIERS_FIELD: classifiers},
+            mark_finished=False)  # each classifier marks its own output
+        return V.HTTP_CREATED, {"result": [
+            f"/api/learningOrchestra/v1/builder/{tool}/{out}"
+            for out in outputs.values()]}
+
+    # ------------------------------------------------------------------
+    def _run(self, train_name: str, test_name: str, code: str,
+             outputs: Dict[str, str]) -> None:
+        training_df = self._ctx.catalog.read_dataframe(train_name)
+        testing_df = self._ctx.catalog.read_dataframe(test_name)
+        ctx_vars, _ = sandbox.run_user_code(
+            code, {"training_df": training_df, "testing_df": testing_df},
+            trusted=self._ctx.config.sandbox_mode == "trusted")
+        try:
+            features_training = ctx_vars["features_training"]
+            features_testing = ctx_vars["features_testing"]
+            features_evaluation = ctx_vars.get("features_evaluation")
+        except KeyError as missing:
+            raise ValueError(
+                f"modelingCode must define {missing.args[0]}")
+        x_train, y_train = _split_xy(features_training, needs_label=True)
+        x_test, _ = _split_xy(features_testing, needs_label=False)
+        x_eval, y_eval = _split_xy(features_evaluation, needs_label=True) \
+            if features_evaluation is not None else (None, None)
+
+        with ThreadPoolExecutor(max_workers=len(outputs)) as pool:
+            futures = {
+                c: pool.submit(self._fit_one, c, x_train, y_train,
+                               x_test, x_eval, y_eval, testing_df,
+                               outputs[c])
+                for c in outputs}
+            errors = {}
+            for c, fut in futures.items():
+                try:
+                    fut.result()
+                except Exception as e:  # noqa: BLE001
+                    errors[c] = e
+                    self._ctx.catalog.append_document(
+                        outputs[c], D.execution_document(
+                            "builder classifier", None,
+                            exception=repr(e)))
+        if errors:
+            raise RuntimeError(f"classifier failures: {errors}")
+
+    def _fit_one(self, classifier_name: str, x_train, y_train, x_test,
+                 x_eval, y_eval, testing_df, out_name: str) -> None:
+        from sklearn.metrics import accuracy_score, f1_score
+
+        clf = _make_classifier(classifier_name)
+        t0 = time.perf_counter()
+        clf.fit(x_train, y_train)
+        fit_time = time.perf_counter() - t0
+        metrics: Dict[str, Any] = {"classifier": classifier_name,
+                                   "fitTime": round(fit_time, 6)}
+        if x_eval is not None and y_eval is not None:
+            pred_eval = clf.predict(x_eval)
+            metrics["accuracy"] = float(accuracy_score(y_eval, pred_eval))
+            metrics["f1"] = float(
+                f1_score(y_eval, pred_eval, average="weighted"))
+        predictions = clf.predict(x_test)
+        out_df = testing_df.copy()
+        if "_id" in out_df.columns:
+            out_df = out_df.drop(columns=["_id"])
+        out_df["prediction"] = predictions
+        self._ctx.catalog.write_dataframe(out_name, out_df)
+        self._ctx.catalog.update_metadata(out_name, metrics)
+        self._ctx.catalog.mark_finished(out_name)
+        self._ctx.catalog.append_document(out_name, D.execution_document(
+            f"builder {classifier_name}", None, extra=metrics))

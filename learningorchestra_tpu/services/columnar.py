@@ -1,0 +1,167 @@
+"""Columnar dataset ops: Histogram, DataType coercion, Projection.
+
+These replace the reference's Mongo-aggregation / per-document /
+Spark-job implementations with single-pass Arrow-columnar compute:
+
+- **Histogram** (histogram_image/histogram.py:25-44): the reference
+  runs a ``$group/$sum`` aggregation per field and stores one document
+  per field of shape ``{field: [{_id: value, count: n}, ...], _id: i}``.
+  Here it is a vectorized ``value_counts`` over the Arrow table —
+  output document shape preserved.
+- **DataType** (data_type_handler_image/data_type_update.py:15-45):
+  the reference rewrites every document over the wire, one
+  ``update_one`` per row. Here it is a columnar cast + dataset rewrite:
+  ``"number"`` coerces strings to float (int when integral, "" -> None),
+  ``"string"`` stringifies — same value semantics, O(columns) round
+  trips instead of O(rows).
+- **Projection** (projection_image/projection.py:32-48): the
+  reference's Spark job is ``select(fields + _id)`` via mongo-spark.
+  Here projection is a zero-copy Arrow column select written to a new
+  dataset. (Row-parallel distribution over hosts is the ingest/feed
+  layer's job; a column select needs no cluster.)
+
+Request field names preserved: ``inputDatasetName``,
+``outputDatasetName``, ``names`` (projection/histogram server.py),
+``datasetName`` + ``types`` (data_type_handler server.py:16-17).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Tuple
+
+from learningorchestra_tpu.catalog import documents as D
+from learningorchestra_tpu.services import validators as V
+
+INPUT_FIELD = "inputDatasetName"
+OUTPUT_FIELD = "outputDatasetName"
+NAMES_FIELD = "names"
+DATASET_NAME_FIELD = "datasetName"
+TYPES_FIELD = "types"
+
+STRING_TYPE = "string"
+NUMBER_TYPE = "number"
+
+
+class HistogramService:
+    def __init__(self, context):
+        self._ctx = context
+        self._validator = V.RequestValidator(context)
+
+    def create(self, body: Dict[str, Any], tool: str = "histogram",
+               ) -> Tuple[int, Dict[str, Any]]:
+        self._validator.required_fields(
+            body, [INPUT_FIELD, OUTPUT_FIELD, NAMES_FIELD])
+        parent = body[INPUT_FIELD]
+        name = self._validator.safe_name(body[OUTPUT_FIELD])
+        fields = body[NAMES_FIELD]
+        self._validator.not_duplicate(name)
+        self._validator.existing_finished(parent)
+        self._validator.valid_fields(parent, fields)
+        self._ctx.catalog.create_collection(
+            name, D.EXPLORE_HISTOGRAM_TYPE,
+            {D.PARENT_NAME_FIELD: parent, D.FIELDS_FIELD: fields})
+        self._ctx.jobs.submit(
+            name, lambda: self._run(parent, name, fields),
+            description=f"histogram of {parent}",
+            parameters={NAMES_FIELD: fields})
+        return V.HTTP_CREATED, {
+            "result": f"/api/learningOrchestra/v1/explore/{tool}/{name}"}
+
+    def _run(self, parent: str, name: str, fields: List[str]) -> None:
+        table = self._ctx.catalog.read_table(parent, columns=fields)
+        for i, field in enumerate(fields):
+            counts = table.column(field).value_counts()
+            buckets = [
+                {"_id": v, "count": c} for v, c in zip(
+                    counts.field("values").to_pylist(),
+                    counts.field("counts").to_pylist())]
+            self._ctx.catalog.append_document(
+                name, {field: buckets})
+        self._ctx.catalog.update_metadata(name, {"rows": len(fields)})
+
+
+class DataTypeService:
+    def __init__(self, context):
+        self._ctx = context
+        self._validator = V.RequestValidator(context)
+
+    def create(self, body: Dict[str, Any], tool: str = "dataType",
+               ) -> Tuple[int, Dict[str, Any]]:
+        self._validator.required_fields(
+            body, [DATASET_NAME_FIELD, TYPES_FIELD])
+        name = body[DATASET_NAME_FIELD]
+        types = body[TYPES_FIELD]
+        meta = self._validator.existing(name)
+        if not meta.get(D.FINISHED_FIELD, False):
+            raise V.HttpError(V.HTTP_NOT_ACCEPTABLE,
+                              f"{V.MESSAGE_UNFINISHED_PARENT}: {name}")
+        if not isinstance(types, dict) or not types:
+            raise V.HttpError(V.HTTP_NOT_ACCEPTABLE, "invalid types")
+        self._validator.valid_fields(name, list(types))
+        for t in types.values():
+            if t not in (STRING_TYPE, NUMBER_TYPE):
+                raise V.HttpError(V.HTTP_NOT_ACCEPTABLE,
+                                  f"invalid field type: {t}")
+        # in-place rewrite: finished -> False while converting
+        # (reference convert_existent_file, data_type_update.py:47-60)
+        self._ctx.catalog.update_metadata(name, {D.FINISHED_FIELD: False})
+        self._ctx.jobs.submit(
+            name, lambda: self._run(name, types),
+            description=f"dataType {types}", parameters={TYPES_FIELD: types})
+        return V.HTTP_SUCCESS, {
+            "result": f"/api/learningOrchestra/v1/transform/{tool}/{name}"}
+
+    def _run(self, name: str, types: Dict[str, str]) -> None:
+        import numpy as np
+        import pandas as pd
+
+        df = self._ctx.catalog.read_dataframe(name)
+        for field, target in types.items():
+            if target == STRING_TYPE:
+                col = df[field].astype(object)
+                df[field] = col.where(~col.isna(), "").astype(str)
+            else:
+                col = df[field].replace("", np.nan)
+                numeric = pd.to_numeric(col, errors="raise")
+                # ints stay ints when every value is integral
+                # (reference float->int downcast, data_type_update.py:40-44)
+                if numeric.dropna().apply(
+                        lambda v: float(v).is_integer()).all():
+                    numeric = numeric.astype("Int64")
+                df[field] = numeric
+        self._ctx.catalog.write_dataframe(name, df)
+        self._ctx.catalog.update_metadata(
+            name, {D.FIELDS_FIELD: [c for c in df.columns if c != "_id"]})
+
+
+class ProjectionService:
+    def __init__(self, context):
+        self._ctx = context
+        self._validator = V.RequestValidator(context)
+
+    def create(self, body: Dict[str, Any], tool: str = "projection",
+               ) -> Tuple[int, Dict[str, Any]]:
+        self._validator.required_fields(
+            body, [INPUT_FIELD, OUTPUT_FIELD, NAMES_FIELD])
+        parent = body[INPUT_FIELD]
+        name = self._validator.safe_name(body[OUTPUT_FIELD])
+        fields = body[NAMES_FIELD]
+        self._validator.not_duplicate(name)
+        self._validator.existing_finished(parent)
+        self._validator.valid_fields(parent, fields)
+        self._ctx.catalog.create_collection(
+            name, D.TRANSFORM_PROJECTION_TYPE,
+            {D.PARENT_NAME_FIELD: parent, D.FIELDS_FIELD: fields})
+        self._ctx.jobs.submit(
+            name, lambda: self._run(parent, name, fields),
+            description=f"projection of {parent}",
+            parameters={NAMES_FIELD: fields})
+        return V.HTTP_CREATED, {
+            "result": f"/api/learningOrchestra/v1/transform/{tool}/{name}"}
+
+    def _run(self, parent: str, name: str, fields: List[str]) -> None:
+        table = self._ctx.catalog.read_table(parent, columns=fields)
+        with self._ctx.catalog.dataset_writer(name) as writer:
+            writer.write_batch(table)
+        self._ctx.catalog.update_metadata(
+            name, {D.FIELDS_FIELD: fields, "rows": table.num_rows})

@@ -1,0 +1,174 @@
+"""Explore & Transform service (per-request reflection executor).
+
+Reference parity (database_executor_image/): POST body ``name``,
+``description``, ``modulePath``, ``class``, ``classParameters``,
+``method``, ``methodParameters`` (server.py:31-37); the class is
+instantiated fresh per request (no stored parent), the method result
+is the artifact (database_execution.py:147-182):
+
+- ``explore/*``  -> the result is rendered to a scatterplot PNG
+  (utils.py:295-320 does ``sns.scatterplot(...).get_figure()
+  .savefig``) served by a ``GET`` with ``image/png``
+  (server.py:151-166);
+- ``transform/*`` -> the result object (fitted scaler / transformed
+  array) is stored as a binary for later steps (utils.py:241-292).
+
+If ``method`` is empty the instance itself is the result (matching the
+reference's method-optional transform flows).
+"""
+
+from __future__ import annotations
+
+import io
+from typing import Any, Dict, Optional, Tuple
+
+from learningorchestra_tpu.catalog import documents as D
+from learningorchestra_tpu.services import validators as V
+
+NAME_FIELD = "name"
+DESCRIPTION_FIELD = "description"
+MODULE_PATH_FIELD = "modulePath"
+CLASS_FIELD = "class"
+CLASS_PARAMETERS_FIELD = "classParameters"
+METHOD_FIELD = "method"
+METHOD_PARAMETERS_FIELD = "methodParameters"
+
+
+def render_plot_png(result: Any) -> bytes:
+    """Render an explore result to PNG bytes.
+
+    Accepts matplotlib figures/axes directly, else scatterplots the
+    first two columns of array/DataFrame-shaped results (the
+    reference's fixed seaborn scatterplot, utils.py:295-320).
+    """
+    import matplotlib
+
+    matplotlib.use("Agg")
+    import matplotlib.pyplot as plt
+    import numpy as np
+
+    fig = None
+    if hasattr(result, "savefig"):  # a Figure
+        fig = result
+    elif hasattr(result, "get_figure"):  # an Axes
+        fig = result.get_figure()
+    else:
+        import pandas as pd
+        import seaborn as sns
+
+        if hasattr(result, "toarray"):  # scipy sparse
+            result = result.toarray()
+        arr = np.asarray(result)
+        if arr.ndim == 1:
+            arr = arr.reshape(-1, 1)
+        frame = pd.DataFrame(arr[:, :2], columns=["x", "y"] if
+                             arr.shape[1] >= 2 else ["x"])
+        if arr.shape[1] == 1:
+            frame["y"] = np.arange(len(frame))
+        ax = sns.scatterplot(data=frame, x="x", y="y")
+        fig = ax.get_figure()
+    buf = io.BytesIO()
+    fig.savefig(buf, format="png")
+    plt.close(fig)
+    return buf.getvalue()
+
+
+class DatabaseExecutorService:
+    def __init__(self, context):
+        self._ctx = context
+        self._validator = V.RequestValidator(context)
+
+    def create(self, body: Dict[str, Any], verb: str, tool: str,
+               ) -> Tuple[int, Dict[str, Any]]:
+        self._validator.required_fields(
+            body, [NAME_FIELD, MODULE_PATH_FIELD, CLASS_FIELD])
+        name = self._validator.safe_name(body[NAME_FIELD])
+        module_path = body[MODULE_PATH_FIELD]
+        class_name = body[CLASS_FIELD]
+        class_parameters = body.get(CLASS_PARAMETERS_FIELD) or {}
+        method = body.get(METHOD_FIELD) or ""
+        method_parameters = body.get(METHOD_PARAMETERS_FIELD) or {}
+        description = body.get(DESCRIPTION_FIELD, "")
+        self._validator.not_duplicate(name)
+        cls = self._validator.valid_class(module_path, class_name)
+        self._validator.valid_class_parameters(cls, class_parameters)
+        if method:
+            self._validator.valid_method(cls, method)
+            self._validator.valid_method_parameters(
+                cls, method, method_parameters)
+        type_string = D.normalize_type(f"{verb}/{tool}")
+        self._ctx.catalog.create_collection(name, type_string, {
+            D.MODULE_PATH_FIELD: module_path,
+            D.CLASS_FIELD: class_name,
+            D.CLASS_PARAMETERS_FIELD: class_parameters,
+            D.METHOD_FIELD: method,
+            D.METHOD_PARAMETERS_FIELD: method_parameters,
+            D.DESCRIPTION_FIELD: description,
+        })
+        self._submit(name, type_string, cls, class_parameters, method,
+                     method_parameters, description, verb)
+        return V.HTTP_CREATED, {
+            "result": f"/api/learningOrchestra/v1/{verb}/{tool}/{name}"}
+
+    def update(self, name: str, body: Dict[str, Any], verb: str, tool: str,
+               ) -> Tuple[int, Dict[str, Any]]:
+        meta = self._validator.existing(name)
+        method = body.get(METHOD_FIELD, meta.get(D.METHOD_FIELD)) or ""
+        method_parameters = body.get(
+            METHOD_PARAMETERS_FIELD,
+            meta.get(D.METHOD_PARAMETERS_FIELD)) or {}
+        class_parameters = body.get(
+            CLASS_PARAMETERS_FIELD, meta.get(D.CLASS_PARAMETERS_FIELD)) or {}
+        description = body.get(DESCRIPTION_FIELD, "")
+        cls = self._validator.valid_class(
+            meta[D.MODULE_PATH_FIELD], meta[D.CLASS_FIELD])
+        if method:
+            self._validator.valid_method(cls, method)
+        self._ctx.catalog.update_metadata(
+            name, {D.METHOD_PARAMETERS_FIELD: method_parameters,
+                   D.CLASS_PARAMETERS_FIELD: class_parameters,
+                   D.FINISHED_FIELD: False})
+        self._submit(name, meta[D.TYPE_FIELD], cls, class_parameters,
+                     method, method_parameters, description, verb)
+        return V.HTTP_SUCCESS, {
+            "result": f"/api/learningOrchestra/v1/{verb}/{tool}/{name}"}
+
+    def delete(self, name: str, verb: str, tool: str,
+               ) -> Tuple[int, Dict[str, Any]]:
+        meta = self._validator.existing(name)
+        self._ctx.catalog.delete_collection(name)
+        self._ctx.artifacts.delete(name, meta.get(D.TYPE_FIELD))
+        return V.HTTP_SUCCESS, {"result": f"deleted {name}"}
+
+    # ------------------------------------------------------------------
+    def image_response(self, name: str) -> Tuple[bytes, str]:
+        """PNG bytes for ``GET /explore/<name>`` (reference
+        server.py:151-166 ``send_file(mimetype="image/png")``)."""
+        meta = self._validator.existing(name)
+        path, content_type = self._ctx.artifacts.bytes_path(
+            name, meta[D.TYPE_FIELD])
+        with open(path, "rb") as f:
+            return f.read(), content_type
+
+    def _submit(self, name: str, type_string: str, cls,
+                class_parameters: Dict[str, Any], method: str,
+                method_parameters: Dict[str, Any], description: str,
+                verb: str) -> None:
+        def run():
+            instance = cls(**self._ctx.params.treat(class_parameters))
+            if method:
+                result = getattr(instance, method)(
+                    **self._ctx.params.treat(method_parameters))
+            else:
+                result = instance
+            if verb == "explore":
+                png = render_plot_png(result)
+                self._ctx.artifacts.save_bytes(
+                    png, name, type_string, filename="plot.png",
+                    content_type="image/png")
+            else:
+                self._ctx.artifacts.save(result, name, type_string)
+            return result
+
+        self._ctx.jobs.submit(name, run, description=description,
+                              parameters=method_parameters)

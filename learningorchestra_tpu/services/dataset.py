@@ -1,0 +1,213 @@
+"""Dataset service: URL ingest + the universal artifact read API.
+
+Reference behavior being re-provided (database_api_image/):
+- ``POST /files?type=dataset/csv``: stream a CSV from ``datasetURI``
+  into storage through a 3-stage pipeline — download ∥ parse ∥ write
+  (database.py:99-151 runs download/treat/save threads over bounded
+  queues; ours streams bytes through a pipe into a chunked Arrow CSV
+  parser feeding a Parquet writer, so the hot loop is columnar instead
+  of per-row ``insert_one`` — database.py:144 is the throughput cliff
+  this design removes).
+- ``POST /files?type=dataset/generic``: stream any file to binary
+  storage (database.py:61-83).
+- ``GET /files`` catalog listing and ``GET /files/<name>`` paged/
+  queried reads for EVERY artifact type (database.py:19-44) — the
+  gateway routes all read GETs of all services here
+  (krakend.json:722-757).
+- ``DELETE /files/<name>`` (server.py:96-111).
+
+Field names match the reference API: ``datasetName``, ``datasetURI``
+(constants.py:17-18), read params ``skip``/``limit``/``query``
+(constants.py:40-48).
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import os
+import threading
+from typing import Any, Dict, Optional, Tuple
+
+from learningorchestra_tpu.catalog import documents as D
+from learningorchestra_tpu.services import validators as V
+
+DATASET_NAME_FIELD = "datasetName"
+DATASET_URI_FIELD = "datasetURI"
+
+_CHUNK = 1 << 20  # 1 MiB download chunks
+
+
+def _open_uri_stream(uri: str):
+    """Readable binary stream for http(s)/file URIs and local paths."""
+    if uri.startswith(("http://", "https://")):
+        import requests
+
+        resp = requests.get(uri, stream=True, timeout=600)
+        resp.raise_for_status()
+        resp.raw.decode_content = True
+        return resp.raw
+    if uri.startswith("file://"):
+        return open(uri[len("file://"):], "rb")
+    return open(uri, "rb")
+
+
+class _PipeReader(io.RawIOBase):
+    """File-like fed by the download thread; read by the parser thread.
+
+    The bounded buffer is the same backpressure the reference gets from
+    its bounded queues (database.py:96-105) — a slow writer throttles
+    the download instead of buffering the whole file in memory.
+    """
+
+    def __init__(self, max_buffered: int = 64):
+        super().__init__()
+        import queue
+
+        self._q: "queue.Queue[Optional[bytes]]" = queue.Queue(max_buffered)
+        self._leftover = b""
+        self._eof = False
+        self._err: list = []
+
+    # producer side
+    def feed(self, data: bytes) -> None:
+        self._q.put(data)
+
+    def finish(self, error: Optional[BaseException] = None) -> None:
+        if error is not None:
+            self._err.append(error)
+        self._q.put(None)
+
+    # consumer side
+    def readable(self) -> bool:
+        return True
+
+    def readinto(self, b) -> int:
+        while not self._leftover and not self._eof:
+            item = self._q.get()
+            if item is None:
+                self._eof = True
+                if self._err:
+                    raise self._err[0]
+            else:
+                self._leftover = item
+        n = min(len(b), len(self._leftover))
+        b[:n] = self._leftover[:n]
+        self._leftover = self._leftover[n:]
+        return n
+
+
+class DatasetService:
+    def __init__(self, context):
+        self._ctx = context
+        self._validator = V.RequestValidator(context)
+
+    # -- POST -----------------------------------------------------------
+    def create(self, body: Dict[str, Any], tool: str,
+               ) -> Tuple[int, Dict[str, Any]]:
+        self._validator.required_fields(
+            body, [DATASET_NAME_FIELD, DATASET_URI_FIELD])
+        name = self._validator.safe_name(body[DATASET_NAME_FIELD])
+        uri = body[DATASET_URI_FIELD]
+        self._validator.not_duplicate(name)
+        if tool not in ("csv", "generic"):
+            raise V.HttpError(V.HTTP_NOT_ACCEPTABLE,
+                              f"unknown dataset tool: {tool}")
+        if not isinstance(uri, str) or not uri:
+            raise V.HttpError(V.HTTP_NOT_ACCEPTABLE,
+                              "invalid url")
+        type_string = f"dataset/{tool}"
+        self._ctx.catalog.create_collection(name, type_string, {"url": uri})
+        run = (self._ingest_csv if tool == "csv" else self._ingest_generic)
+        self._ctx.jobs.submit(
+            name, lambda: run(name, uri),
+            description=f"ingest {uri}", parameters={"url": uri})
+        return V.HTTP_CREATED, {
+            "result": f"/api/learningOrchestra/v1/dataset/{tool}/{name}"}
+
+    # -- pipelines ------------------------------------------------------
+    def _ingest_csv(self, name: str, uri: str) -> None:
+        """download ∥ parse ∥ write, all streaming."""
+        from pyarrow import csv as pa_csv
+
+        pipe = _PipeReader()
+
+        def download() -> None:
+            try:
+                with _open_uri_stream(uri) as stream:
+                    while True:
+                        chunk = stream.read(_CHUNK)
+                        if not chunk:
+                            break
+                        pipe.feed(chunk)
+                pipe.finish()
+            except BaseException as e:  # noqa: BLE001
+                pipe.finish(e)
+
+        t = threading.Thread(target=download, daemon=True,
+                             name=f"lo-ingest-{name}")
+        t.start()
+        rows = 0
+        with self._ctx.catalog.dataset_writer(name) as writer:
+            reader = pa_csv.open_csv(
+                pipe, read_options=pa_csv.ReadOptions(block_size=_CHUNK))
+            for batch in reader:
+                if batch.num_rows:
+                    writer.write_batch(batch)
+                    rows += batch.num_rows
+            fields = writer.fields()
+        t.join()
+        self._ctx.catalog.update_metadata(
+            name, {D.FIELDS_FIELD: fields, "rows": rows})
+
+    def _ingest_generic(self, name: str, uri: str) -> None:
+        buf = io.BytesIO()
+        with _open_uri_stream(uri) as stream:
+            while True:
+                chunk = stream.read(_CHUNK)
+                if not chunk:
+                    break
+                buf.write(chunk)
+        filename = os.path.basename(uri.split("?")[0]) or "payload.bin"
+        self._ctx.artifacts.save_bytes(
+            buf.getvalue(), name, D.DATASET_GENERIC_TYPE, filename=filename)
+        self._ctx.catalog.update_metadata(name, {"sizeBytes": buf.tell()})
+
+    # -- universal GET/DELETE ------------------------------------------
+    def list_files(self) -> Tuple[int, Any]:
+        """Catalog listing: every collection's metadata document
+        (reference Storage.get_metadata_files, database.py:30-44)."""
+        return V.HTTP_SUCCESS, {
+            "result": self._ctx.catalog.list_collections()}
+
+    def read_file(self, name: str, skip: int = 0,
+                  limit: Optional[int] = None,
+                  query: Optional[Dict[str, Any]] = None,
+                  ) -> Tuple[int, Any]:
+        meta = self._validator.existing(name)
+        rows = self._ctx.catalog.read_entries(
+            name, skip=skip, limit=limit, query=query)
+        return V.HTTP_SUCCESS, {"metadata": meta, "result": rows}
+
+    def delete_file(self, name: str) -> Tuple[int, Any]:
+        meta = self._ctx.catalog.get_metadata(name)
+        if meta is None:
+            raise V.HttpError(V.HTTP_NOT_FOUND,
+                              f"{V.MESSAGE_NONEXISTENT_FILE}: {name}")
+        self._ctx.catalog.delete_collection(name)
+        self._ctx.artifacts.delete(name, meta.get(D.TYPE_FIELD))
+        return V.HTTP_SUCCESS, {"result": f"deleted file {name}"}
+
+
+def parse_query_param(raw: Optional[str]) -> Optional[Dict[str, Any]]:
+    """The reference passes ``query`` as a JSON string query param
+    (database.py:19-28)."""
+    if not raw:
+        return None
+    try:
+        q = json.loads(raw)
+    except json.JSONDecodeError:
+        raise V.HttpError(V.HTTP_NOT_ACCEPTABLE, "invalid query")
+    if not isinstance(q, dict):
+        raise V.HttpError(V.HTTP_NOT_ACCEPTABLE, "invalid query")
+    return q

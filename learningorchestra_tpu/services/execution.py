@@ -1,0 +1,172 @@
+"""Binary execution service: Train / Tune / Evaluate / Predict.
+
+One generic "call method X on stored object Y with kwargs Z" executor
+backs four API verbs × two tools, exactly like the reference's
+binary_executor_image (8 type strings, constants.py:41-51; POST body
+``name``, ``modelName``, ``parentName``, ``description``, ``method``,
+``methodParameters``, server.py:23-71).
+
+Semantics preserved (binary_execution.py:118-189):
+- validation walks the parent chain to the root model/* metadata to
+  resolve the module+class whose methods are being called
+  (utils.py:257-276);
+- ``methodParameters`` go through the ``$``/``#``/``.`` DSL;
+- train/tune results ARE the mutated instance itself
+  (binary_execution.py:184-188); evaluate/predict store the returned
+  value;
+- PATCH re-runs a finished execution against its stored parent with
+  new parameters (server.py:74-118);
+- every run appends an execution document; failures record
+  ``repr(exception)`` and leave ``finished`` False.
+
+TPU-native: when the stored parent is a NeuralModel, ``fit`` /
+``evaluate`` / ``predict`` dispatch into the mesh-sharded jit engine
+(runtime/engine.py) — the accelerator lease is held for the duration
+(jobs.py). sklearn parents run their real methods on host CPU.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Tuple
+
+from learningorchestra_tpu.catalog import documents as D
+from learningorchestra_tpu.services import validators as V
+
+NAME_FIELD = "name"
+MODEL_NAME_FIELD = "modelName"
+PARENT_NAME_FIELD = "parentName"
+DESCRIPTION_FIELD = "description"
+METHOD_FIELD = "method"
+METHOD_PARAMETERS_FIELD = "methodParameters"
+
+# verbs whose result is the mutated parent instance
+_INSTANCE_RESULT_PREFIXES = ("train/", "tune/")
+
+
+class ExecutionService:
+    def __init__(self, context):
+        self._ctx = context
+        self._validator = V.RequestValidator(context)
+
+    # ------------------------------------------------------------------
+    def root_model_metadata(self, name: str) -> Dict[str, Any]:
+        """Walk the parentName chain until a model/* artifact — the
+        root whose class defines the callable surface (reference
+        utils.py:257-276)."""
+        seen = set()
+        meta = self._validator.existing(name)
+        while not meta[D.TYPE_FIELD].startswith("model/"):
+            parent = meta.get(D.PARENT_NAME_FIELD)
+            if not parent or parent in seen:
+                raise V.HttpError(
+                    V.HTTP_NOT_ACCEPTABLE,
+                    f"no model root in lineage of: {name}")
+            seen.add(parent)
+            meta = self._validator.existing(parent)
+        return meta
+
+    def _validate_method(self, root_meta: Dict[str, Any], method: str,
+                         method_parameters: Dict[str, Any]) -> None:
+        cls = self._validator.valid_class(
+            root_meta[D.MODULE_PATH_FIELD], root_meta[D.CLASS_FIELD])
+        self._validator.valid_method(cls, method)
+        self._validator.valid_method_parameters(
+            cls, method, method_parameters)
+
+    # ------------------------------------------------------------------
+    def create(self, body: Dict[str, Any], verb: str, tool: str,
+               ) -> Tuple[int, Dict[str, Any]]:
+        self._validator.required_fields(
+            body, [NAME_FIELD, MODEL_NAME_FIELD, METHOD_FIELD,
+                   METHOD_PARAMETERS_FIELD])
+        name = self._validator.safe_name(body[NAME_FIELD])
+        parent_name = body.get(PARENT_NAME_FIELD) or body[MODEL_NAME_FIELD]
+        method = body[METHOD_FIELD]
+        method_parameters = body[METHOD_PARAMETERS_FIELD] or {}
+        description = body.get(DESCRIPTION_FIELD, "")
+        self._validator.not_duplicate(name)
+        self._validator.existing_finished(parent_name)
+        root_meta = self.root_model_metadata(parent_name)
+        self._validate_method(root_meta, method, method_parameters)
+        type_string = D.normalize_type(f"{verb}/{tool}")
+        self._ctx.catalog.create_collection(name, type_string, {
+            D.PARENT_NAME_FIELD: parent_name,
+            D.METHOD_FIELD: method,
+            D.METHOD_PARAMETERS_FIELD: method_parameters,
+            D.DESCRIPTION_FIELD: description,
+        })
+        self._submit(name, type_string, parent_name, method,
+                     method_parameters, description)
+        return V.HTTP_CREATED, {
+            "result": f"/api/learningOrchestra/v1/{verb}/{tool}/{name}"}
+
+    def update(self, name: str, body: Dict[str, Any], verb: str, tool: str,
+               ) -> Tuple[int, Dict[str, Any]]:
+        meta = self._validator.existing(name)
+        method = meta[D.METHOD_FIELD]
+        method_parameters = body.get(
+            METHOD_PARAMETERS_FIELD, meta.get(D.METHOD_PARAMETERS_FIELD)) \
+            or {}
+        description = body.get(DESCRIPTION_FIELD, "")
+        parent_name = meta[D.PARENT_NAME_FIELD]
+        root_meta = self.root_model_metadata(parent_name)
+        self._validate_method(root_meta, method, method_parameters)
+        self._ctx.catalog.update_metadata(
+            name, {D.METHOD_PARAMETERS_FIELD: method_parameters,
+                   D.FINISHED_FIELD: False})
+        self._submit(name, meta[D.TYPE_FIELD], parent_name, method,
+                     method_parameters, description)
+        return V.HTTP_SUCCESS, {
+            "result": f"/api/learningOrchestra/v1/{verb}/{tool}/{name}"}
+
+    def delete(self, name: str, verb: str, tool: str,
+               ) -> Tuple[int, Dict[str, Any]]:
+        meta = self._validator.existing(name)
+        self._ctx.catalog.delete_collection(name)
+        self._ctx.artifacts.delete(name, meta.get(D.TYPE_FIELD))
+        return V.HTTP_SUCCESS, {"result": f"deleted {name}"}
+
+    # ------------------------------------------------------------------
+    def _submit(self, name: str, type_string: str, parent_name: str,
+                method: str, method_parameters: Dict[str, Any],
+                description: str) -> None:
+        def run():
+            parent_type = self._ctx.params.artifact_type(parent_name)
+            instance = self._ctx.artifacts.load(parent_name, parent_type)
+            treated = self._ctx.params.treat(method_parameters)
+            result = getattr(instance, method)(**treated)
+            if type_string.startswith(_INSTANCE_RESULT_PREFIXES):
+                result = instance  # the fitted object is the artifact
+            self._ctx.artifacts.save(result, name, type_string)
+            summary = summarize_result(result)
+            if summary is not None:
+                self._ctx.catalog.append_document(name, {"result": summary})
+            return result
+
+        self._ctx.jobs.submit(
+            name, run, description=description,
+            parameters=method_parameters, needs_mesh=True)
+
+
+def summarize_result(result: Any) -> Optional[Any]:
+    """A JSON-compatible view of an evaluate/predict result for the
+    universal GET reader (the reference leaves results opaque in
+    volumes; surfacing them in documents is a strict superset)."""
+    import numpy as np
+
+    if result is None or isinstance(result, (bool, int, float, str)):
+        return result
+    if isinstance(result, dict):
+        return {str(k): summarize_result(v) for k, v in result.items()}
+    if isinstance(result, (list, tuple)):
+        if len(result) > 1000:
+            return [summarize_result(v) for v in result[:1000]]
+        return [summarize_result(v) for v in result]
+    if isinstance(result, np.ndarray):
+        flat = result.tolist()
+        return flat[:1000] if isinstance(flat, list) and \
+            len(flat) > 1000 else flat
+    if hasattr(result, "history") and isinstance(
+            getattr(result, "history"), (dict, list)):
+        return summarize_result(result.history)
+    return None

@@ -1,0 +1,116 @@
+"""Function service: the wildcard Python-execution step.
+
+Reference parity (code_executor_image/): POST body ``name``,
+``description``, ``function`` (code text OR a URL to fetch it from),
+``functionParameters`` (server.py:24-57, code_execution.py:11-21).
+Parameters go through the ``$`` DSL so datasets arrive as DataFrames;
+the code runs with them as globals, must leave its result in a
+``response`` variable, and captured stdout is stored as
+``functionMessage`` in the execution document
+(code_execution.py:169-196, utils.py:113-138).
+
+Difference by design: the code runs in the framework sandbox
+(services/sandbox.py) rather than bare ``exec`` — same capability
+surface for scientific code, no ambient filesystem/process authority
+(SURVEY §7 hard part #3). ``Config.sandbox_mode = "trusted"`` restores
+reference-equivalent trust.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Tuple
+
+from learningorchestra_tpu.catalog import documents as D
+from learningorchestra_tpu.services import sandbox
+from learningorchestra_tpu.services import validators as V
+
+NAME_FIELD = "name"
+DESCRIPTION_FIELD = "description"
+FUNCTION_FIELD = "function"
+FUNCTION_PARAMETERS_FIELD = "functionParameters"
+RESPONSE_VARIABLE = "response"
+
+
+def fetch_function_code(function: str) -> str:
+    """``function`` may be inline code or a URL to it (reference
+    Function.treat, code_execution.py:11-21)."""
+    if function.startswith(("http://", "https://")):
+        import requests
+
+        resp = requests.get(function, timeout=60)
+        resp.raise_for_status()
+        return resp.text
+    if function.startswith("file://"):
+        with open(function[len("file://"):]) as f:
+            return f.read()
+    return function
+
+
+class FunctionService:
+    def __init__(self, context):
+        self._ctx = context
+        self._validator = V.RequestValidator(context)
+
+    def create(self, body: Dict[str, Any], tool: str = "python",
+               ) -> Tuple[int, Dict[str, Any]]:
+        self._validator.required_fields(
+            body, [NAME_FIELD, FUNCTION_FIELD, FUNCTION_PARAMETERS_FIELD])
+        name = self._validator.safe_name(body[NAME_FIELD])
+        self._validator.not_duplicate(name)
+        function = body[FUNCTION_FIELD]
+        parameters = body[FUNCTION_PARAMETERS_FIELD] or {}
+        description = body.get(DESCRIPTION_FIELD, "")
+        type_string = f"function/{tool}"
+        self._ctx.catalog.create_collection(name, type_string, {
+            D.FUNCTION_FIELD: function,
+            D.FUNCTION_PARAMETERS_FIELD: parameters,
+            D.DESCRIPTION_FIELD: description,
+        })
+        self._submit(name, type_string, function, parameters, description)
+        return V.HTTP_CREATED, {
+            "result": f"/api/learningOrchestra/v1/function/{tool}/{name}"}
+
+    def update(self, name: str, body: Dict[str, Any],
+               tool: str = "python") -> Tuple[int, Dict[str, Any]]:
+        meta = self._validator.existing(name)
+        function = body.get(FUNCTION_FIELD, meta.get(D.FUNCTION_FIELD))
+        parameters = body.get(
+            FUNCTION_PARAMETERS_FIELD,
+            meta.get(D.FUNCTION_PARAMETERS_FIELD)) or {}
+        description = body.get(DESCRIPTION_FIELD, "")
+        self._ctx.catalog.update_metadata(
+            name, {D.FUNCTION_FIELD: function,
+                   D.FUNCTION_PARAMETERS_FIELD: parameters,
+                   D.FINISHED_FIELD: False})
+        self._submit(name, meta[D.TYPE_FIELD], function, parameters,
+                     description)
+        return V.HTTP_SUCCESS, {
+            "result": f"/api/learningOrchestra/v1/function/{tool}/{name}"}
+
+    def delete(self, name: str, tool: str = "python",
+               ) -> Tuple[int, Dict[str, Any]]:
+        meta = self._validator.existing(name)
+        self._ctx.catalog.delete_collection(name)
+        self._ctx.artifacts.delete(name, meta.get(D.TYPE_FIELD))
+        return V.HTTP_SUCCESS, {"result": f"deleted {name}"}
+
+    # ------------------------------------------------------------------
+    def _submit(self, name: str, type_string: str, function: str,
+                parameters: Dict[str, Any], description: str) -> None:
+        def run():
+            code = fetch_function_code(function)
+            treated = self._ctx.params.treat(parameters)
+            ctx_vars, stdout = sandbox.run_user_code(
+                code, treated,
+                trusted=self._ctx.config.sandbox_mode == "trusted")
+            if RESPONSE_VARIABLE not in ctx_vars:
+                raise ValueError(
+                    f"function must assign a {RESPONSE_VARIABLE!r} variable")
+            result = ctx_vars[RESPONSE_VARIABLE]
+            self._ctx.artifacts.save(result, name, type_string)
+            self._ctx.catalog.append_document(
+                name, {D.FUNCTION_MESSAGE_FIELD: stdout})
+            return result
+
+        self._ctx.jobs.submit(name, run, description=description,
+                              parameters=parameters)

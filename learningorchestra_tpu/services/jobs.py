@@ -58,6 +58,7 @@ class JobManager:
                needs_mesh: bool = False,
                max_retries: int = 0,
                on_success: Optional[Callable[[Any], None]] = None,
+               mark_finished: bool = True,
                ) -> Future:
         """Run ``fn`` asynchronously under the reference's
         finished-flag contract for collection ``name`` (which must
@@ -77,7 +78,8 @@ class JobManager:
                         elapsed = time.monotonic() - start
                         if on_success is not None:
                             on_success(result)
-                        self._catalog.mark_finished(name)
+                        if mark_finished:
+                            self._catalog.mark_finished(name)
                         self._catalog.append_document(
                             name, D.execution_document(
                                 description, parameters,

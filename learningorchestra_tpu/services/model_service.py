@@ -1,0 +1,97 @@
+"""Model service: instantiate a tool class and persist the live object.
+
+Reference parity (model_image/): ``POST /defaultModel?type=model/
+{tensorflow,scikitlearn}`` with ``modelName``, ``description``,
+``modulePath``, ``class``, ``classParameters`` (constants.py:2-9,
+server.py:23-64) — validates module/class/ctor kwargs synchronously,
+then on a worker thread resolves the ``$``/``#`` parameter DSL,
+instantiates, and stores the instance as the root of every later
+train/tune lineage (model.py:112-162). PATCH re-instantiates with new
+``classParameters`` (server.py:66-107).
+
+TPU-native notes: ``modulePath: "tensorflow.keras.*"`` resolves to the
+JAX-backed keras shim (models/tf_compat) so the stored object is a
+:class:`~learningorchestra_tpu.models.neural.NeuralModel` handle —
+a mesh-sharded jit engine, not a TF graph. scikit-learn paths load the
+real sklearn class (CPU-side, exactly as the reference runs it).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Tuple
+
+from learningorchestra_tpu.catalog import documents as D
+from learningorchestra_tpu.services import validators as V
+
+MODEL_NAME_FIELD = "modelName"
+DESCRIPTION_FIELD = "description"
+MODULE_PATH_FIELD = "modulePath"
+CLASS_FIELD = "class"
+CLASS_PARAMETERS_FIELD = "classParameters"
+
+
+class ModelService:
+    def __init__(self, context):
+        self._ctx = context
+        self._validator = V.RequestValidator(context)
+
+    def create(self, body: Dict[str, Any], tool: str,
+               ) -> Tuple[int, Dict[str, Any]]:
+        self._validator.required_fields(
+            body, [MODEL_NAME_FIELD, MODULE_PATH_FIELD, CLASS_FIELD,
+                   CLASS_PARAMETERS_FIELD])
+        name = self._validator.safe_name(body[MODEL_NAME_FIELD])
+        module_path = body[MODULE_PATH_FIELD]
+        class_name = body[CLASS_FIELD]
+        class_parameters = body[CLASS_PARAMETERS_FIELD] or {}
+        description = body.get(DESCRIPTION_FIELD, "")
+        self._validator.not_duplicate(name)
+        cls = self._validator.valid_class(module_path, class_name)
+        self._validator.valid_class_parameters(cls, class_parameters)
+        type_string = D.normalize_type(f"model/{tool}")
+        self._ctx.catalog.create_collection(name, type_string, {
+            D.MODULE_PATH_FIELD: module_path,
+            D.CLASS_FIELD: class_name,
+            D.CLASS_PARAMETERS_FIELD: class_parameters,
+            D.DESCRIPTION_FIELD: description,
+        })
+        self._submit(name, type_string, cls, class_parameters, description)
+        return V.HTTP_CREATED, {
+            "result": f"/api/learningOrchestra/v1/model/{tool}/{name}"}
+
+    def update(self, name: str, body: Dict[str, Any], tool: str,
+               ) -> Tuple[int, Dict[str, Any]]:
+        meta = self._validator.existing(name)
+        class_parameters = body.get(
+            CLASS_PARAMETERS_FIELD, meta.get(D.CLASS_PARAMETERS_FIELD)) or {}
+        description = body.get(DESCRIPTION_FIELD, "")
+        cls = self._validator.valid_class(
+            meta[D.MODULE_PATH_FIELD], meta[D.CLASS_FIELD])
+        self._validator.valid_class_parameters(cls, class_parameters)
+        type_string = meta[D.TYPE_FIELD]
+        self._ctx.catalog.update_metadata(
+            name, {D.CLASS_PARAMETERS_FIELD: class_parameters,
+                   D.FINISHED_FIELD: False})
+        self._submit(name, type_string, cls, class_parameters, description)
+        return V.HTTP_SUCCESS, {
+            "result": f"/api/learningOrchestra/v1/model/{tool}/{name}"}
+
+    def delete(self, name: str, tool: str) -> Tuple[int, Dict[str, Any]]:
+        meta = self._validator.existing(name)
+        self._ctx.catalog.delete_collection(name)
+        self._ctx.artifacts.delete(name, meta.get(D.TYPE_FIELD))
+        return V.HTTP_SUCCESS, {"result": f"deleted model {name}"}
+
+    # ------------------------------------------------------------------
+    def _submit(self, name: str, type_string: str, cls,
+                class_parameters: Dict[str, Any], description: str) -> None:
+        def run():
+            treated = self._ctx.params.treat(class_parameters)
+            instance = cls(**treated)
+            self._ctx.artifacts.save(instance, name, type_string)
+            return instance
+
+        self._ctx.jobs.submit(
+            name, run, description=description,
+            parameters=class_parameters,
+            needs_mesh=type_string.endswith(("/tensorflow", "/jax")))

@@ -1,0 +1,367 @@
+"""The REST control plane: one server, the reference's full URI
+contract.
+
+Replaces KrakenD:80 + 9 Flask microservices (reference
+krakend.json:1-1773, SURVEY §L1-L2) with a single threaded stdlib HTTP
+server. Route table (all under ``/api/learningOrchestra/v1``):
+
+====== ================================== ==============================
+verb   path                               handler
+====== ================================== ==============================
+POST   /dataset/{csv,generic}             DatasetService.create
+POST   /model/{tensorflow,scikitlearn,jax} ModelService.create
+POST   /{train,tune,evaluate,predict}/{tool} ExecutionService.create
+POST   /explore/histogram                 HistogramService.create
+POST   /explore/{tool}                    DatabaseExecutorService.create
+POST   /transform/projection              ProjectionService.create
+POST   /transform/dataType                DataTypeService.create
+POST   /transform/{tool}                  DatabaseExecutorService.create
+POST   /function/python                   FunctionService.create
+POST   /builder/sparkml                   BuilderService.create
+PATCH  /{service}/{tool}/{name}           per-service ``update``
+GET    /{service}/{tool}                  catalog listing by type
+GET    /{service}/{tool}/{name}           universal paged read
+                                          (?skip&limit&query, images
+                                          for explore plots)
+DELETE /{service}/{tool}/{name}           per-service ``delete``
+GET    /observe/{name}?seq=N              long-poll change feed
+GET    /health                            liveness + device info
+====== ================================== ==============================
+
+Semantics preserved: POST validates synchronously (406/409/404), then
+returns **201 with the artifact's future GET URI while the job runs
+async**; clients poll ``finished`` in the metadata (reference
+server.py:65-71 in every image). The Observe service — client-side
+Mongo change streams in the reference (README.md:81) — is served here
+directly from the catalog's change feed as long-poll JSON.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Dict, Optional, Tuple
+from urllib.parse import parse_qs, urlparse
+
+from learningorchestra_tpu.catalog import documents as D
+from learningorchestra_tpu.services import validators as V
+from learningorchestra_tpu.services.builder_service import BuilderService
+from learningorchestra_tpu.services.columnar import (DataTypeService,
+                                                     HistogramService,
+                                                     ProjectionService)
+from learningorchestra_tpu.services.context import ServiceContext
+from learningorchestra_tpu.services.database_executor import (
+    DatabaseExecutorService)
+from learningorchestra_tpu.services.dataset import (DatasetService,
+                                                    parse_query_param)
+from learningorchestra_tpu.services.execution import ExecutionService
+from learningorchestra_tpu.services.function_service import FunctionService
+from learningorchestra_tpu.services.model_service import ModelService
+
+EXECUTION_VERBS = ("train", "tune", "evaluate", "predict")
+SERVICES = ("dataset", "model", "transform", "explore", "tune", "train",
+            "evaluate", "predict", "builder", "function")
+
+
+class Api:
+    """Transport-independent dispatch (unit-testable without sockets)."""
+
+    def __init__(self, context: Optional[ServiceContext] = None):
+        self.ctx = context or ServiceContext()
+        self.dataset = DatasetService(self.ctx)
+        self.model = ModelService(self.ctx)
+        self.execution = ExecutionService(self.ctx)
+        self.dbexec = DatabaseExecutorService(self.ctx)
+        self.function = FunctionService(self.ctx)
+        self.histogram = HistogramService(self.ctx)
+        self.projection = ProjectionService(self.ctx)
+        self.datatype = DataTypeService(self.ctx)
+        self.builder = BuilderService(self.ctx)
+
+    # ------------------------------------------------------------------
+    def dispatch(self, method: str, path: str, params: Dict[str, Any],
+                 body: Optional[Dict[str, Any]],
+                 ) -> Tuple[int, Any, str]:
+        """Returns (status, payload, content_type). payload is a dict
+        (JSON) or raw bytes when content_type is not JSON."""
+        try:
+            return self._route(method, path, params, body)
+        except V.HttpError as e:
+            return e.status, {"result": e.message}, "application/json"
+        except Exception as e:  # noqa: BLE001
+            return 500, {"result": f"internal error: {e!r}"}, \
+                "application/json"
+
+    # ------------------------------------------------------------------
+    def _route(self, method: str, path: str, params: Dict[str, Any],
+               body: Optional[Dict[str, Any]],
+               ) -> Tuple[int, Any, str]:
+        prefix = self.ctx.config.api_prefix
+        if path == "/health":
+            return 200, self._health(), "application/json"
+        if not path.startswith(prefix + "/"):
+            return 404, {"result": "unknown route"}, "application/json"
+        parts = [p for p in path[len(prefix):].split("/") if p]
+        if parts and parts[0] == "observe":
+            return self._observe(parts, params)
+        if len(parts) < 2 or parts[0] not in SERVICES:
+            return 404, {"result": "unknown route"}, "application/json"
+        service, tool = parts[0], parts[1]
+        name = "/".join(parts[2:]) if len(parts) > 2 else None
+
+        if method == "GET":
+            return self._get(service, tool, name, params)
+        if method == "POST":
+            if name is not None:
+                raise V.HttpError(V.HTTP_NOT_ACCEPTABLE,
+                                  "POST takes no name in the path")
+            return self._post(service, tool, body or {})
+        if method == "PATCH":
+            if name is None:
+                raise V.HttpError(V.HTTP_NOT_ACCEPTABLE, "missing name")
+            return self._patch(service, tool, name, body or {})
+        if method == "DELETE":
+            if name is None:
+                raise V.HttpError(V.HTTP_NOT_ACCEPTABLE, "missing name")
+            return self._delete(service, tool, name)
+        return 405, {"result": "unsupported method"}, "application/json"
+
+    # ------------------------------------------------------------------
+    def _health(self) -> Dict[str, Any]:
+        info: Dict[str, Any] = {"status": "ok",
+                                "jobsRunning": self.ctx.jobs.running()}
+        try:
+            import jax
+
+            devices = jax.devices()
+            info["deviceCount"] = len(devices)
+            info["devicePlatform"] = devices[0].platform
+        except Exception as e:  # noqa: BLE001
+            info["deviceError"] = repr(e)
+        return info
+
+    def _post(self, service: str, tool: str, body: Dict[str, Any],
+              ) -> Tuple[int, Any, str]:
+        if service == "dataset":
+            status, payload = self.dataset.create(body, tool)
+        elif service == "model":
+            status, payload = self.model.create(body, tool)
+        elif service in EXECUTION_VERBS:
+            status, payload = self.execution.create(body, service, tool)
+        elif service == "explore" and tool == "histogram":
+            status, payload = self.histogram.create(body, tool)
+        elif service == "explore":
+            status, payload = self.dbexec.create(body, service, tool)
+        elif service == "transform" and tool == "projection":
+            status, payload = self.projection.create(body, tool)
+        elif service == "transform" and tool == "dataType":
+            status, payload = self.datatype.create(body, tool)
+        elif service == "transform":
+            status, payload = self.dbexec.create(body, service, tool)
+        elif service == "function":
+            status, payload = self.function.create(body, tool)
+        elif service == "builder":
+            status, payload = self.builder.create(body, tool)
+        else:
+            raise V.HttpError(404, "unknown route")
+        return status, payload, "application/json"
+
+    def _patch(self, service: str, tool: str, name: str,
+               body: Dict[str, Any]) -> Tuple[int, Any, str]:
+        if service == "model":
+            status, payload = self.model.update(name, body, tool)
+        elif service in EXECUTION_VERBS:
+            status, payload = self.execution.update(name, body, service,
+                                                    tool)
+        elif service in ("explore", "transform"):
+            status, payload = self.dbexec.update(name, body, service, tool)
+        elif service == "function":
+            status, payload = self.function.update(name, body, tool)
+        else:
+            raise V.HttpError(V.HTTP_NOT_ACCEPTABLE,
+                              f"PATCH unsupported for {service}")
+        return status, payload, "application/json"
+
+    def _delete(self, service: str, tool: str, name: str,
+                ) -> Tuple[int, Any, str]:
+        if service == "dataset":
+            status, payload = self.dataset.delete_file(name)
+        elif service == "model":
+            status, payload = self.model.delete(name, tool)
+        elif service in EXECUTION_VERBS:
+            status, payload = self.execution.delete(name, service, tool)
+        elif service in ("explore", "transform", "function", "builder"):
+            status, payload = self.dataset.delete_file(name)
+        else:
+            raise V.HttpError(404, "unknown route")
+        return status, payload, "application/json"
+
+    def _get(self, service: str, tool: str, name: Optional[str],
+             params: Dict[str, Any]) -> Tuple[int, Any, str]:
+        if name is None:
+            # listing: every collection of this type (reference routes
+            # list GETs to the dataset reader with ?type=,
+            # krakend.json:722-757)
+            type_string = D.normalize_type(f"{service}/{tool}")
+            return 200, {"result": self.ctx.catalog.list_collections(
+                type_string)}, "application/json"
+        # explore plots are PNGs (reference send_file image/png,
+        # database_executor server.py:151-166); paged/queried GETs
+        # still read the JSON documents so status polling works
+        has_paging = any(k in params for k in ("skip", "limit", "query"))
+        if service == "explore" and tool != "histogram" and not has_paging:
+            meta = self.ctx.catalog.get_metadata(name)
+            if meta is not None and str(
+                    meta.get(D.TYPE_FIELD, "")).startswith("explore/"):
+                try:
+                    png, content_type = self.dbexec.image_response(name)
+                    return 200, png, content_type
+                except Exception:  # noqa: BLE001 - fall through to JSON
+                    pass
+        skip = int(params.get("skip", 0) or 0)
+        limit = params.get("limit")
+        limit = int(limit) if limit not in (None, "") else None
+        query = parse_query_param(params.get("query"))
+        status, payload = self.dataset.read_file(
+            name, skip=skip, limit=limit, query=query)
+        return status, payload, "application/json"
+
+    # ------------------------------------------------------------------
+    def _observe(self, parts, params) -> Tuple[int, Any, str]:
+        """``GET /observe/{name}?seq=N&timeout=S``: block until the
+        collection changes past sequence N, then return the new changes
+        + current metadata (the reference's Observe service is a
+        client-side Mongo change stream; README.md:81)."""
+        if len(parts) < 2:
+            return 200, {"result": {"seq": self.ctx.catalog.latest_seq()}}, \
+                "application/json"
+        name = parts[1]
+        seq = int(params.get("seq", 0) or 0)
+        timeout = min(float(params.get("timeout", 25) or 25), 120.0)
+        changes = self.ctx.catalog.watch(seq, collection=name,
+                                         timeout=timeout)
+        return 200, {"result": {
+            "changes": changes,
+            "seq": self.ctx.catalog.latest_seq(),
+            "metadata": self.ctx.catalog.get_metadata(name),
+        }}, "application/json"
+
+
+# ----------------------------------------------------------------------
+class _Handler(BaseHTTPRequestHandler):
+    api: Api = None  # set by make_server
+    protocol_version = "HTTP/1.1"
+
+    # quiet the default stderr-per-request logging
+    def log_message(self, format, *args):  # noqa: A002
+        pass
+
+    def _read_body(self) -> Optional[Dict[str, Any]]:
+        length = int(self.headers.get("Content-Length") or 0)
+        if not length:
+            return None
+        raw = self.rfile.read(length)
+        try:
+            body = json.loads(raw)
+        except json.JSONDecodeError:
+            return None
+        return body if isinstance(body, dict) else None
+
+    def _respond(self, method: str) -> None:
+        parsed = urlparse(self.path)
+        params = {k: v[0] for k, v in parse_qs(parsed.query).items()}
+        body = self._read_body() if method in ("POST", "PATCH") else None
+        status, payload, content_type = self.api.dispatch(
+            method, parsed.path, params, body)
+        if isinstance(payload, (bytes, bytearray)):
+            data = bytes(payload)
+        else:
+            data = json.dumps(payload).encode()
+            content_type = "application/json"
+        self.send_response(status)
+        self.send_header("Content-Type", content_type)
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+    def do_GET(self):  # noqa: N802
+        self._respond("GET")
+
+    def do_POST(self):  # noqa: N802
+        self._respond("POST")
+
+    def do_PATCH(self):  # noqa: N802
+        self._respond("PATCH")
+
+    def do_DELETE(self):  # noqa: N802
+        self._respond("DELETE")
+
+
+class RestServer:
+    """Owns the HTTP server + its ServiceContext."""
+
+    def __init__(self, context: Optional[ServiceContext] = None,
+                 host: Optional[str] = None, port: Optional[int] = None):
+        self.api = Api(context)
+        cfg = self.api.ctx.config
+        handler = type("BoundHandler", (_Handler,), {"api": self.api})
+        self.httpd = ThreadingHTTPServer(
+            (host or cfg.host, cfg.port if port is None else port), handler)
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def address(self) -> Tuple[str, int]:
+        return self.httpd.server_address[:2]
+
+    @property
+    def base_url(self) -> str:
+        host, port = self.address
+        return f"http://{host}:{port}"
+
+    def start(self) -> "RestServer":
+        self._thread = threading.Thread(
+            target=self.httpd.serve_forever, daemon=True, name="lo-rest")
+        self._thread.start()
+        return self
+
+    def serve_forever(self) -> None:
+        self.httpd.serve_forever()
+
+    def stop(self) -> None:
+        self.httpd.shutdown()
+        self.httpd.server_close()
+        self.api.ctx.close()
+
+
+def main(argv=None) -> None:
+    import argparse
+
+    from learningorchestra_tpu.config import Config, get_config, set_config
+
+    parser = argparse.ArgumentParser(
+        description="learningOrchestra-TPU REST server")
+    parser.add_argument("--host", default=None)
+    parser.add_argument("--port", type=int, default=None)
+    parser.add_argument("--home", default=None,
+                        help="storage root (default LO_HOME or ./.lo_store)")
+    parser.add_argument("--config", default=None,
+                        help="JSON config file")
+    args = parser.parse_args(argv)
+    if args.config:
+        set_config(Config.from_file(args.config))
+    if args.home:
+        set_config(get_config().replace(home=args.home))
+    server = RestServer(host=args.host, port=args.port)
+    host, port = server.address
+    print(f"learningOrchestra-TPU REST on http://{host}:{port}"
+          f"{get_config().api_prefix}", flush=True)
+    try:
+        server.serve_forever()
+    except KeyboardInterrupt:
+        server.stop()
+
+
+if __name__ == "__main__":
+    main()

@@ -146,7 +146,8 @@ def test_paging_past_first_part(catalog):
         w.write_batch({"x": list(range(50, 100))})
     rows = catalog.read_rows("ds", skip=60, limit=5)
     assert [r["x"] for r in rows] == [60, 61, 62, 63, 64]
-    assert catalog.read_rows("ds", limit=0) == []
+    # limit=0 is unlimited (pymongo cursor.limit(0) parity)
+    assert len(catalog.read_rows("ds", limit=0)) == 100
 
 
 def test_append_document_missing_collection(catalog):
@@ -159,7 +160,8 @@ def test_append_adopts_existing_schema(catalog):
     catalog.create_collection("ds", "dataset/csv")
     catalog.write_dataframe("ds", pd.DataFrame({"a": [1], "b": [2.0]}))
     # second append: different column order + int b — must reconcile
-    catalog.write_dataframe("ds", pd.DataFrame({"b": [3], "a": [4]}))
+    catalog.write_dataframe("ds", pd.DataFrame({"b": [3], "a": [4]}),
+                            replace=False)
     df = catalog.read_dataframe("ds")
     assert df["a"].tolist() == [1, 4]
     assert df["b"].tolist() == [2.0, 3.0]

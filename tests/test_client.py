@@ -1,0 +1,80 @@
+"""Client-library tests: drive a live server through
+learningorchestra_tpu.client.Context (parity with the external
+learning-orchestra-client package, reference README.md:92-103)."""
+
+import csv
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture()
+def server(tmp_config):
+    from learningorchestra_tpu.services.server import RestServer
+
+    srv = RestServer(host="127.0.0.1", port=0).start()
+    yield srv
+    srv.stop()
+
+
+@pytest.fixture()
+def client(server):
+    from learningorchestra_tpu.client import Context
+
+    return Context(server.base_url)
+
+
+@pytest.fixture()
+def small_csv(tmp_path):
+    rng = np.random.default_rng(3)
+    path = tmp_path / "d.csv"
+    with open(path, "w", newline="") as f:
+        w = csv.writer(f)
+        w.writerow(["a", "b", "label"])
+        for _ in range(60):
+            a, b = rng.normal(size=2)
+            w.writerow([round(a, 3), round(b, 3), int(a + b > 0)])
+    return path
+
+
+def test_client_end_to_end(client, small_csv):
+    client.dataset_csv.insert("d", str(small_csv))
+    meta = client.wait("d", timeout=60)  # observe-driven wait
+    assert meta["rows"] == 60
+
+    client.function_python.run_function(
+        "fx",
+        "x = d[['a','b']].to_numpy()\n"
+        "y = d['label'].to_numpy('int64')\n"
+        "response = {'x': x, 'y': y}\n",
+        parameters={"d": "$d"})
+    client.function_python.wait("fx", timeout=60)
+
+    client.model_scikitlearn.create(
+        "m", "sklearn.linear_model", "LogisticRegression",
+        {"max_iter": 300})
+    client.model_scikitlearn.wait("m", timeout=60)
+
+    client.train_scikitlearn.run(
+        "mt", "m", "fit", {"X": "$fx.x", "y": "$fx.y"})
+    client.train_scikitlearn.wait("mt", timeout=60)
+
+    client.evaluate_scikitlearn.run(
+        "me", "mt", "score", {"X": "$fx.x", "y": "$fx.y"})
+    client.evaluate_scikitlearn.wait("me", timeout=60)
+    body = client.evaluate_scikitlearn.read("me")
+    scores = [d["result"] for d in body["result"] if "result" in d]
+    assert scores and scores[0] > 0.8
+
+    assert any(m["name"] == "d" for m in client.dataset_csv.search())
+    client.predict_scikitlearn.run("mp", "mt", "predict", {"X": "$fx.x"})
+    client.predict_scikitlearn.wait("mp", timeout=60)
+    client.predict_scikitlearn.delete("mp")
+
+    from learningorchestra_tpu.client import ApiError
+    with pytest.raises(ApiError) as e:
+        client.dataset_csv.insert("d", str(small_csv))
+    assert e.value.status == 409
+
+    health = client.health()
+    assert health["status"] == "ok"

@@ -1,0 +1,430 @@
+"""Executor-layer tests: every service family end-to-end in-process.
+
+Covers the reference's service inventory (SURVEY §2.1): dataset ingest,
+model creation, train/evaluate/predict lineage, explore/transform,
+function, histogram, projection, dataType, builder — all against a
+tmp-dir catalog, no server.
+"""
+
+import csv
+import os
+import time
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture()
+def ctx(tmp_config):
+    from learningorchestra_tpu.services.context import ServiceContext
+
+    context = ServiceContext(tmp_config)
+    yield context
+    context.close()
+
+
+def _write_csv(path, header, rows):
+    with open(path, "w", newline="") as f:
+        w = csv.writer(f)
+        w.writerow(header)
+        w.writerows(rows)
+    return path
+
+
+@pytest.fixture()
+def iris_csv(tmp_path):
+    """Small linearly-separable 2-class dataset."""
+    rng = np.random.default_rng(0)
+    rows = []
+    for i in range(120):
+        label = i % 2
+        base = 1.0 if label else -1.0
+        rows.append([round(base + rng.normal(0, 0.3), 4),
+                     round(base + rng.normal(0, 0.3), 4),
+                     label])
+    return _write_csv(tmp_path / "iris.csv", ["f1", "f2", "label"], rows)
+
+
+def _wait(ctx, name, timeout=60):
+    ctx.jobs.wait(name, timeout=timeout)
+    meta = ctx.catalog.get_metadata(name)
+    assert meta is not None, name
+    if not meta.get("finished"):
+        docs = ctx.catalog.get_documents(name)
+        raise AssertionError(f"job {name} not finished: {docs}")
+    return meta
+
+
+# ----------------------------------------------------------------- dataset
+def test_dataset_csv_ingest(ctx, iris_csv):
+    from learningorchestra_tpu.services.dataset import DatasetService
+
+    ds = DatasetService(ctx)
+    status, body = ds.create(
+        {"datasetName": "iris", "datasetURI": str(iris_csv)}, "csv")
+    assert status == 201 and "iris" in body["result"]
+    meta = _wait(ctx, "iris")
+    assert meta["fields"] == ["f1", "f2", "label"]
+    assert meta["rows"] == 120
+    status, body = ds.read_file("iris", skip=2, limit=3)
+    assert status == 200 and len(body["result"]) == 3
+    # paged sequence is metadata(_id 0), rows(_id 1..N), exec docs --
+    # skip=2 lands on row _id 2 (reference find(skip) semantics)
+    assert body["result"][0]["_id"] == 2
+    # duplicate name -> 409
+    from learningorchestra_tpu.services.validators import HttpError
+    with pytest.raises(HttpError) as e:
+        ds.create({"datasetName": "iris", "datasetURI": str(iris_csv)},
+                  "csv")
+    assert e.value.status == 409
+
+
+def test_dataset_generic_and_delete(ctx, tmp_path):
+    from learningorchestra_tpu.services.dataset import DatasetService
+
+    payload = tmp_path / "blob.bin"
+    payload.write_bytes(b"\x00\x01hello")
+    ds = DatasetService(ctx)
+    status, _ = ds.create(
+        {"datasetName": "blob", "datasetURI": f"file://{payload}"},
+        "generic")
+    assert status == 201
+    _wait(ctx, "blob")
+    assert ctx.artifacts.load("blob", "dataset/generic") == b"\x00\x01hello"
+    status, _ = ds.delete_file("blob")
+    assert status == 200
+    assert ctx.catalog.get_metadata("blob") is None
+
+
+# ------------------------------------------------------------- model/train
+def _ingest(ctx, iris_csv, name="iris"):
+    from learningorchestra_tpu.services.dataset import DatasetService
+
+    DatasetService(ctx).create(
+        {"datasetName": name, "datasetURI": str(iris_csv)}, "csv")
+    _wait(ctx, name)
+
+
+def test_failed_job_records_exception(ctx, iris_csv):
+    """A failing method call leaves finished=False and an exception
+    execution document (reference binary_execution.py:160-175)."""
+    from learningorchestra_tpu.services.execution import ExecutionService
+    from learningorchestra_tpu.services.model_service import ModelService
+
+    _ingest(ctx, iris_csv)
+    ms = ModelService(ctx)
+    status, _ = ms.create({
+        "modelName": "logreg",
+        "modulePath": "sklearn.linear_model",
+        "class": "LogisticRegression",
+        "classParameters": {"max_iter": 200},
+    }, "scikitlearn")
+    assert status == 201
+    _wait(ctx, "logreg")
+
+    ex = ExecutionService(ctx)
+    status, _ = ex.create({
+        "name": "trained",
+        "modelName": "logreg",
+        "method": "fit",
+        "methodParameters": {"X": "$iris.features", "y": "$iris.label"},
+    }, "train", "scikitlearn")
+    assert status == 201
+    # "$iris.features" indexes into a DataFrame artifact -- not a dict;
+    # the job must fail and record it
+    ctx.jobs.wait("trained", timeout=60)
+    meta = ctx.catalog.get_metadata("trained")
+    assert meta["finished"] is False
+    docs = ctx.catalog.get_documents("trained")
+    assert any(d.get("exception") for d in docs)
+
+
+def test_sklearn_full_lineage(ctx, iris_csv):
+    """Dataset -> model -> train -> evaluate -> predict, the reference's
+    north-star call stack (SURVEY §3.3) on the sklearn tool."""
+    from learningorchestra_tpu.services.execution import ExecutionService
+    from learningorchestra_tpu.services.model_service import ModelService
+
+    _ingest(ctx, iris_csv)
+    ModelService(ctx).create({
+        "modelName": "m1",
+        "modulePath": "sklearn.linear_model",
+        "class": "LogisticRegression",
+        "classParameters": {"max_iter": 500},
+    }, "scikitlearn")
+    _wait(ctx, "m1")
+
+    # stage the split arrays as function-produced artifacts
+    # (mirrors the reference's tfds-tuple flow, utils.py:328-332)
+    df = ctx.catalog.read_dataframe("iris")
+    x = df[["f1", "f2"]].to_numpy()
+    y = df["label"].to_numpy()
+    ctx.artifacts.save(x, "iris_x", "function/python")
+    ctx.catalog.create_collection("iris_x", "function/python")
+    ctx.catalog.mark_finished("iris_x")
+    ctx.artifacts.save(y, "iris_y", "function/python")
+    ctx.catalog.create_collection("iris_y", "function/python")
+    ctx.catalog.mark_finished("iris_y")
+
+    ex = ExecutionService(ctx)
+    ex.create({
+        "name": "t1", "modelName": "m1", "method": "fit",
+        "methodParameters": {"X": "$iris_x", "y": "$iris_y"},
+    }, "train", "scikitlearn")
+    _wait(ctx, "t1")
+    trained = ctx.artifacts.load("t1", "train/scikitlearn")
+    assert hasattr(trained, "coef_")
+
+    ex.create({
+        "name": "s1", "modelName": "t1", "method": "score",
+        "methodParameters": {"X": "$iris_x", "y": "$iris_y"},
+    }, "evaluate", "scikitlearn")
+    _wait(ctx, "s1")
+    score = ctx.artifacts.load("s1", "evaluate/scikitlearn")
+    assert score > 0.9
+    # result surfaced in documents for the universal GET
+    docs = ctx.catalog.get_documents("s1")
+    assert any("result" in d for d in docs)
+
+    ex.create({
+        "name": "p1", "modelName": "t1", "method": "predict",
+        "methodParameters": {"X": "$iris_x"},
+    }, "predict", "scikitlearn")
+    _wait(ctx, "p1")
+    preds = ctx.artifacts.load("p1", "predict/scikitlearn")
+    assert len(preds) == 120
+
+
+def test_keras_shim_model_lineage(ctx, iris_csv):
+    """model/tensorflow -> train/tensorflow through the JAX-backed shim
+    (the reference's MNIST-CNN flow shape, BASELINE config 2)."""
+    from learningorchestra_tpu.services.execution import ExecutionService
+    from learningorchestra_tpu.services.model_service import ModelService
+
+    _ingest(ctx, iris_csv)
+    df = ctx.catalog.read_dataframe("iris")
+    ctx.artifacts.save(df[["f1", "f2"]].to_numpy().astype("float32"),
+                       "ix", "function/python")
+    ctx.catalog.create_collection("ix", "function/python")
+    ctx.catalog.mark_finished("ix")
+    ctx.artifacts.save(df["label"].to_numpy().astype("int32"),
+                       "iy", "function/python")
+    ctx.catalog.create_collection("iy", "function/python")
+    ctx.catalog.mark_finished("iy")
+
+    ModelService(ctx).create({
+        "modelName": "net",
+        "modulePath": "tensorflow.keras.models",
+        "class": "Sequential",
+        "classParameters": {"layers": [
+            "#tensorflow.keras.layers.Dense(16, activation='relu')",
+            "#tensorflow.keras.layers.Dense(2, activation='softmax')",
+        ]},
+    }, "tensorflow")
+    _wait(ctx, "net")
+
+    ex = ExecutionService(ctx)
+    ex.create({
+        "name": "net_c", "modelName": "net", "method": "compile",
+        "methodParameters": {
+            "optimizer": "#tensorflow.keras.optimizers.Adam(0.05)",
+            "loss": "sparse_categorical_crossentropy",
+            "metrics": ["accuracy"]},
+    }, "train", "tensorflow")
+    _wait(ctx, "net_c")
+
+    ex.create({
+        "name": "net_t", "modelName": "net_c", "method": "fit",
+        "methodParameters": {"x": "$ix", "y": "$iy", "epochs": 8,
+                             "batch_size": 32},
+    }, "train", "tensorflow")
+    _wait(ctx, "net_t")
+
+    ex.create({
+        "name": "net_e", "modelName": "net_t", "method": "evaluate",
+        "methodParameters": {"x": "$ix", "y": "$iy"},
+    }, "evaluate", "tensorflow")
+    _wait(ctx, "net_e")
+    result = ctx.artifacts.load("net_e", "evaluate/tensorflow")
+    assert result["accuracy"] > 0.85
+
+
+# -------------------------------------------------------- explore/transform
+def test_transform_and_explore(ctx, iris_csv):
+    from learningorchestra_tpu.services.database_executor import (
+        DatabaseExecutorService)
+
+    _ingest(ctx, iris_csv)
+    # stage numeric-only feature matrix for the transform
+    df = ctx.catalog.read_dataframe("iris")
+    ctx.artifacts.save(df[["f1", "f2"]].to_numpy(), "proj_iris",
+                       "function/python")
+    ctx.catalog.create_collection("proj_iris", "function/python")
+    ctx.catalog.mark_finished("proj_iris")
+    svc = DatabaseExecutorService(ctx)
+    status, _ = svc.create({
+        "name": "scaled",
+        "modulePath": "sklearn.preprocessing",
+        "class": "StandardScaler",
+        "classParameters": {},
+        "method": "fit_transform",
+        "methodParameters": {"X": "$proj_iris"},
+    }, "transform", "scikitlearn")
+    assert status == 201
+    _wait(ctx, "scaled")
+    arr = ctx.artifacts.load("scaled", "transform/scikitlearn")
+    assert abs(float(np.mean(arr))) < 1e-6
+
+    status, _ = svc.create({
+        "name": "pca_plot",
+        "modulePath": "sklearn.decomposition",
+        "class": "PCA",
+        "classParameters": {"n_components": 2},
+        "method": "fit_transform",
+        "methodParameters": {"X": "$proj_iris"},
+    }, "explore", "scikitlearn")
+    _wait(ctx, "pca_plot")
+    png, content_type = svc.image_response("pca_plot")
+    assert content_type == "image/png"
+    assert png[:8] == b"\x89PNG\r\n\x1a\n"
+
+
+# ----------------------------------------------------------------- function
+def test_function_service(ctx, iris_csv):
+    from learningorchestra_tpu.services.function_service import (
+        FunctionService)
+
+    _ingest(ctx, iris_csv)
+    fs = FunctionService(ctx)
+    code = (
+        "print('rows', len(iris))\n"
+        "import numpy as np\n"
+        "x = iris[['f1','f2']].to_numpy(dtype='float32')\n"
+        "y = iris['label'].to_numpy(dtype='int32')\n"
+        "response = {'x': x, 'y': y}\n"
+    )
+    status, _ = fs.create({
+        "name": "split",
+        "function": code,
+        "functionParameters": {"iris": "$iris"},
+    })
+    assert status == 201
+    _wait(ctx, "split")
+    stored = ctx.artifacts.load("split", "function/python")
+    assert stored["x"].shape == (120, 2)
+    docs = ctx.catalog.get_documents("split")
+    assert any("rows 120" in (d.get("functionMessage") or "")
+               for d in docs)
+    # $split.x indexing (the reference's $name.X DSL)
+    resolved = ctx.params.resolve_value("$split.x")
+    assert resolved.shape == (120, 2)
+
+
+def test_function_sandbox_blocks_os(ctx):
+    from learningorchestra_tpu.services.function_service import (
+        FunctionService)
+
+    fs = FunctionService(ctx)
+    fs.create({"name": "evil",
+               "function": "import os\nresponse = os.listdir('/')",
+               "functionParameters": {}})
+    ctx.jobs.wait("evil", timeout=30)
+    meta = ctx.catalog.get_metadata("evil")
+    assert meta["finished"] is False
+    docs = ctx.catalog.get_documents("evil")
+    assert any("ImportError" in (d.get("exception") or "") for d in docs)
+
+
+# ------------------------------------------------- histogram/projection/dt
+def test_histogram(ctx, iris_csv):
+    from learningorchestra_tpu.services.columnar import HistogramService
+
+    _ingest(ctx, iris_csv)
+    hs = HistogramService(ctx)
+    status, _ = hs.create({
+        "inputDatasetName": "iris", "outputDatasetName": "iris_hist",
+        "names": ["label"]})
+    assert status == 201
+    _wait(ctx, "iris_hist")
+    docs = ctx.catalog.get_documents("iris_hist")
+    hist_doc = next(d for d in docs if "label" in d)
+    counts = {b["_id"]: b["count"] for b in hist_doc["label"]}
+    assert counts == {0: 60, 1: 60}
+
+
+def test_projection(ctx, iris_csv):
+    from learningorchestra_tpu.services.columnar import ProjectionService
+
+    _ingest(ctx, iris_csv)
+    ps = ProjectionService(ctx)
+    status, _ = ps.create({
+        "inputDatasetName": "iris", "outputDatasetName": "iris_f1",
+        "names": ["f1"]})
+    assert status == 201
+    meta = _wait(ctx, "iris_f1")
+    assert meta["fields"] == ["f1"]
+    rows = ctx.catalog.read_rows("iris_f1", limit=2)
+    assert set(rows[0].keys()) == {"f1", "_id"}
+    # unknown field -> 406
+    from learningorchestra_tpu.services.validators import HttpError
+    with pytest.raises(HttpError) as e:
+        ps.create({"inputDatasetName": "iris",
+                   "outputDatasetName": "bad", "names": ["nope"]})
+    assert e.value.status == 406
+
+
+def test_datatype(ctx, tmp_path):
+    from learningorchestra_tpu.services.columnar import DataTypeService
+
+    _ingest(ctx, _write_csv(
+        tmp_path / "mix.csv", ["a", "b"],
+        [["1", "x"], ["2", "y"], ["", "z"]]), name="mix")
+    # pyarrow infers a as int64 already (with null); force to string
+    dts = DataTypeService(ctx)
+    status, _ = dts.create({"datasetName": "mix",
+                            "types": {"a": "string"}})
+    assert status == 200
+    _wait(ctx, "mix")
+    rows = ctx.catalog.read_rows("mix")
+    assert all(isinstance(r["a"], str) for r in rows)
+    # and back to number: "" -> None, ints stay ints
+    dts.create({"datasetName": "mix", "types": {"a": "number"}})
+    _wait(ctx, "mix")
+    rows = ctx.catalog.read_rows("mix")
+    values = [r["a"] for r in rows]
+    assert values[0] == 1 and values[1] == 2
+    assert values[2] is None
+
+
+# ------------------------------------------------------------------ builder
+def test_builder_pipeline(ctx, iris_csv, tmp_path):
+    from learningorchestra_tpu.services.builder_service import BuilderService
+
+    _ingest(ctx, iris_csv, name="tr")
+    _ingest(ctx, iris_csv, name="te")
+    bs = BuilderService(ctx)
+    code = (
+        "features_training = (training_df[['f1','f2']].to_numpy(),"
+        " training_df['label'].to_numpy())\n"
+        "features_evaluation = features_training\n"
+        "features_testing = testing_df[['f1','f2']].to_numpy()\n"
+    )
+    status, body = bs.create({
+        "trainDatasetName": "tr", "testDatasetName": "te",
+        "modelingCode": code, "classifiersList": ["LR", "DT", "NB"]})
+    assert status == 201
+    assert len(body["result"]) == 3
+    ctx.jobs.wait("teLR", timeout=120)
+    for c in ("LR", "DT", "NB"):
+        meta = ctx.catalog.get_metadata(f"te{c}")
+        assert meta["finished"], c
+        assert meta["accuracy"] > 0.8
+        assert meta["fitTime"] > 0
+        rows = ctx.catalog.read_rows(f"te{c}", limit=3)
+        assert "prediction" in rows[0]
+    # invalid classifier name -> 406
+    from learningorchestra_tpu.services.validators import HttpError
+    with pytest.raises(HttpError) as e:
+        bs.create({"trainDatasetName": "tr", "testDatasetName": "te",
+                   "modelingCode": code, "classifiersList": ["XX"]})
+    assert e.value.status == 406

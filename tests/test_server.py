@@ -1,0 +1,289 @@
+"""REST API tests over real HTTP: the reference's URI contract,
+async-201 + finished-poll, universal reads, observe long-poll.
+
+(Test strategy per SURVEY §4: golden end-to-end pipeline tests against
+the REST API with a live server.)
+"""
+
+import csv
+import json
+import time
+import urllib.request
+import urllib.error
+
+import numpy as np
+import pytest
+
+API = "/api/learningOrchestra/v1"
+
+
+@pytest.fixture()
+def server(tmp_config):
+    from learningorchestra_tpu.services.server import RestServer
+
+    srv = RestServer(host="127.0.0.1", port=0).start()
+    yield srv
+    srv.stop()
+
+
+def _call(server, method, path, body=None, params=""):
+    url = f"{server.base_url}{path}{params}"
+    data = json.dumps(body).encode() if body is not None else None
+    req = urllib.request.Request(url, data=data, method=method)
+    if data:
+        req.add_header("Content-Type", "application/json")
+    try:
+        with urllib.request.urlopen(req, timeout=120) as resp:
+            raw = resp.read()
+            ctype = resp.headers.get("Content-Type", "")
+            status = resp.status
+    except urllib.error.HTTPError as e:
+        raw = e.read()
+        ctype = e.headers.get("Content-Type", "")
+        status = e.code
+    if "json" in ctype:
+        return status, json.loads(raw)
+    return status, raw
+
+
+def _poll_finished(server, path, timeout=60):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        status, body = _call(server, "GET", path, params="?limit=1")
+        assert status == 200, body
+        meta = body["metadata"]
+        if meta.get("finished"):
+            return meta
+        time.sleep(0.1)
+    raise AssertionError(f"timeout polling {path}")
+
+
+@pytest.fixture()
+def titanic_csv(tmp_path):
+    """Titanic-shaped CSV (the reference's flagship demo pipeline,
+    BASELINE config 1)."""
+    rng = np.random.default_rng(7)
+    rows = []
+    for i in range(200):
+        pclass = int(rng.integers(1, 4))
+        sex = rng.choice(["male", "female"])
+        age = round(float(rng.uniform(1, 70)), 1)
+        fare = round(float(rng.uniform(5, 200)), 2)
+        p = 0.8 if sex == "female" else 0.2
+        survived = int(rng.random() < p)
+        rows.append([i, survived, pclass, sex, age, fare])
+    path = tmp_path / "titanic.csv"
+    with open(path, "w", newline="") as f:
+        w = csv.writer(f)
+        w.writerow(["pid", "survived", "pclass", "sex", "age", "fare"])
+        w.writerows(rows)
+    return path
+
+
+def test_health(server):
+    status, body = _call(server, "GET", "/health")
+    assert status == 200
+    assert body["status"] == "ok"
+    assert body.get("deviceCount", 0) >= 1
+
+
+def test_unknown_route(server):
+    status, body = _call(server, "GET", f"{API}/nonsense/x")
+    assert status == 404
+
+
+def test_dataset_rest_roundtrip(server, titanic_csv):
+    status, body = _call(server, "POST", f"{API}/dataset/csv", {
+        "datasetName": "titanic", "datasetURI": str(titanic_csv)})
+    assert status == 201
+    assert body["result"] == f"{API}/dataset/csv/titanic"
+    meta = _poll_finished(server, body["result"])
+    assert meta["rows"] == 200
+    assert "survived" in meta["fields"]
+
+    # paged + queried reads
+    status, body = _call(server, "GET", f"{API}/dataset/csv/titanic",
+                         params="?skip=1&limit=2")
+    assert status == 200 and len(body["result"]) == 2
+    q = json.dumps({"sex": "female"})
+    status, body = _call(
+        server, "GET", f"{API}/dataset/csv/titanic",
+        params=f"?limit=5&query={urllib.request.quote(q)}")
+    assert all(r["sex"] == "female" for r in body["result"])
+
+    # listing by type
+    status, body = _call(server, "GET", f"{API}/dataset/csv")
+    assert any(m["name"] == "titanic" for m in body["result"])
+
+    # duplicate -> 409
+    status, _ = _call(server, "POST", f"{API}/dataset/csv", {
+        "datasetName": "titanic", "datasetURI": str(titanic_csv)})
+    assert status == 409
+
+
+def test_titanic_pipeline_over_rest(server, titanic_csv):
+    """Dataset -> Function(feature prep) -> Model -> Train -> Evaluate
+    -> Predict, entirely through the REST API (reference north-star
+    call stack, SURVEY §3.3; BASELINE config 1)."""
+    status, body = _call(server, "POST", f"{API}/dataset/csv", {
+        "datasetName": "titanic", "datasetURI": str(titanic_csv)})
+    assert status == 201
+    _poll_finished(server, body["result"])
+
+    prep = (
+        "import numpy as np\n"
+        "df = titanic\n"
+        "x = np.stack([df['pclass'].to_numpy(float),"
+        " (df['sex']=='female').to_numpy(float),"
+        " df['age'].to_numpy(float)/80.0,"
+        " df['fare'].to_numpy(float)/250.0], axis=1)\n"
+        "y = df['survived'].to_numpy('int64')\n"
+        "response = {'x': x, 'y': y}\n"
+    )
+    status, body = _call(server, "POST", f"{API}/function/python", {
+        "name": "prep", "function": prep,
+        "functionParameters": {"titanic": "$titanic"}})
+    assert status == 201
+    _poll_finished(server, body["result"])
+
+    status, body = _call(server, "POST", f"{API}/model/scikitlearn", {
+        "modelName": "lr", "modulePath": "sklearn.linear_model",
+        "class": "LogisticRegression",
+        "classParameters": {"max_iter": 500}})
+    assert status == 201
+    _poll_finished(server, body["result"])
+
+    status, body = _call(server, "POST", f"{API}/train/scikitlearn", {
+        "name": "lr_t", "modelName": "lr", "method": "fit",
+        "methodParameters": {"X": "$prep.x", "y": "$prep.y"}})
+    assert status == 201
+    _poll_finished(server, body["result"])
+
+    status, body = _call(server, "POST", f"{API}/evaluate/scikitlearn", {
+        "name": "lr_e", "modelName": "lr_t", "method": "score",
+        "methodParameters": {"X": "$prep.x", "y": "$prep.y"}})
+    assert status == 201
+    _poll_finished(server, body["result"])
+    status, body = _call(server, "GET", f"{API}/evaluate/scikitlearn/lr_e")
+    results = [d["result"] for d in body["result"] if "result" in d]
+    assert results and results[0] > 0.7
+
+    status, body = _call(server, "POST", f"{API}/predict/scikitlearn", {
+        "name": "lr_p", "modelName": "lr_t", "method": "predict",
+        "methodParameters": {"X": "$prep.x"}})
+    assert status == 201
+    _poll_finished(server, body["result"])
+
+    # PATCH re-run with same parent (reference PATCH semantics)
+    status, body = _call(server, "PATCH", f"{API}/predict/scikitlearn/lr_p",
+                         {"methodParameters": {"X": "$prep.x"}})
+    assert status == 200
+    _poll_finished(server, f"{API}/predict/scikitlearn/lr_p")
+
+    # DELETE
+    status, _ = _call(server, "DELETE", f"{API}/predict/scikitlearn/lr_p")
+    assert status == 200
+    status, _ = _call(server, "GET", f"{API}/predict/scikitlearn/lr_p")
+    assert status == 404
+
+
+def test_transform_explore_histogram_over_rest(server, titanic_csv):
+    status, body = _call(server, "POST", f"{API}/dataset/csv", {
+        "datasetName": "t2", "datasetURI": str(titanic_csv)})
+    _poll_finished(server, body["result"])
+
+    # projection
+    status, body = _call(server, "POST", f"{API}/transform/projection", {
+        "inputDatasetName": "t2", "outputDatasetName": "t2_small",
+        "names": ["age", "fare"]})
+    assert status == 201
+    _poll_finished(server, f"{API}/transform/projection/t2_small")
+
+    # histogram
+    status, body = _call(server, "POST", f"{API}/explore/histogram", {
+        "inputDatasetName": "t2", "outputDatasetName": "t2_hist",
+        "names": ["survived"]})
+    assert status == 201
+    _poll_finished(server, f"{API}/explore/histogram/t2_hist")
+    status, body = _call(server, "GET", f"{API}/explore/histogram/t2_hist")
+    hist = next(d for d in body["result"] if "survived" in d)
+    assert sum(b["count"] for b in hist["survived"]) == 200
+
+    # dataType: survived int -> string
+    status, body = _call(server, "POST", f"{API}/transform/dataType", {
+        "datasetName": "t2_small", "types": {"age": "string"}})
+    assert status == 200
+    _poll_finished(server, f"{API}/transform/dataType/t2_small")
+
+    # explore plot (PNG)
+    status, body = _call(server, "POST", f"{API}/explore/scikitlearn", {
+        "name": "pca2", "modulePath": "sklearn.decomposition",
+        "class": "PCA", "classParameters": {"n_components": 2},
+        "method": "fit_transform",
+        "methodParameters": {"X": "$proj_xy"}})
+    assert status == 201
+    # stage the numeric matrix it needs, then re-run via PATCH
+    # (cheaper than a second function step)
+    ctx = server.api.ctx
+    df = ctx.catalog.read_dataframe("t2", columns=["age", "fare"])
+    ctx.artifacts.save(df.to_numpy(), "proj_xy", "function/python")
+    ctx.catalog.create_collection("proj_xy", "function/python")
+    ctx.catalog.mark_finished("proj_xy")
+    status, _ = _call(server, "PATCH", f"{API}/explore/scikitlearn/pca2",
+                      {})
+    _poll_finished(server, f"{API}/explore/scikitlearn/pca2")
+    status, png = _call(server, "GET", f"{API}/explore/scikitlearn/pca2")
+    assert status == 200 and isinstance(png, bytes)
+    assert png[:8] == b"\x89PNG\r\n\x1a\n"
+
+
+def test_builder_over_rest(server, titanic_csv):
+    for ds in ("btr", "bte"):
+        status, body = _call(server, "POST", f"{API}/dataset/csv", {
+            "datasetName": ds, "datasetURI": str(titanic_csv)})
+        _poll_finished(server, body["result"])
+    code = (
+        "import numpy as np\n"
+        "def feats(df):\n"
+        "    return np.stack([df['pclass'].to_numpy(float),"
+        " (df['sex']=='female').to_numpy(float)], axis=1)\n"
+        "features_training = (feats(training_df),"
+        " training_df['survived'].to_numpy('int64'))\n"
+        "features_evaluation = features_training\n"
+        "features_testing = feats(testing_df)\n"
+    )
+    status, body = _call(server, "POST", f"{API}/builder/sparkml", {
+        "trainDatasetName": "btr", "testDatasetName": "bte",
+        "modelingCode": code, "classifiersList": ["LR", "NB"]})
+    assert status == 201
+    assert len(body["result"]) == 2
+    for uri in body["result"]:
+        meta = _poll_finished(server, uri)
+        assert meta["accuracy"] > 0.6
+        status, rows = _call(server, "GET", uri, params="?skip=1&limit=3")
+        assert any("prediction" in r for r in rows["result"])
+
+
+def test_observe_long_poll(server, titanic_csv):
+    import threading
+
+    status, body = _call(server, "GET", f"{API}/observe")
+    seq0 = body["result"]["seq"]
+    results = {}
+
+    def watcher():
+        results["resp"] = _call(
+            server, "GET", f"{API}/observe/obs_ds",
+            params=f"?seq={seq0}&timeout=30")
+
+    t = threading.Thread(target=watcher)
+    t.start()
+    time.sleep(0.2)
+    _call(server, "POST", f"{API}/dataset/csv", {
+        "datasetName": "obs_ds", "datasetURI": str(titanic_csv)})
+    t.join(timeout=40)
+    assert not t.is_alive()
+    status, body = results["resp"]
+    assert status == 200
+    changes = body["result"]["changes"]
+    assert changes and all(c["collection"] == "obs_ds" for c in changes)
