@@ -1,0 +1,170 @@
+"""Headline benchmark: MNIST-CNN training throughput through the REST
+control plane (BASELINE.json metric: samples/sec/chip via /train).
+
+Drives the real pipeline — Function (synthetic MNIST, zero-egress) →
+Model → Train → Evaluate — through the transport-independent Api
+dispatcher, then reports the steady-state training throughput of the
+jitted, mesh-sharded engine on whatever accelerator `jax.devices()`
+offers (one TPU chip under the driver; CPU locally).
+
+``vs_baseline`` is measured live against the reference's execution
+model: the reference trains in-process on the service host's CPU with
+no accelerator (SURVEY §3.3 — ``getattr(instance, "fit")`` running
+TF/sklearn single-node; its 3-VM deployment has no GPU/TPU,
+README.md:63). We time the same CNN/batch-size in torch-CPU as that
+proxy and report ours / reference-proxy.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+"""
+
+import json
+import os
+import sys
+import tempfile
+import time
+
+EPOCHS = 4
+BATCH = 256
+N_SAMPLES = 16384
+IMG = 28
+CLASSES = 10
+
+from __graft_entry__ import FLAGSHIP_CNN_LAYERS as CNN_LAYERS  # noqa: E402
+
+def synth_code() -> str:
+    return f"""
+import numpy as np
+rng = np.random.default_rng(0)
+n, img, classes = {N_SAMPLES}, {IMG}, {CLASSES}
+y = rng.integers(0, classes, size=n).astype(np.int32)
+# class-dependent blobs so accuracy is learnable (sanity), not chance
+x = rng.normal(0.0, 0.35, size=(n, img * img)).astype(np.float32)
+for c in range(classes):
+    x[y == c, c * 64:(c + 1) * 64] += 1.0
+response = {{"x": x, "y": y}}
+"""
+
+
+def _wait(api, uri, timeout=1800.0):
+    name = uri.rstrip("/").split("/")[-1]
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        status, body, _ = api.dispatch("GET", uri, {"limit": "1"}, None)
+        if status == 200 and body["metadata"].get("finished"):
+            return body["metadata"]
+        docs = api.ctx.catalog.get_documents(name)
+        errs = [d["exception"] for d in docs if d.get("exception")]
+        if errs:
+            raise RuntimeError(f"job {name} failed: {errs[0]}")
+        time.sleep(0.25)
+    raise TimeoutError(f"job never finished: {uri}")
+
+
+def run_tpu_path():
+    from learningorchestra_tpu import config as config_mod
+    from learningorchestra_tpu.services.server import Api
+
+    home = tempfile.mkdtemp(prefix="lo_bench_")
+    config_mod.set_config(config_mod.Config(home=home))
+    api = Api()
+    prefix = "/api/learningOrchestra/v1"
+
+    status, body, _ = api.dispatch("POST", f"{prefix}/function/python", {}, {
+        "name": "mnist_synth", "function": synth_code(),
+        "functionParameters": {}, "description": "synthetic MNIST"})
+    assert status == 201, body
+    _wait(api, body["result"])
+
+    status, body, _ = api.dispatch("POST", f"{prefix}/model/tensorflow", {}, {
+        "modelName": "mnist_cnn", "modulePath": "tensorflow.keras.models",
+        "class": "Sequential", "classParameters": {"layers": CNN_LAYERS},
+        "description": "bench CNN"})
+    assert status == 201, body
+    _wait(api, body["result"])
+
+    status, body, _ = api.dispatch("POST", f"{prefix}/train/tensorflow", {}, {
+        "name": "mnist_cnn_t", "modelName": "mnist_cnn", "method": "fit",
+        "methodParameters": {"x": "$mnist_synth.x", "y": "$mnist_synth.y",
+                             "epochs": EPOCHS, "batch_size": BATCH}})
+    assert status == 201, body
+    _wait(api, body["result"])
+
+    status, body, _ = api.dispatch(
+        "POST", f"{prefix}/evaluate/tensorflow", {}, {
+            "name": "mnist_cnn_e", "modelName": "mnist_cnn_t",
+            "method": "evaluate",
+            "methodParameters": {"x": "$mnist_synth.x",
+                                 "y": "$mnist_synth.y"}})
+    assert status == 201, body
+    _wait(api, body["result"])
+
+    import jax
+
+    model = api.ctx.artifacts.load("mnist_cnn_t", "train/tensorflow")
+    # epoch 0 pays jit compilation; steady state is the rest. Engine
+    # throughput spans the whole default mesh — normalize to per-chip.
+    n_chips = len(jax.devices())
+    steady = [h["samplesPerSecond"] / n_chips for h in model.history[1:]]
+    accuracy = api.ctx.artifacts.load(
+        "mnist_cnn_e", "evaluate/tensorflow")["accuracy"]
+    api.ctx.jobs.shutdown()
+    return max(steady), accuracy
+
+
+def run_reference_proxy(max_seconds=60.0):
+    """The same CNN / batch size on torch-CPU — the reference's
+    in-process single-host execution model."""
+    import numpy as np
+    import torch
+    import torch.nn as tnn
+
+    torch.set_num_threads(os.cpu_count() or 4)
+    model = tnn.Sequential(
+        tnn.Conv2d(1, 32, 3, padding=1), tnn.ReLU(), tnn.MaxPool2d(2),
+        tnn.Conv2d(32, 64, 3, padding=1), tnn.ReLU(), tnn.MaxPool2d(2),
+        tnn.Flatten(), tnn.Linear(64 * (IMG // 4) ** 2, 128), tnn.ReLU(),
+        tnn.Linear(128, CLASSES))
+    opt = torch.optim.Adam(model.parameters(), lr=1e-3)
+    loss_fn = tnn.CrossEntropyLoss()
+    x = torch.randn(BATCH, 1, IMG, IMG)
+    y = torch.from_numpy(
+        np.random.default_rng(0).integers(0, CLASSES, BATCH))
+    # warmup
+    for _ in range(2):
+        opt.zero_grad()
+        loss_fn(model(x), y).backward()
+        opt.step()
+    steps = 0
+    t0 = time.perf_counter()
+    while steps < 30 and time.perf_counter() - t0 < max_seconds:
+        opt.zero_grad()
+        loss_fn(model(x), y).backward()
+        opt.step()
+        steps += 1
+    dt = time.perf_counter() - t0
+    return steps * BATCH / dt
+
+
+def main():
+    value, accuracy = run_tpu_path()
+    try:
+        baseline = run_reference_proxy()
+        vs = round(value / baseline, 3)
+    except Exception:  # noqa: BLE001 — baseline proxy must never sink bench
+        baseline, vs = None, None
+    print(json.dumps({
+        "metric": "mnist_cnn_train_samples_per_sec_per_chip",
+        "value": round(value, 2),
+        "unit": "samples/s",
+        "vs_baseline": vs,
+        "extra": {"eval_accuracy": round(float(accuracy), 4),
+                  "reference_proxy_torch_cpu_samples_per_sec":
+                      round(baseline, 2) if baseline else None,
+                  "epochs": EPOCHS, "batch_size": BATCH,
+                  "n_samples": N_SAMPLES},
+    }))
+
+
+if __name__ == "__main__":
+    sys.exit(main())
