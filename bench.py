@@ -46,6 +46,11 @@ response = {{"x": x, "y": y}}
 """
 
 
+def _expect_created(status, body):
+    if status != 201:
+        raise RuntimeError(f"POST failed: {status} {body}")
+
+
 def _wait(api, uri, timeout=1800.0):
     name = uri.rstrip("/").split("/")[-1]
     deadline = time.time() + timeout
@@ -73,21 +78,21 @@ def run_tpu_path():
     status, body, _ = api.dispatch("POST", f"{prefix}/function/python", {}, {
         "name": "mnist_synth", "function": synth_code(),
         "functionParameters": {}, "description": "synthetic MNIST"})
-    assert status == 201, body
+    _expect_created(status, body)
     _wait(api, body["result"])
 
     status, body, _ = api.dispatch("POST", f"{prefix}/model/tensorflow", {}, {
         "modelName": "mnist_cnn", "modulePath": "tensorflow.keras.models",
         "class": "Sequential", "classParameters": {"layers": CNN_LAYERS},
         "description": "bench CNN"})
-    assert status == 201, body
+    _expect_created(status, body)
     _wait(api, body["result"])
 
     status, body, _ = api.dispatch("POST", f"{prefix}/train/tensorflow", {}, {
         "name": "mnist_cnn_t", "modelName": "mnist_cnn", "method": "fit",
         "methodParameters": {"x": "$mnist_synth.x", "y": "$mnist_synth.y",
                              "epochs": EPOCHS, "batch_size": BATCH}})
-    assert status == 201, body
+    _expect_created(status, body)
     _wait(api, body["result"])
 
     status, body, _ = api.dispatch(
@@ -96,7 +101,7 @@ def run_tpu_path():
             "method": "evaluate",
             "methodParameters": {"x": "$mnist_synth.x",
                                  "y": "$mnist_synth.y"}})
-    assert status == 201, body
+    _expect_created(status, body)
     _wait(api, body["result"])
 
     import jax
@@ -112,6 +117,57 @@ def run_tpu_path():
     return max(steady), accuracy
 
 
+def _torch_from_layer_configs(configs):
+    """Build the torch twin FROM the shared flagship config so the
+    proxy can't drift from the measured model."""
+    import torch.nn as tnn
+
+    acts = {"relu": tnn.ReLU, "tanh": tnn.Tanh, "sigmoid": tnn.Sigmoid,
+            "gelu": tnn.GELU}
+
+    def act_of(cfg, is_last):
+        name = cfg.get("activation")
+        if name in (None, "linear"):
+            return None
+        if is_last and name == "softmax":
+            return None  # folded into CrossEntropyLoss, like the jax side
+        if name not in acts:
+            raise ValueError(f"proxy can't mirror activation {name!r}")
+        return acts[name]()
+
+    layers, in_ch, hw, flat = [], 1, IMG, None
+    for i, cfg in enumerate(configs):
+        kind = cfg["kind"]
+        is_last = i == len(configs) - 1
+        if kind == "reshape":
+            in_ch, hw = cfg["shape"][2], cfg["shape"][0]
+        elif kind == "conv2d":
+            kernel = tuple(cfg.get("kernel", (3, 3)))
+            layers.append(tnn.Conv2d(in_ch, cfg["filters"], kernel,
+                                     padding="same"))
+            act = act_of(cfg, is_last)
+            if act is not None:
+                layers.append(act)
+            in_ch = cfg["filters"]
+        elif kind == "maxpool2d":
+            pool = tuple(cfg.get("pool", (2, 2)))
+            stride = tuple(cfg.get("strides", pool))
+            layers.append(tnn.MaxPool2d(pool, stride))
+            hw = (hw - pool[0]) // stride[0] + 1
+        elif kind == "flatten":
+            layers.append(tnn.Flatten())
+            flat = in_ch * hw * hw
+        elif kind == "dense":
+            layers.append(tnn.Linear(flat, cfg["units"]))
+            act = act_of(cfg, is_last)
+            if act is not None:
+                layers.append(act)
+            flat = cfg["units"]
+        else:
+            raise ValueError(f"proxy can't mirror layer kind {kind!r}")
+    return tnn.Sequential(*layers)
+
+
 def run_reference_proxy(max_seconds=60.0):
     """The same CNN / batch size on torch-CPU — the reference's
     in-process single-host execution model."""
@@ -120,11 +176,7 @@ def run_reference_proxy(max_seconds=60.0):
     import torch.nn as tnn
 
     torch.set_num_threads(os.cpu_count() or 4)
-    model = tnn.Sequential(
-        tnn.Conv2d(1, 32, 3, padding=1), tnn.ReLU(), tnn.MaxPool2d(2),
-        tnn.Conv2d(32, 64, 3, padding=1), tnn.ReLU(), tnn.MaxPool2d(2),
-        tnn.Flatten(), tnn.Linear(64 * (IMG // 4) ** 2, 128), tnn.ReLU(),
-        tnn.Linear(128, CLASSES))
+    model = _torch_from_layer_configs(CNN_LAYERS)
     opt = torch.optim.Adam(model.parameters(), lr=1e-3)
     loss_fn = tnn.CrossEntropyLoss()
     x = torch.randn(BATCH, 1, IMG, IMG)

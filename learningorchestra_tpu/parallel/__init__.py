@@ -1,0 +1,25 @@
+"""Parallelism library: explicit TPU-first parallel strategies.
+
+The reference has no DP/TP/PP/SP/EP at all — its only parallelism is
+thread pools, a 3-stage ingest pipeline, and Spark partitions (SURVEY
+§2.4). These modules are the new first-class components the rebuild
+mandates, all built on one device mesh (runtime/mesh.py) with XLA
+collectives over ICI/DCN:
+
+- :mod:`sharding` — GSPMD parameter/activation sharding rules
+  (DP / FSDP / TP) applied by path-regex, scaling-book style.
+- :mod:`ring` — ring attention over the ``sp`` axis
+  (sequence/context parallelism; blockwise online softmax with
+  ``ppermute``-rotated KV blocks).
+- :mod:`ulysses` — DeepSpeed-Ulysses-style sequence parallelism
+  (``all_to_all`` head scatter / seq gather around local attention).
+- :mod:`pipeline` — GPipe pipeline parallelism over the ``pp`` axis
+  (microbatched 1F schedule inside ``shard_map``).
+- :mod:`moe` — mixture-of-experts with expert parallelism over the
+  ``ep`` axis (dense top-k dispatch einsums; no ragged shapes).
+"""
+
+from learningorchestra_tpu.parallel import (moe, pipeline, ring, sharding,
+                                            ulysses)
+
+__all__ = ["moe", "pipeline", "ring", "sharding", "ulysses"]

@@ -1,0 +1,120 @@
+"""Mixture-of-experts with expert parallelism over the ``ep`` axis.
+
+GShard/Switch-style dense dispatch: top-k gating builds a fixed-shape
+(tokens × experts × capacity) dispatch tensor and all routing becomes
+three einsums — no ragged shapes, no data-dependent control flow, so
+XLA tiles everything onto the MXU and, when the expert dim is sharded
+over ``ep``, lowers the dispatch/combine einsums to all-to-alls over
+ICI. Tokens over capacity are dropped (standard; capacity_factor
+controls the drop rate).
+
+Functional params layout (stacked experts, shardable by
+sharding.TRANSFORMER_RULES):
+  ``gate``          (d_model, n_experts)   — replicated
+  ``experts/wi``    (n_experts, d_model, d_ff)
+  ``experts/wo``    (n_experts, d_ff, d_model)
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh
+
+from learningorchestra_tpu.parallel import sharding as sharding_lib
+from learningorchestra_tpu.runtime import mesh as mesh_lib
+
+
+def init_moe_params(rng, d_model: int, d_ff: int, n_experts: int,
+                    dtype=jnp.float32) -> Dict[str, Any]:
+    kg, ki, ko = jax.random.split(rng, 3)
+    scale_in = 1.0 / math.sqrt(d_model)
+    scale_out = 1.0 / math.sqrt(d_ff)
+    return {
+        "gate": (jax.random.normal(kg, (d_model, n_experts)) *
+                 scale_in).astype(dtype),
+        "experts": {
+            "wi": (jax.random.normal(ki, (n_experts, d_model, d_ff)) *
+                   scale_in).astype(dtype),
+            "wo": (jax.random.normal(ko, (n_experts, d_ff, d_model)) *
+                   scale_out).astype(dtype),
+        },
+    }
+
+
+def top_k_gating(logits: jax.Array, k: int, capacity: int,
+                 ) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Returns (dispatch (T,E,C) {0,1}, combine (T,E,C) weights,
+    aux_loss scalar) from router logits (T, E)."""
+    t, e = logits.shape
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+    gate_vals, gate_idx = jax.lax.top_k(probs, k)          # (T, k)
+    gate_vals = gate_vals / jnp.maximum(
+        jnp.sum(gate_vals, axis=-1, keepdims=True), 1e-9)  # renormalize
+
+    dispatch = jnp.zeros((t, e, capacity), jnp.float32)
+    combine = jnp.zeros((t, e, capacity), jnp.float32)
+    # expert fill persists across the k choices so capacity is shared
+    fill = jnp.zeros((e,), jnp.int32)
+    for choice in range(k):
+        idx = gate_idx[:, choice]                          # (T,)
+        onehot = jax.nn.one_hot(idx, e, dtype=jnp.int32)   # (T, E)
+        # position of each token within its chosen expert's buffer
+        pos_in_e = (jnp.cumsum(onehot, axis=0) - 1) + fill[None, :]
+        fill = fill + jnp.sum(onehot, axis=0)
+        pos = jnp.sum(pos_in_e * onehot, axis=-1)          # (T,)
+        keep = pos < capacity
+        pos = jnp.clip(pos, 0, capacity - 1)
+        hot = (jax.nn.one_hot(idx, e, dtype=jnp.float32)[:, :, None] *
+               jax.nn.one_hot(pos, capacity, dtype=jnp.float32)[:, None, :])
+        hot = hot * keep[:, None, None]
+        dispatch = dispatch + hot
+        combine = combine + hot * gate_vals[:, choice, None, None]
+
+    # load-balancing aux loss (Switch: E * mean(frac_tokens * mean_prob))
+    top1 = jax.nn.one_hot(gate_idx[:, 0], e, dtype=jnp.float32)
+    aux = e * jnp.mean(jnp.mean(top1, axis=0) * jnp.mean(probs, axis=0))
+    return dispatch, combine, aux
+
+
+def moe_layer(params: Dict[str, Any], x: jax.Array, *, k: int = 2,
+              capacity_factor: float = 1.25,
+              mesh: Optional[Mesh] = None,
+              ) -> Tuple[jax.Array, jax.Array]:
+    """x: (..., d_model) -> (same shape, aux_loss).
+
+    With ``mesh`` given, expert-stacked tensors are constrained to the
+    ``ep`` axis so GSPMD executes each expert's FFN on its own mesh
+    slice (dispatch/combine become all-to-alls).
+    """
+    orig_shape = x.shape
+    d = orig_shape[-1]
+    tokens = x.reshape(-1, d)
+    t = tokens.shape[0]
+    e = params["gate"].shape[-1]
+    capacity = max(1, int(capacity_factor * k * t / e))
+
+    logits = tokens @ params["gate"].astype(tokens.dtype)
+    dispatch, combine, aux = top_k_gating(logits, k, capacity)
+
+    expert_in = jnp.einsum("tec,td->ecd", dispatch.astype(tokens.dtype),
+                           tokens)
+    if mesh is not None:
+        expert_in = sharding_lib.constrain(
+            expert_in, mesh, mesh_lib.EP, None, None)
+    h = jax.nn.gelu(jnp.einsum("ecd,edf->ecf", expert_in,
+                               params["experts"]["wi"].astype(tokens.dtype),
+                               preferred_element_type=jnp.float32))
+    h = h.astype(tokens.dtype)
+    expert_out = jnp.einsum("ecf,efd->ecd", h,
+                            params["experts"]["wo"].astype(tokens.dtype),
+                            preferred_element_type=jnp.float32)
+    if mesh is not None:
+        expert_out = sharding_lib.constrain(
+            expert_out.astype(tokens.dtype), mesh, mesh_lib.EP, None, None)
+    out = jnp.einsum("tec,ecd->td", combine.astype(jnp.float32),
+                     expert_out.astype(jnp.float32))
+    return out.reshape(orig_shape).astype(x.dtype), aux

@@ -1,0 +1,107 @@
+"""Pipeline parallelism (GPipe schedule) over the ``pp`` axis.
+
+Layer stages live on different devices; microbatches flow through the
+ring of stages with activations handed to the next stage by
+``ppermute`` each tick. The schedule is the classic GPipe fill/drain:
+``M + n_stages - 1`` ticks for M microbatches, bubble fraction
+``(n-1)/(M+n-1)``. Every device runs the same jitted tick body (SPMD —
+no MPMD program needed); invalid bubble ticks compute on garbage and
+are masked out of the result, which keeps control flow static for XLA.
+
+Stage parameters are stacked on a leading ``n_stages`` dim and sharded
+over ``pp``, so each device holds exactly its stage's weights.
+Activation shapes must be uniform across stage boundaries (wrap
+embed/head layers outside the pipelined middle, transformer-style).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from learningorchestra_tpu.runtime import mesh as mesh_lib
+
+
+def pipeline_apply_local(stage_fn: Callable[[Any, jax.Array], jax.Array],
+                         stage_params: Any, x: jax.Array,
+                         num_microbatches: int,
+                         axis_name: str = mesh_lib.PP) -> jax.Array:
+    """Inside shard_map: ``stage_params`` leaves are (1, ...) local
+    stage shards; ``x`` is the local batch (replicated over pp).
+    Returns the pipelined ``stage_{n-1}(...stage_0(x))``, replicated.
+    """
+    n = lax.psum(1, axis_name)
+    idx = lax.axis_index(axis_name)
+    params = jax.tree_util.tree_map(lambda p: p[0], stage_params)
+    m = num_microbatches
+    if x.shape[0] % m:
+        raise ValueError(f"batch {x.shape[0]} not divisible by "
+                         f"microbatches {m}")
+    micro = x.reshape(m, x.shape[0] // m, *x.shape[1:])
+
+    def tick(carry, t):
+        inp_buf, out_buf = carry
+        mb = lax.dynamic_index_in_dim(
+            micro, jnp.clip(t, 0, m - 1), axis=0, keepdims=False)
+        inp = jnp.where(idx == 0, mb, inp_buf)
+        y = stage_fn(params, inp)
+        out_mb = t - (n - 1)
+        write = (idx == n - 1) & (out_mb >= 0) & (out_mb < m)
+        slot = jnp.clip(out_mb, 0, m - 1)
+        old = lax.dynamic_index_in_dim(out_buf, slot, axis=0,
+                                       keepdims=False)
+        out_buf = lax.dynamic_update_index_in_dim(
+            out_buf, jnp.where(write, y, old), slot, axis=0)
+        nxt = lax.ppermute(y, axis_name, _forward_perm(n))
+        return (nxt, out_buf), None
+
+    # scan carries become pp-varying (each stage computes different
+    # values), so the initial values must be cast varying too
+    zero = lax.pcast(jnp.zeros_like(micro[0]), axis_name, to="varying")
+    out0 = lax.pcast(jnp.zeros_like(micro), axis_name, to="varying")
+    (_, out), _ = lax.scan(tick, (zero, out0),
+                           jnp.arange(m + _static_size(n) - 1))
+    # only the last stage holds real outputs; replicate via masked psum
+    out = lax.psum(jnp.where(idx == n - 1, out, 0.0), axis_name)
+    return out.reshape(x.shape[0], *out.shape[2:])
+
+
+def _static_size(n) -> int:
+    """lax.psum(1, axis) inside shard_map is a traced value in some
+    versions; the scan length must be static. shard_map guarantees the
+    axis size is known at trace time via the abstract mesh."""
+    try:
+        return int(n)
+    except Exception:  # noqa: BLE001 — fall back to concrete int carrier
+        raise ValueError("pipeline axis size must be static")
+
+
+def _forward_perm(n) -> list:
+    size = _static_size(n)
+    return [(i, i + 1) for i in range(size - 1)]
+
+
+def pipeline_apply(stage_fn: Callable[[Any, jax.Array], jax.Array],
+                   stage_params: Any, x: jax.Array, mesh: Mesh,
+                   num_microbatches: int = 4) -> jax.Array:
+    """pjit-level entry. ``stage_params`` leaves are stacked
+    (n_stages, ...) and get sharded over ``pp``; ``x`` is the global
+    batch, sharded over the data axes and replicated over ``pp``."""
+    if mesh_lib.PP not in mesh.axis_names:
+        raise ValueError("mesh has no 'pp' axis")
+    data = mesh_lib.data_axes(mesh)
+    xspec = P(data if data else None)
+    pspec = jax.tree_util.tree_map(
+        lambda p: P(*((mesh_lib.PP,) + (None,) * (p.ndim - 1))),
+        stage_params)
+    fn = jax.shard_map(
+        functools.partial(pipeline_apply_local, stage_fn,
+                          num_microbatches=num_microbatches,
+                          axis_name=mesh_lib.PP),
+        mesh=mesh, in_specs=(pspec, xspec), out_specs=xspec)
+    return fn(stage_params, x)

@@ -1,0 +1,141 @@
+"""Ring attention: sequence/context parallelism over the ``sp`` axis.
+
+Long-context attention where the sequence is sharded across devices:
+each device keeps its Q block resident and the K/V blocks rotate
+around the ring (``ppermute`` over ICI neighbours) while an online-
+softmax accumulator (running max + log-sum-exp) keeps the math exact —
+the composition of blockwise softmax corrections equals full softmax.
+Compute on each hop is a dense (seq_local × seq_local) attention block
+that XLA maps onto the MXU, and the rotation overlaps with it in the
+usual XLA async-collective schedule.
+
+The reference has no attention at all (SURVEY §5 long-context row);
+this module is one of the net-new first-class components. Used inside
+``shard_map`` (see :func:`ring_attention_sharded` for the pjit-level
+wrapper).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from learningorchestra_tpu.runtime import mesh as mesh_lib
+
+NEG_INF = -1e30
+
+
+def _block_attn(q, k, v, scale, mask):
+    """One (q_block × kv_block) attention tile.
+
+    q: (b, sq, h, d)  k/v: (b, sk, h, d)  mask: (sq, sk) or None.
+    Returns (numerator (b, sq, h, d), row_max (b, sq, h),
+    row_sumexp (b, sq, h)) of THIS tile only.
+    """
+    scores = jnp.einsum("bqhd,bkhd->bqhk", q, k,
+                        preferred_element_type=jnp.float32) * scale
+    if mask is not None:
+        scores = jnp.where(mask[None, :, None, :], scores, NEG_INF)
+    m = jnp.max(scores, axis=-1)
+    p = jnp.exp(scores - m[..., None])
+    if mask is not None:
+        # rows with no visible keys: exp(NEG_INF - NEG_INF) = 1 junk
+        any_visible = jnp.any(mask, axis=-1)  # (sq,)
+        p = jnp.where(any_visible[None, :, None, None], p, 0.0)
+        m = jnp.where(any_visible[None, :, None], m, NEG_INF)
+    num = jnp.einsum("bqhk,bkhd->bqhd", p,
+                     v.astype(jnp.float32),
+                     preferred_element_type=jnp.float32)
+    return num, m, jnp.sum(p, axis=-1)
+
+
+def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                   axis_name: str = mesh_lib.SP,
+                   causal: bool = False,
+                   scale: Optional[float] = None) -> jax.Array:
+    """Exact attention over a sequence sharded on ``axis_name``.
+
+    Call INSIDE ``shard_map``; q/k/v are the local sequence shards
+    shaped (batch, seq_local, heads, head_dim). Returns the local
+    output shard, same shape as ``q``, in ``q``'s dtype.
+    """
+    n = lax.psum(1, axis_name)
+    my_idx = lax.axis_index(axis_name)
+    b, sq, h, d = q.shape
+    sk = k.shape[1]
+    if scale is None:
+        scale = 1.0 / (d ** 0.5)
+    qf = q.astype(jnp.float32)
+
+    q_pos = my_idx * sq + jnp.arange(sq)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def step(carry, hop):
+        o, m, l, k_blk, v_blk = carry
+        # after `hop` rotations we hold the block that started on
+        # device (my_idx - hop) mod n
+        kv_idx = (my_idx - hop) % n
+        mask = None
+        if causal:
+            k_pos = kv_idx * sk + jnp.arange(sk)
+            mask = q_pos[:, None] >= k_pos[None, :]
+        num, bm, bl = _block_attn(qf, k_blk.astype(jnp.float32),
+                                  v_blk, scale, mask)
+        new_m = jnp.maximum(m, bm)
+        old_c = jnp.exp(m - new_m)
+        blk_c = jnp.exp(bm - new_m)
+        o = o * old_c[..., None] + num * blk_c[..., None]
+        l = l * old_c + bl * blk_c
+        k_blk = lax.ppermute(k_blk, axis_name, perm)
+        v_blk = lax.ppermute(v_blk, axis_name, perm)
+        return (o, new_m, l, k_blk, v_blk), None
+
+    # carries derived from qf so shard_map marks them device-varying
+    # (plain zeros are "unvarying" and fail the scan vma check)
+    o0 = qf * 0.0
+    m0 = qf[..., 0] * 0.0 + NEG_INF
+    l0 = qf[..., 0] * 0.0
+    (o, _, l, _, _), _ = lax.scan(step, (o0, m0, l0, k, v),
+                                  jnp.arange(n))
+    out = o / jnp.maximum(l[..., None], 1e-30)
+    return out.astype(q.dtype)
+
+
+def ring_attention_sharded(q: jax.Array, k: jax.Array, v: jax.Array,
+                           mesh: Mesh, causal: bool = False,
+                           scale: Optional[float] = None) -> jax.Array:
+    """pjit-level entry: global (b, seq, h, d) arrays, sequence sharded
+    over ``sp``, batch over the data axes."""
+    if mesh_lib.SP not in mesh.axis_names:
+        raise ValueError("mesh has no 'sp' axis")
+    data = mesh_lib.data_axes(mesh)
+    spec = P(data if data else None, mesh_lib.SP, None, None)
+    fn = jax.shard_map(
+        functools.partial(ring_attention, axis_name=mesh_lib.SP,
+                          causal=causal, scale=scale),
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
+    return fn(q, k, v)
+
+
+def full_attention_reference(q, k, v, causal: bool = False,
+                             scale: Optional[float] = None) -> jax.Array:
+    """Plain full-softmax attention (the oracle ring_attention must
+    match; also the single-device fallback)."""
+    d = q.shape[-1]
+    if scale is None:
+        scale = 1.0 / (d ** 0.5)
+    scores = jnp.einsum("bqhd,bkhd->bqhk", q.astype(jnp.float32),
+                        k.astype(jnp.float32),
+                        preferred_element_type=jnp.float32) * scale
+    if causal:
+        sq, sk = scores.shape[1], scores.shape[3]
+        mask = jnp.arange(sq)[:, None] >= jnp.arange(sk)[None, :]
+        scores = jnp.where(mask[None, :, None, :], scores, NEG_INF)
+    p = jax.nn.softmax(scores, axis=-1)
+    return jnp.einsum("bqhk,bkhd->bqhd", p,
+                      v.astype(jnp.float32)).astype(q.dtype)

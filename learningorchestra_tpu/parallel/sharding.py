@@ -1,0 +1,131 @@
+"""Parameter/activation sharding rules (DP / FSDP / TP).
+
+The scaling-book recipe: pick a mesh, annotate shardings on params and
+batch, let GSPMD insert the collectives. Rules here are (path-regex →
+PartitionSpec) pairs matched against flax param paths like
+``"decoder/layer_3/attn/q_proj/kernel"``; first match wins. FSDP is a
+fallback rule that shards the largest divisible axis of any still-
+replicated tensor over the ``fsdp`` axis (ZeRO-3-style, gathered by
+XLA just-in-time per layer).
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Optional, Sequence, Tuple
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from learningorchestra_tpu.runtime import mesh as mesh_lib
+
+Rule = Tuple[str, P]
+
+
+# TP rules for the transformer family (models/transformer.py naming):
+# column-parallel in-projections, row-parallel out-projections —
+# activations stay sharded on heads between the two, so the only
+# collective per block is one reduce-scatter/all-gather pair inserted
+# by XLA.
+TRANSFORMER_RULES: Sequence[Rule] = (
+    (r".*(q_proj|k_proj|v_proj|wi|gate|up_proj)/kernel$",
+     P(None, mesh_lib.TP)),
+    (r".*(o_proj|wo|down_proj)/kernel$", P(mesh_lib.TP, None)),
+    (r".*embed/embedding$", P(None, mesh_lib.TP)),
+    (r".*lm_head/kernel$", P(None, mesh_lib.TP)),
+    (r".*experts/(wi|gate)$", P(mesh_lib.EP, None, mesh_lib.TP)),
+    (r".*experts/wo$", P(mesh_lib.EP, mesh_lib.TP, None)),
+    (r".*(bias|scale)$", P()),
+)
+
+
+def _path_str(path) -> str:
+    parts = []
+    for key in path:
+        name = getattr(key, "key", None) or getattr(key, "name", None) \
+            or getattr(key, "idx", None)
+        parts.append(str(name))
+    return "/".join(parts)
+
+
+def _axes_in_mesh(spec: P, mesh: Mesh) -> P:
+    """Drop rule axes the mesh doesn't have (so one rule set serves
+    every mesh shape; a missing axis just means replicated there)."""
+    def keep(entry):
+        if entry is None:
+            return None
+        if isinstance(entry, (tuple, list)):
+            kept = tuple(a for a in entry if a in mesh.axis_names
+                         and mesh.shape[a] > 1)
+            return kept if kept else None
+        return entry if entry in mesh.axis_names and \
+            mesh.shape[entry] > 1 else None
+
+    return P(*(keep(e) for e in spec))
+
+
+def _fsdp_spec(shape: Tuple[int, ...], base: P, mesh: Mesh) -> P:
+    """Extend ``base`` by sharding the largest unsharded divisible dim
+    over the fsdp axis."""
+    if mesh_lib.FSDP not in mesh.axis_names or \
+            mesh.shape[mesh_lib.FSDP] <= 1:
+        return base
+    fsdp_size = mesh.shape[mesh_lib.FSDP]
+    entries = list(base) + [None] * (len(shape) - len(base))
+    candidates = [(dim, i) for i, (dim, e) in enumerate(zip(shape, entries))
+                  if e is None and dim % fsdp_size == 0 and dim >= fsdp_size]
+    if not candidates:
+        return base
+    _, idx = max(candidates)
+    entries[idx] = mesh_lib.FSDP
+    return P(*entries)
+
+
+def spec_for(path: str, shape: Tuple[int, ...], mesh: Mesh,
+             rules: Sequence[Rule] = TRANSFORMER_RULES,
+             fsdp: bool = True) -> P:
+    base = P()
+    for pattern, spec in rules:
+        if re.match(pattern, path):
+            base = _axes_in_mesh(spec, mesh)
+            break
+    return _fsdp_spec(shape, base, mesh) if fsdp else base
+
+
+def param_shardings(params: Any, mesh: Mesh,
+                    rules: Sequence[Rule] = TRANSFORMER_RULES,
+                    fsdp: bool = True) -> Any:
+    """NamedSharding pytree matching ``params`` (use as
+    ``in_shardings``/``device_put`` target)."""
+    def leaf_sharding(path, leaf):
+        shape = getattr(leaf, "shape", ())
+        spec = spec_for(_path_str(path), tuple(shape), mesh, rules, fsdp)
+        if len(spec) > len(shape):  # rule wider than tensor: replicate
+            spec = P()
+        return NamedSharding(mesh, spec)
+
+    return jax.tree_util.tree_map_with_path(leaf_sharding, params)
+
+
+def shard_params(params: Any, mesh: Mesh,
+                 rules: Sequence[Rule] = TRANSFORMER_RULES,
+                 fsdp: bool = True) -> Any:
+    return jax.device_put(params, param_shardings(params, mesh, rules, fsdp))
+
+
+def batch_spec(mesh: Mesh, seq_axis: bool = False) -> P:
+    """Batch activations: batch dim over (dp, fsdp), optionally the
+    sequence dim over sp."""
+    data = mesh_lib.data_axes(mesh)
+    first = data if data else None
+    if seq_axis and mesh_lib.SP in mesh.axis_names and \
+            mesh.shape[mesh_lib.SP] > 1:
+        return P(first, mesh_lib.SP)
+    return P(first)
+
+
+def constrain(x, mesh: Mesh, *spec_entries) -> Any:
+    """``with_sharding_constraint`` shorthand that tolerates axes
+    missing from the mesh."""
+    spec = _axes_in_mesh(P(*spec_entries), mesh)
+    return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, spec))

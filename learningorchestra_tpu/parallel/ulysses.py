@@ -1,0 +1,67 @@
+"""Ulysses-style sequence parallelism: all-to-all head scatter.
+
+The alternative SP strategy (SURVEY §2.4): instead of rotating KV
+around a ring, re-shard with two ``all_to_all``s — gather the full
+sequence while scattering heads, run ordinary full attention on
+``heads / sp`` local heads, then reverse. Communication volume is
+O(seq·hidden / sp) per all-to-all (cheaper than ring for moderate
+sequences; ring wins when seq >> devices·heads or memory forbids
+materializing full seq).
+
+Used inside ``shard_map``; :func:`ulysses_attention_sharded` is the
+pjit-level wrapper.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Callable, Optional
+
+import jax
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from learningorchestra_tpu.parallel import ring as ring_lib
+from learningorchestra_tpu.runtime import mesh as mesh_lib
+
+
+def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                      axis_name: str = mesh_lib.SP,
+                      causal: bool = False,
+                      scale: Optional[float] = None,
+                      attn_fn: Optional[Callable] = None) -> jax.Array:
+    """Inside shard_map: q/k/v local shards (b, seq_local, heads, d)
+    with heads divisible by the axis size. Returns the local output
+    shard (b, seq_local, heads, d)."""
+    n = lax.psum(1, axis_name)
+    if q.shape[2] % n:
+        raise ValueError(
+            f"heads {q.shape[2]} not divisible by sp={n}")
+    if attn_fn is None:
+        attn_fn = functools.partial(ring_lib.full_attention_reference,
+                                    causal=causal, scale=scale)
+
+    def scatter_heads(x):  # (b, s/n, h, d) -> (b, s, h/n, d)
+        return lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1,
+                              tiled=True)
+
+    def gather_heads(x):  # (b, s, h/n, d) -> (b, s/n, h, d)
+        return lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2,
+                              tiled=True)
+
+    out = attn_fn(scatter_heads(q), scatter_heads(k), scatter_heads(v))
+    return gather_heads(out)
+
+
+def ulysses_attention_sharded(q: jax.Array, k: jax.Array, v: jax.Array,
+                              mesh: Mesh, causal: bool = False,
+                              scale: Optional[float] = None) -> jax.Array:
+    if mesh_lib.SP not in mesh.axis_names:
+        raise ValueError("mesh has no 'sp' axis")
+    data = mesh_lib.data_axes(mesh)
+    spec = P(data if data else None, mesh_lib.SP, None, None)
+    fn = jax.shard_map(
+        functools.partial(ulysses_attention, axis_name=mesh_lib.SP,
+                          causal=causal, scale=scale),
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
+    return fn(q, k, v)
