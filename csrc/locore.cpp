@@ -1,0 +1,430 @@
+// locore — first-party native host-compute core for learningorchestra_tpu.
+//
+// The reference outsources all native-performance work to off-the-shelf
+// infrastructure (Spark/JVM executors, MongoDB's C++ storage engine —
+// SURVEY.md §2.2); this module is the rebuild's equivalent native muscle
+// for the host side of the pipeline: CSV -> columnar ingest, predicate
+// filtering, value-count histograms (histogram_image/histogram.py:25-44
+// capability), and the batch-gather hot loop of the device feed. The TPU
+// compute path stays JAX/XLA; everything here runs on the host CPU and is
+// exposed to Python over a plain C ABI via ctypes (no pybind11 in the
+// image).
+//
+// Build: g++ -O3 -std=c++17 -shared -fPIC (learningorchestra_tpu/native
+// builds and caches the .so on first import; every caller keeps a pure
+// Python fallback so the framework works without a toolchain).
+
+#include <cmath>
+#include <cstdint>
+#include <cstdlib>
+#include <cstring>
+#include <string>
+#include <string_view>
+#include <unordered_map>
+#include <vector>
+
+extern "C" {
+
+// ---------------------------------------------------------------------------
+// CSV parsing: RFC-4180-ish (quoted fields, embedded delimiters/newlines,
+// doubled quotes), CRLF tolerant. One LoTable owns all column buffers.
+// Column types: 0 = float64 (missing -> NaN), 1 = string (offsets+data,
+// arrow LargeString layout).
+// ---------------------------------------------------------------------------
+
+struct LoTable {
+  int64_t rows = 0;
+  int64_t cols = 0;
+  std::vector<uint8_t> types;                 // 0 float64, 1 string
+  std::vector<std::vector<double>> fcols;     // per float column
+  std::vector<std::vector<int64_t>> offsets;  // per string column, rows+1
+  std::vector<std::string> sdata;             // per string column, bytes
+};
+
+namespace {
+
+// Parse one record starting at p (end at limit) into cells; returns the
+// position one past the record's newline. Cells are unescaped into `scratch`
+// only when quoted; plain cells are views into the buffer.
+struct Cell {
+  const char* ptr;
+  int64_t len;
+};
+
+inline const char* parse_record(const char* p, const char* limit,
+                                char delim, std::vector<Cell>& cells,
+                                std::string& scratch,
+                                std::vector<size_t>& scratch_marks) {
+  cells.clear();
+  scratch.clear();
+  scratch_marks.clear();
+  const char* cell_start = p;
+  bool in_scratch = false;
+  size_t scratch_begin = 0;
+  auto flush = [&](const char* end) {
+    if (in_scratch) {
+      scratch_marks.push_back(cells.size());
+      cells.push_back({nullptr, (int64_t)(scratch.size() - scratch_begin)});
+      // ptr fixed up after the record completes (scratch may reallocate)
+    } else {
+      cells.push_back({cell_start, (int64_t)(end - cell_start)});
+    }
+    in_scratch = false;
+  };
+  while (p < limit) {
+    char c = *p;
+    if (c == '"' && p == cell_start && !in_scratch) {
+      // quoted cell: unescape into scratch
+      in_scratch = true;
+      scratch_begin = scratch.size();
+      ++p;
+      while (p < limit) {
+        if (*p == '"') {
+          if (p + 1 < limit && p[1] == '"') {
+            scratch.push_back('"');
+            p += 2;
+          } else {
+            ++p;
+            break;
+          }
+        } else {
+          scratch.push_back(*p++);
+        }
+      }
+      continue;  // next char should be delim/newline/EOF
+    }
+    if (c == delim) {
+      flush(p);
+      ++p;
+      cell_start = p;
+      scratch_begin = scratch.size();
+      continue;
+    }
+    if (c == '\n' || c == '\r') {
+      flush(p > cell_start && p[-1] == '\r' && !in_scratch ? p - 1 : p);
+      if (c == '\r' && p + 1 < limit && p[1] == '\n') ++p;
+      ++p;
+      // fix up scratch-backed cell pointers now that scratch is stable
+      {
+        size_t off = 0;
+        for (size_t k = 0; k < scratch_marks.size(); ++k) {
+          Cell& cell = cells[scratch_marks[k]];
+          cell.ptr = scratch.data() + off;
+          off += cell.len;
+        }
+      }
+      return p;
+    }
+    ++p;
+  }
+  // record ends at EOF without newline
+  flush(limit);
+  {
+    size_t off = 0;
+    for (size_t k = 0; k < scratch_marks.size(); ++k) {
+      Cell& cell = cells[scratch_marks[k]];
+      cell.ptr = scratch.data() + off;
+      off += cell.len;
+    }
+  }
+  return limit;
+}
+
+// strtod on a bounded view; empty/whitespace-only cells are "missing"
+// (NaN, still numeric — matches the Python fallback's strip-then-empty).
+inline bool parse_float(const Cell& cell, double* out) {
+  bool all_ws = true;
+  for (int64_t i = 0; i < cell.len; ++i) {
+    if (cell.ptr[i] != ' ' && cell.ptr[i] != '\t') {
+      all_ws = false;
+      break;
+    }
+  }
+  if (all_ws) {
+    *out = std::nan("");
+    return true;
+  }
+  if (cell.len >= 64) return false;
+  char tmp[64];
+  std::memcpy(tmp, cell.ptr, cell.len);
+  tmp[cell.len] = '\0';
+  char* end = nullptr;
+  double v = std::strtod(tmp, &end);
+  while (end && *end == ' ') ++end;
+  if (end != tmp + cell.len) return false;
+  *out = v;
+  return true;
+}
+
+}  // namespace
+
+// Parse a complete-records buffer. forced_types: nullptr to sniff (a column
+// is float64 iff every cell parses), else an int8 array of length >= ncols
+// from a previous chunk's sniff so all chunks share one schema. has_header:
+// skip the first record. Returns nullptr on malformed input (ragged rows).
+LoTable* lo_csv_parse(const char* buf, int64_t len, char delim,
+                      int has_header, const int8_t* forced_types) {
+  auto table = new LoTable();
+  const char* p = buf;
+  const char* limit = buf + len;
+  std::vector<Cell> cells;
+  std::string scratch;
+  std::vector<size_t> scratch_marks;
+
+  if (has_header) {
+    if (p >= limit) return table;
+    p = parse_record(p, limit, delim, cells, scratch, scratch_marks);
+    table->cols = (int64_t)cells.size();
+  }
+
+  // Column-major staging: first pass collects raw cells row by row and
+  // numeric candidacy; we keep parsed doubles as we go so numeric columns
+  // need no second text scan.
+  std::vector<std::vector<double>> fvals;
+  std::vector<std::vector<std::string>> svals;  // raw text per column
+  std::vector<uint8_t> numeric_ok;              // candidacy while sniffing
+
+  int64_t row = 0;
+  while (p < limit) {
+    // skip blank lines
+    if (*p == '\n' || *p == '\r') {
+      ++p;
+      continue;
+    }
+    p = parse_record(p, limit, delim, cells, scratch, scratch_marks);
+    if (table->cols == 0) table->cols = (int64_t)cells.size();
+    if ((int64_t)cells.size() != table->cols) {
+      delete table;
+      return nullptr;  // ragged
+    }
+    if (row == 0) {
+      fvals.resize(table->cols);
+      svals.resize(table->cols);
+      numeric_ok.assign(table->cols, 1);
+      if (forced_types) {
+        for (int64_t j = 0; j < table->cols; ++j)
+          numeric_ok[j] = forced_types[j] == 0;
+      }
+    }
+    for (int64_t j = 0; j < table->cols; ++j) {
+      double v;
+      if (numeric_ok[j] && parse_float(cells[j], &v)) {
+        fvals[j].push_back(v);
+      } else {
+        if (numeric_ok[j] && !forced_types) {
+          numeric_ok[j] = 0;  // demote: keep nothing, text below rebuilds
+        } else if (numeric_ok[j]) {
+          // forced numeric but unparseable -> NaN
+          fvals[j].push_back(std::nan(""));
+          continue;
+        }
+      }
+      svals[j].emplace_back(cells[j].ptr, (size_t)cells[j].len);
+    }
+    ++row;
+  }
+  table->rows = row;
+  if (table->cols == 0) return table;
+  if (fvals.empty()) {
+    fvals.resize(table->cols);
+    svals.resize(table->cols);
+    numeric_ok.assign(table->cols, 1);
+    if (forced_types)
+      for (int64_t j = 0; j < table->cols; ++j)
+        numeric_ok[j] = forced_types[j] == 0;
+  }
+
+  table->types.resize(table->cols);
+  for (int64_t j = 0; j < table->cols; ++j) {
+    bool is_float = numeric_ok[j] &&
+                    (int64_t)fvals[j].size() == table->rows;
+    if (forced_types) is_float = forced_types[j] == 0;
+    table->types[j] = is_float ? 0 : 1;
+    if (is_float) {
+      table->fcols.push_back(std::move(fvals[j]));
+      table->offsets.emplace_back();
+      table->sdata.emplace_back();
+    } else {
+      std::vector<int64_t> offs;
+      offs.reserve(table->rows + 1);
+      std::string data;
+      int64_t off = 0;
+      offs.push_back(0);
+      for (auto& s : svals[j]) {
+        data.append(s);
+        off += (int64_t)s.size();
+        offs.push_back(off);
+      }
+      table->fcols.emplace_back();
+      table->offsets.push_back(std::move(offs));
+      table->sdata.push_back(std::move(data));
+    }
+  }
+  return table;
+}
+
+void lo_table_free(LoTable* t) { delete t; }
+int64_t lo_table_rows(const LoTable* t) { return t->rows; }
+int64_t lo_table_cols(const LoTable* t) { return t->cols; }
+int32_t lo_table_col_type(const LoTable* t, int64_t j) {
+  return t->types[j];
+}
+const double* lo_table_fcol(const LoTable* t, int64_t j) {
+  return t->fcols[j].data();
+}
+const int64_t* lo_table_scol_offsets(const LoTable* t, int64_t j) {
+  return t->offsets[j].data();
+}
+const char* lo_table_scol_data(const LoTable* t, int64_t j) {
+  return t->sdata[j].data();
+}
+int64_t lo_table_scol_data_len(const LoTable* t, int64_t j) {
+  return (int64_t)t->sdata[j].size();
+}
+
+// ---------------------------------------------------------------------------
+// Value counts (histogram service: Mongo $group/$sum equivalent,
+// histogram_image/histogram.py:25-44). Insertion-ordered keys.
+// ---------------------------------------------------------------------------
+
+struct LoCounts {
+  std::vector<double> fkeys;
+  std::vector<std::string> skeys;  // parallel to counts when string-keyed
+  std::vector<int64_t> counts;
+  std::string sdata;               // packed string keys
+  std::vector<int64_t> soffsets;
+  bool is_string = false;
+};
+
+LoCounts* lo_value_counts_f64(const double* vals, int64_t n) {
+  auto out = new LoCounts();
+  std::unordered_map<double, int64_t> idx;
+  idx.reserve((size_t)(n / 4 + 8));
+  int64_t nan_slot = -1;  // NaN != NaN, so the map can't key it
+  for (int64_t i = 0; i < n; ++i) {
+    double key = vals[i];
+    if (std::isnan(key)) {
+      if (nan_slot < 0) {
+        nan_slot = (int64_t)out->fkeys.size();
+        out->fkeys.push_back(std::nan(""));
+        out->counts.push_back(0);
+      }
+      ++out->counts[nan_slot];
+      continue;
+    }
+    auto it = idx.find(key);
+    if (it == idx.end()) {
+      idx.emplace(key, (int64_t)out->fkeys.size());
+      out->fkeys.push_back(key);
+      out->counts.push_back(1);
+    } else {
+      ++out->counts[it->second];
+    }
+  }
+  return out;
+}
+
+LoCounts* lo_value_counts_str(const char* data, const int64_t* offsets,
+                              int64_t n) {
+  auto out = new LoCounts();
+  out->is_string = true;
+  std::unordered_map<std::string_view, int64_t> idx;
+  idx.reserve((size_t)(n / 4 + 8));
+  for (int64_t i = 0; i < n; ++i) {
+    std::string_view key(data + offsets[i],
+                         (size_t)(offsets[i + 1] - offsets[i]));
+    auto it = idx.find(key);
+    if (it == idx.end()) {
+      idx.emplace(key, (int64_t)out->skeys.size());
+      out->skeys.emplace_back(key);
+      out->counts.push_back(1);
+    } else {
+      ++out->counts[it->second];
+    }
+  }
+  out->soffsets.push_back(0);
+  for (auto& s : out->skeys) {
+    out->sdata.append(s);
+    out->soffsets.push_back((int64_t)out->sdata.size());
+  }
+  return out;
+}
+
+void lo_counts_free(LoCounts* c) { delete c; }
+int64_t lo_counts_n(const LoCounts* c) {
+  return (int64_t)c->counts.size();
+}
+const double* lo_counts_fkeys(const LoCounts* c) { return c->fkeys.data(); }
+const int64_t* lo_counts_counts(const LoCounts* c) {
+  return c->counts.data();
+}
+const char* lo_counts_sdata(const LoCounts* c) { return c->sdata.data(); }
+const int64_t* lo_counts_soffsets(const LoCounts* c) {
+  return c->soffsets.data();
+}
+
+// ---------------------------------------------------------------------------
+// Predicate filter: AND of simple comparisons over float64 columns.
+// op: 0 ==, 1 !=, 2 <, 3 <=, 4 >, 5 >=. Writes a 0/1 mask.
+// ---------------------------------------------------------------------------
+
+void lo_filter_f64(const double* const* cols, int64_t nrows, int64_t npreds,
+                   const int64_t* col_idx, const int32_t* ops,
+                   const double* operands, uint8_t* mask) {
+  std::memset(mask, 1, (size_t)nrows);
+  for (int64_t k = 0; k < npreds; ++k) {
+    const double* col = cols[col_idx[k]];
+    const double v = operands[k];
+    const int32_t op = ops[k];
+    for (int64_t i = 0; i < nrows; ++i) {
+      if (!mask[i]) continue;
+      double x = col[i];
+      bool keep;
+      switch (op) {
+        case 0: keep = x == v; break;
+        case 1: keep = x != v; break;
+        case 2: keep = x < v; break;
+        case 3: keep = x <= v; break;
+        case 4: keep = x > v; break;
+        default: keep = x >= v; break;
+      }
+      if (!keep) mask[i] = 0;
+    }
+  }
+}
+
+// String equality predicate applied on top of an existing mask.
+void lo_filter_str_eq(const char* data, const int64_t* offsets,
+                      int64_t nrows, const char* needle, int64_t needle_len,
+                      int32_t negate, uint8_t* mask) {
+  std::string_view want(needle, (size_t)needle_len);
+  for (int64_t i = 0; i < nrows; ++i) {
+    if (!mask[i]) continue;
+    std::string_view got(data + offsets[i],
+                         (size_t)(offsets[i + 1] - offsets[i]));
+    bool eq = got == want;
+    if (negate ? eq : !eq) mask[i] = 0;
+  }
+}
+
+// ---------------------------------------------------------------------------
+// Batch gather: rows of a C-contiguous float32 matrix by index — the device
+// feed's per-step hot loop (shuffled minibatch assembly).
+// ---------------------------------------------------------------------------
+
+void lo_gather_f32(const float* src, int64_t nrows, int64_t ncols,
+                   const int64_t* idx, int64_t nidx, float* dst) {
+  const size_t rowbytes = (size_t)ncols * sizeof(float);
+  for (int64_t i = 0; i < nidx; ++i) {
+    int64_t r = idx[i];
+    if (r < 0 || r >= nrows) {
+      std::memset(dst + i * ncols, 0, rowbytes);
+    } else {
+      std::memcpy(dst + i * ncols, src + r * ncols, rowbytes);
+    }
+  }
+}
+
+int32_t lo_abi_version() { return 1; }
+
+}  // extern "C"

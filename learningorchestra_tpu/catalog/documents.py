@@ -137,8 +137,8 @@ def execution_document(description: str,
 def matches_query(doc: Dict[str, Any], query: Optional[Dict[str, Any]]) -> bool:
     """Tiny Mongo-style filter evaluator for document reads.
 
-    Supports equality and {$gt,$gte,$lt,$lte,$ne,$in} — covering the
-    reference's pass-through ``query`` parameter on reads
+    Supports equality and {$eq,$gt,$gte,$lt,$lte,$ne,$in} — covering
+    the reference's pass-through ``query`` parameter on reads
     (database_api_image/database.py:19-28).
     """
     if not query:
@@ -148,7 +148,9 @@ def matches_query(doc: Dict[str, Any], query: Optional[Dict[str, Any]]) -> bool:
         if isinstance(cond, dict):
             for op, rhs in cond.items():
                 try:
-                    if op == "$gt" and not value > rhs:
+                    if op == "$eq" and not value == rhs:
+                        return False
+                    elif op == "$gt" and not value > rhs:
                         return False
                     elif op == "$gte" and not value >= rhs:
                         return False
@@ -160,7 +162,8 @@ def matches_query(doc: Dict[str, Any], query: Optional[Dict[str, Any]]) -> bool:
                         return False
                     elif op == "$in" and value not in rhs:
                         return False
-                    elif op not in ("$gt", "$gte", "$lt", "$lte", "$ne", "$in"):
+                    elif op not in ("$eq", "$gt", "$gte", "$lt", "$lte",
+                                    "$ne", "$in"):
                         raise ValueError(f"unsupported query operator: {op}")
                 except TypeError:
                     return False

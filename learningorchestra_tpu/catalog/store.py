@@ -359,6 +359,18 @@ class Catalog:
                 skipped += nrows
                 continue
             table = pq.read_table(f, columns=want_cols)
+            fast = self._fast_filter_take(table, query, base, skip,
+                                          remaining)
+            if fast is not None:
+                taken, n_skipped = fast
+                out.extend(taken)
+                skip -= n_skipped
+                skipped += n_skipped
+                remaining -= len(taken)
+                if remaining <= 0:
+                    return out, skipped
+                base += nrows
+                continue
             batch_rows = table.to_pylist()
             for i, row in enumerate(batch_rows):
                 row[D.ID] = base + i + 1  # reference rows start at _id 1
@@ -374,6 +386,42 @@ class Catalog:
                     return out, skipped
             base += nrows
         return out, skipped
+
+    @staticmethod
+    def _fast_filter_take(table, query, base: int, skip: int, remaining):
+        """Columnar query evaluation for one Parquet file via the
+        native core (falls back to numpy without a toolchain; returns
+        None when the query shape needs the per-row Python evaluator).
+
+        Returns ``(rows, n_skipped)`` — the row-documents to emit (with
+        ``_id``) and how much of ``skip`` was consumed by matched rows.
+        """
+        if query is None:
+            return None
+        try:
+            import numpy as np
+
+            from learningorchestra_tpu.native import ops as nops
+        except ImportError:  # pragma: no cover
+            return None
+        names = set(table.column_names)
+        if not set(query) <= names:
+            return None  # e.g. _id or metadata-only fields
+        mask = nops.filter_mask_arrow(table, query)
+        if mask is None:
+            return None
+        matched = np.flatnonzero(mask)
+        n_skipped = min(skip, len(matched))
+        avail = matched[n_skipped:]
+        if remaining != float("inf"):
+            avail = avail[:int(remaining)]
+        if len(avail) == 0:
+            return [], n_skipped
+        sub = table.take(pa.array(avail)).to_pylist()
+        for offset, original_index in zip(
+                range(len(avail)), avail.tolist()):
+            sub[offset][D.ID] = base + original_index + 1
+        return sub, n_skipped
 
     # ------------------------------------------------------------------
     # combined read (the universal GET in the reference routes all

@@ -1,0 +1,152 @@
+"""First-party native host-compute core (csrc/locore.cpp).
+
+The reference delegates every native-performance component to
+off-the-shelf infrastructure (Spark executors, MongoDB's storage
+engine — SURVEY.md §2.2). This package is the rebuild's own native
+layer: the C++ core is compiled on first use with the in-image g++
+toolchain, cached next to the source keyed by a source hash, and bound
+over a plain C ABI with ctypes (pybind11 is not in the image). Callers
+must treat :func:`get_lib` returning ``None`` as "no toolchain" and
+fall back to their pure-Python path — the framework never hard-requires
+the .so.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import subprocess
+import tempfile
+import threading
+from typing import Optional
+
+_LOCK = threading.Lock()
+_LIB: Optional[ctypes.CDLL] = None
+_TRIED = False
+_ABI_VERSION = 1
+
+_PKG_DIR = os.path.dirname(os.path.abspath(__file__))
+_REPO_ROOT = os.path.dirname(os.path.dirname(_PKG_DIR))
+_SOURCE_CANDIDATES = (
+    os.path.join(_REPO_ROOT, "csrc", "locore.cpp"),
+    os.path.join(_PKG_DIR, "locore.cpp"),  # installed-package layout
+)
+
+
+def _source_path() -> Optional[str]:
+    for path in _SOURCE_CANDIDATES:
+        if os.path.exists(path):
+            return path
+    return None
+
+
+def _cache_dir() -> str:
+    base = os.environ.get("LO_NATIVE_CACHE") or os.path.join(
+        os.path.expanduser("~"), ".cache", "learningorchestra_tpu")
+    os.makedirs(base, exist_ok=True)
+    return base
+
+
+def _build(source: str) -> Optional[str]:
+    with open(source, "rb") as f:
+        digest = hashlib.sha256(f.read()).hexdigest()[:16]
+    so_path = os.path.join(_cache_dir(), f"locore_{digest}.so")
+    if os.path.exists(so_path):
+        return so_path
+    cmd = ["g++", "-O3", "-std=c++17", "-shared", "-fPIC",
+           "-o", so_path + ".tmp", source]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+    except (OSError, subprocess.SubprocessError):
+        return None
+    os.replace(so_path + ".tmp", so_path)
+    return so_path
+
+
+def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
+    c = ctypes
+    i64, i32, i8 = c.c_int64, c.c_int32, c.c_int8
+    p = c.POINTER
+
+    lib.lo_abi_version.restype = i32
+    lib.lo_csv_parse.restype = c.c_void_p
+    lib.lo_csv_parse.argtypes = [c.c_char_p, i64, c.c_char, i32, p(i8)]
+    lib.lo_table_free.argtypes = [c.c_void_p]
+    for name, res in (("lo_table_rows", i64), ("lo_table_cols", i64)):
+        fn = getattr(lib, name)
+        fn.restype = res
+        fn.argtypes = [c.c_void_p]
+    lib.lo_table_col_type.restype = i32
+    lib.lo_table_col_type.argtypes = [c.c_void_p, i64]
+    lib.lo_table_fcol.restype = p(c.c_double)
+    lib.lo_table_fcol.argtypes = [c.c_void_p, i64]
+    lib.lo_table_scol_offsets.restype = p(i64)
+    lib.lo_table_scol_offsets.argtypes = [c.c_void_p, i64]
+    lib.lo_table_scol_data.restype = c.c_void_p
+    lib.lo_table_scol_data.argtypes = [c.c_void_p, i64]
+    lib.lo_table_scol_data_len.restype = i64
+    lib.lo_table_scol_data_len.argtypes = [c.c_void_p, i64]
+
+    lib.lo_value_counts_f64.restype = c.c_void_p
+    lib.lo_value_counts_f64.argtypes = [p(c.c_double), i64]
+    # data pointers are c_void_p so Arrow Buffer.address / numpy
+    # pointers pass zero-copy (bytes objects are accepted too)
+    lib.lo_value_counts_str.restype = c.c_void_p
+    lib.lo_value_counts_str.argtypes = [c.c_void_p, p(i64), i64]
+    lib.lo_counts_free.argtypes = [c.c_void_p]
+    lib.lo_counts_n.restype = i64
+    lib.lo_counts_n.argtypes = [c.c_void_p]
+    lib.lo_counts_fkeys.restype = p(c.c_double)
+    lib.lo_counts_fkeys.argtypes = [c.c_void_p]
+    lib.lo_counts_counts.restype = p(i64)
+    lib.lo_counts_counts.argtypes = [c.c_void_p]
+    lib.lo_counts_sdata.restype = c.c_void_p
+    lib.lo_counts_sdata.argtypes = [c.c_void_p]
+    lib.lo_counts_soffsets.restype = p(i64)
+    lib.lo_counts_soffsets.argtypes = [c.c_void_p]
+
+    lib.lo_filter_f64.restype = None
+    lib.lo_filter_f64.argtypes = [p(p(c.c_double)), i64, i64, p(i64),
+                                  p(i32), p(c.c_double), p(c.c_uint8)]
+    lib.lo_filter_str_eq.restype = None
+    lib.lo_filter_str_eq.argtypes = [c.c_void_p, p(i64), i64, c.c_char_p,
+                                     i64, i32, p(c.c_uint8)]
+
+    lib.lo_gather_f32.restype = None
+    lib.lo_gather_f32.argtypes = [p(c.c_float), i64, i64, p(i64), i64,
+                                  p(c.c_float)]
+    return lib
+
+
+def get_lib() -> Optional[ctypes.CDLL]:
+    """The loaded native core, building it on first call; ``None`` when
+    the source or toolchain is unavailable or disabled
+    (``LO_NATIVE=0``)."""
+    global _LIB, _TRIED
+    if _LIB is not None or _TRIED:
+        return _LIB
+    with _LOCK:
+        if _LIB is not None or _TRIED:
+            return _LIB
+        _TRIED = True
+        if os.environ.get("LO_NATIVE", "1") == "0":
+            return None
+        source = _source_path()
+        if source is None:
+            return None
+        so_path = _build(source)
+        if so_path is None:
+            return None
+        try:
+            lib = _bind(ctypes.CDLL(so_path))
+        except OSError:
+            return None
+        if lib.lo_abi_version() != _ABI_VERSION:
+            return None
+        _LIB = lib
+    return _LIB
+
+
+def available() -> bool:
+    return get_lib() is not None

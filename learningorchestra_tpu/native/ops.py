@@ -1,0 +1,452 @@
+"""Numpy-facing wrappers over the native core, each with a pure-Python
+fallback that is semantically equivalent (numeric width inference may
+differ: the native CSV path refines integral float columns to int64 at
+the ingest layer, mirroring the Arrow reader).
+
+These are the host-side hot paths the reference pays Spark/Mongo for
+(SURVEY.md §2.2): CSV -> columnar ingest (database_api_image
+/database.py:99-151's per-row pipeline), per-field value counts
+(histogram_image/histogram.py:25-44), predicate filtering (the Mongo
+``query`` param on every read, database.py:19-28), and shuffled
+minibatch gather for the device feed.
+"""
+
+from __future__ import annotations
+
+import csv as _csv
+import ctypes
+import io
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from learningorchestra_tpu import native
+
+Column = Tuple[str, np.ndarray]  # (kind "f"|"s", values)
+
+_OPS = {"$eq": 0, "$ne": 1, "$lt": 2, "$lte": 3, "$gt": 4, "$gte": 5}
+
+
+# ---------------------------------------------------------------------------
+# CSV parse
+# ---------------------------------------------------------------------------
+
+def parse_csv(buf: bytes, *, delimiter: str = ",",
+              has_header: bool = True,
+              forced_types: Optional[Sequence[int]] = None,
+              ) -> Tuple[List[np.ndarray], List[int]]:
+    """Parse a complete-records CSV buffer into columns.
+
+    Returns ``(columns, types)`` where ``types[j]`` is 0 for float64 and
+    1 for string; float columns are ``np.float64`` arrays (missing ->
+    NaN), string columns ``np.object_`` arrays of ``str``. The header
+    record is skipped, not returned (read it with :func:`csv_header`).
+    ``forced_types`` pins the per-column schema so chunked parses agree.
+    """
+    lib = native.get_lib()
+    if lib is None:
+        return _parse_csv_py(buf, delimiter=delimiter,
+                             has_header=has_header,
+                             forced_types=forced_types)
+    forced = None
+    if forced_types is not None:
+        forced = np.asarray(forced_types, dtype=np.int8)
+        forced = forced.ctypes.data_as(ctypes.POINTER(ctypes.c_int8))
+    handle = lib.lo_csv_parse(buf, len(buf),
+                              delimiter.encode()[:1] or b",",
+                              1 if has_header else 0, forced)
+    if not handle:
+        # ragged/malformed: the Python path raises the detailed error
+        return _parse_csv_py(buf, delimiter=delimiter,
+                             has_header=has_header,
+                             forced_types=forced_types)
+    try:
+        rows = lib.lo_table_rows(handle)
+        cols = lib.lo_table_cols(handle)
+        out_cols: List[np.ndarray] = []
+        out_types: List[int] = []
+        for j in range(cols):
+            ctype = lib.lo_table_col_type(handle, j)
+            out_types.append(int(ctype))
+            if ctype == 0:
+                ptr = lib.lo_table_fcol(handle, j)
+                arr = np.ctypeslib.as_array(ptr, shape=(rows,)).copy() \
+                    if rows else np.empty(0, np.float64)
+                out_cols.append(arr)
+            else:
+                offs_ptr = lib.lo_table_scol_offsets(handle, j)
+                offs = np.ctypeslib.as_array(offs_ptr, shape=(rows + 1,))
+                data_len = lib.lo_table_scol_data_len(handle, j)
+                data = ctypes.string_at(lib.lo_table_scol_data(handle, j),
+                                        data_len) if data_len else b""
+                vals = np.empty(rows, dtype=object)
+                for i in range(rows):
+                    vals[i] = data[offs[i]:offs[i + 1]].decode(
+                        "utf-8", "replace")
+                out_cols.append(vals)
+        return out_cols, out_types
+    finally:
+        lib.lo_table_free(handle)
+
+
+def _parse_csv_py(buf: bytes, *, delimiter: str, has_header: bool,
+                  forced_types: Optional[Sequence[int]],
+                  ) -> Tuple[List[np.ndarray], List[int]]:
+    text = buf.decode("utf-8", "replace")
+    reader = _csv.reader(io.StringIO(text), delimiter=delimiter)
+    records = [r for r in reader if r]
+    if has_header and records:
+        records = records[1:]
+    if not records:
+        return [], list(forced_types or [])
+    ncols = len(records[0])
+    for r in records:
+        if len(r) != ncols:
+            raise ValueError(
+                f"ragged CSV: expected {ncols} fields, got {len(r)}")
+    out_cols: List[np.ndarray] = []
+    out_types: List[int] = []
+    for j in range(ncols):
+        raw = [r[j] for r in records]
+        want = forced_types[j] if forced_types is not None else None
+        floats = None
+        if want in (0, None):
+            floats = np.empty(len(raw), np.float64)
+            ok = True
+            for i, cell in enumerate(raw):
+                cell = cell.strip()
+                if cell == "":
+                    floats[i] = np.nan
+                    continue
+                try:
+                    floats[i] = float(cell)
+                except ValueError:
+                    if want == 0:
+                        floats[i] = np.nan
+                    else:
+                        ok = False
+                        break
+            if not ok:
+                floats = None
+        if floats is not None:
+            out_cols.append(floats)
+            out_types.append(0)
+        else:
+            out_cols.append(np.array(raw, dtype=object))
+            out_types.append(1)
+    return out_cols, out_types
+
+
+def csv_header(first_line: str, delimiter: str = ",") -> List[str]:
+    return next(_csv.reader(io.StringIO(first_line),
+                            delimiter=delimiter))
+
+
+def safe_split(data: bytes) -> int:
+    """Index just past the last newline that terminates a complete CSV
+    record (even number of quote chars before it, so we never split
+    inside a quoted field); -1 when no complete record is buffered."""
+    arr = np.frombuffer(data, np.uint8)
+    newlines = np.flatnonzero(arr == 10)
+    if newlines.size == 0:
+        return -1
+    quote_parity = np.cumsum(arr == 34) & 1
+    complete = newlines[quote_parity[newlines] == 0]
+    if complete.size == 0:
+        return -1
+    return int(complete[-1]) + 1
+
+
+# ---------------------------------------------------------------------------
+# Value counts
+# ---------------------------------------------------------------------------
+
+def value_counts(values: np.ndarray) -> Tuple[List[Any], np.ndarray]:
+    """First-seen-ordered unique values and counts (NaNs bucket
+    together)."""
+    lib = native.get_lib()
+    arr = np.asarray(values)
+    if lib is not None and arr.dtype.kind == "f":
+        v = np.ascontiguousarray(arr, dtype=np.float64)
+        handle = lib.lo_value_counts_f64(
+            v.ctypes.data_as(ctypes.POINTER(ctypes.c_double)), len(v))
+        try:
+            n = lib.lo_counts_n(handle)
+            keys = (np.ctypeslib.as_array(lib.lo_counts_fkeys(handle),
+                                          shape=(n,)).copy()
+                    if n else np.empty(0, np.float64))
+            counts = (np.ctypeslib.as_array(lib.lo_counts_counts(handle),
+                                            shape=(n,)).copy()
+                      if n else np.empty(0, np.int64))
+            return keys.tolist(), counts  # plain floats: JSON-safe keys
+        finally:
+            lib.lo_counts_free(handle)
+    if lib is not None and arr.dtype.kind in ("O", "U"):
+        enc = [str(x).encode("utf-8") for x in arr]
+        offsets = np.zeros(len(enc) + 1, np.int64)
+        np.cumsum([len(b) for b in enc], out=offsets[1:])
+        data = b"".join(enc)
+        handle = lib.lo_value_counts_str(
+            data, offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            len(enc))
+        try:
+            n = lib.lo_counts_n(handle)
+            counts = (np.ctypeslib.as_array(lib.lo_counts_counts(handle),
+                                            shape=(n,)).copy()
+                      if n else np.empty(0, np.int64))
+            soffs = (np.ctypeslib.as_array(lib.lo_counts_soffsets(handle),
+                                           shape=(n + 1,))
+                     if n else np.zeros(1, np.int64))
+            sdata = ctypes.string_at(lib.lo_counts_sdata(handle),
+                                     int(soffs[-1])) if n else b""
+            keys = [sdata[soffs[i]:soffs[i + 1]].decode("utf-8", "replace")
+                    for i in range(n)]
+            return keys, counts
+        finally:
+            lib.lo_counts_free(handle)
+    return _value_counts_py(arr)
+
+
+def _value_counts_py(arr: np.ndarray) -> Tuple[List[Any], np.ndarray]:
+    keys: List[Any] = []
+    index: Dict[Any, int] = {}
+    counts: List[int] = []
+    nan_slot = -1
+    for x in arr.tolist():
+        if isinstance(x, float) and np.isnan(x):
+            if nan_slot < 0:
+                nan_slot = len(keys)
+                keys.append(float("nan"))
+                counts.append(0)
+            counts[nan_slot] += 1
+            continue
+        slot = index.get(x)
+        if slot is None:
+            index[x] = len(keys)
+            keys.append(x)
+            counts.append(1)
+        else:
+            counts[slot] += 1
+    return keys, np.asarray(counts, dtype=np.int64)
+
+
+# ---------------------------------------------------------------------------
+# Predicate filter
+# ---------------------------------------------------------------------------
+
+def filter_mask(columns: Dict[str, np.ndarray],
+                query: Dict[str, Any]) -> Optional[np.ndarray]:
+    """Boolean keep-mask for a Mongo-style AND query over columns.
+
+    Supported per field: scalar equality, ``{"$eq"/"$ne"/"$lt"/"$lte"/
+    "$gt"/"$gte": number}``, string equality/inequality. Returns None if
+    the query shape is unsupported (caller falls back to the row loop).
+    """
+    if not query:
+        return None
+    nrows = None
+    numeric: List[Tuple[np.ndarray, int, float]] = []
+    strings: List[Tuple[np.ndarray, str, bool]] = []
+    for field, cond in query.items():
+        if field not in columns:
+            return None
+        col = np.asarray(columns[field])
+        if nrows is None:
+            nrows = len(col)
+        pairs = (list(cond.items())
+                 if isinstance(cond, dict) else [("$eq", cond)])
+        for op, operand in pairs:
+            if op not in _OPS:
+                return None
+            if isinstance(operand, (int, float)) and not isinstance(
+                    operand, bool) and col.dtype.kind in "fiu":
+                if abs(operand) > 2.0 ** 53:
+                    return None  # f64 staging would lose int precision
+                numeric.append((np.ascontiguousarray(col, np.float64),
+                                _OPS[op], float(operand)))
+            elif isinstance(operand, str) and col.dtype.kind in ("O", "U") \
+                    and op in ("$eq", "$ne"):
+                strings.append((col, operand, op == "$ne"))
+            else:
+                return None
+    if nrows is None:
+        return None
+    lib = native.get_lib()
+    mask = np.ones(nrows, np.uint8)
+    if numeric:
+        if lib is not None:
+            cols_arr = (ctypes.POINTER(ctypes.c_double) * len(numeric))(
+                *[c.ctypes.data_as(ctypes.POINTER(ctypes.c_double))
+                  for c, _, _ in numeric])
+            col_idx = np.arange(len(numeric), dtype=np.int64)
+            ops = np.asarray([o for _, o, _ in numeric], np.int32)
+            operands = np.asarray([v for _, _, v in numeric], np.float64)
+            lib.lo_filter_f64(
+                cols_arr, nrows, len(numeric),
+                col_idx.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+                ops.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+                operands.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+                mask.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)))
+        else:
+            for col, op, v in numeric:
+                keep = {0: col == v, 1: col != v, 2: col < v,
+                        3: col <= v, 4: col > v, 5: col >= v}[op]
+                mask &= keep.astype(np.uint8)
+    for col, want, negate in strings:
+        eq = np.fromiter((x == want for x in col), np.uint8,
+                         count=nrows)
+        mask &= (1 - eq) if negate else eq
+    return mask.astype(bool)
+
+
+def _string_array_buffers(arr) -> Optional[Tuple[bytes, np.ndarray]]:
+    """(data, int64 absolute offsets) views of an Arrow string array,
+    or None when the layout isn't plain string/large_string."""
+    import pyarrow as pa
+
+    if pa.types.is_string(arr.type):
+        off_dtype = np.int32
+    elif pa.types.is_large_string(arr.type):
+        off_dtype = np.int64
+    else:
+        return None
+    bufs = arr.buffers()
+    if len(bufs) < 3 or bufs[1] is None or bufs[2] is None:
+        return None
+    offs = np.frombuffer(bufs[1], off_dtype)[
+        arr.offset:arr.offset + len(arr) + 1]
+    return bufs[2], np.ascontiguousarray(offs, dtype=np.int64)
+
+
+def filter_mask_arrow(table, query: Dict[str, Any],
+                      ) -> Optional[np.ndarray]:
+    """:func:`filter_mask` evaluated directly on an Arrow table —
+    string predicates run in the native core over Arrow's own
+    offset/data buffers (zero copy), numeric predicates over numpy
+    views. Returns None when the query shape needs the per-row Python
+    evaluator."""
+    import pyarrow as pa
+
+    if not query:
+        return None
+    nrows = table.num_rows
+    numeric: Dict[str, Any] = {}
+    strings: List[Tuple[Any, str, bool]] = []
+    for field, cond in query.items():
+        if field not in table.column_names:
+            return None
+        col = table.column(field)
+        pairs = (list(cond.items())
+                 if isinstance(cond, dict) else [("$eq", cond)])
+        for op, operand in pairs:
+            if op not in _OPS:
+                return None
+            if (isinstance(operand, str)
+                    and (pa.types.is_string(col.type)
+                         or pa.types.is_large_string(col.type))
+                    and op in ("$eq", "$ne")):
+                strings.append((col, operand, op == "$ne"))
+            elif (isinstance(operand, (int, float))
+                    and not isinstance(operand, bool)
+                    and (pa.types.is_floating(col.type)
+                         or pa.types.is_integer(col.type))):
+                numeric.setdefault(field, {})[op] = operand
+            else:
+                return None
+    mask = np.ones(nrows, dtype=bool)
+    if numeric:
+        cols = {f: table.column(f).to_numpy(zero_copy_only=False)
+                for f in numeric}
+        num_mask = filter_mask(cols, numeric)
+        if num_mask is None:
+            return None
+        mask &= num_mask
+    lib = native.get_lib()
+    for col, want, negate in strings:
+        arr = col.combine_chunks() if isinstance(
+            col, pa.ChunkedArray) else col
+        eq = None
+        if lib is not None:
+            bufs = _string_array_buffers(arr)
+            if bufs is not None:
+                data, offs = bufs
+                eq8 = np.ones(nrows, np.uint8)
+                needle = want.encode("utf-8")
+                lib.lo_filter_str_eq(
+                    data.address,  # Arrow Buffer, zero copy
+                    offs.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+                    nrows, needle, len(needle), 0,
+                    eq8.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)))
+                eq = eq8.astype(bool)
+        if eq is None:
+            vals = arr.to_numpy(zero_copy_only=False)
+            eq = np.fromiter((x == want for x in vals), bool,
+                             count=nrows)
+        if arr.null_count:
+            null = arr.is_null().to_numpy(zero_copy_only=False)
+            eq &= ~null  # null never equals a string
+        mask &= ~eq if negate else eq
+    return mask
+
+
+def value_counts_arrow(col) -> Tuple[List[Any], np.ndarray]:
+    """Per-column value counts for histograms: native core over Arrow
+    string buffers / float64 views when possible, Arrow's own kernel
+    otherwise (nulls, exotic types)."""
+    import pyarrow as pa
+    import pyarrow.compute as pc
+
+    arr = col.combine_chunks() if isinstance(col, pa.ChunkedArray) else col
+    lib = native.get_lib()
+    if lib is not None and not arr.null_count:
+        # integer columns go to Arrow's kernel so keys stay ints
+        if pa.types.is_floating(arr.type):
+            return value_counts(arr.to_numpy(zero_copy_only=False))
+        bufs = _string_array_buffers(arr)
+        if bufs is not None:
+            data, offs = bufs
+            handle = lib.lo_value_counts_str(
+                data.address,  # Arrow Buffer, zero copy
+                offs.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+                len(arr))
+            try:
+                n = lib.lo_counts_n(handle)
+                counts = (np.ctypeslib.as_array(
+                    lib.lo_counts_counts(handle), shape=(n,)).copy()
+                    if n else np.empty(0, np.int64))
+                soffs = (np.ctypeslib.as_array(
+                    lib.lo_counts_soffsets(handle), shape=(n + 1,))
+                    if n else np.zeros(1, np.int64))
+                sdata = ctypes.string_at(
+                    lib.lo_counts_sdata(handle),
+                    int(soffs[-1])) if n and soffs[-1] else b""
+                keys = [sdata[soffs[i]:soffs[i + 1]].decode(
+                    "utf-8", "replace") for i in range(n)]
+                return keys, counts
+            finally:
+                lib.lo_counts_free(handle)
+    counted = pc.value_counts(arr)
+    return (counted.field("values").to_pylist(),
+            np.asarray(counted.field("counts").to_pylist(),
+                       dtype=np.int64))
+
+
+# ---------------------------------------------------------------------------
+# Batch gather
+# ---------------------------------------------------------------------------
+
+def gather_rows(src: np.ndarray, idx: np.ndarray) -> np.ndarray:
+    """``src[idx]`` for a C-contiguous float32 2-D matrix (native
+    memcpy per row); falls back to numpy fancy indexing otherwise."""
+    lib = native.get_lib()
+    if (lib is None or src.dtype != np.float32 or src.ndim != 2
+            or not src.flags.c_contiguous):
+        return src[idx]
+    idx64 = np.ascontiguousarray(idx, dtype=np.int64)
+    out = np.empty((len(idx64), src.shape[1]), np.float32)
+    lib.lo_gather_f32(
+        src.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        src.shape[0], src.shape[1],
+        idx64.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)), len(idx64),
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)))
+    return out

@@ -69,12 +69,14 @@ class ArrayBatcher:
             rng = np.random.default_rng(self._seed + epoch_index)
             rng.shuffle(order)
         bs = self.batch_size
+        from learningorchestra_tpu.native import ops as nops
         for start in range(0, n, bs):
             idx = order[start:start + bs]
             pad = bs - len(idx)
             batch = {}
             for key, arr in self._arrays.items():
-                take = arr[idx]
+                # native row-memcpy for the common float32 matrix case
+                take = nops.gather_rows(arr, idx)
                 if pad:
                     take = np.concatenate(
                         [take, np.zeros((pad,) + arr.shape[1:], arr.dtype)])

@@ -68,13 +68,16 @@ class HistogramService:
             "result": f"/api/learningOrchestra/v1/explore/{tool}/{name}"}
 
     def _run(self, parent: str, name: str, fields: List[str]) -> None:
+        from learningorchestra_tpu.native import ops as nops
+
         table = self._ctx.catalog.read_table(parent, columns=fields)
         for i, field in enumerate(fields):
-            counts = table.column(field).value_counts()
+            # native-core hash aggregation (csrc/locore.cpp) over the
+            # column buffers; Arrow's kernel covers nulls/exotic types
+            values, counts = nops.value_counts_arrow(table.column(field))
             buckets = [
-                {"_id": v, "count": c} for v, c in zip(
-                    counts.field("values").to_pylist(),
-                    counts.field("counts").to_pylist())]
+                {"_id": v, "count": int(c)}
+                for v, c in zip(values, counts)]
             self._ctx.catalog.append_document(
                 name, {field: buckets})
         self._ctx.catalog.update_metadata(name, {"rows": len(fields)})

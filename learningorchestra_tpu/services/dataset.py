@@ -127,7 +127,20 @@ class DatasetService:
 
     # -- pipelines ------------------------------------------------------
     def _ingest_csv(self, name: str, uri: str) -> None:
-        """download ∥ parse ∥ write, all streaming."""
+        """download ∥ parse ∥ write, all streaming.
+
+        Parsing prefers the first-party native core (csrc/locore.cpp,
+        our equivalent of the native muscle the reference rents from
+        Spark/Mongo — SURVEY §2.2); without a toolchain it rides
+        Arrow's C++ CSV reader instead. Both paths append columnar
+        record batches, removing the reference's per-row ``insert_one``
+        cliff (database.py:144).
+        """
+        from learningorchestra_tpu import native
+
+        if native.available():
+            self._ingest_csv_native(name, uri)
+            return
         from pyarrow import csv as pa_csv
 
         pipe = _PipeReader()
@@ -159,6 +172,117 @@ class DatasetService:
         t.join()
         self._ctx.catalog.update_metadata(
             name, {D.FIELDS_FIELD: fields, "rows": rows})
+
+    def _ingest_csv_native(self, name: str, uri: str) -> None:
+        """Chunked ingest through the native CSV parser: a download
+        thread streams bytes into a pipe (download ∥ parse ∥ write,
+        like the Arrow path), the consumer cuts at quote-safe record
+        boundaries, parses complete records to columns in C++, and
+        appends Parquet record batches. The first data-bearing chunk
+        sniffs per-column types (float64 -> int64 when all values are
+        integral, Arrow-reader parity); later chunks are pinned to that
+        schema (unparseable numerics become nulls)."""
+        from learningorchestra_tpu.native import ops as nops
+
+        pipe = _PipeReader()
+
+        def download() -> None:
+            try:
+                with _open_uri_stream(uri) as stream:
+                    while True:
+                        chunk = stream.read(_CHUNK)
+                        if not chunk:
+                            break
+                        pipe.feed(chunk)
+                pipe.finish()
+            except BaseException as e:  # noqa: BLE001
+                pipe.finish(e)
+
+        t = threading.Thread(target=download, daemon=True,
+                             name=f"lo-ingest-{name}")
+        t.start()
+        header = None
+        forced = None
+        rows = 0
+        buf = b""
+        with self._ctx.catalog.dataset_writer(name) as writer:
+            while True:
+                data = pipe.read(_CHUNK)
+                if not data:
+                    break
+                buf += data
+                if len(buf) < _CHUNK:
+                    continue
+                cut = nops.safe_split(buf)
+                if cut <= 0:
+                    continue
+                chunk, buf = buf[:cut], buf[cut:]
+                header, forced, n = self._write_native_chunk(
+                    writer, chunk, header, forced)
+                rows += n
+            if buf.strip():
+                header, forced, n = self._write_native_chunk(
+                    writer, buf, header, forced)
+                rows += n
+            fields = writer.fields()
+        t.join()
+        self._ctx.catalog.update_metadata(
+            name, {D.FIELDS_FIELD: fields, "rows": rows})
+
+    # forced-type codes carried between chunks: 0 float64, 1 string,
+    # 2 int64 (the C++ core knows 0/1; 2 is refined here)
+    @staticmethod
+    def _write_native_chunk(writer, chunk: bytes, header, forced):
+        import numpy as np
+        import pyarrow as pa
+
+        from learningorchestra_tpu.native import ops as nops
+
+        has_header = header is None
+        if has_header:
+            nl = chunk.find(b"\n")
+            first = chunk[:nl if nl >= 0 else len(chunk)]
+            header = nops.csv_header(
+                first.decode("utf-8", "replace").rstrip("\r"))
+        native_forced = (None if forced is None else
+                         [1 if t == 1 else 0 for t in forced])
+        cols, types = nops.parse_csv(chunk, has_header=has_header,
+                                     forced_types=native_forced)
+        n = len(cols[0]) if cols else 0
+        if n == 0:
+            # header-only / blank chunk: nothing learned, nothing
+            # pinned (a zero-row sniff would default every column to
+            # float64 and corrupt later string chunks)
+            return header, forced, 0
+        if len(cols) != len(header):
+            raise ValueError(
+                f"CSV has {len(cols)} columns but header names "
+                f"{len(header)}")
+        if forced is None:
+            forced = list(types)
+            for j, (kind, col) in enumerate(zip(types, cols)):
+                if kind != 0:
+                    continue
+                finite = col[np.isfinite(col)]
+                if (finite.size and np.all(finite == np.floor(finite))
+                        and np.all(np.abs(finite) < 2.0 ** 53)):
+                    forced[j] = 2
+        arrays = []
+        for kind, col in zip(forced, cols):
+            if kind == 1:
+                arrays.append(pa.array(col.tolist(), type=pa.string()))
+                continue
+            # from_pandas: NaN -> null, matching the Arrow CSV reader's
+            # empty-cell handling (and keeping row reads JSON-safe)
+            arr = pa.array(np.asarray(col, np.float64), from_pandas=True)
+            if kind == 2:
+                # a later chunk with non-integral values fails the safe
+                # cast — same error class as Arrow's streaming reader
+                # hitting a type change after block-1 inference
+                arr = arr.cast(pa.int64())
+            arrays.append(arr)
+        writer.write_batch(pa.Table.from_arrays(arrays, names=header))
+        return header, forced, n
 
     def _ingest_generic(self, name: str, uri: str) -> None:
         buf = io.BytesIO()

@@ -1,0 +1,235 @@
+"""Native core (csrc/locore.cpp) — build, parity with the pure-Python
+fallbacks, and the ingest/query/batcher wiring."""
+
+import math
+
+import numpy as np
+import pytest
+
+from learningorchestra_tpu import native
+from learningorchestra_tpu.native import ops
+
+CSV = (b"name,age,score\n"
+       b"alice,30,1.5\n"
+       b'"bob, jr",41,\n'
+       b'"say ""hi""",-2,0\n'
+       b"carol,7e1,2.25\r\n")
+
+
+def test_native_builds():
+    # g++ is baked into the image; the toolchain path must work here
+    assert native.available()
+
+
+def test_csv_parse_native_matches_python():
+    cols, types = ops.parse_csv(CSV)
+    pcols, ptypes = ops._parse_csv_py(CSV, delimiter=",", has_header=True,
+                                      forced_types=None)
+    assert types == ptypes == [1, 0, 0]
+    assert list(cols[0]) == list(pcols[0]) == [
+        "alice", "bob, jr", 'say "hi"', "carol"]
+    np.testing.assert_array_equal(cols[1], [30.0, 41.0, -2.0, 70.0])
+    np.testing.assert_array_equal(cols[1], pcols[1])
+    assert math.isnan(cols[2][1]) and math.isnan(pcols[2][1])
+    np.testing.assert_array_equal(cols[2][[0, 2, 3]], [1.5, 0.0, 2.25])
+
+
+def test_csv_parse_forced_types():
+    # chunk 2 of a split file: no header, schema pinned by chunk 1
+    chunk = b"dave,notanumber,3\n"
+    cols, types = ops.parse_csv(chunk, has_header=False,
+                                forced_types=[1, 0, 0])
+    assert types == [1, 0, 0]
+    assert cols[0][0] == "dave"
+    assert math.isnan(cols[1][0])  # forced numeric, unparseable -> NaN
+    assert cols[2][0] == 3.0
+
+
+def test_csv_parse_ragged_raises():
+    with pytest.raises(ValueError):
+        ops.parse_csv(b"a,b\n1,2\n3\n")
+
+
+def test_safe_split_respects_quotes():
+    data = b'a,b\n1,"x\ny",\n2,'
+    cut = ops.safe_split(data)
+    # the newline inside the quoted field must not be chosen
+    assert data[:cut] == b'a,b\n1,"x\ny",\n'
+
+
+def test_value_counts_parity_floats_and_strings():
+    v = np.array([1.0, 2.0, 1.0, np.nan, np.nan, 3.0])
+    keys, counts = ops.value_counts(v)
+    pkeys, pcounts = ops._value_counts_py(v)
+    assert [k if not (isinstance(k, float) and math.isnan(k)) else "nan"
+            for k in keys] == [1.0, 2.0, "nan", 3.0]
+    np.testing.assert_array_equal(counts, [2, 1, 2, 1])
+    np.testing.assert_array_equal(counts, pcounts)
+    assert len(pkeys) == len(keys)
+
+    s = np.array(["x", "y", "x", "z", "x"], dtype=object)
+    keys, counts = ops.value_counts(s)
+    assert keys == ["x", "y", "z"]
+    np.testing.assert_array_equal(counts, [3, 1, 1])
+
+
+def test_filter_mask_numeric_and_string():
+    cols = {"age": np.array([30.0, 41.0, -2.0, 70.0]),
+            "name": np.array(["a", "b", "a", "c"], dtype=object)}
+    mask = ops.filter_mask(cols, {"age": {"$gt": 0, "$lt": 50}})
+    np.testing.assert_array_equal(mask, [True, True, False, False])
+    mask = ops.filter_mask(cols, {"name": "a", "age": {"$gte": -2}})
+    np.testing.assert_array_equal(mask, [True, False, True, False])
+    mask = ops.filter_mask(cols, {"name": {"$ne": "a"}})
+    np.testing.assert_array_equal(mask, [False, True, False, True])
+    # unsupported shapes defer to the row evaluator
+    assert ops.filter_mask(cols, {"age": {"$in": [30.0]}}) is None
+    assert ops.filter_mask(cols, {"missing": 1}) is None
+
+
+def test_whitespace_cell_stays_numeric():
+    # parity: a spaces-only cell is "missing" in BOTH paths (review
+    # finding: native used to demote the whole column to string)
+    buf = b"x\n1\n  \n3\n"
+    cols, types = ops.parse_csv(buf)
+    pcols, ptypes = ops._parse_csv_py(buf, delimiter=",",
+                                      has_header=True, forced_types=None)
+    assert types == ptypes == [0]
+    assert math.isnan(cols[0][1]) and math.isnan(pcols[0][1])
+
+
+def test_filter_mask_arrow_strings_and_ints():
+    import pyarrow as pa
+
+    table = pa.table({
+        "age": pa.array([30, 41, None, 70], type=pa.int64()),
+        "name": pa.array(["a", "b", None, "a"]),
+    })
+    mask = ops.filter_mask_arrow(table, {"name": "a"})
+    np.testing.assert_array_equal(mask, [True, False, False, True])
+    # null passes $ne (None != "a"), matching matches_query
+    mask = ops.filter_mask_arrow(table, {"name": {"$ne": "a"}})
+    np.testing.assert_array_equal(mask, [False, True, True, False])
+    mask = ops.filter_mask_arrow(table, {"age": {"$gte": 41}, "name": "a"})
+    np.testing.assert_array_equal(mask, [False, False, False, True])
+    assert ops.filter_mask_arrow(table, {"age": {"$in": [30]}}) is None
+
+
+def test_value_counts_arrow_native_and_fallback():
+    import pyarrow as pa
+
+    col = pa.chunked_array([["x", "y"], ["x", "z", "x"]])
+    keys, counts = ops.value_counts_arrow(col)
+    assert dict(zip(keys, counts.tolist())) == {"x": 3, "y": 1, "z": 1}
+    ints = pa.chunked_array([[1, 2, 2, None]])
+    keys, counts = ops.value_counts_arrow(ints)
+    assert dict(zip([k for k in keys], counts.tolist())) == {
+        1: 1, 2: 2, None: 1}
+    floats = pa.chunked_array([[1.5, 1.5, 2.0]])
+    keys, counts = ops.value_counts_arrow(floats)
+    assert dict(zip(keys, counts.tolist())) == {1.5: 2, 2.0: 1}
+    assert all(isinstance(k, float) for k in keys)  # JSON-safe
+
+
+def test_eq_operator_consistency():
+    from learningorchestra_tpu.catalog import documents as D
+
+    assert D.matches_query({"a": 30}, {"a": {"$eq": 30}})
+    assert not D.matches_query({"a": 31}, {"a": {"$eq": 30}})
+    cols = {"a": np.array([30.0, 31.0])}
+    np.testing.assert_array_equal(
+        ops.filter_mask(cols, {"a": {"$eq": 30}}), [True, False])
+
+
+def test_header_only_first_chunk_does_not_pin_schema(tmp_config,
+                                                     tmp_path):
+    """Review finding: a chunk boundary right after the header must not
+    pin every column to float64."""
+    import learningorchestra_tpu.services.dataset as dataset_mod
+    from learningorchestra_tpu.services.context import ServiceContext
+    from learningorchestra_tpu.services.dataset import DatasetService
+
+    # header is exactly one small chunk; rows arrive later
+    csv_path = tmp_path / "late.csv"
+    csv_path.write_text("name,age\n" + "".join(
+        f"person{i},{i}\n" for i in range(200)))
+    ctx = ServiceContext(tmp_config)
+    svc = DatasetService(ctx)
+    old_chunk = dataset_mod._CHUNK
+    dataset_mod._CHUNK = 16  # header alone fills the first chunk
+    try:
+        svc.create({"datasetName": "late",
+                    "datasetURI": csv_path.as_uri()}, "csv")
+        ctx.jobs.wait("late", timeout=60)
+    finally:
+        dataset_mod._CHUNK = old_chunk
+    rows = ctx.catalog.read_rows("late", limit=2)
+    assert rows[0]["name"] == "person0"
+    assert rows[0]["age"] == 0  # integral column refined to int64
+
+
+def test_gather_rows_matches_fancy_indexing():
+    src = np.arange(20, dtype=np.float32).reshape(5, 4)
+    idx = np.array([3, 1, 1, 0])
+    np.testing.assert_array_equal(ops.gather_rows(src, idx), src[idx])
+    # non-eligible dtype silently falls back
+    src64 = src.astype(np.float64)
+    np.testing.assert_array_equal(ops.gather_rows(src64, idx), src64[idx])
+
+
+def test_native_ingest_end_to_end(tmp_config, tmp_path):
+    from learningorchestra_tpu.services.context import ServiceContext
+
+    from learningorchestra_tpu.services.dataset import DatasetService
+
+    csv_path = tmp_path / "people.csv"
+    csv_path.write_bytes(CSV)
+    ctx = ServiceContext(tmp_config)
+    svc = DatasetService(ctx)
+    status, _ = svc.create(
+        {"datasetName": "people", "datasetURI": csv_path.as_uri()}, "csv")
+    assert status == 201
+    ctx.jobs.wait("people", timeout=30)
+    meta = ctx.catalog.get_metadata("people")
+    assert meta["finished"] is True
+    assert meta["fields"] == ["name", "age", "score"]
+    assert meta["rows"] == 4
+    rows = ctx.catalog.read_rows("people")
+    assert rows[0] == {"name": "alice", "age": 30.0, "score": 1.5,
+                       "_id": 1}
+    assert rows[1]["score"] is None  # empty numeric cell -> null
+    # columnar fast-path query matches the row evaluator
+    q = {"age": {"$gt": 0}}
+    fast = ctx.catalog.read_rows("people", query=q)
+    import learningorchestra_tpu.catalog.documents as D
+    slow = [r for r in ctx.catalog.read_rows("people")
+            if D.matches_query(r, q)]
+    assert fast == slow
+
+
+def test_chunked_native_ingest_large(tmp_config, tmp_path):
+    """Multi-chunk path: file bigger than one chunk, schema pinned."""
+    import learningorchestra_tpu.services.dataset as dataset_mod
+    from learningorchestra_tpu.services.context import ServiceContext
+    from learningorchestra_tpu.services.dataset import DatasetService
+
+    n = 5000
+    lines = ["x,label"] + [f"{i}.5,row{i % 7}" for i in range(n)]
+    csv_path = tmp_path / "big.csv"
+    csv_path.write_text("\n".join(lines) + "\n")
+    ctx = ServiceContext(tmp_config)
+    svc = DatasetService(ctx)
+    old_chunk = dataset_mod._CHUNK
+    dataset_mod._CHUNK = 4096  # force many chunks
+    try:
+        svc.create({"datasetName": "big",
+                    "datasetURI": csv_path.as_uri()}, "csv")
+        ctx.jobs.wait("big", timeout=60)
+    finally:
+        dataset_mod._CHUNK = old_chunk
+    meta = ctx.catalog.get_metadata("big")
+    assert meta["rows"] == n
+    assert meta["finished"] is True
+    rows = ctx.catalog.read_rows("big", skip=n - 1)
+    assert rows[0]["x"] == n - 1 + 0.5
+    assert rows[0]["label"] == f"row{(n - 1) % 7}"
