@@ -1,0 +1,14 @@
+"""Pallas TPU kernels for the hot ops.
+
+The reference has no custom kernels (its native muscle is rented from
+Spark/Mongo, SURVEY §2.2); here the compute path is first-party:
+fused flash attention for the transformer family, written against the
+MXU/VMEM model from the Pallas TPU guide. Everything degrades to an
+interpret-mode run on CPU so the 8-virtual-device test mesh exercises
+the same code path the TPU compiles.
+"""
+
+from learningorchestra_tpu.ops.attention import (  # noqa: F401
+    flash_attention,
+    reference_attention,
+)

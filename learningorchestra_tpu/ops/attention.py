@@ -1,0 +1,255 @@
+"""Fused flash attention (Pallas TPU kernel).
+
+Forward: one ``pallas_call`` over a ``(batch*heads, q_blocks,
+kv_blocks)`` grid — the Q tile stays resident in VMEM while K/V tiles
+stream past it, an online-softmax accumulator (running max +
+log-sum-exp) keeps the math exact, and scores never round-trip to HBM.
+The MXU sees two matmuls per tile (``q·kᵀ`` and ``p·v``), both with
+``preferred_element_type=float32``.
+
+Backward: custom VJP via the standard flash recurrence — a
+``lax.scan`` over K/V blocks recomputes each score tile from the saved
+log-sum-exp, so the (seq × seq) score matrix is never materialised
+(memory stays O(seq · block) however long the context). XLA maps the
+per-block einsums onto the MXU; a hand-scheduled Pallas backward adds
+little beyond what this scan already fuses.
+
+The reference framework has no attention op at all (SURVEY §5
+"long-context" row — sequence models run inside user TF code through
+the generic executor, binary_execution.py:177-189); flash attention is
+one of the net-new TPU-first components. On CPU (tests, the 8-virtual-
+device mesh) the same kernel runs in interpreter mode.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+
+
+def _round_up(x: int, m: int) -> int:
+    return ((x + m - 1) // m) * m
+
+
+def _auto_interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+# ----------------------------------------------------------------------
+# forward kernel
+# ----------------------------------------------------------------------
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
+                acc_ref, m_ref, l_ref,
+                *, scale: float, causal: bool, kv_len: int,
+                block_q: int, block_k: int):
+    i = pl.program_id(1)
+    j = pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(j == 0)
+    def _init():
+        m_ref[...] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    # causal: skip K/V tiles strictly above the diagonal band
+    run = True
+    if causal:
+        run = j * block_k <= i * block_q + block_q - 1
+
+    @pl.when(run)
+    def _tile():
+        q = q_ref[0]                       # (block_q, d)
+        k = k_ref[0]                       # (block_k, d)
+        v = v_ref[0]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale   # (bq, bk)
+
+        col = j * block_k + lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1)
+        valid = col < kv_len
+        if causal:
+            row = i * block_q + lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            valid = jnp.logical_and(valid, row >= col)
+        s = jnp.where(valid, s, NEG_INF)
+
+        m_prev = m_ref[:, :1]                              # (bq, 1)
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        # guard: a fully-masked row has s = m_new = NEG_INF and
+        # exp(0) = 1 junk — zero it explicitly
+        p = jnp.where(valid, jnp.exp(s - m_new), 0.0)
+        alpha = jnp.exp(m_prev - m_new)                    # (bq, 1)
+        acc_ref[...] = acc_ref[...] * alpha + jax.lax.dot_general(
+            p, v.astype(jnp.float32), (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        l_ref[...] = l_ref[...] * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        m_ref[...] = m_new * jnp.ones_like(m_ref)
+
+    @pl.when(j == nk - 1)
+    def _finish():
+        l = l_ref[:, :1]
+        safe_l = jnp.where(l > 0, l, 1.0)
+        o_ref[0] = (acc_ref[...] / safe_l).astype(o_ref.dtype)
+        m = m_ref[:, :1]
+        lse = jnp.where(l > 0, m + jnp.log(safe_l), 0.0)
+        lse_ref[0] = lse[:, 0]
+
+
+def _fwd_pallas(q, k, v, *, scale: float, causal: bool,
+                block_q: int, block_k: int, interpret: bool
+                ) -> Tuple[jax.Array, jax.Array]:
+    """q/k/v: (bh, s, d) — returns (o (bh, sq, d), lse (bh, sq))."""
+    bh, sq, d = q.shape
+    sk = k.shape[1]
+    block_q = min(block_q, _round_up(sq, 8))
+    block_k = min(block_k, _round_up(sk, 8))
+    sq_p, sk_p = _round_up(sq, block_q), _round_up(sk, block_k)
+    d_p = _round_up(d, 128)
+    q = jnp.pad(q, ((0, 0), (0, sq_p - sq), (0, d_p - d)))
+    k = jnp.pad(k, ((0, 0), (0, sk_p - sk), (0, d_p - d)))
+    v = jnp.pad(v, ((0, 0), (0, sk_p - sk), (0, d_p - d)))
+
+    grid = (bh, sq_p // block_q, sk_p // block_k)
+    kernel = functools.partial(
+        _fwd_kernel, scale=scale, causal=causal, kv_len=sk,
+        block_q=block_q, block_k=block_k)
+    lanes = 128
+    scratch = [
+        pltpu.VMEM((block_q, d_p), jnp.float32),
+        pltpu.VMEM((block_q, lanes), jnp.float32),
+        pltpu.VMEM((block_q, lanes), jnp.float32),
+    ]
+    o, lse = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q, d_p), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_k, d_p), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d_p), lambda b, i, j: (b, j, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_q, d_p), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_q), lambda b, i, j: (b, i)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, sq_p, d_p), q.dtype),
+            jax.ShapeDtypeStruct((bh, sq_p), jnp.float32),
+        ],
+        scratch_shapes=scratch,
+        interpret=interpret,
+    )(q, k, v)
+    return o[:, :sq, :d], lse[:, :sq]
+
+
+# ----------------------------------------------------------------------
+# backward: blockwise scan over K/V tiles (flash recurrence)
+# ----------------------------------------------------------------------
+def _bwd_one_head(q, k, v, o, lse, do, *, scale: float, causal: bool,
+                  block_k: int):
+    """Single (s, d) head. Returns (dq, dk, dv) in float32."""
+    sq, d = q.shape
+    sk = k.shape[0]
+    sk_p = _round_up(sk, block_k)
+    k = jnp.pad(k, ((0, sk_p - sk), (0, 0)))
+    v = jnp.pad(v, ((0, sk_p - sk), (0, 0)))
+    nk = sk_p // block_k
+
+    qf = q.astype(jnp.float32)
+    kf = k.astype(jnp.float32).reshape(nk, block_k, d)
+    vf = v.astype(jnp.float32).reshape(nk, block_k, d)
+    dof = do.astype(jnp.float32)
+    delta = jnp.sum(dof * o.astype(jnp.float32), axis=-1)   # (sq,)
+    rows = jnp.arange(sq)
+
+    def step(dq, blk):
+        kj, vj, j = blk
+        s = (qf @ kj.T) * scale                             # (sq, bk)
+        col = j * block_k + jnp.arange(block_k)
+        valid = (col < sk)[None, :]
+        if causal:
+            valid = jnp.logical_and(valid, rows[:, None] >= col[None, :])
+        p = jnp.where(valid, jnp.exp(s - lse[:, None]), 0.0)
+        dv_j = p.T @ dof                                    # (bk, d)
+        dp = dof @ vj.T                                     # (sq, bk)
+        ds = p * (dp - delta[:, None]) * scale
+        dk_j = ds.T @ qf
+        return dq + ds @ kj, (dk_j, dv_j)
+
+    dq0 = jnp.zeros((sq, d), jnp.float32)
+    dq, (dk, dv) = lax.scan(step, dq0, (kf, vf, jnp.arange(nk)))
+    return dq, dk.reshape(sk_p, d)[:sk], dv.reshape(sk_p, d)[:sk]
+
+
+# ----------------------------------------------------------------------
+# custom-vjp wrapper
+# ----------------------------------------------------------------------
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash(q, k, v, causal, scale, block_q, block_k, interpret):
+    o, _ = _fwd_pallas(q, k, v, scale=scale, causal=causal,
+                       block_q=block_q, block_k=block_k,
+                       interpret=interpret)
+    return o
+
+
+def _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret):
+    o, lse = _fwd_pallas(q, k, v, scale=scale, causal=causal,
+                         block_q=block_q, block_k=block_k,
+                         interpret=interpret)
+    return o, (q, k, v, o, lse)
+
+
+def _flash_bwd(causal, scale, block_q, block_k, interpret, res, g):
+    q, k, v, o, lse = res
+    bwd = jax.vmap(functools.partial(
+        _bwd_one_head, scale=scale, causal=causal, block_k=block_k))
+    dq, dk, dv = bwd(q, k, v, o, lse, g)
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+# ----------------------------------------------------------------------
+# public API
+# ----------------------------------------------------------------------
+def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
+                    causal: bool = False, scale: Optional[float] = None,
+                    block_q: int = 128, block_k: int = 128,
+                    interpret: Optional[bool] = None) -> jax.Array:
+    """Fused attention over (batch, seq, heads, head_dim) arrays.
+
+    Layout matches :mod:`learningorchestra_tpu.parallel.ring` so the
+    transformer can swap between single-chip flash and ring/Ulysses SP
+    without reshuffling. Differentiable (custom VJP).
+    """
+    b, sq, h, d = q.shape
+    if scale is None:
+        scale = 1.0 / (d ** 0.5)
+    if interpret is None:
+        interpret = _auto_interpret()
+
+    def merge(x):
+        return x.transpose(0, 2, 1, 3).reshape(b * h, x.shape[1], d)
+
+    o = _flash(merge(q), merge(k), merge(v), causal, float(scale),
+               int(block_q), int(block_k), bool(interpret))
+    return o.reshape(b, h, sq, d).transpose(0, 2, 1, 3)
+
+
+def reference_attention(q, k, v, causal: bool = False,
+                        scale: Optional[float] = None) -> jax.Array:
+    """Unfused full-softmax oracle (same layout/contract)."""
+    from learningorchestra_tpu.parallel.ring import full_attention_reference
+
+    return full_attention_reference(q, k, v, causal=causal, scale=scale)

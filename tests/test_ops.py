@@ -1,0 +1,73 @@
+"""Flash attention kernel vs the full-softmax oracle.
+
+Runs the real Pallas kernel in interpreter mode on the CPU backend
+(same kernel source the TPU compiles), checking values AND gradients
+against reference_attention.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from learningorchestra_tpu.ops import flash_attention, reference_attention
+
+
+def _rand(shape, key):
+    return jax.random.normal(jax.random.PRNGKey(key), shape, jnp.float32)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+@pytest.mark.parametrize("sq,sk", [(32, 32), (40, 56)])
+def test_forward_matches_reference(causal, sq, sk):
+    if causal and sq != sk:
+        pytest.skip("causal oracle assumes square positions")
+    b, h, d = 2, 3, 16
+    q, k, v = (_rand((b, s, h, d), i)
+               for i, s in enumerate((sq, sk, sk)))
+    out = flash_attention(q, k, v, causal=causal, block_q=16, block_k=16)
+    ref = reference_attention(q, k, v, causal=causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_gradients_match_reference(causal):
+    b, s, h, d = 1, 24, 2, 8
+    q, k, v = (_rand((b, s, h, d), 10 + i) for i in range(3))
+
+    def loss_flash(q, k, v):
+        o = flash_attention(q, k, v, causal=causal, block_q=8, block_k=8)
+        return jnp.sum(jnp.sin(o))
+
+    def loss_ref(q, k, v):
+        return jnp.sum(jnp.sin(reference_attention(q, k, v, causal=causal)))
+
+    g1 = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    g2 = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b_ in zip(g1, g2):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                   atol=5e-5, rtol=5e-4)
+
+
+def test_jit_and_uneven_blocks():
+    b, s, h, d = 2, 50, 2, 12  # nothing divides the block sizes
+    q, k, v = (_rand((b, s, h, d), 20 + i) for i in range(3))
+    f = jax.jit(lambda q, k, v: flash_attention(
+        q, k, v, causal=True, block_q=16, block_k=16))
+    out = f(q, k, v)
+    ref = reference_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+
+
+def test_bfloat16_path():
+    b, s, h, d = 1, 32, 2, 16
+    q, k, v = (_rand((b, s, h, d), 30 + i).astype(jnp.bfloat16)
+               for i in range(3))
+    out = flash_attention(q, k, v, causal=False, block_q=16, block_k=16)
+    assert out.dtype == jnp.bfloat16
+    ref = reference_attention(q, k, v)
+    np.testing.assert_allclose(np.asarray(out, np.float32),
+                               np.asarray(ref, np.float32),
+                               atol=3e-2, rtol=3e-2)
