@@ -1,0 +1,560 @@
+"""Decoder-only transformer family — the framework's flagship
+long-context architecture.
+
+The reference has no attention or transformer anywhere (SURVEY §5
+"long-context" row: sequence models run as opaque user TF code through
+the generic executor, binary_execution.py:177-189). This module is the
+net-new TPU-first model family the parallelism library was built for:
+
+- param naming matches ``parallel.sharding.TRANSFORMER_RULES`` exactly
+  (``embed/embedding``, ``q_proj|k_proj|v_proj|o_proj/kernel``,
+  ``gate|up_proj|down_proj/kernel``, ``experts/wi|wo``,
+  ``lm_head/kernel``), so TP/FSDP/EP sharding is a table lookup;
+- attention is pluggable per config: ``dot`` (XLA-fused reference),
+  ``flash`` (Pallas kernel, shard_map'd over heads so TP keeps the
+  kernel local), ``ring`` (sequence-parallel KV rotation over ``sp``),
+  ``ulysses`` (all-to-all head scatter over ``sp``);
+- rotary position embeddings + RMSNorm + gated-SiLU MLP — the modern
+  decoder block, all MXU-shaped matmuls;
+- optional mixture-of-experts MLP (``n_experts > 0``) through
+  ``parallel.moe`` with expert parallelism over ``ep``.
+
+``LanguageModel`` wraps the flax module in the same keras-shaped
+method surface as :class:`~learningorchestra_tpu.models.neural.
+NeuralModel` (fit/evaluate/predict + generate), because those method
+names and kwargs are the reference's REST contract
+(``method: "fit"``, binary_executor_image/server.py:23-71).
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import os
+from typing import Any, Dict, List, Optional, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from learningorchestra_tpu.ops import attention as attn_ops
+from learningorchestra_tpu.parallel import moe as moe_lib
+from learningorchestra_tpu.parallel import ring as ring_lib
+from learningorchestra_tpu.parallel import sharding as sharding_lib
+from learningorchestra_tpu.parallel import ulysses as ulysses_lib
+from learningorchestra_tpu.runtime import data as data_lib
+from learningorchestra_tpu.runtime import engine as engine_lib
+from learningorchestra_tpu.runtime import mesh as mesh_lib
+
+ATTENTION_IMPLS = ("dot", "flash", "ring", "ulysses")
+
+
+# ----------------------------------------------------------------------
+# rotary position embeddings
+# ----------------------------------------------------------------------
+def rope_tables(seq_len: int, head_dim: int, base: float = 10000.0,
+                offset: int = 0) -> Tuple[jax.Array, jax.Array]:
+    half = head_dim // 2
+    freqs = 1.0 / (base ** (jnp.arange(half, dtype=jnp.float32) / half))
+    pos = jnp.arange(offset, offset + seq_len, dtype=jnp.float32)
+    ang = pos[:, None] * freqs[None, :]                    # (s, half)
+    return jnp.cos(ang), jnp.sin(ang)
+
+
+def apply_rope(x: jax.Array, cos: jax.Array, sin: jax.Array) -> jax.Array:
+    """x: (b, s, h, d) with d even; rotate pairs (x1, x2)."""
+    half = x.shape[-1] // 2
+    x1, x2 = x[..., :half], x[..., half:]
+    c = cos[None, :, None, :].astype(x.dtype)
+    s = sin[None, :, None, :].astype(x.dtype)
+    return jnp.concatenate([x1 * c - x2 * s, x1 * s + x2 * c], axis=-1)
+
+
+# ----------------------------------------------------------------------
+# flax modules
+# ----------------------------------------------------------------------
+class _Experts(nn.Module):
+    """Bare param holder so expert weights live at ``.../experts/*``
+    where the EP sharding rules expect them."""
+    n_experts: int
+    d_model: int
+    d_ff: int
+
+    @nn.compact
+    def __call__(self):
+        wi = self.param(
+            "wi", nn.initializers.normal(1.0 / math.sqrt(self.d_model)),
+            (self.n_experts, self.d_model, self.d_ff))
+        wo = self.param(
+            "wo", nn.initializers.normal(1.0 / math.sqrt(self.d_ff)),
+            (self.n_experts, self.d_ff, self.d_model))
+        return wi, wo
+
+
+class _Attention(nn.Module):
+    n_heads: int
+    head_dim: int
+    impl: str
+    causal: bool
+
+    @nn.compact
+    def __call__(self, x, train: bool):
+        d_model = x.shape[-1]
+        proj = self.n_heads * self.head_dim
+        dense = lambda name, feats: nn.Dense(  # noqa: E731
+            feats, use_bias=False, name=name)
+        b, s, _ = x.shape
+        shape4 = (b, s, self.n_heads, self.head_dim)
+        q = dense("q_proj", proj)(x).reshape(shape4)
+        k = dense("k_proj", proj)(x).reshape(shape4)
+        v = dense("v_proj", proj)(x).reshape(shape4)
+
+        cos, sin = rope_tables(s, self.head_dim)
+        q, k = apply_rope(q, cos, sin), apply_rope(k, cos, sin)
+
+        o = _dispatch_attention(q, k, v, impl=self.impl,
+                                causal=self.causal)
+        o = o.reshape(b, s, proj)
+        return dense("o_proj", d_model)(o)
+
+
+def _dispatch_attention(q, k, v, *, impl: str, causal: bool):
+    mesh = mesh_lib.get_default_mesh()
+    b, s, h, _ = q.shape
+    data_size = mesh_lib.data_parallel_size(mesh)
+    sp = mesh.shape.get(mesh_lib.SP, 1)
+    tp = mesh.shape.get(mesh_lib.TP, 1)
+    # shard_map needs every mapped dim to divide its mesh axis; the
+    # 1-sample param-init trace (and odd user shapes) fall back to the
+    # fused full-softmax path, which is numerically identical
+    divisible = b % data_size == 0 and s % sp == 0
+
+    if impl == "ring" and sp > 1 and divisible:
+        return ring_lib.ring_attention_sharded(q, k, v, mesh, causal=causal)
+    if impl == "ulysses" and sp > 1 and divisible and h % sp == 0:
+        return ulysses_lib.ulysses_attention_sharded(q, k, v, mesh,
+                                                     causal=causal)
+    if impl == "flash":
+        sharded = tp > 1 or data_size > 1
+        if not sharded:
+            return attn_ops.flash_attention(q, k, v, causal=causal)
+        if b % data_size == 0 and h % tp == 0:
+            # pallas_call is opaque to GSPMD — shard_map it so each
+            # device runs the kernel on its local (batch, heads) tile
+            # and TP never gathers heads
+            data = mesh_lib.data_axes(mesh)
+            spec = P(data if data else None, None,
+                     mesh_lib.TP if tp > 1 else None, None)
+            # check_vma=False: pallas_call emits ShapeDtypeStructs with
+            # no varying-mesh-axes info, which the vma checker rejects
+            fn = jax.shard_map(
+                lambda a, b_, c: attn_ops.flash_attention(a, b_, c,
+                                                          causal=causal),
+                mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+                check_vma=False)
+            return fn(q, k, v)
+    # "dot" and all fallbacks (no sp axis, non-divisible shapes)
+    return ring_lib.full_attention_reference(q, k, v, causal=causal)
+
+
+class _MLP(nn.Module):
+    d_ff: int
+
+    @nn.compact
+    def __call__(self, x):
+        d_model = x.shape[-1]
+        gate = nn.Dense(self.d_ff, use_bias=False, name="gate")(x)
+        up = nn.Dense(self.d_ff, use_bias=False, name="up_proj")(x)
+        h = nn.silu(gate) * up
+        return nn.Dense(d_model, use_bias=False, name="down_proj")(h)
+
+
+class _MoE(nn.Module):
+    n_experts: int
+    d_ff: int
+    k: int = 2
+
+    @nn.compact
+    def __call__(self, x):
+        d_model = x.shape[-1]
+        gate = self.param("gate",
+                          nn.initializers.normal(1.0 / math.sqrt(d_model)),
+                          (d_model, self.n_experts))
+        wi, wo = _Experts(self.n_experts, d_model, self.d_ff,
+                          name="experts")()
+        params = {"gate": gate, "experts": {"wi": wi, "wo": wo}}
+        mesh = mesh_lib.get_default_mesh()
+        ep_mesh = mesh if (mesh_lib.EP in mesh.axis_names and
+                           mesh.shape[mesh_lib.EP] > 1) else None
+        return moe_lib.moe_layer(params, x, k=self.k, mesh=ep_mesh)
+
+
+class _Block(nn.Module):
+    n_heads: int
+    head_dim: int
+    d_ff: int
+    attention: str
+    causal: bool
+    n_experts: int
+    moe_k: int
+    dropout: float
+
+    @nn.compact
+    def __call__(self, x, train: bool):
+        h = nn.RMSNorm(name="attn_norm")(x)
+        h = _Attention(self.n_heads, self.head_dim, self.attention,
+                       self.causal, name="attn")(h, train)
+        if self.dropout and train:
+            h = nn.Dropout(self.dropout, deterministic=False)(h)
+        x = x + h
+        h = nn.RMSNorm(name="mlp_norm")(x)
+        aux = jnp.zeros((), jnp.float32)
+        if self.n_experts > 0:
+            h, aux = _MoE(self.n_experts, self.d_ff, self.moe_k,
+                          name="moe")(h)
+        else:
+            h = _MLP(self.d_ff, name="mlp")(h)
+        if self.dropout and train:
+            h = nn.Dropout(self.dropout, deterministic=False)(h)
+        return x + h, aux
+
+
+class TransformerLM(nn.Module):
+    """Decoder-only LM: tokens (b, s) int32 -> (logits (b, s, V), aux).
+
+    ``aux`` is the summed MoE load-balance loss (zero for dense MLP).
+    """
+    vocab_size: int
+    d_model: int = 256
+    n_layers: int = 4
+    n_heads: int = 4
+    d_ff: int = 0            # 0 -> 4 * d_model
+    attention: str = "dot"
+    causal: bool = True
+    n_experts: int = 0
+    moe_k: int = 2
+    dropout: float = 0.0
+
+    @nn.compact
+    def __call__(self, tokens, train: bool = False):
+        if self.attention not in ATTENTION_IMPLS:
+            raise ValueError(f"unknown attention impl: {self.attention!r}")
+        d_ff = self.d_ff or 4 * self.d_model
+        head_dim = self.d_model // self.n_heads
+        mesh = mesh_lib.get_default_mesh()
+
+        x = nn.Embed(self.vocab_size, self.d_model, name="embed")(tokens)
+        x = sharding_lib.constrain(
+            x, mesh, mesh_lib.data_axes(mesh) or None,
+            mesh_lib.SP if self.attention in ("ring", "ulysses") else None,
+            None)
+        aux_total = jnp.zeros((), jnp.float32)
+        for i in range(self.n_layers):
+            x, aux = _Block(self.n_heads, head_dim, d_ff, self.attention,
+                            self.causal, self.n_experts, self.moe_k,
+                            self.dropout, name=f"layer_{i}")(x, train)
+            aux_total = aux_total + aux
+        x = nn.RMSNorm(name="final_norm")(x)
+        logits = nn.Dense(self.vocab_size, use_bias=False,
+                          name="lm_head")(x)
+        return logits, aux_total
+
+
+# ----------------------------------------------------------------------
+# losses over (outputs=(logits, aux), batch, weights)
+# ----------------------------------------------------------------------
+def next_token_loss(aux_coef: float = 0.01):
+    """Causal LM loss: predict token t+1 from prefix <= t; padding
+    tokens (id 0) and padded tail samples are masked out."""
+    import optax
+
+    def loss_fn(outputs, batch, weights):
+        logits, aux = outputs
+        tokens = batch["x"].astype(jnp.int32)
+        tgt = tokens[:, 1:]
+        lg = logits[:, :-1].astype(jnp.float32)
+        per_tok = optax.softmax_cross_entropy_with_integer_labels(lg, tgt)
+        tok_mask = (tgt != 0).astype(jnp.float32)
+        if weights is not None:
+            tok_mask = tok_mask * weights.astype(jnp.float32)[:, None]
+        total = jnp.maximum(jnp.sum(tok_mask), 1e-9)
+        loss = jnp.sum(per_tok * tok_mask) / total
+        return loss + aux_coef * aux.astype(jnp.float32)
+
+    return loss_fn
+
+
+def token_accuracy(outputs, batch, weights):
+    logits, _ = outputs
+    tokens = batch["x"].astype(jnp.int32)
+    tgt = tokens[:, 1:]
+    pred = jnp.argmax(logits[:, :-1].astype(jnp.float32), axis=-1)
+    tok_mask = (tgt != 0).astype(jnp.float32)
+    if weights is not None:
+        tok_mask = tok_mask * weights.astype(jnp.float32)[:, None]
+    correct = (pred == tgt).astype(jnp.float32) * tok_mask
+    return jnp.sum(correct), jnp.sum(tok_mask)
+
+
+# ----------------------------------------------------------------------
+# keras-shaped wrapper (the stored lineage-root instance)
+# ----------------------------------------------------------------------
+class LanguageModel:
+    """Trainable LM artifact with the reference's method-call surface.
+
+    ``attention="auto"`` picks the Pallas flash kernel on TPU and the
+    XLA-fused dot implementation elsewhere.
+    """
+
+    _CONFIG_KEYS = ("vocab_size", "d_model", "n_layers", "n_heads",
+                    "d_ff", "max_len", "attention", "n_experts", "moe_k",
+                    "dropout", "aux_coef")
+
+    def __init__(self, vocab_size: int, d_model: int = 256,
+                 n_layers: int = 4, n_heads: int = 4, d_ff: int = 0,
+                 max_len: int = 512, attention: str = "auto",
+                 n_experts: int = 0, moe_k: int = 2, dropout: float = 0.0,
+                 aux_coef: float = 0.01, name: str = "language_model"):
+        self.name = name
+        self.vocab_size = int(vocab_size)
+        self.d_model = int(d_model)
+        self.n_layers = int(n_layers)
+        self.n_heads = int(n_heads)
+        self.d_ff = int(d_ff)
+        self.max_len = int(max_len)
+        self.attention = attention
+        self.n_experts = int(n_experts)
+        self.moe_k = int(moe_k)
+        self.dropout = float(dropout)
+        self.aux_coef = float(aux_coef)
+        self.optimizer_spec: Dict[str, Any] = {"kind": "adamw",
+                                               "learning_rate": 3e-4}
+        self.params: Any = None
+        self.history: List[Dict[str, Any]] = []
+        self.seed = 0
+        self._engine: Optional[engine_lib.Engine] = None
+        self._state = None
+
+    # ------------------------------------------------------------------
+    def _resolved_attention(self) -> str:
+        if self.attention != "auto":
+            return self.attention
+        return "flash" if jax.default_backend() == "tpu" else "dot"
+
+    @property
+    def module(self) -> TransformerLM:
+        return TransformerLM(
+            vocab_size=self.vocab_size, d_model=self.d_model,
+            n_layers=self.n_layers, n_heads=self.n_heads, d_ff=self.d_ff,
+            attention=self._resolved_attention(), causal=True,
+            n_experts=self.n_experts, moe_k=self.moe_k,
+            dropout=self.dropout)
+
+    def compile(self, optimizer: Any = "adamw", loss: Any = None,
+                metrics: Any = None, **_: Any) -> None:
+        if isinstance(optimizer, str):
+            self.optimizer_spec = {"kind": optimizer}
+        elif isinstance(optimizer, dict):
+            self.optimizer_spec = dict(optimizer)
+        elif hasattr(optimizer, "spec"):
+            self.optimizer_spec = dict(optimizer.spec)
+        else:
+            raise TypeError(f"unsupported optimizer: {optimizer!r}")
+        self._engine = None
+
+    # ------------------------------------------------------------------
+    def _apply_fn(self, params, model_state, batch, train, rng):
+        rngs = {"dropout": rng} if (train and rng is not None and
+                                    self.dropout) else None
+        out = self.module.apply({"params": params}, batch["x"],
+                                train=train, rngs=rngs)
+        return out, model_state
+
+    def _build_params(self, sample_x: np.ndarray) -> None:
+        rng = jax.random.PRNGKey(self.seed)
+        variables = self.module.init(rng, jnp.asarray(sample_x[:1]),
+                                     train=False)
+        self.params = dict(variables)["params"]
+
+    def _get_engine(self) -> engine_lib.Engine:
+        if self._engine is None:
+            from learningorchestra_tpu.config import get_config
+            from learningorchestra_tpu.models.neural import build_optimizer
+
+            dtype = jnp.bfloat16 \
+                if get_config().compute_dtype == "bfloat16" else jnp.float32
+            mesh = mesh_lib.get_default_mesh()
+            seq_axis = self._resolved_attention() in ("ring", "ulysses")
+            self._engine = engine_lib.Engine(
+                apply_fn=self._apply_fn,
+                loss_fn=next_token_loss(self.aux_coef),
+                optimizer=build_optimizer(self.optimizer_spec),
+                mesh=mesh,
+                metrics={"accuracy": token_accuracy},
+                compute_dtype=dtype,
+                param_rules=sharding_lib.TRANSFORMER_RULES,
+                batch_sharding=jax.sharding.NamedSharding(
+                    mesh, sharding_lib.batch_spec(mesh, seq_axis=seq_axis)),
+                predict_transform=lambda outputs: outputs[0])
+        return self._engine
+
+    # ------------------------------------------------------------------
+    def _coerce_tokens(self, x) -> np.ndarray:
+        if hasattr(x, "to_numpy"):
+            x = data_lib.dataframe_to_arrays(x)["x"]
+        x = np.asarray(x)
+        if x.ndim == 1:  # flat corpus -> non-overlapping windows
+            seq = min(self.max_len, max(2, len(x) // 2))
+            n = len(x) // seq
+            x = x[:n * seq].reshape(n, seq)
+        if x.shape[1] > self.max_len:
+            x = x[:, :self.max_len]
+        return x.astype(np.int32)
+
+    def _batcher(self, x, batch_size: Optional[int],
+                 shuffle: bool = False) -> data_lib.ArrayBatcher:
+        from learningorchestra_tpu.config import get_config
+
+        mesh = mesh_lib.get_default_mesh()
+        return data_lib.ArrayBatcher(
+            {"x": self._coerce_tokens(x)},
+            batch_size or get_config().default_batch_size,
+            shuffle=shuffle, seed=self.seed,
+            dp_multiple=mesh_lib.data_parallel_size(mesh))
+
+    def fit(self, x=None, y=None, batch_size: Optional[int] = None,
+            epochs: int = 1, shuffle: bool = True, checkpointer=None,
+            log_fn=None, **_: Any):
+        from learningorchestra_tpu.models.neural import History
+
+        batcher = self._batcher(x, batch_size, shuffle=shuffle)
+        if self.params is None:
+            self._build_params(batcher.array("x"))
+        eng = self._get_engine()
+        state = eng.init_state(self.params)
+        state, history = eng.fit(state, batcher, epochs=epochs,
+                                 seed=self.seed, checkpointer=checkpointer,
+                                 log_fn=log_fn)
+        self._state = state
+        self.params = jax.tree_util.tree_map(np.asarray, state.params)
+        self.history.extend(history)
+        return History(history)
+
+    def evaluate(self, x=None, y=None, batch_size: Optional[int] = None,
+                 **_: Any) -> Dict[str, float]:
+        self._require_built()
+        eng = self._get_engine()
+        state = self._state or eng.init_state(self.params)
+        return eng.evaluate(state, self._batcher(x, batch_size))
+
+    def predict(self, x=None, batch_size: Optional[int] = None,
+                **_: Any) -> np.ndarray:
+        """Next-token logits (n, seq, vocab)."""
+        self._require_built()
+        eng = self._get_engine()
+        state = self._state or eng.init_state(self.params)
+        return eng.predict(state, self._batcher(x, batch_size))
+
+    def generate(self, prompt, max_new_tokens: int = 32,
+                 temperature: float = 0.0, seed: int = 0) -> np.ndarray:
+        """Greedy / temperature sampling. prompt: (b, s) token ids.
+
+        Prompts longer than ``max_len`` keep their last ``max_len - 1``
+        tokens (sliding-window truncation).
+        """
+        self._require_built()
+        prompt = np.atleast_2d(np.asarray(prompt)).astype(np.int32)
+        b, s = prompt.shape
+        if s >= self.max_len:
+            prompt = prompt[:, -(self.max_len - 1):]
+            s = prompt.shape[1]
+        total = min(self.max_len, s + max_new_tokens)
+        buf = np.zeros((b, total), np.int32)
+        buf[:, :s] = prompt
+        buf = jnp.asarray(buf)
+        step = self._gen_step(b, total, float(temperature))
+        params = self.params
+        key = jax.random.PRNGKey(seed)
+        for pos in range(s, total):
+            key, sub = jax.random.split(key)
+            buf = step(params, buf, jnp.asarray(pos), sub)
+        return np.asarray(buf)
+
+    def _gen_step(self, b: int, total: int, temperature: float):
+        """One jitted decode step per (batch, length, temperature) —
+        params are an argument, not a closure, so weights stay
+        device-resident buffers instead of being baked into the
+        executable, and repeated generate() calls reuse the compile."""
+        cache = getattr(self, "_gen_cache", None)
+        if cache is None:
+            cache = self._gen_cache = {}
+        sig = (b, total, temperature, self._resolved_attention())
+        if sig in cache:
+            return cache[sig]
+        module = self.module
+
+        @jax.jit
+        def step(params, buf, pos, key):
+            logits, _ = module.apply({"params": params}, buf, train=False)
+            last = jnp.take_along_axis(
+                logits, (pos - 1)[None, None, None].repeat(b, 0), axis=1
+            )[:, 0].astype(jnp.float32)
+            if temperature > 0:
+                nxt = jax.random.categorical(key, last / temperature,
+                                             axis=-1)
+            else:
+                nxt = jnp.argmax(last, axis=-1)
+            return buf.at[:, pos].set(nxt.astype(jnp.int32))
+
+        cache[sig] = step
+        return step
+
+    def _require_built(self) -> None:
+        if self.params is None:
+            raise RuntimeError(
+                "model has no parameters yet — call fit() first "
+                "(or load a trained artifact)")
+
+    def num_params(self) -> int:
+        if self.params is None:
+            return 0
+        return sum(int(np.prod(p.shape))
+                   for p in jax.tree_util.tree_leaves(self.params))
+
+    # ------------------------------------------------------------------
+    # artifact-store native protocol (catalog/artifacts.py)
+    # ------------------------------------------------------------------
+    def __lo_save__(self, path: str) -> None:
+        from learningorchestra_tpu.runtime import checkpoint as ckpt
+
+        config = {k: getattr(self, k) for k in self._CONFIG_KEYS}
+        config.update(name=self.name, optimizer_spec=self.optimizer_spec,
+                      seed=self.seed, history=self.history,
+                      built=self.params is not None)
+        with open(os.path.join(path, "config.json"), "w") as f:
+            json.dump(config, f)
+        if self.params is not None:
+            ckpt.save_pytree({"params": self.params},
+                             os.path.join(path, "weights.msgpack"))
+
+    @classmethod
+    def __lo_load__(cls, path: str) -> "LanguageModel":
+        from learningorchestra_tpu.runtime import checkpoint as ckpt
+
+        with open(os.path.join(path, "config.json")) as f:
+            config = json.load(f)
+        model = cls(**{k: config[k] for k in cls._CONFIG_KEYS},
+                    name=config["name"])
+        model.optimizer_spec = config["optimizer_spec"]
+        model.seed = config["seed"]
+        model.history = config["history"]
+        if config["built"]:
+            sample = np.zeros((1, 8), np.int32)
+            model._build_params(sample)
+            restored = ckpt.load_pytree(
+                os.path.join(path, "weights.msgpack"),
+                {"params": model.params})
+            model.params = restored["params"]
+        return model

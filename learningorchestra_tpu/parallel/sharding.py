@@ -126,6 +126,18 @@ def batch_spec(mesh: Mesh, seq_axis: bool = False) -> P:
 
 def constrain(x, mesh: Mesh, *spec_entries) -> Any:
     """``with_sharding_constraint`` shorthand that tolerates axes
-    missing from the mesh."""
+    missing from the mesh and dims the axis size doesn't divide (e.g.
+    the 1-sample trace during param init)."""
     spec = _axes_in_mesh(P(*spec_entries), mesh)
+
+    def fits(entry, dim):
+        axes = entry if isinstance(entry, (tuple, list)) else (entry,)
+        size = 1
+        for a in axes:
+            size *= mesh.shape[a]
+        return dim % size == 0
+
+    entries = [e if (e is not None and fits(e, d)) else None
+               for e, d in zip(spec, x.shape)]
+    spec = P(*entries)
     return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, spec))

@@ -111,11 +111,22 @@ def prefetch_to_device(iterator: Iterable[Dict[str, np.ndarray]],
                 continue
         return False
 
+    def _clipped(arr):
+        # one batch spec serves every entry: trailing spec dims beyond
+        # an array's rank are dropped (e.g. P(dp, sp) on the 1-D
+        # sample-weight column becomes P(dp))
+        from jax.sharding import PartitionSpec
+        spec = sharding.spec
+        if len(spec) > arr.ndim:
+            spec = PartitionSpec(*tuple(spec)[:arr.ndim])
+        return NamedSharding(sharding.mesh, spec)
+
     def producer() -> None:
         try:
             for batch in iterator:
                 if sharding is not None:
-                    batch = jax.device_put(batch, sharding)
+                    batch = {k: jax.device_put(v, _clipped(v))
+                             for k, v in batch.items()}
                 else:
                     batch = jax.device_put(batch)
                 if not _put(batch):

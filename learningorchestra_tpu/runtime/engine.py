@@ -56,7 +56,11 @@ class Engine:
                  mesh=None,
                  metrics: Optional[Dict[str, Callable]] = None,
                  compute_dtype: Any = jnp.bfloat16,
-                 donate_state: bool = True):
+                 donate_state: bool = True,
+                 param_rules=None,
+                 fsdp: bool = True,
+                 batch_sharding=None,
+                 predict_transform: Optional[Callable] = None):
         self._apply_fn = apply_fn
         self._loss_fn = loss_fn
         self._optimizer = optimizer
@@ -67,9 +71,33 @@ class Engine:
         self._eval_step = None
         self._predict_step = None
         self._donate = donate_state
+        # (path-regex -> PartitionSpec) rules for TP/FSDP param layout;
+        # None = replicate (pure DP)
+        self._param_rules = param_rules
+        self._fsdp = fsdp
+        self._batch_sharding = batch_sharding
+        # maps raw apply outputs to the prediction array (models whose
+        # apply returns a tuple, e.g. (logits, moe_aux))
+        self._predict_transform = predict_transform
+        self._step_flops: Optional[float] = None
+        self._flops_key = None
 
     # ------------------------------------------------------------------
     def init_state(self, params, model_state=None) -> TrainState:
+        if self._mesh is not None and self._param_rules is not None:
+            from learningorchestra_tpu.parallel import sharding as rules_lib
+
+            shardings = rules_lib.param_shardings(
+                params, self._mesh, self._param_rules, fsdp=self._fsdp)
+            params = jax.device_put(params, shardings)
+            # jit propagates the param shardings into matching
+            # optimizer-state leaves (adam mu/nu mirror params)
+            opt_state = jax.jit(self._optimizer.init)(params)
+            rep = mesh_lib.replicated(self._mesh)
+            return TrainState(
+                step=jax.device_put(jnp.zeros((), jnp.int32), rep),
+                params=params, opt_state=opt_state,
+                model_state=jax.device_put(model_state or {}, rep))
         state = TrainState(step=jnp.zeros((), jnp.int32), params=params,
                            opt_state=self._optimizer.init(params),
                            model_state=model_state or {})
@@ -134,6 +162,8 @@ class Engine:
             outputs, _ = self._apply_fn(
                 self._cast(state.params), state.model_state,
                 self._cast(batch), False, None)
+            if self._predict_transform is not None:
+                outputs = self._predict_transform(outputs)
             # predictions leave the device in full precision even when
             # compute ran in bfloat16 (downstream softmax/thresholds
             # shouldn't inherit MXU rounding)
@@ -145,9 +175,24 @@ class Engine:
 
     # ------------------------------------------------------------------
     def _device_feed(self, batcher: data_lib.ArrayBatcher, epoch: int):
-        sharding = (mesh_lib.batch_sharding(self._mesh)
-                    if self._mesh is not None else None)
+        sharding = self._batch_sharding
+        if sharding is None and self._mesh is not None:
+            sharding = mesh_lib.batch_sharding(self._mesh)
         return data_lib.prefetch_to_device(batcher.epoch(epoch), sharding)
+
+    def _measure_flops(self, state, batch, rng) -> None:
+        """Per-step flop estimate from the lowered HLO (cheap — no
+        compile). Basis for the MFU line in every history record."""
+        key = tuple(sorted((k, tuple(v.shape)) for k, v in batch.items()))
+        if self._step_flops is not None and key == self._flops_key:
+            return
+        self._flops_key = key
+        try:
+            cost = self._train_step.lower(state, batch, rng).cost_analysis()
+            flops = float(cost.get("flops", 0.0)) if cost else 0.0
+            self._step_flops = flops if flops > 0 else 0.0
+        except Exception:  # noqa: BLE001 — accounting must never sink a run
+            self._step_flops = 0.0
 
     def fit(self, state: TrainState, batcher: data_lib.ArrayBatcher,
             epochs: int = 1, seed: int = 0,
@@ -168,19 +213,42 @@ class Engine:
             # epoch end
             sums: Dict[str, Any] = {}
             counts: Dict[str, Any] = {}
+            steps = 0
+            # MFU must reflect steady-state compute, not XLA compile:
+            # on the compile epoch the roofline window starts after the
+            # first step completes (one extra sync, once per fit)
+            t_steady, steady_steps = t0, 0
             for batch in self._device_feed(batcher, epoch):
                 rng = jax.random.fold_in(base_rng, host_step)
                 host_step += 1
+                if steps == 0 and epoch == 0:
+                    self._measure_flops(state, batch, rng)
                 state, metrics = self._train_step(state, batch, rng)
+                if steps == 0 and epoch == 0:
+                    jax.block_until_ready(metrics)
+                    t_steady, steady_steps = time.perf_counter(), -1
+                steps += 1
                 for k, (s, c) in metrics.items():
                     sums[k] = sums.get(k, 0) + s
                     counts[k] = counts.get(k, 0) + c
             jax.block_until_ready(state.params)
-            dt = time.perf_counter() - t0
+            now = time.perf_counter()
+            dt = now - t0
             record = {k: float(sums[k]) / max(float(counts[k]), 1e-9)
                       for k in sums}
             record.update(epoch=epoch, epochSeconds=round(dt, 4),
                           samplesPerSecond=round(batcher.num_samples / dt, 2))
+            steady_steps += steps
+            dt_steady = now - t_steady
+            if self._step_flops and steady_steps > 0 and dt_steady > 0:
+                n_dev = (self._mesh.size if self._mesh is not None
+                         else jax.device_count())
+                achieved = self._step_flops * steady_steps / dt_steady
+                record["tflopsPerSecPerChip"] = round(
+                    achieved / n_dev / 1e12, 4)
+                peak = peak_flops_per_chip()
+                if peak:
+                    record["mfu"] = round(achieved / n_dev / peak, 4)
             history.append(record)
             if checkpointer is not None:
                 checkpointer.save(int(state.step), state)
@@ -211,6 +279,33 @@ class Engine:
             outs.append(np.asarray(self._predict_step(state, batch)))
         full = np.concatenate(outs, axis=0)
         return full[:batcher.num_samples]  # drop padding
+
+
+# per-chip dense bf16 peak FLOP/s, public spec-sheet numbers; substring
+# matched against jax's device_kind
+_PEAK_FLOPS_BF16 = (
+    ("v6", 918e12),          # Trillium
+    ("v5p", 459e12),
+    ("v5 lite", 197e12),     # v5e reports "TPU v5 lite"
+    ("v5e", 197e12),
+    ("v5", 459e12),
+    ("v4", 275e12),
+    ("v3", 123e12),
+    ("v2", 45e12),
+)
+
+
+def peak_flops_per_chip() -> Optional[float]:
+    """Dense bf16 peak of the current accelerator, None off-TPU (MFU is
+    only meaningful against a hardware roofline)."""
+    dev = jax.devices()[0]
+    if dev.platform != "tpu":
+        return None
+    kind = getattr(dev, "device_kind", "").lower()
+    for key, peak in _PEAK_FLOPS_BF16:
+        if key in kind:
+            return peak
+    return None
 
 
 def _total(weights):

@@ -76,11 +76,13 @@ def build_mesh(spec: str = "auto",
             raise ValueError(f"{n} devices not divisible by {known}")
         sizes[unknown[0]] = n // known
     total = int(np.prod(list(sizes.values())))
-    if total != n:
+    if total > n:
         raise ValueError(
             f"mesh {sizes} needs {total} devices, have {n}")
+    # a mesh smaller than the host's device count is legal (e.g. a
+    # sub-slice lease, or dp=1 debugging on a multi-chip host)
     return jax.make_mesh(tuple(sizes.values()), tuple(sizes.keys()),
-                         auto * len(sizes), devices=devices)
+                         auto * len(sizes), devices=devices[:total])
 
 
 _default_mesh: Optional[Mesh] = None
