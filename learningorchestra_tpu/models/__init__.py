@@ -17,6 +17,10 @@ The reference trains whatever class the user names by module path —
 """
 
 from learningorchestra_tpu.models.neural import NeuralModel  # noqa: F401
+from learningorchestra_tpu.models.sweep import (  # noqa: F401
+    GridSearch,
+    RandomSearch,
+)
 from learningorchestra_tpu.models.transformer import (  # noqa: F401
     LanguageModel,
     TransformerLM,

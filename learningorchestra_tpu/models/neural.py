@@ -83,6 +83,19 @@ class NeuralModel:
         self.seed: int = 0
         self._engine: Optional[engine_lib.Engine] = None
         self._state: Optional[engine_lib.TrainState] = None
+        self._mesh_override = None
+
+    def set_mesh(self, mesh) -> None:
+        """Pin this model to a mesh (e.g. a sweep trial's sub-slice of
+        the default mesh) instead of the process-wide default."""
+        self._mesh_override = mesh
+        self._engine = None
+        # device state from a previous fit is laid out on the old mesh;
+        # host params survive, state must rebuild on the new mesh
+        self._state = None
+
+    def _mesh(self):
+        return self._mesh_override or mesh_lib.get_default_mesh()
 
     # ------------------------------------------------------------------
     def add(self, layer_config: Dict[str, Any]) -> None:
@@ -159,7 +172,7 @@ class NeuralModel:
                 apply_fn=self._apply_fn,
                 loss_fn=_LOSSES[self.loss_name],
                 optimizer=build_optimizer(self.optimizer_spec),
-                mesh=mesh_lib.get_default_mesh(),
+                mesh=self._mesh(),
                 metrics={n: _METRICS[n] for n in self.metric_names},
                 compute_dtype=dtype)
         return self._engine
@@ -187,7 +200,7 @@ class NeuralModel:
     def _batcher(self, x, y=None, batch_size: Optional[int] = None,
                  shuffle: bool = False) -> data_lib.ArrayBatcher:
         from learningorchestra_tpu.config import get_config
-        mesh = mesh_lib.get_default_mesh()
+        mesh = self._mesh()
         arrays = {"x": self._coerce_x(x)}
         if y is not None:
             arrays["y"] = self._coerce_y(y)
@@ -216,9 +229,8 @@ class NeuralModel:
             for k, v in val.items():
                 history[-1][f"val_{k}"] = v
         self._state = state
-        self.params = jax.tree_util.tree_map(np.asarray, state.params)
-        self.model_state = jax.tree_util.tree_map(
-            np.asarray, state.model_state)
+        self.params = engine_lib.to_host(state.params)
+        self.model_state = engine_lib.to_host(state.model_state)
         self.history.extend(history)
         return History(history)
 

@@ -1,0 +1,326 @@
+"""Hyperparameter sweeps over mesh sub-slices.
+
+The reference's Tune service is ``GridSearchCV.fit`` running on one
+host through the generic executor (SURVEY §3.3; constants.py:41-51
+``tune/*`` type strings). That path still works here for sklearn
+estimators. This module is the TPU-native counterpart for JAX models:
+trials are scheduled onto **disjoint sub-slices of the device mesh**
+and run concurrently — JAX dispatches jitted computations on disjoint
+devices asynchronously, so k sub-slices give k-way trial parallelism
+over ICI where the reference used Spark workers (SURVEY §2.4,
+BASELINE north star).
+
+The surface is GridSearchCV-shaped on purpose (``fit``,
+``best_params_``, ``best_score_``, ``cv_results_``) because those
+names are what reference clients send through the REST method-call
+contract.
+"""
+
+from __future__ import annotations
+
+import itertools
+import json
+import os
+import random as random_mod
+import tempfile
+import time
+from concurrent.futures import ThreadPoolExecutor
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from learningorchestra_tpu.runtime import mesh as mesh_lib
+
+# hyperparameter names routed into the optimizer spec
+_OPTIMIZER_KEYS = {"kind", "learning_rate", "lr", "momentum",
+                   "weight_decay", "beta_1", "beta_2", "rho", "nesterov"}
+# names routed into fit() kwargs
+_FIT_KEYS = {"batch_size", "epochs"}
+
+
+def sub_meshes(mesh, k: int) -> List[Any]:
+    """Split a mesh into ``k`` disjoint data-parallel sub-meshes.
+
+    Trial parallelism beats intra-trial parallelism for sweeps of
+    small models, so sub-slices are 1-D ``dp`` meshes regardless of
+    the parent's axes.
+    """
+    devices = list(np.asarray(mesh.devices).flat)
+    k = max(1, min(k, len(devices)))
+    per = len(devices) // k
+    return [mesh_lib.build_mesh(f"dp={per}",
+                                devices=devices[i * per:(i + 1) * per])
+            for i in range(k)]
+
+
+def _clone(estimator):
+    """Config-level clone through the artifact save/load protocol —
+    fresh params, fresh engine, no shared state with the original."""
+    with tempfile.TemporaryDirectory(prefix="lo_sweep_clone_") as tmp:
+        estimator.__lo_save__(tmp)
+        clone = type(estimator).__lo_load__(tmp)
+    clone.params = None  # sweep trials train from scratch
+    return clone
+
+
+def _apply_overrides(model, overrides: Dict[str, Any]) -> Dict[str, Any]:
+    """Route hyperparameters to optimizer spec / fit kwargs / model
+    attributes. Returns the fit kwargs."""
+    fit_kwargs: Dict[str, Any] = {}
+    for key, value in overrides.items():
+        if key in _FIT_KEYS:
+            fit_kwargs[key] = value
+        elif key in _OPTIMIZER_KEYS:
+            if key == "lr":
+                key = "learning_rate"
+            model.optimizer_spec[key] = value
+        elif key == "optimizer":
+            model.optimizer_spec["kind"] = value
+        elif hasattr(model, key):
+            setattr(model, key, value)
+        else:
+            raise ValueError(
+                f"unknown hyperparameter {key!r} for "
+                f"{type(model).__name__}")
+    model._engine = None  # spec changes must rebuild the engine
+    return fit_kwargs
+
+
+class GridSearch:
+    """Exhaustive (or sampled) hyperparameter search for the
+    framework's keras-shaped models, trial-parallel over the mesh.
+
+    Parameters
+    ----------
+    estimator:
+        A NeuralModel / LanguageModel (typically a ``$model`` artifact
+        reference through the parameter DSL).
+    param_grid:
+        dict of name -> list of candidate values. Names route to the
+        optimizer spec (``learning_rate``, ``optimizer``, ...), fit
+        kwargs (``batch_size``, ``epochs``), or model attributes
+        (``dropout``, ``seed``, ...).
+    n_iter:
+        If set, sample this many random combinations instead of the
+        full grid (random search).
+    scoring:
+        Metric name from evaluate() to maximize; ``"loss"`` is
+        minimized. Default: accuracy if the model reports it.
+    validation_split:
+        Tail fraction of the data held out for scoring each trial.
+    max_parallel:
+        Cap on concurrent trials (default: one per mesh device).
+    refit:
+        Retrain the best config on the full data into
+        ``best_estimator_`` (default True).
+    """
+
+    def __init__(self, estimator, param_grid: Dict[str, Sequence[Any]],
+                 n_iter: Optional[int] = None, scoring: str = "auto",
+                 validation_split: float = 0.2,
+                 max_parallel: Optional[int] = None, refit: bool = True,
+                 seed: int = 0, name: str = "grid_search"):
+        if not param_grid:
+            raise ValueError("param_grid must not be empty")
+        self.name = name
+        self.estimator = estimator
+        self.param_grid = {k: list(v) for k, v in param_grid.items()}
+        self.n_iter = n_iter
+        self.scoring = scoring
+        self.validation_split = float(validation_split)
+        self.max_parallel = max_parallel
+        self.refit = refit
+        self.seed = int(seed)
+        self.cv_results_: Dict[str, List[Any]] = {}
+        self.best_params_: Optional[Dict[str, Any]] = None
+        self.best_score_: Optional[float] = None
+        self.best_estimator_ = None
+
+    # ------------------------------------------------------------------
+    def _combinations(self) -> List[Dict[str, Any]]:
+        keys = sorted(self.param_grid)
+        combos = [dict(zip(keys, values)) for values in
+                  itertools.product(*(self.param_grid[k] for k in keys))]
+        if self.n_iter is not None and self.n_iter < len(combos):
+            rng = random_mod.Random(self.seed)
+            combos = rng.sample(combos, self.n_iter)
+        return combos
+
+    def _split(self, x, y):
+        x = np.asarray(x)
+        n = len(x)
+        n_val = max(1, int(n * self.validation_split)) \
+            if self.validation_split > 0 else 0
+        if n_val == 0 or n_val >= n:
+            return x, y, x, y  # degenerate: score on train data
+        tx, vx = x[:-n_val], x[-n_val:]
+        if y is None:
+            return tx, None, vx, None
+        y = np.asarray(y)
+        return tx, y[:-n_val], vx, y[-n_val:]
+
+    def _score(self, metrics: Dict[str, float]) -> float:
+        if self.scoring == "auto":
+            if "accuracy" in metrics:
+                return float(metrics["accuracy"])
+            return -float(metrics["loss"])
+        if self.scoring == "loss":
+            return -float(metrics["loss"])
+        return float(metrics[self.scoring])
+
+    # ------------------------------------------------------------------
+    def fit(self, x=None, y=None, **fit_kwargs) -> "GridSearch":
+        import queue as queue_mod
+
+        import jax
+
+        combos = self._combinations()
+        tx, ty, vx, vy = self._split(x, y)
+        mesh = mesh_lib.get_default_mesh()
+        if jax.process_count() > 1:
+            # multi-host: every host replays this fit (execution.py
+            # fan-out) and must execute identical programs in identical
+            # order — sub-slice thread scheduling is timing-dependent
+            # and a sub-slice may own no local devices, so trials run
+            # sequentially over the full global mesh instead
+            k = 1
+            slices = [mesh]
+        else:
+            k = min(len(combos), self.max_parallel or mesh.size)
+            slices = sub_meshes(mesh, k)
+            k = min(k, len(slices))  # never more workers than slices
+        # free pool, not idx % k: a fast trial returns its slice for
+        # the next combo instead of contending with a slow neighbour
+        free = queue_mod.Queue()
+        for s in slices:
+            free.put(s)
+
+        def run_trial(combo):
+            model = _clone(self.estimator)
+            sub = free.get()
+            try:
+                model.set_mesh(sub)
+                trial_kwargs = dict(fit_kwargs)
+                trial_kwargs.update(_apply_overrides(model, combo))
+                t0 = time.perf_counter()
+                if ty is None:
+                    model.fit(tx, **trial_kwargs)
+                    metrics = model.evaluate(
+                        vx, batch_size=trial_kwargs.get("batch_size"))
+                else:
+                    model.fit(tx, ty, **trial_kwargs)
+                    metrics = model.evaluate(
+                        vx, vy, batch_size=trial_kwargs.get("batch_size"))
+            finally:
+                free.put(sub)
+            return {"params": combo, "metrics": metrics,
+                    "score": self._score(metrics),
+                    "fit_time": round(time.perf_counter() - t0, 4)}
+
+        if k > 1:
+            with ThreadPoolExecutor(max_workers=k) as pool:
+                results = list(pool.map(run_trial, combos))
+        else:
+            results = [run_trial(c) for c in combos]
+
+        self.cv_results_ = {
+            "params": [r["params"] for r in results],
+            "mean_test_score": [r["score"] for r in results],
+            "mean_fit_time": [r["fit_time"] for r in results],
+            "metrics": [r["metrics"] for r in results],
+        }
+        best = max(results, key=lambda r: r["score"])
+        self.best_params_ = best["params"]
+        self.best_score_ = best["score"]
+        if self.refit:
+            model = _clone(self.estimator)
+            refit_kwargs = dict(fit_kwargs)
+            refit_kwargs.update(_apply_overrides(model,
+                                                 dict(best["params"])))
+            if y is None:
+                model.fit(x, **refit_kwargs)
+            else:
+                model.fit(x, y, **refit_kwargs)
+            self.best_estimator_ = model
+        return self
+
+    # keras-ish conveniences so tune results flow through the generic
+    # summarize/evaluate/predict REST verbs
+    def evaluate(self, x=None, y=None, **kwargs) -> Dict[str, float]:
+        self._require_fitted()
+        return self.best_estimator_.evaluate(x, y, **kwargs)
+
+    def predict(self, x=None, **kwargs):
+        self._require_fitted()
+        return self.best_estimator_.predict(x, **kwargs)
+
+    def _require_fitted(self) -> None:
+        if self.best_estimator_ is None:
+            raise RuntimeError(
+                "sweep has no refit model — call fit() first "
+                "(with refit=True)")
+
+    def summary(self) -> Dict[str, Any]:
+        return {"best_params": self.best_params_,
+                "best_score": self.best_score_,
+                "n_trials": len(self.cv_results_.get("params", []))}
+
+    # ------------------------------------------------------------------
+    # artifact-store native protocol (catalog/artifacts.py)
+    # ------------------------------------------------------------------
+    def __lo_save__(self, path: str) -> None:
+        est_dir = os.path.join(path, "estimator")
+        os.makedirs(est_dir, exist_ok=True)
+        self.estimator.__lo_save__(est_dir)
+        best_dir = None
+        if self.best_estimator_ is not None:
+            best_dir = os.path.join(path, "best_estimator")
+            os.makedirs(best_dir, exist_ok=True)
+            self.best_estimator_.__lo_save__(best_dir)
+        config = {
+            "name": self.name,
+            "estimator_class": type(self.estimator).__name__,
+            "param_grid": self.param_grid,
+            "n_iter": self.n_iter,
+            "scoring": self.scoring,
+            "validation_split": self.validation_split,
+            "max_parallel": self.max_parallel,
+            "refit": self.refit,
+            "seed": self.seed,
+            "cv_results": self.cv_results_,
+            "best_params": self.best_params_,
+            "best_score": self.best_score_,
+            "has_best": best_dir is not None,
+        }
+        with open(os.path.join(path, "config.json"), "w") as f:
+            json.dump(config, f)
+
+    @classmethod
+    def __lo_load__(cls, path: str) -> "GridSearch":
+        from learningorchestra_tpu import models as models_pkg
+
+        with open(os.path.join(path, "config.json")) as f:
+            config = json.load(f)
+        est_cls = getattr(models_pkg, config["estimator_class"])
+        estimator = est_cls.__lo_load__(os.path.join(path, "estimator"))
+        sweep = cls(estimator, config["param_grid"],
+                    n_iter=config["n_iter"], scoring=config["scoring"],
+                    validation_split=config["validation_split"],
+                    max_parallel=config["max_parallel"],
+                    refit=config["refit"], seed=config["seed"],
+                    name=config["name"])
+        sweep.cv_results_ = config["cv_results"]
+        sweep.best_params_ = config["best_params"]
+        sweep.best_score_ = config["best_score"]
+        if config["has_best"]:
+            sweep.best_estimator_ = est_cls.__lo_load__(
+                os.path.join(path, "best_estimator"))
+        return sweep
+
+
+class RandomSearch(GridSearch):
+    """GridSearch with sampled combinations (``n_iter`` required)."""
+
+    def __init__(self, estimator, param_grid: Dict[str, Sequence[Any]],
+                 n_iter: int = 8, **kwargs):
+        super().__init__(estimator, param_grid, n_iter=n_iter, **kwargs)

@@ -98,6 +98,7 @@ class _Attention(nn.Module):
     head_dim: int
     impl: str
     causal: bool
+    mesh: Any = None
 
     @nn.compact
     def __call__(self, x, train: bool):
@@ -115,13 +116,13 @@ class _Attention(nn.Module):
         q, k = apply_rope(q, cos, sin), apply_rope(k, cos, sin)
 
         o = _dispatch_attention(q, k, v, impl=self.impl,
-                                causal=self.causal)
+                                causal=self.causal, mesh=self.mesh)
         o = o.reshape(b, s, proj)
         return dense("o_proj", d_model)(o)
 
 
-def _dispatch_attention(q, k, v, *, impl: str, causal: bool):
-    mesh = mesh_lib.get_default_mesh()
+def _dispatch_attention(q, k, v, *, impl: str, causal: bool, mesh=None):
+    mesh = mesh or mesh_lib.get_default_mesh()
     b, s, h, _ = q.shape
     data_size = mesh_lib.data_parallel_size(mesh)
     sp = mesh.shape.get(mesh_lib.SP, 1)
@@ -175,6 +176,7 @@ class _MoE(nn.Module):
     n_experts: int
     d_ff: int
     k: int = 2
+    mesh: Any = None
 
     @nn.compact
     def __call__(self, x):
@@ -185,7 +187,7 @@ class _MoE(nn.Module):
         wi, wo = _Experts(self.n_experts, d_model, self.d_ff,
                           name="experts")()
         params = {"gate": gate, "experts": {"wi": wi, "wo": wo}}
-        mesh = mesh_lib.get_default_mesh()
+        mesh = self.mesh or mesh_lib.get_default_mesh()
         ep_mesh = mesh if (mesh_lib.EP in mesh.axis_names and
                            mesh.shape[mesh_lib.EP] > 1) else None
         return moe_lib.moe_layer(params, x, k=self.k, mesh=ep_mesh)
@@ -200,12 +202,13 @@ class _Block(nn.Module):
     n_experts: int
     moe_k: int
     dropout: float
+    mesh: Any = None
 
     @nn.compact
     def __call__(self, x, train: bool):
         h = nn.RMSNorm(name="attn_norm")(x)
         h = _Attention(self.n_heads, self.head_dim, self.attention,
-                       self.causal, name="attn")(h, train)
+                       self.causal, self.mesh, name="attn")(h, train)
         if self.dropout and train:
             h = nn.Dropout(self.dropout, deterministic=False)(h)
         x = x + h
@@ -213,7 +216,7 @@ class _Block(nn.Module):
         aux = jnp.zeros((), jnp.float32)
         if self.n_experts > 0:
             h, aux = _MoE(self.n_experts, self.d_ff, self.moe_k,
-                          name="moe")(h)
+                          self.mesh, name="moe")(h)
         else:
             h = _MLP(self.d_ff, name="mlp")(h)
         if self.dropout and train:
@@ -236,6 +239,7 @@ class TransformerLM(nn.Module):
     n_experts: int = 0
     moe_k: int = 2
     dropout: float = 0.0
+    mesh: Any = None
 
     @nn.compact
     def __call__(self, tokens, train: bool = False):
@@ -243,7 +247,7 @@ class TransformerLM(nn.Module):
             raise ValueError(f"unknown attention impl: {self.attention!r}")
         d_ff = self.d_ff or 4 * self.d_model
         head_dim = self.d_model // self.n_heads
-        mesh = mesh_lib.get_default_mesh()
+        mesh = self.mesh or mesh_lib.get_default_mesh()
 
         x = nn.Embed(self.vocab_size, self.d_model, name="embed")(tokens)
         x = sharding_lib.constrain(
@@ -254,7 +258,8 @@ class TransformerLM(nn.Module):
         for i in range(self.n_layers):
             x, aux = _Block(self.n_heads, head_dim, d_ff, self.attention,
                             self.causal, self.n_experts, self.moe_k,
-                            self.dropout, name=f"layer_{i}")(x, train)
+                            self.dropout, self.mesh,
+                            name=f"layer_{i}")(x, train)
             aux_total = aux_total + aux
         x = nn.RMSNorm(name="final_norm")(x)
         logits = nn.Dense(self.vocab_size, use_bias=False,
@@ -336,6 +341,19 @@ class LanguageModel:
         self.seed = 0
         self._engine: Optional[engine_lib.Engine] = None
         self._state = None
+        self._mesh_override = None
+
+    def set_mesh(self, mesh) -> None:
+        """Pin this model to a mesh (e.g. a sweep trial's sub-slice of
+        the default mesh) instead of the process-wide default."""
+        self._mesh_override = mesh
+        self._engine = None
+        # device state from a previous fit is laid out on the old mesh;
+        # host params survive, state must rebuild on the new mesh
+        self._state = None
+
+    def _mesh(self):
+        return self._mesh_override or mesh_lib.get_default_mesh()
 
     # ------------------------------------------------------------------
     def _resolved_attention(self) -> str:
@@ -350,7 +368,7 @@ class LanguageModel:
             n_layers=self.n_layers, n_heads=self.n_heads, d_ff=self.d_ff,
             attention=self._resolved_attention(), causal=True,
             n_experts=self.n_experts, moe_k=self.moe_k,
-            dropout=self.dropout)
+            dropout=self.dropout, mesh=self._mesh_override)
 
     def compile(self, optimizer: Any = "adamw", loss: Any = None,
                 metrics: Any = None, **_: Any) -> None:
@@ -385,7 +403,7 @@ class LanguageModel:
 
             dtype = jnp.bfloat16 \
                 if get_config().compute_dtype == "bfloat16" else jnp.float32
-            mesh = mesh_lib.get_default_mesh()
+            mesh = self._mesh()
             seq_axis = self._resolved_attention() in ("ring", "ulysses")
             self._engine = engine_lib.Engine(
                 apply_fn=self._apply_fn,
@@ -417,7 +435,7 @@ class LanguageModel:
                  shuffle: bool = False) -> data_lib.ArrayBatcher:
         from learningorchestra_tpu.config import get_config
 
-        mesh = mesh_lib.get_default_mesh()
+        mesh = self._mesh()
         return data_lib.ArrayBatcher(
             {"x": self._coerce_tokens(x)},
             batch_size or get_config().default_batch_size,
@@ -438,7 +456,7 @@ class LanguageModel:
                                  seed=self.seed, checkpointer=checkpointer,
                                  log_fn=log_fn)
         self._state = state
-        self.params = jax.tree_util.tree_map(np.asarray, state.params)
+        self.params = engine_lib.to_host(state.params)
         self.history.extend(history)
         return History(history)
 

@@ -287,3 +287,47 @@ def test_observe_long_poll(server, titanic_csv):
     assert status == 200
     changes = body["result"]["changes"]
     assert changes and all(c["collection"] == "obs_ds" for c in changes)
+
+
+def test_tune_grid_search_pipeline(server):
+    """/model creates a GridSearch over a $model ref; /tune fit runs
+    trial-parallel over mesh sub-slices; results readable via GET."""
+    st, body = _call(server, "POST", f"{API}/function/python", body={
+        "name": "tune_data", "functionParameters": {},
+        "function": ("import numpy as np\n"
+                     "rng = np.random.default_rng(0)\n"
+                     "x = rng.normal(size=(48, 8)).astype(np.float32)\n"
+                     "y = (x[:, 0] > 0).astype(np.int32)\n"
+                     "x[:, 1] = y * 2.0\n"
+                     "response = {'x': x, 'y': y}\n")})
+    assert st == 201, body
+    _poll_finished(server, f"{API}/function/python/tune_data")
+
+    st, body = _call(server, "POST", f"{API}/model/tensorflow", body={
+        "modelName": "tune_base",
+        "modulePath": "learningorchestra_tpu.models",
+        "class": "NeuralModel",
+        "classParameters": {"layer_configs": [
+            {"kind": "dense", "units": 8, "activation": "relu"},
+            {"kind": "dense", "units": 2, "activation": "softmax"}]}})
+    assert st == 201, body
+    _poll_finished(server, f"{API}/model/tensorflow/tune_base")
+
+    st, body = _call(server, "POST", f"{API}/model/tensorflow", body={
+        "modelName": "tune_sweep",
+        "modulePath": "learningorchestra_tpu.models",
+        "class": "GridSearch",
+        "classParameters": {"estimator": "$tune_base",
+                            "param_grid": {"learning_rate": [0.0001, 0.05]},
+                            "validation_split": 0.25}})
+    assert st == 201, body
+    _poll_finished(server, f"{API}/model/tensorflow/tune_sweep")
+
+    st, body = _call(server, "POST", f"{API}/tune/tensorflow", body={
+        "name": "tune_run", "modelName": "tune_sweep", "method": "fit",
+        "methodParameters": {"x": "$tune_data.x", "y": "$tune_data.y",
+                             "epochs": 4, "batch_size": 8}})
+    assert st == 201, body
+    meta = _poll_finished(server, f"{API}/tune/tensorflow/tune_run",
+                          timeout=300)
+    assert meta["finished"]

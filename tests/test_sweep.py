@@ -1,0 +1,95 @@
+"""Hyperparameter sweep: trial-parallel grid/random search over mesh
+sub-slices, GridSearchCV-shaped surface, artifact round-trip."""
+
+import numpy as np
+import pytest
+
+from learningorchestra_tpu import config as config_mod
+from learningorchestra_tpu.models import GridSearch, NeuralModel, RandomSearch
+from learningorchestra_tpu.models.sweep import sub_meshes
+from learningorchestra_tpu.runtime import mesh as mesh_lib
+
+
+@pytest.fixture(autouse=True)
+def _cfg(tmp_path):
+    config_mod.set_config(config_mod.Config(
+        home=str(tmp_path / "lo_home"), mesh_shape="auto",
+        compute_dtype="float32"))
+    yield
+    config_mod.reset_config()
+
+
+def _estimator():
+    model = NeuralModel([
+        {"kind": "dense", "units": 16, "activation": "relu"},
+        {"kind": "dense", "units": 2, "activation": "softmax"},
+    ], name="toy")
+    model.compile({"kind": "adam", "learning_rate": 1e-3})
+    return model
+
+
+def _data(n=64):
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(n, 8)).astype(np.float32)
+    y = (x[:, 0] > 0).astype(np.int32)
+    x[:, 1] = y * 2.0  # separable
+    return x, y
+
+
+def test_sub_meshes_partition():
+    mesh = mesh_lib.get_default_mesh()
+    slices = sub_meshes(mesh, 4)
+    assert len(slices) == 4
+    seen = set()
+    for m in slices:
+        ids = {d.id for d in np.asarray(m.devices).flat}
+        assert not (ids & seen)
+        seen |= ids
+
+
+def test_grid_search_finds_better_lr():
+    x, y = _data()
+    sweep = GridSearch(_estimator(),
+                       {"learning_rate": [1e-5, 5e-2]},
+                       validation_split=0.25)
+    sweep.fit(x, y, epochs=8, batch_size=16)
+    assert len(sweep.cv_results_["params"]) == 2
+    assert sweep.best_params_["learning_rate"] == 5e-2
+    assert sweep.best_estimator_ is not None
+    preds = sweep.predict(x[:8])
+    assert preds.shape == (8, 2)
+
+
+def test_random_search_samples():
+    x, y = _data(32)
+    sweep = RandomSearch(_estimator(),
+                         {"learning_rate": [1e-4, 1e-3, 1e-2, 1e-1],
+                          "batch_size": [8, 16]},
+                         n_iter=3, refit=False, seed=1)
+    sweep.fit(x, y, epochs=1)
+    assert len(sweep.cv_results_["params"]) == 3
+    assert sweep.best_params_ is not None
+    assert sweep.best_estimator_ is None  # refit=False
+
+
+def test_unknown_hyperparameter_rejected():
+    x, y = _data(16)
+    sweep = GridSearch(_estimator(), {"warp_factor": [9]}, refit=False)
+    with pytest.raises(ValueError, match="warp_factor"):
+        sweep.fit(x, y, epochs=1)
+
+
+def test_save_load_roundtrip(tmp_path):
+    x, y = _data(32)
+    sweep = GridSearch(_estimator(), {"learning_rate": [1e-2]},
+                       validation_split=0.25)
+    sweep.fit(x, y, epochs=2, batch_size=16)
+    art = tmp_path / "sweep_art"
+    art.mkdir()
+    sweep.__lo_save__(str(art))
+    loaded = GridSearch.__lo_load__(str(art))
+    assert loaded.best_params_ == sweep.best_params_
+    assert loaded.best_score_ == sweep.best_score_
+    p1 = sweep.predict(x[:8])
+    p2 = loaded.predict(x[:8])
+    np.testing.assert_allclose(p1, p2, atol=1e-5)
