@@ -121,12 +121,21 @@ def prefetch_to_device(iterator: Iterable[Dict[str, np.ndarray]],
             spec = PartitionSpec(*tuple(spec)[:arr.ndim])
         return NamedSharding(sharding.mesh, spec)
 
+    def _stage(arr):
+        target = _clipped(arr)
+        if jax.process_count() > 1:
+            # multi-host: every process holds the same full host batch
+            # (shared store, deterministic batcher); each contributes
+            # only the shards its devices own
+            return jax.make_array_from_callback(
+                arr.shape, target, lambda idx: arr[idx])
+        return jax.device_put(arr, target)
+
     def producer() -> None:
         try:
             for batch in iterator:
                 if sharding is not None:
-                    batch = {k: jax.device_put(v, _clipped(v))
-                             for k, v in batch.items()}
+                    batch = {k: _stage(v) for k, v in batch.items()}
                 else:
                     batch = jax.device_put(batch)
                 if not _put(batch):

@@ -1,0 +1,186 @@
+"""Multi-host runtime: one process per host over a TPU pod slice.
+
+The reference scales out with Docker Swarm — ``run.sh`` deploys 17
+services across manager/worker VMs and work reaches other machines via
+HTTP + MongoDB + Spark RPC (SURVEY §L0, §2.5). The TPU-native
+equivalent is the JAX multi-controller model: the SAME program starts
+on every host (``jax.distributed.initialize``), each host sees its
+local chips, ``jax.devices()`` becomes the global pod, and every jitted
+computation over a global mesh runs collectives over ICI/DCN — no
+hand-written communication layer.
+
+Deployment contract (parity with ``bash run.sh`` + env vars):
+
+    # host 0 (coordinator; also serves the REST control plane)
+    python -m learningorchestra_tpu --coordinator 10.0.0.1:8476 \
+        --num-hosts 4 --host-id 0
+    # hosts 1..3 (workers: join the runtime, serve jobs, no REST)
+    python -m learningorchestra_tpu --coordinator 10.0.0.1:8476 \
+        --num-hosts 4 --host-id 1 ...
+
+Env-var forms: LO_COORDINATOR, LO_NUM_HOSTS, LO_HOST_ID (flags win).
+On TPU pod slices created through a cloud provisioner the three values
+are usually auto-discoverable and may all be omitted —
+``jax.distributed.initialize`` falls back to the provider's metadata.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import traceback
+from typing import Any, Dict, Optional
+
+_initialized = False
+# serializes the (length, payload) broadcast pair of each publish so
+# concurrent publishers (job thread vs shutdown path) cannot interleave
+# their collectives and desynchronize the workers' recv loop
+_publish_lock = threading.Lock()
+
+
+def initialize(coordinator_address: Optional[str] = None,
+               num_processes: Optional[int] = None,
+               process_id: Optional[int] = None) -> bool:
+    """Join (or form) the multi-host JAX runtime. Returns True if a
+    multi-host runtime was initialized, False for single-host runs.
+
+    Call BEFORE any other jax API touches the backend. Safe to call
+    twice (second call is a no-op), safe to call single-host (no-op
+    unless a coordinator is configured).
+    """
+    global _initialized
+    if _initialized:
+        return True
+
+    coordinator_address = coordinator_address or \
+        os.environ.get("LO_COORDINATOR")
+    if num_processes is None and os.environ.get("LO_NUM_HOSTS"):
+        num_processes = int(os.environ["LO_NUM_HOSTS"])
+    if process_id is None and os.environ.get("LO_HOST_ID"):
+        process_id = int(os.environ["LO_HOST_ID"])
+
+    if coordinator_address is None and num_processes is None:
+        return False  # single host, nothing to form
+
+    import jax
+
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id)
+    _initialized = True
+    return True
+
+
+def shutdown() -> None:
+    global _initialized
+    if not _initialized:
+        return
+    import jax
+
+    jax.distributed.shutdown()
+    _initialized = False
+
+
+def host_info() -> Dict[str, Any]:
+    """Topology snapshot for /health and execution documents."""
+    import jax
+
+    return {
+        "processIndex": jax.process_index(),
+        "processCount": jax.process_count(),
+        "localDevices": len(jax.local_devices()),
+        "globalDevices": len(jax.devices()),
+        "platform": jax.default_backend(),
+    }
+
+
+def is_coordinator() -> bool:
+    """Process 0 owns the REST control plane; workers join the runtime
+    and participate in every global computation (single-controller
+    orchestration, multi-controller execution)."""
+    import jax
+
+    return jax.process_index() == 0
+
+
+# ----------------------------------------------------------------------
+# coordinator -> workers control channel
+# ----------------------------------------------------------------------
+class HostBridge:
+    """JSON message fan-out from the coordinator to every worker.
+
+    JAX's multi-controller model requires all processes to execute the
+    same jitted computations over a global mesh. One REST call lands on
+    host 0 only, so the job description must reach the other hosts
+    before any of them can enter the sharded program. The bridge rides
+    the runtime's own collective layer (``broadcast_one_to_all``): two
+    broadcasts per message — a length header, then the padded JSON
+    payload — so no extra sockets, auth, or serialization formats
+    exist beyond what the pod already trusts.
+
+    Coordinator: ``bridge.publish({"op": ..., ...})``.
+    Workers: ``bridge.follow(handler)`` blocks, executing each message
+    until a ``{"op": "shutdown"}`` arrives. Every ``publish`` must be
+    matched by every worker being inside ``follow`` — the same SPMD
+    contract as any collective.
+    """
+
+    def publish(self, message: Dict[str, Any]) -> None:
+        with _publish_lock:
+            self._exchange(message)
+
+    def _exchange(self, message: Optional[Dict[str, Any]]
+                  ) -> Dict[str, Any]:
+        import json
+
+        import jax.numpy as jnp
+        import numpy as np
+        from jax.experimental import multihost_utils as mhu
+
+        payload = b"" if message is None else \
+            json.dumps(message).encode("utf-8")
+        length = mhu.broadcast_one_to_all(
+            jnp.asarray([len(payload)], jnp.int32))
+        n = int(length[0])
+        buf = np.zeros((n,), np.uint8)
+        buf[:len(payload)] = np.frombuffer(payload, np.uint8)
+        data = mhu.broadcast_one_to_all(jnp.asarray(buf))
+        return json.loads(bytes(np.asarray(data).tobytes()).decode("utf-8"))
+
+    def recv(self) -> Dict[str, Any]:
+        return self._exchange(None)
+
+    def follow(self, handler) -> None:
+        """Worker loop: apply ``handler`` to each published message
+        until shutdown. ``{"op": "run", "target": "pkg.mod:fn",
+        "kwargs": {...}}`` messages resolve and call the target — the
+        hook the job manager uses to replay a training job on every
+        host so the global-mesh jit has all participants."""
+        while True:
+            msg = self.recv()
+            op = msg.get("op")
+            if op == "shutdown":
+                return
+            if op == "ping":
+                continue
+            # a failing replay must NOT kill the worker: the
+            # coordinator records the (identical) failure in the
+            # execution document, and a dead worker would hang every
+            # later collective on the pod
+            try:
+                if op == "run":
+                    _run_target(msg["target"], msg.get("kwargs") or {})
+                else:
+                    handler(msg)
+            except Exception:  # noqa: BLE001
+                traceback.print_exc()
+
+
+def _run_target(target: str, kwargs: Dict[str, Any]) -> Any:
+    import importlib
+
+    module_path, _, fn_name = target.partition(":")
+    module = importlib.import_module(module_path)
+    fn = getattr(module, fn_name)
+    return fn(**kwargs)

@@ -164,6 +164,12 @@ class Engine:
                 self._cast(batch), False, None)
             if self._predict_transform is not None:
                 outputs = self._predict_transform(outputs)
+            if self._mesh is not None and jax.process_count() > 1:
+                # multi-host: replicate so every process can read the
+                # full prediction (np.asarray needs addressability)
+                outputs = jax.tree_util.tree_map(
+                    lambda o: jax.lax.with_sharding_constraint(
+                        o, mesh_lib.replicated(self._mesh)), outputs)
             # predictions leave the device in full precision even when
             # compute ran in bfloat16 (downstream softmax/thresholds
             # shouldn't inherit MXU rounding)
@@ -306,6 +312,37 @@ def peak_flops_per_chip() -> Optional[float]:
         if key in kind:
             return peak
     return None
+
+
+def to_host(tree):
+    """Device pytree -> host numpy, correct on multi-host pods.
+
+    Replicated or locally-addressable arrays read directly; global
+    arrays sharded across other processes go through a jitted identity
+    with replicated out_shardings (a compiled all-gather) first.
+    """
+    def fetch(x):
+        if isinstance(x, jax.Array) and not (
+                x.is_fully_replicated or x.is_fully_addressable):
+            x = _replicator(x.sharding.mesh)(x)
+        return np.asarray(x)
+
+    return jax.tree_util.tree_map(fetch, tree)
+
+
+_REPLICATORS: Dict[Any, Callable] = {}
+
+
+def _replicator(mesh):
+    """One jitted identity-with-replicated-output per mesh, shared by
+    every to_host leaf so XLA compiles each gather shape once."""
+    fn = _REPLICATORS.get(mesh)
+    if fn is None:
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        rep = NamedSharding(mesh, PartitionSpec())
+        fn = _REPLICATORS[mesh] = jax.jit(lambda a: a, out_shardings=rep)
+    return fn
 
 
 def _total(weights):

@@ -131,6 +131,7 @@ class ExecutionService:
                 method: str, method_parameters: Dict[str, Any],
                 description: str) -> None:
         def run():
+            _broadcast_to_workers(parent_name, method, method_parameters)
             parent_type = self._ctx.params.artifact_type(parent_name)
             instance = self._ctx.artifacts.load(parent_name, parent_type)
             treated = self._ctx.params.treat(method_parameters)
@@ -146,6 +147,52 @@ class ExecutionService:
         self._ctx.jobs.submit(
             name, run, description=description,
             parameters=method_parameters, needs_mesh=True)
+
+
+# ----------------------------------------------------------------------
+# multi-host fan-out (SURVEY §7 hard part #5: one REST call -> N hosts)
+# ----------------------------------------------------------------------
+def _broadcast_to_workers(parent_name: str, method: str,
+                          method_parameters: Dict[str, Any]) -> None:
+    """On a multi-host pod the coordinator publishes every mesh job
+    before entering it: the jitted train/eval/predict step runs over
+    the GLOBAL mesh, whose collectives need all processes to execute
+    the same program. Workers replay the identical method call from
+    the shared artifact store (see :func:`replay_method_call`)."""
+    import jax
+
+    from learningorchestra_tpu.runtime import distributed as dist
+
+    if jax.process_count() <= 1:
+        return
+    dist.HostBridge().publish({
+        "op": "run",
+        "target": "learningorchestra_tpu.services.execution:"
+                  "replay_method_call",
+        "kwargs": {"parent_name": parent_name, "method": method,
+                   "method_parameters": method_parameters}})
+
+
+_worker_ctx = None
+
+
+def replay_method_call(parent_name: str, method: str,
+                       method_parameters: Dict[str, Any]) -> None:
+    """Worker-side twin of the coordinator's pipeline: load the same
+    artifact from the shared store, resolve the same parameters, call
+    the same method — so every host participates in the global-mesh
+    jit. Catalog/artifact WRITES stay with the coordinator; the
+    worker's copy of the result is discarded."""
+    global _worker_ctx
+    if _worker_ctx is None:
+        from learningorchestra_tpu.services.context import ServiceContext
+
+        _worker_ctx = ServiceContext()
+    ctx = _worker_ctx
+    parent_type = ctx.params.artifact_type(parent_name)
+    instance = ctx.artifacts.load(parent_name, parent_type)
+    treated = ctx.params.treat(method_parameters)
+    getattr(instance, method)(**treated)
 
 
 def summarize_result(result: Any) -> Optional[Any]:

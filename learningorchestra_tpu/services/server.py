@@ -348,11 +348,34 @@ def main(argv=None) -> None:
                         help="storage root (default LO_HOME or ./.lo_store)")
     parser.add_argument("--config", default=None,
                         help="JSON config file")
+    parser.add_argument("--coordinator", default=None,
+                        help="host:port of process 0 for multi-host runs "
+                             "(default LO_COORDINATOR)")
+    parser.add_argument("--num-hosts", type=int, default=None,
+                        help="total processes in the pod "
+                             "(default LO_NUM_HOSTS)")
+    parser.add_argument("--host-id", type=int, default=None,
+                        help="this process's index (default LO_HOST_ID)")
     args = parser.parse_args(argv)
     if args.config:
         set_config(Config.from_file(args.config))
     if args.home:
         set_config(get_config().replace(home=args.home))
+
+    from learningorchestra_tpu.runtime import distributed as dist
+
+    multi_host = dist.initialize(coordinator_address=args.coordinator,
+                                 num_processes=args.num_hosts,
+                                 process_id=args.host_id)
+    if multi_host and not dist.is_coordinator():
+        # workers never serve REST: they follow the coordinator's job
+        # broadcasts so every global-mesh jit has all participants
+        info = dist.host_info()
+        print(f"learningOrchestra-TPU worker {info['processIndex']}/"
+              f"{info['processCount']} following coordinator", flush=True)
+        dist.HostBridge().follow(lambda msg: None)
+        return
+
     server = RestServer(host=args.host, port=args.port)
     host, port = server.address
     print(f"learningOrchestra-TPU REST on http://{host}:{port}"
@@ -361,6 +384,22 @@ def main(argv=None) -> None:
         server.serve_forever()
     except KeyboardInterrupt:
         server.stop()
+    finally:
+        if multi_host:
+            import time as time_mod
+
+            # drain in-flight mesh jobs first: a job thread publishing
+            # its fan-out after our shutdown broadcast would block on a
+            # collective the workers already left
+            deadline = time_mod.monotonic() + 60
+            while server.api.ctx.jobs.running() and \
+                    time_mod.monotonic() < deadline:
+                time_mod.sleep(0.25)
+            try:
+                dist.HostBridge().publish({"op": "shutdown"})
+            except Exception:  # noqa: BLE001 — teardown best-effort
+                pass
+            dist.shutdown()
 
 
 if __name__ == "__main__":

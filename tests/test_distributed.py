@@ -1,0 +1,185 @@
+"""Multi-host runtime: REAL 2-process jax.distributed formation on the
+CPU backend — global device view, a cross-host collective, and a
+HostBridge publish/follow round-trip."""
+
+import socket
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from learningorchestra_tpu.runtime import distributed as dist
+
+
+def test_single_host_noop(monkeypatch):
+    monkeypatch.delenv("LO_COORDINATOR", raising=False)
+    monkeypatch.delenv("LO_NUM_HOSTS", raising=False)
+    assert dist.initialize() is False
+
+
+def test_host_info_single():
+    info = dist.host_info()
+    assert info["processCount"] == 1
+    assert info["processIndex"] == 0
+    assert info["globalDevices"] >= 1
+
+
+_WORKER = textwrap.dedent("""
+    import os, sys
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    sys.path.insert(0, "@REPO@")
+    from learningorchestra_tpu.runtime import distributed as dist
+
+    ok = dist.initialize(coordinator_address="@COORD@",
+                         num_processes=2, process_id=@PID@)
+    assert ok
+    info = dist.host_info()
+    assert info["processCount"] == 2, info
+    assert info["globalDevices"] == 4, info
+
+    # cross-host collective over the global mesh
+    import jax.numpy as jnp
+    from jax.experimental import multihost_utils as mhu
+    total = mhu.process_allgather(jnp.asarray([info["processIndex"]]))
+    assert sorted(int(x) for x in total.ravel()) == [0, 1], total
+
+    bridge = dist.HostBridge()
+    if info["processIndex"] == 0:
+        bridge.publish({"op": "custom", "value": 41})
+        bridge.publish({"op": "shutdown"})
+    else:
+        seen = []
+        bridge.follow(lambda m: seen.append(m["value"]))
+        assert seen == [41], seen
+    print("HOST_OK", info["processIndex"])
+""")
+
+
+def test_two_process_formation_and_bridge(tmp_path):
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    coord = f"127.0.0.1:{port}"
+    procs = []
+    for pid in range(2):
+        script = (_WORKER.replace("@REPO@", "/root/repo")
+                  .replace("@COORD@", coord).replace("@PID@", str(pid)))
+        procs.append(subprocess.Popen(
+            [sys.executable, "-c", script],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            env={"PATH": "/usr/bin:/bin"}))
+    outs = []
+    for p in procs:
+        try:
+            out, _ = p.communicate(timeout=300)
+        except subprocess.TimeoutExpired:
+            p.kill()
+            out, _ = p.communicate()
+        outs.append(out.decode(errors="replace"))
+    for pid, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"host {pid} failed:\n{out}"
+        assert f"HOST_OK {pid}" in out
+
+
+_TRAIN = textwrap.dedent("""
+    import os, sys, json
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+    os.environ["LO_HOME"] = "@HOME@"
+    os.environ["LO_MESH_SHAPE"] = "auto"
+    os.environ["LO_COMPUTE_DTYPE"] = "float32"
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    sys.path.insert(0, "@REPO@")
+    from learningorchestra_tpu.runtime import distributed as dist
+
+    assert dist.initialize(coordinator_address="@COORD@",
+                           num_processes=2, process_id=@PID@)
+    assert jax.device_count() == 4
+
+    if @PID@ == 0:
+        import time
+        from learningorchestra_tpu.services.server import Api
+        api = Api()
+        prefix = "/api/learningOrchestra/v1"
+
+        def wait(uri):
+            for _ in range(600):
+                st, body, _h = api.dispatch("GET", uri, {"limit": "1"}, None)
+                if st == 200 and body["metadata"].get("finished"):
+                    return
+                docs = api.ctx.catalog.get_documents(
+                    uri.rstrip("/").split("/")[-1])
+                errs = [d["exception"] for d in docs if d.get("exception")]
+                assert not errs, errs
+                time.sleep(0.2)
+            raise SystemExit("timeout: " + uri)
+
+        st, body, _h = api.dispatch("POST", prefix + "/function/python", {}, {
+            "name": "mh_data", "functionParameters": {},
+            "function": ("import numpy as np\\n"
+                         "rng = np.random.default_rng(0)\\n"
+                         "x = rng.normal(size=(32, 8)).astype(np.float32)\\n"
+                         "y = (x[:, 0] > 0).astype(np.int32)\\n"
+                         "response = {'x': x, 'y': y}\\n")})
+        assert st == 201, body
+        wait(body["result"])
+
+        st, body, _h = api.dispatch("POST", prefix + "/model/tensorflow", {}, {
+            "modelName": "mh_model",
+            "modulePath": "learningorchestra_tpu.models",
+            "class": "NeuralModel",
+            "classParameters": {"layer_configs": [
+                {"kind": "dense", "units": 8, "activation": "relu"},
+                {"kind": "dense", "units": 2, "activation": "softmax"}]}})
+        assert st == 201, body
+        wait(body["result"])
+
+        st, body, _h = api.dispatch("POST", prefix + "/train/tensorflow", {}, {
+            "name": "mh_train", "modelName": "mh_model", "method": "fit",
+            "methodParameters": {"x": "$mh_data.x", "y": "$mh_data.y",
+                                 "epochs": 2, "batch_size": 8}})
+        assert st == 201, body
+        wait(body["result"])
+        trained = api.ctx.artifacts.load("mh_train", "train/tensorflow")
+        assert trained.history, "no training history"
+        dist.HostBridge().publish({"op": "shutdown"})
+        api.ctx.jobs.shutdown()
+    else:
+        dist.HostBridge().follow(lambda m: None)
+    print("TRAIN_OK", @PID@)
+""")
+
+
+def test_two_process_rest_train_replay(tmp_path):
+    """A /train REST job on the coordinator fans out to the worker via
+    the HostBridge and the fit jits over the GLOBAL 4-device mesh."""
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    coord = f"127.0.0.1:{port}"
+    home = str(tmp_path / "shared_home")
+    procs = []
+    for pid in range(2):
+        script = (_TRAIN.replace("@REPO@", "/root/repo")
+                  .replace("@COORD@", coord).replace("@PID@", str(pid))
+                  .replace("@HOME@", home))
+        procs.append(subprocess.Popen(
+            [sys.executable, "-c", script],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            env={"PATH": "/usr/bin:/bin"}))
+    outs = []
+    for p in procs:
+        try:
+            out, _ = p.communicate(timeout=540)
+        except subprocess.TimeoutExpired:
+            p.kill()
+            out, _ = p.communicate()
+        outs.append(out.decode(errors="replace"))
+    for pid, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"host {pid} failed:\n{out}"
+        assert f"TRAIN_OK {pid}" in out
