@@ -58,6 +58,12 @@ class Config:
     default_batch_size: int = 128
     compute_dtype: str = dataclasses.field(
         default_factory=lambda: os.environ.get("LO_COMPUTE_DTYPE", "bfloat16"))
+    # Datasets at or below this size train via the whole-epoch
+    # lax.scan fast path (one dispatch per epoch instead of per step);
+    # 0 disables.
+    scan_fit_max_bytes: int = dataclasses.field(
+        default_factory=lambda: int(os.environ.get(
+            "LO_SCAN_FIT_MAX_BYTES", str(1 << 30))))
 
     # Ingest pipeline.
     ingest_chunk_rows: int = dataclasses.field(

@@ -62,6 +62,37 @@ class ArrayBatcher:
         callers reuse it instead of re-converting the source data)."""
         return self._arrays[key]
 
+    def total_bytes(self) -> int:
+        return sum(a.nbytes for a in self._arrays.values())
+
+    @property
+    def shuffles(self) -> bool:
+        return self._shuffle
+
+    @property
+    def seed(self) -> int:
+        return self._seed
+
+    def padded_arrays(self) -> Dict[str, np.ndarray]:
+        """All samples padded to ``steps_per_epoch * batch_size`` rows
+        plus the 0/1 ``MASK_KEY`` column, in natural (unshuffled)
+        order — the device-resident layout of the engine's scan fast
+        path, which shuffles in HBM instead of re-transferring each
+        epoch."""
+        n_total = self.steps_per_epoch * self.batch_size
+        pad = n_total - self.num_samples
+        out: Dict[str, np.ndarray] = {}
+        for key, arr in self._arrays.items():
+            if pad:
+                arr = np.concatenate(
+                    [arr, np.zeros((pad,) + arr.shape[1:], arr.dtype)])
+            out[key] = arr
+        mask = np.ones((n_total,), np.float32)
+        if pad:
+            mask[self.num_samples:] = 0.0
+        out[MASK_KEY] = mask
+        return out
+
     def epoch(self, epoch_index: int = 0) -> Iterator[Dict[str, np.ndarray]]:
         n = self.num_samples
         order = np.arange(n)
@@ -88,6 +119,30 @@ class ArrayBatcher:
             yield batch
 
 
+def stage_to_device(arr: np.ndarray,
+                    sharding: Optional[NamedSharding]) -> jax.Array:
+    """Host array -> device array under ``sharding``.
+
+    - trailing spec dims beyond the array's rank are dropped (one
+      batch spec serves every entry, e.g. P(dp, sp) on the 1-D
+      sample-weight column becomes P(dp));
+    - on multi-host pods every process holds the same full host batch
+      (shared store, deterministic batcher) and contributes only the
+      shards its devices own.
+    """
+    if sharding is None:
+        return jax.device_put(arr)
+    from jax.sharding import PartitionSpec
+    spec = sharding.spec
+    if len(spec) > arr.ndim:
+        spec = PartitionSpec(*tuple(spec)[:arr.ndim])
+    target = NamedSharding(sharding.mesh, spec)
+    if jax.process_count() > 1:
+        return jax.make_array_from_callback(
+            arr.shape, target, lambda idx: arr[idx])
+    return jax.device_put(arr, target)
+
+
 def prefetch_to_device(iterator: Iterable[Dict[str, np.ndarray]],
                        sharding: Optional[NamedSharding] = None,
                        buffer_size: int = 2,
@@ -111,31 +166,12 @@ def prefetch_to_device(iterator: Iterable[Dict[str, np.ndarray]],
                 continue
         return False
 
-    def _clipped(arr):
-        # one batch spec serves every entry: trailing spec dims beyond
-        # an array's rank are dropped (e.g. P(dp, sp) on the 1-D
-        # sample-weight column becomes P(dp))
-        from jax.sharding import PartitionSpec
-        spec = sharding.spec
-        if len(spec) > arr.ndim:
-            spec = PartitionSpec(*tuple(spec)[:arr.ndim])
-        return NamedSharding(sharding.mesh, spec)
-
-    def _stage(arr):
-        target = _clipped(arr)
-        if jax.process_count() > 1:
-            # multi-host: every process holds the same full host batch
-            # (shared store, deterministic batcher); each contributes
-            # only the shards its devices own
-            return jax.make_array_from_callback(
-                arr.shape, target, lambda idx: arr[idx])
-        return jax.device_put(arr, target)
-
     def producer() -> None:
         try:
             for batch in iterator:
                 if sharding is not None:
-                    batch = {k: _stage(v) for k, v in batch.items()}
+                    batch = {k: stage_to_device(v, sharding)
+                             for k, v in batch.items()}
                 else:
                     batch = jax.device_put(batch)
                 if not _put(batch):

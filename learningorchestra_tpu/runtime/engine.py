@@ -70,6 +70,7 @@ class Engine:
         self._train_step = None
         self._eval_step = None
         self._predict_step = None
+        self._epoch_steps: Dict[Any, Callable] = {}
         self._donate = donate_state
         # (path-regex -> PartitionSpec) rules for TP/FSDP param layout;
         # None = replicate (pure DP)
@@ -116,32 +117,68 @@ class Engine:
         return jax.tree_util.tree_map(cast_leaf, tree)
 
     # ------------------------------------------------------------------
+    def _train_step_body(self, state: TrainState, batch, rng):
+        weights = batch.get(data_lib.MASK_KEY)
+
+        def loss_of(params):
+            outputs, new_model_state = self._apply_fn(
+                self._cast(params), state.model_state,
+                self._cast(batch), True, rng)
+            loss = self._loss_fn(outputs, batch, weights)
+            return loss.astype(jnp.float32), (outputs, new_model_state)
+
+        (loss, (outputs, new_model_state)), grads = jax.value_and_grad(
+            loss_of, has_aux=True)(state.params)
+        updates, new_opt = self._optimizer.update(
+            grads, state.opt_state, state.params)
+        new_params = optax.apply_updates(state.params, updates)
+        metrics = {"loss": (loss * _total(weights), _total(weights))}
+        for name, fn in self._metrics.items():
+            metrics[name] = fn(outputs, batch, weights)
+        new_state = state.replace(step=state.step + 1, params=new_params,
+                                  opt_state=new_opt,
+                                  model_state=new_model_state)
+        return new_state, metrics
+
     def _build_train_step(self):
-        def step_fn(state: TrainState, batch, rng):
-            weights = batch.get(data_lib.MASK_KEY)
+        donate = (0,) if self._donate else ()
+        return jax.jit(self._train_step_body, donate_argnums=donate)
 
-            def loss_of(params):
-                outputs, new_model_state = self._apply_fn(
-                    self._cast(params), state.model_state,
-                    self._cast(batch), True, rng)
-                loss = self._loss_fn(outputs, batch, weights)
-                return loss.astype(jnp.float32), (outputs, new_model_state)
+    def _build_epoch_step(self, steps: int, batch_size: int,
+                          shuffle: bool):
+        """Whole-epoch fast path: ONE jitted program per epoch that
+        shuffles ON DEVICE and lax.scans the train step over the
+        batches. The dataset stays resident in HBM across epochs —
+        after the first transfer the host link carries nothing, and
+        per-step Python dispatch (which dominates small models)
+        disappears."""
+        n_total = steps * batch_size
 
-            (loss, (outputs, new_model_state)), grads = jax.value_and_grad(
-                loss_of, has_aux=True)(state.params)
-            updates, new_opt = self._optimizer.update(
-                grads, state.opt_state, state.params)
-            new_params = optax.apply_updates(state.params, updates)
-            metrics = {"loss": (loss * _total(weights), _total(weights))}
-            for name, fn in self._metrics.items():
-                metrics[name] = fn(outputs, batch, weights)
-            new_state = state.replace(step=state.step + 1, params=new_params,
-                                      opt_state=new_opt,
-                                      model_state=new_model_state)
-            return new_state, metrics
+        def epoch_fn(state: TrainState, arrays, step_rng, shuffle_rng,
+                     epoch_idx):
+            if shuffle:
+                # distinct stream from the step rng (key reuse would
+                # correlate data order with dropout masks), seeded by
+                # the batcher so its reproducibility contract holds
+                perm = jax.random.permutation(
+                    jax.random.fold_in(shuffle_rng, epoch_idx), n_total)
+                arrays = jax.tree_util.tree_map(
+                    lambda a: jnp.take(a, perm, axis=0), arrays)
+            batches = jax.tree_util.tree_map(
+                lambda a: a.reshape((steps, batch_size) + a.shape[1:]),
+                arrays)
+
+            def step(carry, batch):
+                rng = jax.random.fold_in(step_rng, carry.step)
+                return self._train_step_body(carry, batch, rng)
+
+            state, metrics = jax.lax.scan(step, state, batches)
+            totals = {k: (jnp.sum(s), jnp.sum(c))
+                      for k, (s, c) in metrics.items()}
+            return state, totals
 
         donate = (0,) if self._donate else ()
-        return jax.jit(step_fn, donate_argnums=donate)
+        return jax.jit(epoch_fn, donate_argnums=donate)
 
     def _build_eval_step(self):
         def step_fn(state: TrainState, batch):
@@ -180,13 +217,32 @@ class Engine:
         return jax.jit(step_fn)
 
     # ------------------------------------------------------------------
-    def _device_feed(self, batcher: data_lib.ArrayBatcher, epoch: int):
-        sharding = self._batch_sharding
-        if sharding is None and self._mesh is not None:
-            sharding = mesh_lib.batch_sharding(self._mesh)
-        return data_lib.prefetch_to_device(batcher.epoch(epoch), sharding)
+    def _resolve_batch_sharding(self):
+        if self._batch_sharding is not None:
+            return self._batch_sharding
+        if self._mesh is not None:
+            return mesh_lib.batch_sharding(self._mesh)
+        return None
 
-    def _measure_flops(self, state, batch, rng) -> None:
+    def _device_feed(self, batcher: data_lib.ArrayBatcher, epoch: int):
+        return data_lib.prefetch_to_device(
+            batcher.epoch(epoch), self._resolve_batch_sharding())
+
+    def _roofline_record(self, record: Dict[str, Any], steps: int,
+                         dt: float) -> None:
+        """Attach achieved tflops/sec/chip + MFU for ``steps`` steady-
+        state steps over ``dt`` seconds."""
+        if not self._step_flops or steps <= 0 or dt <= 0:
+            return
+        n_dev = (self._mesh.size if self._mesh is not None
+                 else jax.device_count())
+        achieved = self._step_flops * steps / dt
+        record["tflopsPerSecPerChip"] = round(achieved / n_dev / 1e12, 4)
+        peak = peak_flops_per_chip()
+        if peak:
+            record["mfu"] = round(achieved / n_dev / peak, 4)
+
+    def _measure_flops(self, state, batch, rng, step_fn=None) -> None:
         """Per-step flop estimate from the lowered HLO (cheap — no
         compile). Basis for the MFU line in every history record."""
         key = tuple(sorted((k, tuple(v.shape)) for k, v in batch.items()))
@@ -194,17 +250,83 @@ class Engine:
             return
         self._flops_key = key
         try:
-            cost = self._train_step.lower(state, batch, rng).cost_analysis()
+            fn = step_fn if step_fn is not None else self._train_step
+            lowered = fn.lower(state, batch, rng)
+            cost = lowered.cost_analysis()
+            if not cost or not cost.get("flops"):
+                # some PJRT backends only report costs on the compiled
+                # executable (one extra compile, once per batch shape)
+                cost = lowered.compile().cost_analysis()
             flops = float(cost.get("flops", 0.0)) if cost else 0.0
             self._step_flops = flops if flops > 0 else 0.0
         except Exception:  # noqa: BLE001 — accounting must never sink a run
             self._step_flops = 0.0
 
+    def _should_scan(self, batcher: data_lib.ArrayBatcher) -> bool:
+        from learningorchestra_tpu.config import get_config
+
+        limit = get_config().scan_fit_max_bytes
+        return limit > 0 and batcher.total_bytes() <= limit and \
+            batcher.steps_per_epoch > 1
+
+    def _fit_scanned(self, state: TrainState,
+                     batcher: data_lib.ArrayBatcher, epochs: int,
+                     seed: int, checkpointer, log_fn,
+                     ) -> Tuple[TrainState, List[Dict[str, Any]]]:
+        steps = batcher.steps_per_epoch
+        bs = batcher.batch_size
+        key = (steps, bs, batcher.shuffles)
+        epoch_step = self._epoch_steps.get(key)
+        if epoch_step is None:
+            epoch_step = self._epoch_steps[key] = \
+                self._build_epoch_step(steps, bs, batcher.shuffles)
+        base_rng = jax.random.PRNGKey(seed)
+        shuffle_rng = jax.random.PRNGKey(batcher.seed)
+        # one host->HBM transfer for the whole fit; epochs shuffle in
+        # HBM (the host link, not the MXU, is the scarce resource)
+        sharding = self._resolve_batch_sharding()
+        padded = batcher.padded_arrays()
+        device_arrays = {k: data_lib.stage_to_device(v, sharding)
+                         for k, v in padded.items()}
+        history: List[Dict[str, Any]] = []
+        for epoch in range(epochs):
+            t0 = time.perf_counter()
+            if epoch == 0:
+                one = {k: v[:bs] for k, v in padded.items()}
+                self._measure_flops(
+                    state, one, base_rng,
+                    step_fn=jax.jit(self._train_step_body))
+            state, totals = epoch_step(state, device_arrays, base_rng,
+                                       shuffle_rng, jnp.asarray(epoch))
+            jax.block_until_ready(state.params)
+            dt = time.perf_counter() - t0
+            record = {k: float(s) / max(float(c), 1e-9)
+                      for k, (s, c) in totals.items()}
+            record.update(epoch=epoch, epochSeconds=round(dt, 4),
+                          samplesPerSecond=round(
+                              batcher.num_samples / dt, 2))
+            # compile epoch has no steady-state window in scan mode;
+            # roofline numbers start at epoch 1
+            if epoch > 0:
+                self._roofline_record(record, steps, dt)
+            history.append(record)
+            if checkpointer is not None:
+                checkpointer.save(int(state.step), state)
+            if log_fn is not None:
+                log_fn(record)
+        return state, history
+
     def fit(self, state: TrainState, batcher: data_lib.ArrayBatcher,
             epochs: int = 1, seed: int = 0,
             checkpointer=None,
             log_fn: Optional[Callable[[Dict[str, Any]], None]] = None,
+            scan_batches: Optional[bool] = None,
             ) -> Tuple[TrainState, List[Dict[str, Any]]]:
+        use_scan = (self._should_scan(batcher) if scan_batches is None
+                    else scan_batches)
+        if use_scan:
+            return self._fit_scanned(state, batcher, epochs, seed,
+                                     checkpointer, log_fn)
         if self._train_step is None:
             self._train_step = self._build_train_step()
         base_rng = jax.random.PRNGKey(seed)
@@ -245,16 +367,7 @@ class Engine:
             record.update(epoch=epoch, epochSeconds=round(dt, 4),
                           samplesPerSecond=round(batcher.num_samples / dt, 2))
             steady_steps += steps
-            dt_steady = now - t_steady
-            if self._step_flops and steady_steps > 0 and dt_steady > 0:
-                n_dev = (self._mesh.size if self._mesh is not None
-                         else jax.device_count())
-                achieved = self._step_flops * steady_steps / dt_steady
-                record["tflopsPerSecPerChip"] = round(
-                    achieved / n_dev / 1e12, 4)
-                peak = peak_flops_per_chip()
-                if peak:
-                    record["mfu"] = round(achieved / n_dev / peak, 4)
+            self._roofline_record(record, steady_steps, now - t_steady)
             history.append(record)
             if checkpointer is not None:
                 checkpointer.save(int(state.step), state)
