@@ -1,0 +1,52 @@
+"""Pipeline-parallel LM: pipelined forward must equal the sequential
+oracle, and the train step (autodiff through the GPipe schedule) must
+run and learn on a dp×pp mesh."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from learningorchestra_tpu.models import pp_transformer as pp_lm
+from learningorchestra_tpu.runtime import mesh as mesh_lib
+
+VOCAB, D, LAYERS, HEADS = 32, 16, 4, 2
+
+
+@pytest.fixture()
+def params():
+    return pp_lm.init_params(jax.random.PRNGKey(0), VOCAB, D, LAYERS)
+
+
+def _tokens(n=8, s=12):
+    rng = np.random.default_rng(0)
+    return rng.integers(1, VOCAB, size=(n, s)).astype(np.int32)
+
+
+def test_pipelined_forward_matches_sequential(params):
+    tokens = jnp.asarray(_tokens())
+    mesh = mesh_lib.build_mesh("dp=2,pp=4")
+    ref = pp_lm.forward(params, tokens, None, HEADS)  # sequential
+    out = jax.jit(lambda p, t: pp_lm.forward(
+        p, t, mesh, HEADS, num_microbatches=4))(params, tokens)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-4, rtol=2e-4)
+
+
+def test_pipelined_train_learns(params):
+    mesh = mesh_lib.build_mesh("dp=2,pp=4")
+    # ABAB pattern — predictable next token
+    rng = np.random.default_rng(1)
+    a = rng.integers(1, VOCAB, size=(32, 1))
+    b = rng.integers(1, VOCAB, size=(32, 1))
+    tokens = np.tile(np.concatenate([a, b], 1), (1, 6)).astype(np.int32)
+    _, losses = pp_lm.fit(params, tokens, mesh, HEADS, steps=12,
+                          batch_size=16, learning_rate=5e-3)
+    assert all(np.isfinite(losses))
+    assert losses[-1] < losses[0] * 0.8
+
+
+def test_layer_count_must_divide_pp(params):
+    mesh = mesh_lib.build_mesh("pp=8")  # 4 layers % 8 != 0
+    with pytest.raises(ValueError, match="not divisible"):
+        pp_lm.forward(params, jnp.asarray(_tokens()), mesh, HEADS)
