@@ -269,6 +269,17 @@ class Engine:
         return limit > 0 and batcher.total_bytes() <= limit and \
             batcher.steps_per_epoch > 1
 
+    def _maybe_restore(self, state: TrainState, checkpointer
+                       ) -> TrainState:
+        """Resume from the newest checkpoint if one exists — this is
+        what turns the reference's 'failed jobs are lost, resubmit from
+        the parent' story (README.md:194-198) into true mid-training
+        resume: a PATCH re-run picks up at the last saved step."""
+        if checkpointer is None or checkpointer.latest_step() is None:
+            return state
+        restored = checkpointer.restore(state)
+        return state if restored is None else restored
+
     def _fit_scanned(self, state: TrainState,
                      batcher: data_lib.ArrayBatcher, epochs: int,
                      seed: int, checkpointer, log_fn,
@@ -322,6 +333,7 @@ class Engine:
             log_fn: Optional[Callable[[Dict[str, Any]], None]] = None,
             scan_batches: Optional[bool] = None,
             ) -> Tuple[TrainState, List[Dict[str, Any]]]:
+        state = self._maybe_restore(state, checkpointer)
         use_scan = (self._should_scan(batcher) if scan_batches is None
                     else scan_batches)
         if use_scan:

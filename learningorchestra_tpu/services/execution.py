@@ -121,9 +121,15 @@ class ExecutionService:
 
     def delete(self, name: str, verb: str, tool: str,
                ) -> Tuple[int, Dict[str, Any]]:
+        import shutil
+
         meta = self._validator.existing(name)
         self._ctx.catalog.delete_collection(name)
         self._ctx.artifacts.delete(name, meta.get(D.TYPE_FIELD))
+        # a stale checkpoint dir would make a future execution reusing
+        # this name silently resume from the deleted run
+        shutil.rmtree(checkpoint_dir_for(self._ctx, name),
+                      ignore_errors=True)
         return V.HTTP_SUCCESS, {"result": f"deleted {name}"}
 
     # ------------------------------------------------------------------
@@ -131,11 +137,18 @@ class ExecutionService:
                 method: str, method_parameters: Dict[str, Any],
                 description: str) -> None:
         def run():
-            _broadcast_to_workers(parent_name, method, method_parameters)
+            _broadcast_to_workers(name, type_string, parent_name, method,
+                                  method_parameters)
             parent_type = self._ctx.params.artifact_type(parent_name)
             instance = self._ctx.artifacts.load(parent_name, parent_type)
             treated = self._ctx.params.treat(method_parameters)
-            result = getattr(instance, method)(**treated)
+            ckpt = _prepare_checkpointer(self._ctx, name, type_string,
+                                         treated)
+            try:
+                result = getattr(instance, method)(**treated)
+            finally:
+                if ckpt is not None:
+                    ckpt.close()  # flush async orbax writes
             if type_string.startswith(_INSTANCE_RESULT_PREFIXES):
                 result = instance  # the fitted object is the artifact
             self._ctx.artifacts.save(result, name, type_string)
@@ -149,10 +162,38 @@ class ExecutionService:
             parameters=method_parameters, needs_mesh=True)
 
 
+def checkpoint_dir_for(ctx, name: str) -> str:
+    import os
+
+    return os.path.join(ctx.config.checkpoints_dir, name)
+
+
+def _prepare_checkpointer(ctx, name: str, type_string: str,
+                          treated: Dict[str, Any]):
+    """``"checkpoint": true`` in fit methodParameters enables per-epoch
+    orbax checkpointing under the execution's name; a PATCH re-run of
+    the same execution then resumes from the latest step (the engine
+    restores before training — beyond the reference, whose failed jobs
+    restart from scratch, README.md:194-198).
+
+    Train executions only: a tune sweep runs many concurrent trial
+    fits that would collide in one checkpoint manager (and restoring
+    trial A's weights into trial B corrupts the sweep)."""
+    enabled = treated.pop("checkpoint", False)
+    if not type_string.startswith("train/") or not enabled:
+        return None
+    from learningorchestra_tpu.runtime.checkpoint import Checkpointer
+
+    ckpt = Checkpointer(checkpoint_dir_for(ctx, name))
+    treated["checkpointer"] = ckpt
+    return ckpt
+
+
 # ----------------------------------------------------------------------
 # multi-host fan-out (SURVEY §7 hard part #5: one REST call -> N hosts)
 # ----------------------------------------------------------------------
-def _broadcast_to_workers(parent_name: str, method: str,
+def _broadcast_to_workers(name: str, type_string: str, parent_name: str,
+                          method: str,
                           method_parameters: Dict[str, Any]) -> None:
     """On a multi-host pod the coordinator publishes every mesh job
     before entering it: the jitted train/eval/predict step runs over
@@ -169,20 +210,23 @@ def _broadcast_to_workers(parent_name: str, method: str,
         "op": "run",
         "target": "learningorchestra_tpu.services.execution:"
                   "replay_method_call",
-        "kwargs": {"parent_name": parent_name, "method": method,
+        "kwargs": {"name": name, "type_string": type_string,
+                   "parent_name": parent_name, "method": method,
                    "method_parameters": method_parameters}})
 
 
 _worker_ctx = None
 
 
-def replay_method_call(parent_name: str, method: str,
+def replay_method_call(name: str, type_string: str, parent_name: str,
+                       method: str,
                        method_parameters: Dict[str, Any]) -> None:
     """Worker-side twin of the coordinator's pipeline: load the same
     artifact from the shared store, resolve the same parameters, call
     the same method — so every host participates in the global-mesh
-    jit. Catalog/artifact WRITES stay with the coordinator; the
-    worker's copy of the result is discarded."""
+    jit (including orbax checkpoint saves, which are collective).
+    Catalog/artifact WRITES stay with the coordinator; the worker's
+    copy of the result is discarded."""
     global _worker_ctx
     if _worker_ctx is None:
         from learningorchestra_tpu.services.context import ServiceContext
@@ -192,7 +236,12 @@ def replay_method_call(parent_name: str, method: str,
     parent_type = ctx.params.artifact_type(parent_name)
     instance = ctx.artifacts.load(parent_name, parent_type)
     treated = ctx.params.treat(method_parameters)
-    getattr(instance, method)(**treated)
+    ckpt = _prepare_checkpointer(ctx, name, type_string, treated)
+    try:
+        getattr(instance, method)(**treated)
+    finally:
+        if ckpt is not None:
+            ckpt.close()
 
 
 def summarize_result(result: Any) -> Optional[Any]:

@@ -25,7 +25,9 @@ GET    /{service}/{tool}/{name}           universal paged read
                                           for explore plots)
 DELETE /{service}/{tool}/{name}           per-service ``delete``
 GET    /observe/{name}?seq=N              long-poll change feed
-GET    /health                            liveness + device info
+POST   /profile {action: start|stop}      jax.profiler trace capture
+GET    /profile                           profiler status + trace list
+GET    /health                            liveness + topology info
 ====== ================================== ==============================
 
 Semantics preserved: POST validates synchronously (406/409/404), then
@@ -79,6 +81,8 @@ class Api:
         self.projection = ProjectionService(self.ctx)
         self.datatype = DataTypeService(self.ctx)
         self.builder = BuilderService(self.ctx)
+        self._profile_dir: Optional[str] = None  # active jax trace
+        self._profile_lock = threading.Lock()
 
     # ------------------------------------------------------------------
     def dispatch(self, method: str, path: str, params: Dict[str, Any],
@@ -106,6 +110,8 @@ class Api:
         parts = [p for p in path[len(prefix):].split("/") if p]
         if parts and parts[0] == "observe":
             return self._observe(parts, params)
+        if parts and parts[0] == "profile":
+            return self._profile(method, body or {})
         if len(parts) < 2 or parts[0] not in SERVICES:
             return 404, {"result": "unknown route"}, "application/json"
         service, tool = parts[0], parts[1]
@@ -133,14 +139,62 @@ class Api:
         info: Dict[str, Any] = {"status": "ok",
                                 "jobsRunning": self.ctx.jobs.running()}
         try:
-            import jax
+            from learningorchestra_tpu.runtime import distributed as dist
 
-            devices = jax.devices()
-            info["deviceCount"] = len(devices)
-            info["devicePlatform"] = devices[0].platform
+            info.update(dist.host_info())
+            info["deviceCount"] = info["globalDevices"]
+            info["devicePlatform"] = info["platform"]
         except Exception as e:  # noqa: BLE001
             info["deviceError"] = repr(e)
         return info
+
+    def _profile(self, method: str, body: Dict[str, Any],
+                 ) -> Tuple[int, Any, str]:
+        """``POST /profile {"action": "start"|"stop"}`` captures a
+        ``jax.profiler`` trace (XLA device activity, HLO timelines —
+        view in TensorBoard/Perfetto). ``GET /profile`` lists captured
+        traces. The reference's only profiling surface is the Spark UI
+        + builder fitTime (SURVEY §5); this is first-party and covers
+        every jitted computation in the process."""
+        import os
+        import time as time_mod
+
+        import jax
+
+        if method == "GET":
+            root = os.path.join(self.ctx.config.home, "profiles")
+            traces = sorted(os.listdir(root)) if os.path.isdir(root) else []
+            return 200, {"active": self._profile_dir is not None,
+                         "traces": traces}, "application/json"
+        if method != "POST":
+            return 405, {"result": "unsupported method"}, "application/json"
+        action = (body.get("action") or "").lower()
+        # ThreadingHTTPServer: concurrent start/stop must not race the
+        # singleton profiler state
+        with self._profile_lock:
+            if action == "start":
+                if self._profile_dir is not None:
+                    raise V.HttpError(V.HTTP_NOT_ACCEPTABLE,
+                                      "a trace is already active")
+                trace_dir = os.path.join(
+                    self.ctx.config.home, "profiles",
+                    f"{time_mod.strftime('%Y%m%d-%H%M%S')}-"
+                    f"{time_mod.time_ns() % 1_000_000:06d}")
+                os.makedirs(trace_dir)
+                jax.profiler.start_trace(trace_dir)
+                self._profile_dir = trace_dir
+                return 201, {"result": trace_dir}, "application/json"
+            if action == "stop":
+                if self._profile_dir is None:
+                    raise V.HttpError(V.HTTP_NOT_ACCEPTABLE,
+                                      "no active trace")
+                jax.profiler.stop_trace()
+                trace_dir, self._profile_dir = self._profile_dir, None
+                n_files = sum(len(fs) for _, _, fs in os.walk(trace_dir))
+                return 200, {"result": trace_dir,
+                             "files": n_files}, "application/json"
+        raise V.HttpError(V.HTTP_NOT_ACCEPTABLE,
+                          "action must be 'start' or 'stop'")
 
     def _post(self, service: str, tool: str, body: Dict[str, Any],
               ) -> Tuple[int, Any, str]:

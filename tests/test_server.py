@@ -331,3 +331,81 @@ def test_tune_grid_search_pipeline(server):
     meta = _poll_finished(server, f"{API}/tune/tensorflow/tune_run",
                           timeout=300)
     assert meta["finished"]
+
+
+def test_train_checkpoint_and_patch_resume(server):
+    """checkpoint: true saves per-epoch orbax steps under the execution
+    name; PATCH re-runs the same execution and resumes from them."""
+    import os
+
+    st, body = _call(server, "POST", f"{API}/function/python", body={
+        "name": "ck_data", "functionParameters": {},
+        "function": ("import numpy as np\n"
+                     "rng = np.random.default_rng(0)\n"
+                     "x = rng.normal(size=(32, 8)).astype(np.float32)\n"
+                     "y = (x[:, 0] > 0).astype(np.int32)\n"
+                     "response = {'x': x, 'y': y}\n")})
+    assert st == 201, body
+    _poll_finished(server, f"{API}/function/python/ck_data")
+
+    st, body = _call(server, "POST", f"{API}/model/tensorflow", body={
+        "modelName": "ck_model",
+        "modulePath": "learningorchestra_tpu.models",
+        "class": "NeuralModel",
+        "classParameters": {"layer_configs": [
+            {"kind": "dense", "units": 4, "activation": "relu"},
+            {"kind": "dense", "units": 2, "activation": "softmax"}]}})
+    assert st == 201, body
+    _poll_finished(server, f"{API}/model/tensorflow/ck_model")
+
+    st, body = _call(server, "POST", f"{API}/train/tensorflow", body={
+        "name": "ck_train", "modelName": "ck_model", "method": "fit",
+        "methodParameters": {"x": "$ck_data.x", "y": "$ck_data.y",
+                             "epochs": 2, "batch_size": 8,
+                             "checkpoint": True}})
+    assert st == 201, body
+    _poll_finished(server, f"{API}/train/tensorflow/ck_train")
+
+    ckpt_dir = os.path.join(server.api.ctx.config.checkpoints_dir,
+                            "ck_train")
+    assert os.path.isdir(ckpt_dir) and os.listdir(ckpt_dir)
+
+    from learningorchestra_tpu.runtime.checkpoint import Checkpointer
+
+    ck = Checkpointer(ckpt_dir)
+    assert ck.latest_step() == 8  # 2 epochs x 4 steps
+    ck.close()
+
+    st, body = _call(server, "PATCH", f"{API}/train/tensorflow/ck_train",
+                     body={"methodParameters": {
+                         "x": "$ck_data.x", "y": "$ck_data.y",
+                         "epochs": 1, "batch_size": 8,
+                         "checkpoint": True}})
+    assert st == 200, body
+    _poll_finished(server, f"{API}/train/tensorflow/ck_train")
+    # resumed from step 8, one more epoch -> step 12 (a restart from
+    # scratch would have left the latest checkpoint at 4)
+    ck = Checkpointer(ckpt_dir)
+    assert ck.latest_step() == 12
+    ck.close()
+
+
+def test_profile_trace_capture(server):
+    """POST /profile start/stop captures a jax.profiler trace."""
+    import jax.numpy as jnp
+
+    st, body = _call(server, "POST", f"{API}/profile",
+                     body={"action": "start"})
+    assert st == 201, body
+    # give the profiler something to record
+    (jnp.ones((64, 64)) @ jnp.ones((64, 64))).block_until_ready()
+    st, body = _call(server, "POST", f"{API}/profile",
+                     body={"action": "stop"})
+    assert st == 200, body
+    assert body["files"] > 0
+    st, body = _call(server, "GET", f"{API}/profile")
+    assert st == 200 and len(body["traces"]) == 1
+    # double-stop is a client error, not a crash
+    st, body = _call(server, "POST", f"{API}/profile",
+                     body={"action": "stop"})
+    assert st == 406
