@@ -100,8 +100,15 @@ class SequentialModule(nn.Module):
             elif kind == "layernorm":
                 x = nn.LayerNorm(name=name)(x)
             elif kind == "embedding":
-                x = nn.Embed(cfg["vocab"], cfg["dim"], name=name)(
-                    x.astype(jnp.int32))
+                # accept native (vocab/dim) and keras (input_dim/
+                # output_dim) key names; fail loud when both missing
+                vocab = cfg.get("vocab", cfg.get("input_dim"))
+                dim = cfg.get("dim", cfg.get("output_dim"))
+                if vocab is None or dim is None:
+                    raise ValueError(
+                        "embedding layer needs vocab/dim (or keras "
+                        f"input_dim/output_dim); got {dict(cfg)}")
+                x = nn.Embed(vocab, dim, name=name)(x.astype(jnp.int32))
             elif kind == "lstm":
                 units = cfg["units"]
                 rnn = nn.RNN(nn.OptimizedLSTMCell(units), name=name)

@@ -102,8 +102,11 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
         safe_l = jnp.where(l > 0, l, 1.0)
         o_ref[0] = (acc_ref[...] / safe_l).astype(o_ref.dtype)
         m = m_ref[:, :1]
-        lse = jnp.where(l > 0, m + jnp.log(safe_l), 0.0)
-        lse_ref[0] = lse[:, 0]
+        lse = jnp.where(l > 0, m + jnp.log(safe_l), 0.0)  # (bq, 1)
+        # lse output carries a 128-lane trailing dim (Mosaic requires
+        # the last two block dims tile to (8, 128)); value broadcast
+        # across lanes, wrapper reads lane 0
+        lse_ref[0] = lse * jnp.ones_like(lse_ref[0])
 
 
 def _fwd_pallas(q, k, v, *, scale: float, causal: bool,
@@ -140,16 +143,16 @@ def _fwd_pallas(q, k, v, *, scale: float, causal: bool,
         ],
         out_specs=[
             pl.BlockSpec((1, block_q, d_p), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, block_q), lambda b, i, j: (b, i)),
+            pl.BlockSpec((1, block_q, lanes), lambda b, i, j: (b, i, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((bh, sq_p, d_p), q.dtype),
-            jax.ShapeDtypeStruct((bh, sq_p), jnp.float32),
+            jax.ShapeDtypeStruct((bh, sq_p, lanes), jnp.float32),
         ],
         scratch_shapes=scratch,
         interpret=interpret,
     )(q, k, v)
-    return o[:, :sq, :d], lse[:, :sq]
+    return o[:, :sq, :d], lse[:, :sq, 0]
 
 
 # ----------------------------------------------------------------------

@@ -140,3 +140,21 @@ def test_resnet50_shim_builds(tmp_config):
     with pytest.warns(UserWarning, match="offline"):
         model = keras.applications.ResNet50(weights="imagenet", classes=10)
     assert model.layer_configs[0]["kind"] == "resnet50"
+
+
+def test_embedding_accepts_keras_key_names(tmp_config):
+    """input_dim/output_dim (keras) and vocab/dim (native) both work."""
+    import numpy as np
+
+    from learningorchestra_tpu.models import NeuralModel
+
+    x = np.random.default_rng(0).integers(1, 50, size=(16, 8))
+    y = (x[:, 0] > 25).astype(np.int32)
+    for cfg in ({"kind": "embedding", "input_dim": 50, "output_dim": 8},
+                {"kind": "embedding", "vocab": 50, "dim": 8}):
+        m = NeuralModel([cfg, {"kind": "lstm", "units": 8},
+                         {"kind": "dense", "units": 1,
+                          "activation": "sigmoid"}])
+        m.compile("adam", loss="binary_crossentropy")
+        h = m.fit(x, y, batch_size=8, epochs=1)
+        assert np.isfinite(h.history["loss"][0])
