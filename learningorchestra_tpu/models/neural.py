@@ -223,9 +223,13 @@ class NeuralModel:
         state, history = eng.fit(state, batcher, epochs=epochs,
                                  seed=self.seed, checkpointer=checkpointer,
                                  log_fn=log_fn)
+        # history can be empty on a no-op resume (checkpoint budget
+        # already consumed) — still evaluate, record as its own entry
         if validation_data is not None:
             vx, vy = validation_data[0], validation_data[1]
             val = eng.evaluate(state, self._batcher(vx, vy, batch_size))
+            if not history:
+                history.append({})
             for k, v in val.items():
                 history[-1][f"val_{k}"] = v
         self._state = state

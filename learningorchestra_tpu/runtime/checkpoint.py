@@ -49,6 +49,27 @@ class Checkpointer:
             return None
         return self._mgr.restore(step, args=ocp.args.StandardRestore(target))
 
+    # -- sidecar progress metadata ------------------------------------
+    # Epoch progress can't be reconstructed from the restored step when
+    # a re-run reshapes the feed (different batch_size / data size), so
+    # the engine records it here next to the orbax steps.
+    def save_meta(self, meta: dict) -> None:
+        import json
+
+        path = os.path.join(self._dir, "progress.json")
+        with open(path + ".tmp", "w") as f:
+            json.dump(meta, f)
+        os.replace(path + ".tmp", path)
+
+    def load_meta(self) -> Optional[dict]:
+        import json
+
+        path = os.path.join(self._dir, "progress.json")
+        if not os.path.exists(path):
+            return None
+        with open(path) as f:
+            return json.load(f)
+
     def close(self) -> None:
         self._mgr.wait_until_finished()
         self._mgr.close()

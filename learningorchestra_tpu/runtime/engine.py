@@ -157,9 +157,10 @@ class Engine:
         def epoch_fn(state: TrainState, arrays, step_rng, shuffle_rng,
                      epoch_idx):
             if shuffle:
-                # distinct stream from the step rng (key reuse would
-                # correlate data order with dropout masks), seeded by
-                # the batcher so its reproducibility contract holds
+                # shuffle_rng is pre-folded with a constant tag (see
+                # _shuffle_rng) so the permutation stream stays distinct
+                # from the dropout stream even when batcher.seed equals
+                # the step seed (the default for every model class)
                 perm = jax.random.permutation(
                     jax.random.fold_in(shuffle_rng, epoch_idx), n_total)
                 arrays = jax.tree_util.tree_map(
@@ -269,20 +270,40 @@ class Engine:
         return limit > 0 and batcher.total_bytes() <= limit and \
             batcher.steps_per_epoch > 1
 
+    def _save_checkpoint(self, checkpointer, state: TrainState,
+                         epoch: int) -> None:
+        step = int(state.step)
+        checkpointer.save(step, state)
+        # the orbax save above is async: the sidecar records which step
+        # it describes, and resume ignores it unless that exact step is
+        # what actually restored (a crash mid-save leaves an older
+        # committed step + a newer sidecar — trusting it would skip
+        # never-trained epochs)
+        if hasattr(checkpointer, "save_meta"):
+            checkpointer.save_meta({"step": step, "epochs_done": epoch + 1})
+
     def _maybe_restore(self, state: TrainState, checkpointer
-                       ) -> TrainState:
+                       ) -> Tuple[TrainState, bool]:
         """Resume from the newest checkpoint if one exists — this is
         what turns the reference's 'failed jobs are lost, resubmit from
         the parent' story (README.md:194-198) into true mid-training
-        resume: a PATCH re-run picks up at the last saved step."""
+        resume: a PATCH re-run picks up at the last saved step.
+
+        Returns (state, restored) — the flag lets ``fit`` subtract the
+        already-completed epochs from the requested budget only on a
+        real resume (plain repeated ``fit`` calls keep accumulating
+        epochs, Keras-style)."""
         if checkpointer is None or checkpointer.latest_step() is None:
-            return state
+            return state, False
         restored = checkpointer.restore(state)
-        return state if restored is None else restored
+        if restored is None:
+            return state, False
+        return restored, True
 
     def _fit_scanned(self, state: TrainState,
                      batcher: data_lib.ArrayBatcher, epochs: int,
                      seed: int, checkpointer, log_fn,
+                     start_epoch: int = 0,
                      ) -> Tuple[TrainState, List[Dict[str, Any]]]:
         steps = batcher.steps_per_epoch
         bs = batcher.batch_size
@@ -292,7 +313,7 @@ class Engine:
             epoch_step = self._epoch_steps[key] = \
                 self._build_epoch_step(steps, bs, batcher.shuffles)
         base_rng = jax.random.PRNGKey(seed)
-        shuffle_rng = jax.random.PRNGKey(batcher.seed)
+        shuffle_rng = _shuffle_rng(batcher.seed)
         # one host->HBM transfer for the whole fit; epochs shuffle in
         # HBM (the host link, not the MXU, is the scarce resource)
         sharding = self._resolve_batch_sharding()
@@ -300,9 +321,9 @@ class Engine:
         device_arrays = {k: data_lib.stage_to_device(v, sharding)
                          for k, v in padded.items()}
         history: List[Dict[str, Any]] = []
-        for epoch in range(epochs):
+        for epoch in range(start_epoch, epochs):
             t0 = time.perf_counter()
-            if epoch == 0:
+            if epoch == start_epoch:
                 one = {k: v[:bs] for k, v in padded.items()}
                 self._measure_flops(
                     state, one, base_rng,
@@ -317,12 +338,12 @@ class Engine:
                           samplesPerSecond=round(
                               batcher.num_samples / dt, 2))
             # compile epoch has no steady-state window in scan mode;
-            # roofline numbers start at epoch 1
-            if epoch > 0:
+            # roofline numbers start with the second executed epoch
+            if epoch > start_epoch:
                 self._roofline_record(record, steps, dt)
             history.append(record)
             if checkpointer is not None:
-                checkpointer.save(int(state.step), state)
+                self._save_checkpoint(checkpointer, state, epoch)
             if log_fn is not None:
                 log_fn(record)
         return state, history
@@ -333,21 +354,43 @@ class Engine:
             log_fn: Optional[Callable[[Dict[str, Any]], None]] = None,
             scan_batches: Optional[bool] = None,
             ) -> Tuple[TrainState, List[Dict[str, Any]]]:
-        state = self._maybe_restore(state, checkpointer)
+        state, restored = self._maybe_restore(state, checkpointer)
+        # On a real resume the requested ``epochs`` is the TOTAL budget:
+        # a PATCH re-run of a crashed job trains only the remainder and
+        # a re-run of a finished job is a no-op (not a silent doubling).
+        # Completed epochs come from the checkpoint's progress sidecar
+        # (robust to a re-run reshaping the feed); the restored step is
+        # the fallback for checkpoints written before the sidecar.
+        start_epoch = 0
+        if restored:
+            meta = (checkpointer.load_meta()
+                    if hasattr(checkpointer, "load_meta") else None)
+            if meta and "epochs_done" in meta and \
+                    int(meta.get("step", -1)) == int(state.step):
+                start_epoch = min(epochs, int(meta["epochs_done"]))
+            else:
+                start_epoch = min(
+                    epochs,
+                    int(state.step) // max(1, batcher.steps_per_epoch))
+            if start_epoch >= epochs:
+                return state, []
         use_scan = (self._should_scan(batcher) if scan_batches is None
                     else scan_batches)
         if use_scan:
             return self._fit_scanned(state, batcher, epochs, seed,
-                                     checkpointer, log_fn)
+                                     checkpointer, log_fn,
+                                     start_epoch=start_epoch)
         if self._train_step is None:
             self._train_step = self._build_train_step()
         base_rng = jax.random.PRNGKey(seed)
         history: List[Dict[str, Any]] = []
         # Host-side step counter for the dropout rng: reading
         # ``state.step`` here would sync the host on every step and
-        # serialize the prefetch pipeline against device compute.
+        # serialize the prefetch pipeline against device compute. It
+        # continues from the restored step, so the per-step rng stream
+        # does not replay draws consumed before a crash.
         host_step = int(state.step)
-        for epoch in range(epochs):
+        for epoch in range(start_epoch, epochs):
             t0 = time.perf_counter()
             # metric accumulation stays on-device (async); one sync at
             # epoch end
@@ -361,10 +404,10 @@ class Engine:
             for batch in self._device_feed(batcher, epoch):
                 rng = jax.random.fold_in(base_rng, host_step)
                 host_step += 1
-                if steps == 0 and epoch == 0:
+                if steps == 0 and epoch == start_epoch:
                     self._measure_flops(state, batch, rng)
                 state, metrics = self._train_step(state, batch, rng)
-                if steps == 0 and epoch == 0:
+                if steps == 0 and epoch == start_epoch:
                     jax.block_until_ready(metrics)
                     t_steady, steady_steps = time.perf_counter(), -1
                 steps += 1
@@ -382,7 +425,7 @@ class Engine:
             self._roofline_record(record, steady_steps, now - t_steady)
             history.append(record)
             if checkpointer is not None:
-                checkpointer.save(int(state.step), state)
+                self._save_checkpoint(checkpointer, state, epoch)
             if log_fn is not None:
                 log_fn(record)
         return state, history
@@ -468,6 +511,17 @@ def _replicator(mesh):
         rep = NamedSharding(mesh, PartitionSpec())
         fn = _REPLICATORS[mesh] = jax.jit(lambda a: a, out_shardings=rep)
     return fn
+
+
+_SHUFFLE_TAG = 0x5348_5546  # "SHUF": domain-separates permutation keys
+
+
+def _shuffle_rng(seed: int) -> jax.Array:
+    """Shuffle-permutation key stream, domain-separated from the step
+    (dropout) stream: ``PRNGKey(seed)`` folded with a constant tag, so
+    fold_in(key, epoch) never collides with fold_in(step_key, step)
+    even when both seeds are the same integer."""
+    return jax.random.fold_in(jax.random.PRNGKey(seed), _SHUFFLE_TAG)
 
 
 def _total(weights):

@@ -256,8 +256,27 @@ def test_checkpoint_resume(tmp_config, tmp_path):
     first_steps = int(state.step)
     assert first_steps == 8  # 4 steps/epoch * 2
 
-    # fresh engine + zeroed state: must restore, not restart
+    # fresh engine + zeroed state: restores, and ``epochs`` is the
+    # TOTAL budget — 2 are done, so epochs=3 trains exactly 1 more
     eng2, state2, batcher2 = make()
-    state2, _ = eng2.fit(state2, batcher2, epochs=1, checkpointer=ckpt)
+    state2, hist2 = eng2.fit(state2, batcher2, epochs=3, checkpointer=ckpt)
     assert int(state2.step) == first_steps + 4
+    assert [h["epoch"] for h in hist2] == [2]
+
+    # re-running a finished budget is a no-op, not a silent doubling
+    eng3, state3, batcher3 = make()
+    state3, hist3 = eng3.fit(state3, batcher3, epochs=3, checkpointer=ckpt)
+    assert int(state3.step) == first_steps + 4
+    assert hist3 == []
+
+    # epoch progress comes from the checkpoint sidecar, so a re-run
+    # that RESHAPES the feed (batch_size 8 -> 4, 8 steps/epoch) still
+    # counts 3 epochs done: budget 3 stays a no-op even though
+    # step(12) // new_steps_per_epoch(8) would miscount as 1
+    eng4, state4, _ = make()
+    batcher4 = data_lib.ArrayBatcher({"x": x, "y": y}, batch_size=4,
+                                     dp_multiple=4)
+    state4, hist4 = eng4.fit(state4, batcher4, epochs=3, checkpointer=ckpt)
+    assert int(state4.step) == first_steps + 4
+    assert hist4 == []
     ckpt.close()

@@ -379,12 +379,14 @@ def test_train_checkpoint_and_patch_resume(server):
     st, body = _call(server, "PATCH", f"{API}/train/tensorflow/ck_train",
                      body={"methodParameters": {
                          "x": "$ck_data.x", "y": "$ck_data.y",
-                         "epochs": 1, "batch_size": 8,
+                         "epochs": 3, "batch_size": 8,
                          "checkpoint": True}})
     assert st == 200, body
     _poll_finished(server, f"{API}/train/tensorflow/ck_train")
-    # resumed from step 8, one more epoch -> step 12 (a restart from
-    # scratch would have left the latest checkpoint at 4)
+    # resumed from step 8 with a TOTAL budget of 3 epochs: 2 already
+    # done, so exactly one more epoch runs -> step 12 (a restart from
+    # scratch would have left the latest checkpoint at 4; the old
+    # overshoot bug would have trained 3 more epochs -> step 20)
     ck = Checkpointer(ckpt_dir)
     assert ck.latest_step() == 12
     ck.close()
