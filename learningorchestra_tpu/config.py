@@ -70,11 +70,22 @@ class Config:
         default_factory=lambda: int(os.environ.get("LO_INGEST_CHUNK", "65536")))
     ingest_queue_depth: int = 8
 
-    # Function / '#' DSL sandboxing: 'restricted' (namespace jail) or
-    # 'trusted' (plain exec, reference-equivalent behavior,
-    # code_execution.py:169-196).
+    # Function / '#' DSL sandboxing: 'subprocess' (separate process +
+    # rlimits + fs/exec/socket audit guard — a real jail),
+    # 'restricted' (in-process namespace jail), or 'trusted' (plain
+    # exec, reference-equivalent behavior, code_execution.py:169-196).
     sandbox_mode: str = dataclasses.field(
-        default_factory=lambda: os.environ.get("LO_SANDBOX", "restricted"))
+        default_factory=lambda: os.environ.get("LO_SANDBOX", "subprocess"))
+    # subprocess-jail resource limits
+    sandbox_cpu_seconds: int = dataclasses.field(
+        default_factory=lambda: int(os.environ.get(
+            "LO_SANDBOX_CPU_SECONDS", "600")))
+    sandbox_memory_bytes: int = dataclasses.field(
+        default_factory=lambda: int(os.environ.get(
+            "LO_SANDBOX_MEMORY_BYTES", str(8 << 30))))
+    sandbox_file_bytes: int = dataclasses.field(
+        default_factory=lambda: int(os.environ.get(
+            "LO_SANDBOX_FILE_BYTES", str(1 << 30))))
 
     # Observability.
     log_level: str = dataclasses.field(

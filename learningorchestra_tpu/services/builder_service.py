@@ -137,7 +137,7 @@ class BuilderService:
         testing_df = self._ctx.catalog.read_dataframe(test_name)
         ctx_vars, _ = sandbox.run_user_code(
             code, {"training_df": training_df, "testing_df": testing_df},
-            trusted=self._ctx.config.sandbox_mode == "trusted")
+            mode=self._ctx.config.sandbox_mode)
         try:
             features_training = ctx_vars["features_training"]
             features_testing = ctx_vars["features_testing"]

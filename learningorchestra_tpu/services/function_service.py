@@ -101,8 +101,7 @@ class FunctionService:
             code = fetch_function_code(function)
             treated = self._ctx.params.treat(parameters)
             ctx_vars, stdout = sandbox.run_user_code(
-                code, treated,
-                trusted=self._ctx.config.sandbox_mode == "trusted")
+                code, treated, mode=self._ctx.config.sandbox_mode)
             if RESPONSE_VARIABLE not in ctx_vars:
                 raise ValueError(
                     f"function must assign a {RESPONSE_VARIABLE!r} variable")

@@ -50,13 +50,35 @@ class ParameterResolver:
               ) -> Dict[str, Any]:
         if not method_parameters:
             return {}
+        # batch every '#' expression into ONE sandbox pass (a spawn per
+        # expression would dominate request latency in subprocess
+        # mode); iteration order below matches the collection order
+        exprs = []
+        for value in method_parameters.values():
+            if isinstance(value, list):
+                exprs.extend(v for v in value if self._is_hash(v))
+            elif self._is_hash(value):
+                exprs.append(value)
+        results = iter(sandbox.eval_hash_expressions(
+            exprs, mode=self._ctx.config.sandbox_mode)) if exprs else None
+
+        def resolve(v):
+            if self._is_hash(v):
+                return next(results)
+            return self.resolve_value(v)
+
         out = {}
         for name, value in method_parameters.items():
             if isinstance(value, list):
-                out[name] = [self.resolve_value(v) for v in value]
+                out[name] = [resolve(v) for v in value]
             else:
-                out[name] = self.resolve_value(value)
+                out[name] = resolve(value)
         return out
+
+    @staticmethod
+    def _is_hash(value: Any) -> bool:
+        # mirrors resolve_value's precedence: '$' wins over '#'
+        return isinstance(value, str) and "$" not in value and "#" in value
 
     def resolve_value(self, value: Any) -> Any:
         if not isinstance(value, str):
@@ -68,8 +90,8 @@ class ParameterResolver:
                 return self.load_object(artifact_name)[key]
             return self.load_artifact(ref)
         if "#" in value:
-            trusted = self._ctx.config.sandbox_mode == "trusted"
-            return sandbox.eval_hash_expression(value, trusted=trusted)
+            return sandbox.eval_hash_expression(
+                value, mode=self._ctx.config.sandbox_mode)
         return value
 
     # -- artifact loading ----------------------------------------------

@@ -3,19 +3,31 @@
 The reference runs user code with bare ``exec`` in-process in three
 places: the ``#`` parameter DSL (binary_execution.py:52-64), the
 Function service (code_execution.py:169-196), and Builder modeling
-code (builder.py:84-105). Capability is preserved here but behind a
-namespace jail (SURVEY §7 hard part #3):
+code (builder.py:84-105). Capability is preserved here behind a real
+jail (SURVEY §7 hard part #3), with three trust levels
+(``Config.sandbox_mode``):
 
-- builtins restricted to a safe subset (no open/eval/exec/__import__);
-- ``import`` routed through a whitelist of scientific modules;
-- ``import tensorflow`` resolves to the framework's JAX-backed
-  ``tensorflow`` compatibility shim
-  (:mod:`learningorchestra_tpu.models.tf_compat`) — real TF is not a
-  dependency, and user code written against the reference's executor
-  keeps working on TPU unchanged.
+- ``"subprocess"`` (default) — user code runs in a SEPARATE PROCESS:
+  rlimits (cpu / address space / file size), cwd pinned to a scratch
+  dir, a process-wide ``sys.addaudithook`` that denies filesystem
+  access outside {scratch, interpreter/site-packages} and all
+  process-spawn / socket operations, plus the namespace jail below.
+  Results come back over a typed encoding (primitives, ndarrays as
+  dtype+shape+bytes, DataFrames as Arrow IPC) — the parent NEVER
+  unpickles an attacker-controllable object graph, so a compromised
+  child cannot gadget its way back into the server process.
+- ``"restricted"`` — the in-process namespace jail only: builtins
+  restricted to a safe subset (no open/eval/exec/__import__),
+  ``import`` routed through a whitelist of scientific modules. Faster
+  (no spawn), but dunder traversal can escape it — use for
+  semi-trusted code.
+- ``"trusted"`` — plain exec (reference-equivalent trust model).
 
-``Config.sandbox_mode = "trusted"`` switches to plain exec
-(reference-equivalent trust model) for operators who want it.
+In every mode ``import tensorflow`` resolves to the framework's
+JAX-backed ``tensorflow`` compatibility shim
+(:mod:`learningorchestra_tpu.models.tf_compat`) — real TF is not a
+dependency, and user code written against the reference's executor
+keeps working on TPU unchanged.
 """
 
 from __future__ import annotations
@@ -23,9 +35,11 @@ from __future__ import annotations
 import builtins as _builtins
 import importlib
 import io
+import os
+import pickle
 import sys
 from contextlib import redirect_stdout
-from typing import Any, Dict, Optional, Tuple
+from typing import Any, Dict, List, Optional, Tuple
 
 _ALLOWED_MODULE_PREFIXES = (
     "numpy", "pandas", "sklearn", "scipy", "math", "random", "json", "re",
@@ -106,19 +120,35 @@ def make_sandbox_globals(extra: Optional[Dict[str, Any]] = None,
     return g
 
 
+def _resolve_mode(trusted: bool, mode: Optional[str]) -> str:
+    if trusted:
+        return "trusted"
+    if mode is not None:
+        return mode
+    from learningorchestra_tpu.config import get_config
+
+    return get_config().sandbox_mode
+
+
 def run_user_code(code: str,
                   parameters: Optional[Dict[str, Any]] = None,
                   trusted: bool = False,
                   inject_tensorflow: bool = True,
+                  mode: Optional[str] = None,
                   ) -> Tuple[Dict[str, Any], str]:
     """Execute user code with injected parameter globals, capturing
     stdout (the Function-service contract: result left in a
     ``response`` variable, prints captured as ``functionMessage``;
     reference code_execution.py:169-196).
 
-    Returns (context_variables, captured_stdout).
+    ``mode`` is one of ``subprocess`` / ``restricted`` / ``trusted``
+    (default: ``Config.sandbox_mode``; ``trusted=True`` forces
+    trusted). Returns (context_variables, captured_stdout).
     """
-    g = make_sandbox_globals(parameters, trusted=trusted)
+    resolved = _resolve_mode(trusted, mode)
+    if resolved == "subprocess":
+        return _run_in_subprocess(code, parameters, inject_tensorflow)
+    g = make_sandbox_globals(parameters, trusted=resolved == "trusted")
     if inject_tensorflow and "tensorflow" not in g:
         g["tensorflow"] = resolve_module("tensorflow")
     stdout = io.StringIO()
@@ -127,11 +157,380 @@ def run_user_code(code: str,
     return g, stdout.getvalue()
 
 
-def eval_hash_expression(class_code: str, trusted: bool = False) -> Any:
+def eval_hash_expressions(exprs: List[str], trusted: bool = False,
+                          mode: Optional[str] = None) -> List[Any]:
+    """Evaluate many ``#`` expressions in ONE sandbox pass — in
+    subprocess mode this is one child interpreter for the whole
+    request instead of a ~1.5 s spawn+import per expression. Each
+    expression binds its own variable, so results stay distinct
+    objects even for textually identical expressions."""
+    if not exprs:
+        return []
+    lines = [e.replace("#", f"__lo_hash_{i} = ", 1)
+             for i, e in enumerate(exprs)]
+    g, _ = run_user_code("\n".join(lines), trusted=trusted, mode=mode)
+    return [g[f"__lo_hash_{i}"] for i in range(len(exprs))]
+
+
+def eval_hash_expression(class_code: str, trusted: bool = False,
+                         mode: Optional[str] = None) -> Any:
     """The ``#`` DSL: ``"#<expr>"`` binds ``<expr>`` to a variable and
     returns it, with ``tensorflow`` importable (reference
     binary_execution.py:52-64 rewrites ``#`` to ``class_instance=``).
     """
-    rewritten = class_code.replace("#", "class_instance=", 1)
-    g, _ = run_user_code(rewritten, trusted=trusted)
-    return g["class_instance"]
+    return eval_hash_expressions([class_code], trusted=trusted,
+                                 mode=mode)[0]
+
+
+# ======================================================================
+# subprocess jail
+# ======================================================================
+# Child -> parent values cross as a TYPED encoding, not free pickle:
+# primitives pass through, ndarrays become (tag, dtype, shape, bytes),
+# DataFrames become Arrow IPC bytes. The envelope pickle therefore
+# contains only containers of primitives/bytes — except ``#``-DSL spec
+# objects (tf_compat layer/optimizer/loss specs), which pickle by class
+# reference gated through _RestrictedUnpickler: only CLASSES under
+# learningorchestra_tpu.models.tf_compat resolve, so a malicious child
+# that overwrites the result file cannot reach a dangerous callable in
+# the parent (classic pickle-gadget escape).
+
+_ND_TAG = "__lo_nd.v1__"
+_DF_TAG = "__lo_df.v1__"
+_SERIES_TAG = "__lo_series.v1__"
+_PICKLE_TAG = "__lo_obj.v1__"
+_TUPLE_TAG = "__lo_tuple.v1__"
+
+_PICKLE_CLASS_PREFIX = "learningorchestra_tpu.models.tf_compat"
+
+
+class _Unencodable(Exception):
+    pass
+
+
+def _encode_value(v: Any, depth: int = 0) -> Any:
+    import numpy as np
+
+    if depth > 32:
+        raise _Unencodable("nesting too deep")
+    if v is None or isinstance(v, (bool, int, float, str, bytes)):
+        return v
+    if isinstance(v, np.generic):
+        return [_ND_TAG, v.dtype.str, [], v.tobytes()]
+    if isinstance(v, np.ndarray):
+        if v.dtype == object:
+            raise _Unencodable("object-dtype array")
+        c = np.ascontiguousarray(v)
+        return [_ND_TAG, c.dtype.str, list(c.shape), c.tobytes()]
+    if isinstance(v, tuple):
+        return [_TUPLE_TAG, [_encode_value(x, depth + 1) for x in v]]
+    if isinstance(v, list):
+        return [_encode_value(x, depth + 1) for x in v]
+    if isinstance(v, dict):
+        out = {}
+        for k, val in v.items():
+            if not isinstance(k, (str, int, float, bool)):
+                raise _Unencodable(f"non-primitive dict key {k!r}")
+            out[k] = _encode_value(val, depth + 1)
+        return out
+    mod = type(v).__module__ or ""
+    if mod.split(".")[0] == "pandas" and \
+            type(v).__name__ in ("DataFrame", "Series"):
+        import pyarrow as pa
+
+        is_series = type(v).__name__ == "Series"
+        obj = v.to_frame("__series__") if is_series else v
+        table = pa.Table.from_pandas(obj, preserve_index=True)
+        sink = pa.BufferOutputStream()
+        import pyarrow.ipc as ipc
+
+        with ipc.new_stream(sink, table.schema) as w:
+            w.write_table(table)
+        tag = _SERIES_TAG if is_series else _DF_TAG
+        return [tag, sink.getvalue().to_pybytes()]
+    if mod.startswith(_PICKLE_CLASS_PREFIX):
+        return [_PICKLE_TAG, pickle.dumps(v)]
+    raise _Unencodable(f"type {type(v).__name__} does not cross the "
+                       "sandbox boundary")
+
+
+def _decode_value(v: Any) -> Any:
+    import numpy as np
+
+    if isinstance(v, list) and v and v[0] == _ND_TAG:
+        _, dtype, shape, buf = v
+        arr = np.frombuffer(buf, dtype=np.dtype(dtype)).reshape(shape)
+        return arr[()] if shape == [] else arr.copy()
+    if isinstance(v, list) and v and v[0] == _TUPLE_TAG:
+        return tuple(_decode_value(x) for x in v[1])
+    if isinstance(v, list) and v and v[0] in (_DF_TAG, _SERIES_TAG):
+        import pyarrow.ipc as ipc
+
+        df = ipc.open_stream(v[1]).read_all().to_pandas()
+        return df["__series__"] if v[0] == _SERIES_TAG else df
+    if isinstance(v, list) and v and v[0] == _PICKLE_TAG:
+        return _RestrictedUnpickler(io.BytesIO(v[1])).load()
+    if isinstance(v, list):
+        return [_decode_value(x) for x in v]
+    if isinstance(v, dict):
+        return {k: _decode_value(val) for k, val in v.items()}
+    return v
+
+
+class _RestrictedUnpickler(pickle.Unpickler):
+    """find_class limited to CLASSES under the tf_compat shim — the
+    only live objects the ``#`` DSL needs to hand back (optimizer /
+    layer / loss specs)."""
+
+    def find_class(self, module: str, name: str):
+        import inspect
+
+        if module == "builtins" and name in ("dict", "list", "tuple",
+                                             "set", "frozenset"):
+            return getattr(_builtins, name)
+        if module.startswith(_PICKLE_CLASS_PREFIX):
+            obj = getattr(importlib.import_module(module), name)
+            if inspect.isclass(obj):
+                return obj
+        raise pickle.UnpicklingError(
+            f"sandbox result may not reference {module}.{name}")
+
+
+def _safe_load_envelope(raw: bytes) -> Dict[str, Any]:
+    """Unpickle the child's result envelope. The envelope itself is
+    containers/primitives/bytes only, so find_class should never fire
+    outside the tf_compat allowlist — _RestrictedUnpickler enforces
+    that against a child that wrote arbitrary bytes."""
+    return _RestrictedUnpickler(io.BytesIO(raw)).load()
+
+
+_RESULT_FILE = "__lo_result__.pkl"
+
+# Bootstrap for the child interpreter: read the payload BEFORE any
+# framework import so sys.path can be replicated first.
+_CHILD_BOOT = (
+    "import pickle,sys\n"
+    "p = pickle.load(sys.stdin.buffer)\n"
+    "sys.path[:0] = [q for q in p['sys_path'] if q not in sys.path]\n"
+    "from learningorchestra_tpu.services import sandbox\n"
+    "sandbox._child_main(p)\n"
+)
+
+
+def _run_in_subprocess(code: str, parameters: Optional[Dict[str, Any]],
+                       inject_tensorflow: bool,
+                       ) -> Tuple[Dict[str, Any], str]:
+    import shutil
+    import subprocess
+    import tempfile
+
+    from learningorchestra_tpu.config import get_config
+
+    cfg = get_config()
+    scratch = tempfile.mkdtemp(prefix="lo_sbx_")
+    try:
+        enc_params = {}
+        dropped_in: List[str] = []
+        for k, v in (parameters or {}).items():
+            try:
+                enc_params[k] = _encode_value(v)
+            except _Unencodable:
+                dropped_in.append(k)
+        if dropped_in:
+            raise TypeError(
+                f"parameters {dropped_in} cannot cross into sandboxed "
+                "code (use sandbox_mode=restricted/trusted for live-"
+                "object parameters)")
+        payload = {
+            "code": code,
+            "parameters": enc_params,
+            "inject_tensorflow": inject_tensorflow,
+            "scratch": scratch,
+            # '' means "the parent's cwd" — resolve it, don't drop it
+            # (the framework may only be importable via that entry)
+            "sys_path": [p or os.getcwd() for p in sys.path],
+            "limits": {
+                "cpu": cfg.sandbox_cpu_seconds,
+                "mem": cfg.sandbox_memory_bytes,
+                "fsize": cfg.sandbox_file_bytes,
+            },
+        }
+        env = {
+            "PATH": os.environ.get("PATH", "/usr/bin:/bin"),
+            "HOME": scratch,
+            "TMPDIR": scratch,
+            "PYTHONPATH": os.pathsep.join(payload["sys_path"]),
+            # user code importing jax must not grab the parent's TPU
+            "JAX_PLATFORMS": "cpu",
+            "LANG": os.environ.get("LANG", "C.UTF-8"),
+        }
+        wall = max(30.0, cfg.sandbox_cpu_seconds * 2.0)
+        try:
+            proc = subprocess.run(
+                [sys.executable, "-c", _CHILD_BOOT],
+                input=pickle.dumps(payload), env=env, cwd=scratch,
+                capture_output=True, timeout=wall)
+        except subprocess.TimeoutExpired as e:
+            raise TimeoutError(
+                f"sandboxed code exceeded {wall:.0f}s wall clock") from e
+        result_path = os.path.join(scratch, _RESULT_FILE)
+        if not os.path.exists(result_path):
+            detail = (proc.stderr or b"")[-2000:].decode(errors="replace")
+            raise RuntimeError(
+                f"sandboxed code died (exit {proc.returncode}): {detail}")
+        with open(result_path, "rb") as f:
+            envelope = _safe_load_envelope(f.read())
+        if "error" in envelope:
+            err = envelope["error"]
+            exc_cls = getattr(_builtins, str(err.get("type")), None)
+            if not (isinstance(exc_cls, type)
+                    and issubclass(exc_cls, BaseException)):
+                exc_cls = RuntimeError
+            raise exc_cls(
+                f"{err.get('message')}\n[sandbox traceback]\n"
+                f"{err.get('traceback', '')}")
+        ctx_vars = {k: _decode_value(v)
+                    for k, v in envelope.get("vars", {}).items()}
+        return ctx_vars, envelope.get("stdout", "")
+    finally:
+        shutil.rmtree(scratch, ignore_errors=True)
+
+
+# -- child side --------------------------------------------------------
+_GUARD_DENIED_EVENTS = frozenset({
+    "os.system", "os.exec", "os.posix_spawn", "os.spawn", "os.fork",
+    "os.forkpty", "subprocess.Popen", "pty.spawn", "socket.__new__",
+    "socket.bind", "socket.connect", "socket.getaddrinfo",
+    "socket.gethostbyname", "os.kill", "os.killpg", "signal.pthread_kill",
+    "resource.setrlimit", "webbrowser.open",
+    # ctypes is a full jail bypass (CDLL(None).system(...) — raw libc
+    # calls fire no audit events), so FFI is denied wholesale
+    "ctypes.dlopen", "ctypes.dlsym", "ctypes.call_function",
+    "ctypes.cdata", "ctypes.cdata_buffer", "ctypes.addressof",
+    "ctypes.string_at", "ctypes.wstring_at",
+})
+
+_GUARD_WRITE_EVENTS = frozenset({
+    "os.remove", "os.rename", "os.rmdir", "os.mkdir", "os.chmod",
+    "os.chown", "os.link", "os.symlink", "os.truncate", "shutil.rmtree",
+    "shutil.move", "os.utime",
+})
+
+_GUARD_READ_EVENTS = frozenset({"os.listdir", "os.scandir", "glob.glob"})
+
+# /proc entries with no cross-process secrets (hardware/self info only)
+_PROC_ALLOWED = ("/proc/cpuinfo", "/proc/stat", "/proc/meminfo",
+                 "/proc/sys/vm", "/proc/filesystems", "/proc/version")
+
+
+def _install_guard(scratch: str, read_prefixes: Tuple[str, ...]) -> None:
+    scratch = os.path.realpath(scratch)
+    reads = tuple(os.path.realpath(p) for p in read_prefixes)
+    # check_path realpaths user paths, which resolves the /proc/self
+    # symlink to /proc/<pid> — allow the resolved form
+    proc_allowed = _PROC_ALLOWED + (os.path.realpath("/proc/self"),)
+
+    def under(path: str, prefix: str) -> bool:
+        return path == prefix or path.startswith(prefix + os.sep)
+
+    def check_path(raw, writing: bool) -> None:
+        if raw is None or isinstance(raw, int):
+            return
+        try:
+            p = os.path.realpath(os.fspath(raw))
+        except (TypeError, ValueError):
+            raise PermissionError(f"sandbox: bad path {raw!r}")
+        if under(p, scratch):
+            return
+        if not writing:
+            if any(under(p, r) for r in reads):
+                return
+            if any(under(p, a) for a in proc_allowed):
+                return
+        raise PermissionError(
+            f"sandbox: {'write' if writing else 'read'} access to "
+            f"{p!r} denied")
+
+    def hook(event: str, args) -> None:
+        if event == "open":
+            path, mode, flags = (list(args) + [None, None])[:3]
+            if mode is None:
+                writing = bool((flags or 0) & (os.O_WRONLY | os.O_RDWR
+                                               | os.O_CREAT))
+            else:
+                writing = any(c in str(mode) for c in "wax+")
+            check_path(path, writing)
+        elif event in _GUARD_DENIED_EVENTS or \
+                event.startswith(("socket.", "ftplib.", "smtplib.",
+                                  "urllib.", "http.")):
+            raise PermissionError(f"sandbox: {event} denied")
+        elif event in _GUARD_WRITE_EVENTS:
+            check_path(args[0] if args else None, True)
+        elif event in _GUARD_READ_EVENTS:
+            check_path(args[0] if args else None, False)
+
+    sys.addaudithook(hook)
+
+
+def _child_main(payload: Dict[str, Any]) -> None:  # pragma: no cover
+    """Entry point inside the jailed interpreter (see _CHILD_BOOT)."""
+    import resource
+    import traceback
+
+    scratch = payload["scratch"]
+    limits = payload["limits"]
+    result_path = os.path.join(scratch, _RESULT_FILE)
+
+    def write_result(obj: Dict[str, Any]) -> None:
+        tmp = result_path + ".tmp"
+        with open(tmp, "wb") as f:
+            pickle.dump(obj, f)
+        os.replace(tmp, result_path)
+
+    try:
+        resource.setrlimit(resource.RLIMIT_CPU,
+                           (limits["cpu"], limits["cpu"]))
+        resource.setrlimit(resource.RLIMIT_AS,
+                           (limits["mem"], limits["mem"]))
+        resource.setrlimit(resource.RLIMIT_FSIZE,
+                           (limits["fsize"], limits["fsize"]))
+        resource.setrlimit(resource.RLIMIT_CORE, (0, 0))
+        os.chdir(scratch)
+        # reads allowed under the interpreter tree + every sys.path
+        # root (imports), plus shared system data (zoneinfo etc.)
+        read_prefixes = tuple(dict.fromkeys(
+            [sys.prefix, sys.exec_prefix, "/usr", "/lib", "/lib64",
+             "/opt"] + [p for p in sys.path if p]))
+        _install_guard(scratch, read_prefixes)
+
+        parameters = {k: _decode_value(v)
+                      for k, v in payload["parameters"].items()}
+        g = make_sandbox_globals(parameters, trusted=False)
+        if payload.get("inject_tensorflow") and "tensorflow" not in g:
+            g["tensorflow"] = resolve_module("tensorflow")
+        stdout = io.StringIO()
+        with redirect_stdout(stdout):
+            exec(compile(payload["code"], "<lo-user-code>", "exec"), g)  # noqa: S102,E501
+
+        out_vars: Dict[str, Any] = {}
+        dropped: List[str] = []
+        for k, v in g.items():
+            if k in ("__builtins__", "__name__", "tensorflow") or \
+                    k in parameters:
+                continue
+            if type(v).__name__ == "module" or callable(v):
+                continue
+            try:
+                out_vars[k] = _encode_value(v)
+            except Exception:  # noqa: BLE001 — best-effort var export
+                dropped.append(k)
+        write_result({"vars": out_vars, "stdout": stdout.getvalue(),
+                      "dropped": dropped})
+    except BaseException as e:  # noqa: BLE001 — report, then exit
+        try:
+            write_result({"error": {
+                "type": type(e).__name__, "message": str(e),
+                "traceback": traceback.format_exc(limit=20)}})
+        except Exception:  # noqa: BLE001
+            os._exit(13)
+    os._exit(0)

@@ -1,0 +1,177 @@
+"""Subprocess-jail tests: user code must not reach the host.
+
+The reference runs Function / Builder / ``#`` code with bare ``exec``
+in-process (code_execution.py:169-196, builder.py:84-105,
+binary_execution.py:52-64). Our default ``sandbox_mode="subprocess"``
+is a real jail: separate process, rlimits, cwd pinned to a scratch
+dir, and an audit hook denying fs access outside
+{scratch, interpreter tree}, process spawning, and sockets. These
+tests drive the escape attempts the in-process namespace jail could
+not stop (SURVEY §7 hard part #3).
+"""
+
+import numpy as np
+import pytest
+
+from learningorchestra_tpu.services import sandbox
+
+
+def test_jail_normal_code_and_stdout(tmp_config):
+    g, out = sandbox.run_user_code(
+        "import numpy as np\n"
+        "print('computed')\n"
+        "response = {'x': np.arange(6, dtype='float32').reshape(2, 3)}\n",
+        mode="subprocess")
+    assert g["response"]["x"].shape == (2, 3)
+    assert g["response"]["x"].dtype == np.float32
+    assert "computed" in out
+
+
+def test_jail_dataframe_params_cross_boundary(tmp_config):
+    import pandas as pd
+
+    df = pd.DataFrame({"a": [1, 2, 3], "b": ["x", "y", "z"]})
+    g, _ = sandbox.run_user_code(
+        "response = {'vals': frame['a'].to_numpy() * 2,"
+        " 'frame': frame[frame['a'] > 1]}",
+        {"frame": df}, mode="subprocess")
+    assert list(g["response"]["vals"]) == [2, 4, 6]
+    assert list(g["response"]["frame"]["b"]) == ["y", "z"]
+
+
+def test_jail_blocks_passwd_read_via_pandas(tmp_config):
+    with pytest.raises(PermissionError, match="denied"):
+        sandbox.run_user_code(
+            "import pandas as pd\n"
+            "response = pd.read_csv('/etc/passwd')\n",
+            mode="subprocess")
+
+
+def test_jail_blocks_passwd_read_via_numpy(tmp_config):
+    with pytest.raises(PermissionError, match="denied"):
+        sandbox.run_user_code(
+            "import numpy as np\n"
+            "response = np.loadtxt('/etc/passwd', dtype=str)\n",
+            mode="subprocess")
+
+
+def test_jail_blocks_dunder_escape_to_os_system(tmp_config, tmp_path):
+    """The classic namespace-jail escape — object-graph traversal to a
+    loader, then os.system — dies on the audit hook instead."""
+    marker = tmp_path / "pwned"
+    code = (
+        "cls = [c for c in ().__class__.__base__.__subclasses__()"
+        " if c.__name__ == 'BuiltinImporter'][0]\n"
+        "os = cls().load_module('os')\n"
+        f"response = os.system('touch {marker}')\n")
+    with pytest.raises(PermissionError, match="os.system"):
+        sandbox.run_user_code(code, mode="subprocess")
+    assert not marker.exists()
+
+
+def test_jail_blocks_ctypes_ffi_escape(tmp_config, tmp_path):
+    """ctypes is a total audit-hook bypass (raw libc calls fire no
+    events) — the dlopen/call_function events themselves are denied."""
+    marker = tmp_path / "escape_ctypes"
+    code = (
+        "cls = [c for c in ().__class__.__base__.__subclasses__()"
+        " if c.__name__ == 'BuiltinImporter'][0]\n"
+        "ct = cls().load_module('ctypes')\n"
+        "libc = ct.CDLL(None)\n"
+        f"response = libc.system(b'touch {marker}')\n")
+    with pytest.raises(PermissionError, match="ctypes"):
+        sandbox.run_user_code(code, mode="subprocess")
+    assert not marker.exists()
+
+
+def test_jail_batched_hash_exprs_are_distinct_objects(tmp_config):
+    """One child evaluates the whole batch; textually identical
+    expressions still produce distinct spec objects (no aliasing)."""
+    a, b = sandbox.eval_hash_expressions(
+        ["#tensorflow.keras.optimizers.Adam(0.01)",
+         "#tensorflow.keras.optimizers.Adam(0.01)"], mode="subprocess")
+    assert a is not b
+    assert a.spec == b.spec
+
+
+def test_jail_blocks_write_outside_scratch(tmp_config, tmp_path):
+    target = tmp_path / "leak.npy"
+    code = (
+        "cls = [c for c in ().__class__.__base__.__subclasses__()"
+        " if c.__name__ == 'BuiltinImporter'][0]\n"
+        "io_mod = cls().load_module('io')\n"
+        f"f = io_mod.open('{target}', 'w')\n"
+        "f.write('x')\n"
+        "response = 1\n")
+    with pytest.raises(PermissionError, match="denied"):
+        sandbox.run_user_code(code, mode="subprocess")
+    assert not target.exists()
+
+
+def test_jail_import_allowlist_still_applies(tmp_config):
+    with pytest.raises(ImportError):
+        sandbox.run_user_code("import os\nresponse = 1",
+                              mode="subprocess")
+    with pytest.raises(ImportError):
+        sandbox.run_user_code("import subprocess\nresponse = 1",
+                              mode="subprocess")
+
+
+def test_jail_hash_dsl_returns_spec_objects(tmp_config):
+    opt = sandbox.eval_hash_expression(
+        "#tensorflow.keras.optimizers.Adam(0.01)", mode="subprocess")
+    assert type(opt).__name__ == "Adam"
+    assert opt.spec["learning_rate"] == 0.01
+
+
+def test_jail_runtime_errors_propagate_with_type(tmp_config):
+    with pytest.raises(ValueError, match="boom"):
+        sandbox.run_user_code("raise ValueError('boom')",
+                              mode="subprocess")
+
+
+def test_restricted_unpickler_blocks_gadgets(tmp_config):
+    """A compromised child can write arbitrary bytes to the result
+    file; the parent-side unpickler must refuse to resolve anything
+    outside the tf_compat class allowlist (no pickle-gadget escapes
+    back into the server process)."""
+    import pickle
+
+    class Evil:
+        def __reduce__(self):
+            return (print, ("gadget-fired",))
+
+    raw = pickle.dumps({"vars": {"response": Evil()}, "stdout": ""})
+    with pytest.raises(pickle.UnpicklingError, match="may not reference"):
+        sandbox._safe_load_envelope(raw)
+
+    # referencing module-level CALLABLES inside the framework is also
+    # refused — only tf_compat classes resolve
+    raw2 = pickle.dumps(sandbox.run_user_code)
+    with pytest.raises(pickle.UnpicklingError):
+        sandbox._safe_load_envelope(raw2)
+
+
+def test_jail_function_service_end_to_end(tmp_config):
+    """FunctionService under the default (subprocess) mode: jobs fail
+    closed on escape attempts and succeed on real work."""
+    from learningorchestra_tpu.services.context import ServiceContext
+    from learningorchestra_tpu.services.function_service import (
+        FunctionService)
+
+    ctx = ServiceContext(tmp_config)
+    try:
+        assert ctx.config.sandbox_mode == "subprocess"
+        fs = FunctionService(ctx)
+        fs.create({"name": "evil_read",
+                   "function": "import pandas as pd\n"
+                               "response = pd.read_csv('/etc/passwd')",
+                   "functionParameters": {}})
+        ctx.jobs.wait("evil_read", timeout=120)
+        meta = ctx.catalog.get_metadata("evil_read")
+        assert meta["finished"] is False
+        docs = ctx.catalog.get_documents("evil_read")
+        assert any("PermissionError" in (d.get("exception") or "")
+                   for d in docs)
+    finally:
+        ctx.close()
