@@ -1,21 +1,29 @@
-"""Headline benchmark: MNIST-CNN training throughput through the REST
-control plane (BASELINE.json metric: samples/sec/chip via /train).
+"""Headline benchmarks through the REST control plane.
 
-Drives the real pipeline — Function (synthetic MNIST, zero-egress) →
-Model → Train → Evaluate — through the transport-independent Api
-dispatcher, then reports the steady-state training throughput of the
-jitted, mesh-sharded engine on whatever accelerator `jax.devices()`
-offers (one TPU chip under the driver; CPU locally).
+Drives the real pipeline — Function (synthetic data, zero-egress) →
+Model → Train (→ Evaluate) — through the transport-independent Api
+dispatcher for THREE model families, and reports the steady-state
+training throughput plus the engine's roofline numbers
+(tflops/sec/chip and MFU against the chip's bf16 peak) on whatever
+accelerator ``jax.devices()`` offers (one TPU chip under the driver;
+CPU locally, where MFU is undefined and omitted):
 
-``vs_baseline`` is measured live against the reference's execution
-model: the reference trains in-process on the service host's CPU with
-no accelerator (SURVEY §3.3 — ``getattr(instance, "fit")`` running
-TF/sklearn single-node; its 3-VM deployment has no GPU/TPU,
-README.md:63). We time the same CNN/batch-size in torch-CPU as that
-proxy and report ours / reference-proxy.
+1. MNIST-CNN   — the BASELINE.json metric (samples/sec/chip via
+                 /train); ``vs_baseline`` is measured live against the
+                 reference's execution model (in-process CPU training,
+                 SURVEY §3.3) via a torch-CPU twin of the same layers.
+2. IMDb-LSTM   — BASELINE.md config 3 shape: embedding → LSTM →
+                 dense over (n, 200) token sequences.
+3. TransformerLM — the north-star MFU workload: decoder-only LM with
+                 the Pallas flash-attention kernel on TPU (the path
+                 ``attention="auto"`` picks), trained on synthetic
+                 token streams.
 
 Prints ONE JSON line:
-  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N, "extra": {...}}
+
+The full self-measured table (per BASELINE.md:33-35) lives in
+``extra.models``; BENCHMARKS.md holds the committed copy.
 """
 
 import json
@@ -30,7 +38,24 @@ N_SAMPLES = 16384
 IMG = 28
 CLASSES = 10
 
+# IMDb-LSTM shape (BASELINE config 3): 200-token reviews, binary label
+LSTM_VOCAB = 20000
+LSTM_SEQ = 200
+LSTM_N = 8192
+LSTM_BATCH = 128
+LSTM_EPOCHS = 3
+
+# TransformerLM (north-star MFU workload)
+TLM_VOCAB = 32000
+TLM_SEQ = 512
+TLM_N = 2048
+TLM_BATCH = 16
+TLM_EPOCHS = 3
+TLM_CFG = {"vocab_size": TLM_VOCAB, "d_model": 512, "n_layers": 8,
+           "n_heads": 8, "d_ff": 2048, "max_len": TLM_SEQ}
+
 from __graft_entry__ import FLAGSHIP_CNN_LAYERS as CNN_LAYERS  # noqa: E402
+
 
 def synth_code() -> str:
     return f"""
@@ -43,6 +68,33 @@ x = rng.normal(0.0, 0.35, size=(n, img * img)).astype(np.float32)
 for c in range(classes):
     x[y == c, c * 64:(c + 1) * 64] += 1.0
 response = {{"x": x, "y": y}}
+"""
+
+
+def lstm_synth_code() -> str:
+    return f"""
+import numpy as np
+rng = np.random.default_rng(1)
+n, seq, vocab = {LSTM_N}, {LSTM_SEQ}, {LSTM_VOCAB}
+x = rng.integers(0, vocab, size=(n, seq)).astype(np.int32)
+# sentiment proxy: label from the low-token density in the first half
+# (learnable by an RNN, not linearly from any single position)
+y = (np.mean(x[:, :seq // 2] < vocab // 4, axis=1) > 0.25).astype(np.int32)
+response = {{"x": x, "y": y}}
+"""
+
+
+def tlm_synth_code() -> str:
+    return f"""
+import numpy as np
+rng = np.random.default_rng(2)
+n, seq, vocab = {TLM_N}, {TLM_SEQ}, {TLM_VOCAB}
+# learnable stream: affine next-token map with random per-sequence
+# offsets (next-token accuracy can rise above chance; sanity signal)
+start = rng.integers(0, vocab, size=(n, 1))
+steps = np.arange(seq, dtype=np.int64)[None, :]
+x = ((start + 97 * steps) % vocab).astype(np.int32)
+response = {{"x": x}}
 """
 
 
@@ -66,7 +118,69 @@ def _wait(api, uri, timeout=1800.0):
     raise TimeoutError(f"job never finished: {uri}")
 
 
+def _steady_stats(history, n_chips):
+    """Best steady-state epoch (epoch 0 pays jit compilation) →
+    per-chip samples/s + the engine's roofline numbers."""
+    steady = [h for h in history[1:]] or history
+    best = max(steady, key=lambda h: h.get("samplesPerSecond", 0.0))
+    out = {
+        "samples_per_sec_per_chip": round(
+            best.get("samplesPerSecond", 0.0) / n_chips, 2),
+        "epoch_seconds": best.get("epochSeconds"),
+    }
+    if best.get("tflopsPerSecPerChip") is not None:
+        out["tflops_per_sec_per_chip"] = best["tflopsPerSecPerChip"]
+    if best.get("mfu") is not None:
+        out["mfu"] = best["mfu"]
+    if "loss" in best:
+        out["final_loss"] = round(float(best["loss"]), 4)
+    if "accuracy" in best:
+        out["final_train_accuracy"] = round(float(best["accuracy"]), 4)
+    return out
+
+
+def _run_pipeline(api, prefix, tag, fn_code, module_path, class_name,
+                  class_params, train_params, evaluate=False):
+    """Function → Model → Train (→ Evaluate) under unique names; returns
+    (train_history, eval_metrics_or_None)."""
+    status, body, _ = api.dispatch("POST", f"{prefix}/function/python", {}, {
+        "name": f"{tag}_data", "function": fn_code,
+        "functionParameters": {}, "description": f"synthetic {tag} data"})
+    _expect_created(status, body)
+    _wait(api, body["result"])
+
+    status, body, _ = api.dispatch("POST", f"{prefix}/model/tensorflow", {}, {
+        "modelName": f"{tag}_model", "modulePath": module_path,
+        "class": class_name, "classParameters": class_params,
+        "description": f"bench {tag}"})
+    _expect_created(status, body)
+    _wait(api, body["result"])
+
+    status, body, _ = api.dispatch("POST", f"{prefix}/train/tensorflow", {}, {
+        "name": f"{tag}_train", "modelName": f"{tag}_model", "method": "fit",
+        "methodParameters": train_params})
+    _expect_created(status, body)
+    _wait(api, body["result"])
+
+    model = api.ctx.artifacts.load(f"{tag}_train", "train/tensorflow")
+    eval_metrics = None
+    if evaluate:
+        status, body, _ = api.dispatch(
+            "POST", f"{prefix}/evaluate/tensorflow", {}, {
+                "name": f"{tag}_eval", "modelName": f"{tag}_train",
+                "method": "evaluate",
+                "methodParameters": {"x": f"${tag}_data.x",
+                                     "y": f"${tag}_data.y"}})
+        _expect_created(status, body)
+        _wait(api, body["result"])
+        eval_metrics = api.ctx.artifacts.load(
+            f"{tag}_eval", "evaluate/tensorflow")
+    return model.history, eval_metrics
+
+
 def run_tpu_path():
+    import jax
+
     from learningorchestra_tpu import config as config_mod
     from learningorchestra_tpu.services.server import Api
 
@@ -74,47 +188,49 @@ def run_tpu_path():
     config_mod.set_config(config_mod.Config(home=home))
     api = Api()
     prefix = "/api/learningOrchestra/v1"
-
-    status, body, _ = api.dispatch("POST", f"{prefix}/function/python", {}, {
-        "name": "mnist_synth", "function": synth_code(),
-        "functionParameters": {}, "description": "synthetic MNIST"})
-    _expect_created(status, body)
-    _wait(api, body["result"])
-
-    status, body, _ = api.dispatch("POST", f"{prefix}/model/tensorflow", {}, {
-        "modelName": "mnist_cnn", "modulePath": "tensorflow.keras.models",
-        "class": "Sequential", "classParameters": {"layers": CNN_LAYERS},
-        "description": "bench CNN"})
-    _expect_created(status, body)
-    _wait(api, body["result"])
-
-    status, body, _ = api.dispatch("POST", f"{prefix}/train/tensorflow", {}, {
-        "name": "mnist_cnn_t", "modelName": "mnist_cnn", "method": "fit",
-        "methodParameters": {"x": "$mnist_synth.x", "y": "$mnist_synth.y",
-                             "epochs": EPOCHS, "batch_size": BATCH}})
-    _expect_created(status, body)
-    _wait(api, body["result"])
-
-    status, body, _ = api.dispatch(
-        "POST", f"{prefix}/evaluate/tensorflow", {}, {
-            "name": "mnist_cnn_e", "modelName": "mnist_cnn_t",
-            "method": "evaluate",
-            "methodParameters": {"x": "$mnist_synth.x",
-                                 "y": "$mnist_synth.y"}})
-    _expect_created(status, body)
-    _wait(api, body["result"])
-
-    import jax
-
-    model = api.ctx.artifacts.load("mnist_cnn_t", "train/tensorflow")
-    # epoch 0 pays jit compilation; steady state is the rest. Engine
-    # throughput spans the whole default mesh — normalize to per-chip.
     n_chips = len(jax.devices())
-    steady = [h["samplesPerSecond"] / n_chips for h in model.history[1:]]
-    accuracy = api.ctx.artifacts.load(
-        "mnist_cnn_e", "evaluate/tensorflow")["accuracy"]
+    models = {}
+
+    # 1. MNIST-CNN (headline)
+    history, ev = _run_pipeline(
+        api, prefix, "cnn", synth_code(),
+        "tensorflow.keras.models", "Sequential",
+        {"layers": CNN_LAYERS},
+        {"x": "$cnn_data.x", "y": "$cnn_data.y",
+         "epochs": EPOCHS, "batch_size": BATCH},
+        evaluate=True)
+    models["mnist_cnn"] = _steady_stats(history, n_chips)
+    models["mnist_cnn"]["eval_accuracy"] = round(float(ev["accuracy"]), 4)
+
+    # 2. IMDb-LSTM (BASELINE config 3 shape)
+    history, ev = _run_pipeline(
+        api, prefix, "lstm", lstm_synth_code(),
+        "learningorchestra_tpu.models", "NeuralModel",
+        {"layer_configs": [
+            {"kind": "embedding", "vocab": LSTM_VOCAB, "dim": 128},
+            {"kind": "lstm", "units": 128},
+            {"kind": "dense", "units": 2, "activation": "softmax"}]},
+        {"x": "$lstm_data.x", "y": "$lstm_data.y",
+         "epochs": LSTM_EPOCHS, "batch_size": LSTM_BATCH},
+        evaluate=True)
+    models["imdb_lstm"] = _steady_stats(history, n_chips)
+    models["imdb_lstm"]["eval_accuracy"] = round(float(ev["accuracy"]), 4)
+
+    # 3. TransformerLM with flash attention (north-star MFU workload)
+    history, _ = _run_pipeline(
+        api, prefix, "tlm", tlm_synth_code(),
+        "learningorchestra_tpu.models", "LanguageModel",
+        TLM_CFG,
+        {"x": "$tlm_data.x", "epochs": TLM_EPOCHS,
+         "batch_size": TLM_BATCH})
+    tlm = _steady_stats(history, n_chips)
+    tlm["tokens_per_sec_per_chip"] = round(
+        tlm["samples_per_sec_per_chip"] * TLM_SEQ, 2)
+    models["transformer_lm"] = tlm
+
     api.ctx.jobs.shutdown()
-    return max(steady), accuracy
+    headline = models["mnist_cnn"]["samples_per_sec_per_chip"]
+    return headline, models
 
 
 def _torch_from_layer_configs(configs):
@@ -199,7 +315,7 @@ def run_reference_proxy(max_seconds=60.0):
 
 
 def main():
-    value, accuracy = run_tpu_path()
+    value, models = run_tpu_path()
     try:
         baseline = run_reference_proxy()
         vs = round(value / baseline, 3)
@@ -210,11 +326,22 @@ def main():
         "value": round(value, 2),
         "unit": "samples/s",
         "vs_baseline": vs,
-        "extra": {"eval_accuracy": round(float(accuracy), 4),
-                  "reference_proxy_torch_cpu_samples_per_sec":
-                      round(baseline, 2) if baseline else None,
-                  "epochs": EPOCHS, "batch_size": BATCH,
-                  "n_samples": N_SAMPLES},
+        "extra": {
+            "reference_proxy_torch_cpu_samples_per_sec":
+                round(baseline, 2) if baseline else None,
+            "models": models,
+            "configs": {
+                "mnist_cnn": {"epochs": EPOCHS, "batch_size": BATCH,
+                              "n_samples": N_SAMPLES},
+                "imdb_lstm": {"epochs": LSTM_EPOCHS,
+                              "batch_size": LSTM_BATCH,
+                              "n_samples": LSTM_N, "seq_len": LSTM_SEQ,
+                              "vocab": LSTM_VOCAB},
+                "transformer_lm": dict(TLM_CFG, epochs=TLM_EPOCHS,
+                                       batch_size=TLM_BATCH,
+                                       n_samples=TLM_N),
+            },
+        },
     }))
 
 

@@ -100,8 +100,14 @@ class _Attention(nn.Module):
     causal: bool
     mesh: Any = None
 
+    def _cache_vars(self, b: int, cache_len: int, dtype):
+        shape = (b, cache_len, self.n_heads, self.head_dim)
+        ck = self.variable("cache", "k", jnp.zeros, shape, dtype)
+        cv = self.variable("cache", "v", jnp.zeros, shape, dtype)
+        return ck, cv
+
     @nn.compact
-    def __call__(self, x, train: bool):
+    def __call__(self, x, train: bool, decode_pos=None, cache_len: int = 0):
         d_model = x.shape[-1]
         proj = self.n_heads * self.head_dim
         dense = lambda name, feats: nn.Dense(  # noqa: E731
@@ -112,11 +118,43 @@ class _Attention(nn.Module):
         k = dense("k_proj", proj)(x).reshape(shape4)
         v = dense("v_proj", proj)(x).reshape(shape4)
 
-        cos, sin = rope_tables(s, self.head_dim)
-        q, k = apply_rope(q, cos, sin), apply_rope(k, cos, sin)
-
-        o = _dispatch_attention(q, k, v, impl=self.impl,
-                                causal=self.causal, mesh=self.mesh)
+        if decode_pos is not None:
+            # single-token step at absolute position decode_pos: rope
+            # from the scalar position, attend over the KV cache
+            half = self.head_dim // 2
+            freqs = 1.0 / (10000.0 ** (
+                jnp.arange(half, dtype=jnp.float32) / half))
+            ang = decode_pos.astype(jnp.float32) * freqs       # (half,)
+            cos, sin = jnp.cos(ang)[None, :], jnp.sin(ang)[None, :]
+            q = apply_rope(q, cos, sin)
+            k = apply_rope(k, cos, sin)
+            ck, cv = self._cache_vars(b, cache_len, x.dtype)
+            ck.value = jax.lax.dynamic_update_slice(
+                ck.value, k.astype(x.dtype), (0, decode_pos, 0, 0))
+            cv.value = jax.lax.dynamic_update_slice(
+                cv.value, v.astype(x.dtype), (0, decode_pos, 0, 0))
+            scores = jnp.einsum(
+                "bqhd,bkhd->bqhk", q.astype(jnp.float32),
+                ck.value.astype(jnp.float32),
+                preferred_element_type=jnp.float32,
+            ) / math.sqrt(self.head_dim)
+            visible = jnp.arange(cache_len) <= decode_pos
+            scores = jnp.where(visible[None, None, None, :], scores,
+                               ring_lib.NEG_INF)
+            p = jax.nn.softmax(scores, axis=-1)
+            o = jnp.einsum("bqhk,bkhd->bqhd", p,
+                           cv.value.astype(jnp.float32)).astype(x.dtype)
+        else:
+            cos, sin = rope_tables(s, self.head_dim)
+            q, k = apply_rope(q, cos, sin), apply_rope(k, cos, sin)
+            if cache_len:
+                # prefill: stash the prompt's K/V so decode steps can
+                # continue from position s without recomputing them
+                ck, cv = self._cache_vars(b, cache_len, x.dtype)
+                ck.value = ck.value.at[:, :s].set(k.astype(x.dtype))
+                cv.value = cv.value.at[:, :s].set(v.astype(x.dtype))
+            o = _dispatch_attention(q, k, v, impl=self.impl,
+                                    causal=self.causal, mesh=self.mesh)
         o = o.reshape(b, s, proj)
         return dense("o_proj", d_model)(o)
 
@@ -205,10 +243,11 @@ class _Block(nn.Module):
     mesh: Any = None
 
     @nn.compact
-    def __call__(self, x, train: bool):
+    def __call__(self, x, train: bool, decode_pos=None, cache_len: int = 0):
         h = nn.RMSNorm(name="attn_norm")(x)
         h = _Attention(self.n_heads, self.head_dim, self.attention,
-                       self.causal, self.mesh, name="attn")(h, train)
+                       self.causal, self.mesh, name="attn")(
+            h, train, decode_pos=decode_pos, cache_len=cache_len)
         if self.dropout and train:
             h = nn.Dropout(self.dropout, deterministic=False)(h)
         x = x + h
@@ -242,7 +281,8 @@ class TransformerLM(nn.Module):
     mesh: Any = None
 
     @nn.compact
-    def __call__(self, tokens, train: bool = False):
+    def __call__(self, tokens, train: bool = False, decode_pos=None,
+                 cache_len: int = 0):
         if self.attention not in ATTENTION_IMPLS:
             raise ValueError(f"unknown attention impl: {self.attention!r}")
         d_ff = self.d_ff or 4 * self.d_model
@@ -250,16 +290,19 @@ class TransformerLM(nn.Module):
         mesh = self.mesh or mesh_lib.get_default_mesh()
 
         x = nn.Embed(self.vocab_size, self.d_model, name="embed")(tokens)
-        x = sharding_lib.constrain(
-            x, mesh, mesh_lib.data_axes(mesh) or None,
-            mesh_lib.SP if self.attention in ("ring", "ulysses") else None,
-            None)
+        if decode_pos is None:
+            x = sharding_lib.constrain(
+                x, mesh, mesh_lib.data_axes(mesh) or None,
+                mesh_lib.SP if self.attention in ("ring", "ulysses")
+                else None,
+                None)
         aux_total = jnp.zeros((), jnp.float32)
         for i in range(self.n_layers):
             x, aux = _Block(self.n_heads, head_dim, d_ff, self.attention,
                             self.causal, self.n_experts, self.moe_k,
                             self.dropout, self.mesh,
-                            name=f"layer_{i}")(x, train)
+                            name=f"layer_{i}")(
+                x, train, decode_pos=decode_pos, cache_len=cache_len)
             aux_total = aux_total + aux
         x = nn.RMSNorm(name="final_norm")(x)
         logits = nn.Dense(self.vocab_size, use_bias=False,
@@ -477,10 +520,15 @@ class LanguageModel:
 
     def generate(self, prompt, max_new_tokens: int = 32,
                  temperature: float = 0.0, seed: int = 0) -> np.ndarray:
-        """Greedy / temperature sampling. prompt: (b, s) token ids.
+        """Greedy / temperature sampling with an incremental KV cache:
+        the prompt runs ONCE (prefill fills every layer's K/V cache),
+        then each new token is a single-position forward attending
+        over the cache — O(L) per token instead of the O(L²) full
+        re-forward. prompt: (b, s) token ids.
 
         Prompts longer than ``max_len`` keep their last ``max_len - 1``
-        tokens (sliding-window truncation).
+        tokens (sliding-window truncation). Token id 0 is reserved as
+        padding by ``next_token_loss`` and is masked out of sampling.
         """
         self._require_built()
         prompt = np.atleast_2d(np.asarray(prompt)).astype(np.int32)
@@ -492,42 +540,60 @@ class LanguageModel:
         buf = np.zeros((b, total), np.int32)
         buf[:, :s] = prompt
         buf = jnp.asarray(buf)
-        step = self._gen_step(b, total, float(temperature))
+        prefill, step = self._gen_fns(b, s, total, float(temperature))
         params = self.params
         key = jax.random.PRNGKey(seed)
-        for pos in range(s, total):
+        key, sub = jax.random.split(key)
+        buf, cache = prefill(params, buf, sub)
+        for pos in range(s + 1, total):
             key, sub = jax.random.split(key)
-            buf = step(params, buf, jnp.asarray(pos), sub)
+            buf, cache = step(params, cache, buf, jnp.asarray(pos), sub)
         return np.asarray(buf)
 
-    def _gen_step(self, b: int, total: int, temperature: float):
-        """One jitted decode step per (batch, length, temperature) —
-        params are an argument, not a closure, so weights stay
-        device-resident buffers instead of being baked into the
-        executable, and repeated generate() calls reuse the compile."""
-        cache = getattr(self, "_gen_cache", None)
-        if cache is None:
-            cache = self._gen_cache = {}
-        sig = (b, total, temperature, self._resolved_attention())
-        if sig in cache:
-            return cache[sig]
+    @staticmethod
+    def _sample(last, temperature: float, key):
+        # id 0 is the padding/loss-mask token — never emit it
+        last = last.astype(jnp.float32).at[..., 0].set(ring_lib.NEG_INF)
+        if temperature > 0:
+            return jax.random.categorical(key, last / temperature, axis=-1)
+        return jnp.argmax(last, axis=-1)
+
+    def _gen_fns(self, b: int, s: int, total: int, temperature: float):
+        """Jitted (prefill, decode_step) per (batch, prompt_len, total,
+        temperature) — params/cache are arguments, not closures, so
+        weights stay device-resident and repeated generate() calls
+        reuse the compile. The cache is donated through each decode
+        step (updated in place, no per-token copy)."""
+        fns = getattr(self, "_gen_cache_fns", None)
+        if fns is None:
+            fns = self._gen_cache_fns = {}
+        sig = (b, s, total, temperature, self._resolved_attention())
+        if sig in fns:
+            return fns[sig]
         module = self.module
 
         @jax.jit
-        def step(params, buf, pos, key):
-            logits, _ = module.apply({"params": params}, buf, train=False)
-            last = jnp.take_along_axis(
-                logits, (pos - 1)[None, None, None].repeat(b, 0), axis=1
-            )[:, 0].astype(jnp.float32)
-            if temperature > 0:
-                nxt = jax.random.categorical(key, last / temperature,
-                                             axis=-1)
-            else:
-                nxt = jnp.argmax(last, axis=-1)
-            return buf.at[:, pos].set(nxt.astype(jnp.int32))
+        def prefill(params, buf, key):
+            (logits, _), mut = module.apply(
+                {"params": params}, buf[:, :s], train=False,
+                cache_len=total, mutable=["cache"])
+            nxt = self._sample(logits[:, -1], temperature, key)
+            buf = buf.at[:, s].set(nxt.astype(jnp.int32))
+            return buf, mut["cache"]
 
-        cache[sig] = step
-        return step
+        @functools.partial(jax.jit, donate_argnums=(1,))
+        def step(params, cache, buf, pos, key):
+            tok = jax.lax.dynamic_slice(buf, (0, pos - 1), (b, 1))
+            (logits, _), mut = module.apply(
+                {"params": params, "cache": cache}, tok, train=False,
+                decode_pos=pos - 1, cache_len=total, mutable=["cache"])
+            nxt = self._sample(logits[:, 0], temperature, key)
+            buf = jax.lax.dynamic_update_slice(
+                buf, nxt[:, None].astype(jnp.int32), (0, pos))
+            return buf, mut["cache"]
+
+        fns[sig] = (prefill, step)
+        return fns[sig]
 
     def _require_built(self) -> None:
         if self.params is None:

@@ -1,4 +1,4 @@
-"""Fused flash attention (Pallas TPU kernel).
+"""Fused flash attention (Pallas TPU kernels, forward AND backward).
 
 Forward: one ``pallas_call`` over a ``(batch*heads, q_blocks,
 kv_blocks)`` grid — the Q tile stays resident in VMEM while K/V tiles
@@ -7,18 +7,24 @@ log-sum-exp) keeps the math exact, and scores never round-trip to HBM.
 The MXU sees two matmuls per tile (``q·kᵀ`` and ``p·v``), both with
 ``preferred_element_type=float32``.
 
-Backward: custom VJP via the standard flash recurrence — a
-``lax.scan`` over K/V blocks recomputes each score tile from the saved
-log-sum-exp, so the (seq × seq) score matrix is never materialised
-(memory stays O(seq · block) however long the context). XLA maps the
-per-block einsums onto the MXU; a hand-scheduled Pallas backward adds
-little beyond what this scan already fuses.
+Backward: custom VJP with two hand-scheduled Pallas kernels using the
+standard flash recurrence (score tiles recomputed from the saved
+log-sum-exp; the (seq × seq) matrix is never materialised):
+
+- dQ kernel — Q/dO tiles resident, K/V stream past; 3 MXU matmuls per
+  tile (``q·kᵀ``, ``do·vᵀ``, ``ds·k``), dQ accumulates in VMEM.
+- dK/dV kernel — K/V tiles resident, Q/dO stream past; 4 MXU matmuls
+  per tile, dK/dV accumulate in VMEM.
+
+``delta = Σ do·o`` is a cheap XLA fusion outside the kernels. Causal
+runs skip fully-masked tiles in all three kernels (grid-level
+``pl.when``), halving causal FLOPs.
 
 The reference framework has no attention op at all (SURVEY §5
 "long-context" row — sequence models run inside user TF code through
 the generic executor, binary_execution.py:177-189); flash attention is
 one of the net-new TPU-first components. On CPU (tests, the 8-virtual-
-device mesh) the same kernel runs in interpreter mode.
+device mesh) the same kernels run in interpreter mode.
 """
 
 from __future__ import annotations
@@ -156,42 +162,174 @@ def _fwd_pallas(q, k, v, *, scale: float, causal: bool,
 
 
 # ----------------------------------------------------------------------
-# backward: blockwise scan over K/V tiles (flash recurrence)
+# backward kernels (flash recurrence, hand-scheduled)
 # ----------------------------------------------------------------------
-def _bwd_one_head(q, k, v, o, lse, do, *, scale: float, causal: bool,
-                  block_k: int):
-    """Single (s, d) head. Returns (dq, dk, dv) in float32."""
-    sq, d = q.shape
-    sk = k.shape[0]
-    sk_p = _round_up(sk, block_k)
-    k = jnp.pad(k, ((0, sk_p - sk), (0, 0)))
-    v = jnp.pad(v, ((0, sk_p - sk), (0, 0)))
-    nk = sk_p // block_k
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                   dq_ref, dq_acc_ref,
+                   *, scale: float, causal: bool, kv_len: int,
+                   block_q: int, block_k: int):
+    """Grid (bh, q_blocks, kv_blocks): Q/dO resident, K/V stream."""
+    i = pl.program_id(1)
+    j = pl.program_id(2)
+    nk = pl.num_programs(2)
 
-    qf = q.astype(jnp.float32)
-    kf = k.astype(jnp.float32).reshape(nk, block_k, d)
-    vf = v.astype(jnp.float32).reshape(nk, block_k, d)
-    dof = do.astype(jnp.float32)
-    delta = jnp.sum(dof * o.astype(jnp.float32), axis=-1)   # (sq,)
-    rows = jnp.arange(sq)
+    @pl.when(j == 0)
+    def _init():
+        dq_acc_ref[...] = jnp.zeros_like(dq_acc_ref)
 
-    def step(dq, blk):
-        kj, vj, j = blk
-        s = (qf @ kj.T) * scale                             # (sq, bk)
-        col = j * block_k + jnp.arange(block_k)
-        valid = (col < sk)[None, :]
+    run = True
+    if causal:
+        run = j * block_k <= i * block_q + block_q - 1
+
+    @pl.when(run)
+    def _tile():
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        do = do_ref[0].astype(jnp.float32)
+        lse = lse_ref[0][:, :1]                            # (bq, 1)
+        delta = delta_ref[0][:, :1]
+
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale     # (bq, bk)
+        col = j * block_k + lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1)
+        valid = col < kv_len
         if causal:
-            valid = jnp.logical_and(valid, rows[:, None] >= col[None, :])
-        p = jnp.where(valid, jnp.exp(s - lse[:, None]), 0.0)
-        dv_j = p.T @ dof                                    # (bk, d)
-        dp = dof @ vj.T                                     # (sq, bk)
-        ds = p * (dp - delta[:, None]) * scale
-        dk_j = ds.T @ qf
-        return dq + ds @ kj, (dk_j, dv_j)
+            row = i * block_q + lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            valid = jnp.logical_and(valid, row >= col)
+        p = jnp.where(valid, jnp.exp(s - lse), 0.0)
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)             # (bq, bk)
+        ds = p * (dp - delta) * scale
+        dq_acc_ref[...] += jax.lax.dot_general(
+            ds, k.astype(jnp.float32), (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
 
-    dq0 = jnp.zeros((sq, d), jnp.float32)
-    dq, (dk, dv) = lax.scan(step, dq0, (kf, vf, jnp.arange(nk)))
-    return dq, dk.reshape(sk_p, d)[:sk], dv.reshape(sk_p, d)[:sk]
+    @pl.when(j == nk - 1)
+    def _finish():
+        dq_ref[0] = dq_acc_ref[...].astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                    dk_ref, dv_ref, dk_acc_ref, dv_acc_ref,
+                    *, scale: float, causal: bool, kv_len: int,
+                    block_q: int, block_k: int):
+    """Grid (bh, kv_blocks, q_blocks): K/V resident, Q/dO stream."""
+    j = pl.program_id(1)
+    i = pl.program_id(2)
+    nq = pl.num_programs(2)
+
+    @pl.when(i == 0)
+    def _init():
+        dk_acc_ref[...] = jnp.zeros_like(dk_acc_ref)
+        dv_acc_ref[...] = jnp.zeros_like(dv_acc_ref)
+
+    run = True
+    if causal:
+        run = j * block_k <= i * block_q + block_q - 1
+
+    @pl.when(run)
+    def _tile():
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        do = do_ref[0].astype(jnp.float32)
+        lse = lse_ref[0][:, :1]
+        delta = delta_ref[0][:, :1]
+
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale     # (bq, bk)
+        col = j * block_k + lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1)
+        valid = col < kv_len
+        if causal:
+            row = i * block_q + lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            valid = jnp.logical_and(valid, row >= col)
+        p = jnp.where(valid, jnp.exp(s - lse), 0.0)         # (bq, bk)
+        dv_acc_ref[...] += jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)             # (bk, d)
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        ds = p * (dp - delta) * scale                       # (bq, bk)
+        dk_acc_ref[...] += jax.lax.dot_general(
+            ds, q.astype(jnp.float32), (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)             # (bk, d)
+
+    @pl.when(i == nq - 1)
+    def _finish():
+        dk_ref[0] = dk_acc_ref[...].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc_ref[...].astype(dv_ref.dtype)
+
+
+def _bwd_pallas(q, k, v, o, lse, do, *, scale: float, causal: bool,
+                block_q: int, block_k: int, interpret: bool):
+    """q/k/v/o/do: (bh, s, d), lse: (bh, sq). Returns (dq, dk, dv)."""
+    bh, sq, d = q.shape
+    sk = k.shape[1]
+    block_q = min(block_q, _round_up(sq, 8))
+    block_k = min(block_k, _round_up(sk, 8))
+    sq_p, sk_p = _round_up(sq, block_q), _round_up(sk, block_k)
+    d_p = _round_up(d, 128)
+    lanes = 128
+
+    # delta = rowsum(do * o): one XLA fusion, no kernel needed. Padded
+    # rows carry q = do = 0, so their p·(dp - delta) contributions to
+    # dk/dv vanish without an explicit row mask.
+    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
+                    axis=-1)                                 # (bh, sq)
+
+    q = jnp.pad(q, ((0, 0), (0, sq_p - sq), (0, d_p - d)))
+    k = jnp.pad(k, ((0, 0), (0, sk_p - sk), (0, d_p - d)))
+    v = jnp.pad(v, ((0, 0), (0, sk_p - sk), (0, d_p - d)))
+    do = jnp.pad(do, ((0, 0), (0, sq_p - sq), (0, d_p - d)))
+    lse_l = jnp.pad(lse, ((0, 0), (0, sq_p - sq)))[..., None] * \
+        jnp.ones((1, 1, lanes), jnp.float32)
+    delta_l = jnp.pad(delta, ((0, 0), (0, sq_p - sq)))[..., None] * \
+        jnp.ones((1, 1, lanes), jnp.float32)
+
+    q_spec_i = pl.BlockSpec((1, block_q, d_p), lambda b, i, j: (b, i, 0))
+    kv_spec_j = pl.BlockSpec((1, block_k, d_p), lambda b, i, j: (b, j, 0))
+    row_spec_i = pl.BlockSpec((1, block_q, lanes),
+                              lambda b, i, j: (b, i, 0))
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
+                          kv_len=sk, block_q=block_q, block_k=block_k),
+        grid=(bh, sq_p // block_q, sk_p // block_k),
+        in_specs=[q_spec_i, kv_spec_j, kv_spec_j, q_spec_i, row_spec_i,
+                  row_spec_i],
+        out_specs=q_spec_i,
+        out_shape=jax.ShapeDtypeStruct((bh, sq_p, d_p), jnp.float32),
+        scratch_shapes=[pltpu.VMEM((block_q, d_p), jnp.float32)],
+        interpret=interpret,
+    )(q, k, v, do, lse_l, delta_l)
+
+    # second kernel: K/V resident, Q streams — grid dims (b, j, i)
+    q_spec_g2 = pl.BlockSpec((1, block_q, d_p), lambda b, j, i: (b, i, 0))
+    kv_spec_g2 = pl.BlockSpec((1, block_k, d_p), lambda b, j, i: (b, j, 0))
+    row_spec_g2 = pl.BlockSpec((1, block_q, lanes),
+                               lambda b, j, i: (b, i, 0))
+    dk, dv = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
+                          kv_len=sk, block_q=block_q, block_k=block_k),
+        grid=(bh, sk_p // block_k, sq_p // block_q),
+        in_specs=[q_spec_g2, kv_spec_g2, kv_spec_g2, q_spec_g2,
+                  row_spec_g2, row_spec_g2],
+        out_specs=[kv_spec_g2, kv_spec_g2],
+        out_shape=[jax.ShapeDtypeStruct((bh, sk_p, d_p), jnp.float32),
+                   jax.ShapeDtypeStruct((bh, sk_p, d_p), jnp.float32)],
+        scratch_shapes=[pltpu.VMEM((block_k, d_p), jnp.float32),
+                        pltpu.VMEM((block_k, d_p), jnp.float32)],
+        interpret=interpret,
+    )(q, k, v, do, lse_l, delta_l)
+    return (dq[:, :sq, :d], dk[:, :sk, :d], dv[:, :sk, :d])
 
 
 # ----------------------------------------------------------------------
@@ -214,9 +352,9 @@ def _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret):
 
 def _flash_bwd(causal, scale, block_q, block_k, interpret, res, g):
     q, k, v, o, lse = res
-    bwd = jax.vmap(functools.partial(
-        _bwd_one_head, scale=scale, causal=causal, block_k=block_k))
-    dq, dk, dv = bwd(q, k, v, o, lse, g)
+    dq, dk, dv = _bwd_pallas(q, k, v, o, lse, g, scale=scale,
+                             causal=causal, block_q=block_q,
+                             block_k=block_k, interpret=interpret)
     return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
 
 

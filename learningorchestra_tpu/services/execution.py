@@ -144,6 +144,7 @@ class ExecutionService:
             treated = self._ctx.params.treat(method_parameters)
             ckpt = _prepare_checkpointer(self._ctx, name, type_string,
                                          treated)
+            _inject_epoch_log(self._ctx, name, instance, method, treated)
             try:
                 result = getattr(instance, method)(**treated)
             finally:
@@ -160,6 +161,35 @@ class ExecutionService:
         self._ctx.jobs.submit(
             name, run, description=description,
             parameters=method_parameters, needs_mesh=True)
+
+
+def _inject_epoch_log(ctx, name: str, instance: Any, method: str,
+                      treated: Dict[str, Any]) -> None:
+    """Stream per-epoch training records (loss/accuracy/samplesPerSecond
+    and the engine's tflopsPerSecPerChip/mfu roofline numbers) into the
+    execution's documents as they happen, when the target method takes a
+    ``log_fn`` (our engine-backed fits do; sklearn methods don't). The
+    reference's only perf instrumentation is Builder's post-hoc fitTime
+    (builder_image/builder.py:117-122) — live epoch records through the
+    universal GET reader are a strict superset."""
+    import inspect
+
+    if "log_fn" in treated:
+        return
+    try:
+        params = inspect.signature(getattr(instance, method)).parameters
+    except (TypeError, ValueError):
+        return
+    if "log_fn" not in params:
+        return
+
+    def log_record(record: Dict[str, Any]) -> None:
+        try:
+            ctx.catalog.append_document(name, {"epochRecord": record})
+        except Exception:  # noqa: BLE001 — logging must never sink a fit
+            pass
+
+    treated["log_fn"] = log_record
 
 
 def checkpoint_dir_for(ctx, name: str) -> str:
