@@ -285,6 +285,20 @@ class Catalog:
         reference utils.py:318-326)."""
         return self.read_table(name, columns).to_pandas()
 
+    def iter_batches(self, name: str,
+                     columns: Optional[Sequence[str]] = None,
+                     batch_size: int = 65536):
+        """Stream the dataset as pyarrow RecordBatches without ever
+        materializing the whole table — the out-of-core data plane for
+        10M-row Builder configs (the reference streams via
+        mongo-spark partitions, builder.py:174-176; here it's Parquet
+        row-group scanning with bounded RSS)."""
+        cols = list(columns) if columns else None
+        for f in self._dataset_files(name):
+            pf = pq.ParquetFile(f)
+            yield from pf.iter_batches(batch_size=batch_size,
+                                       columns=cols)
+
     def write_dataframe(self, name: str, df, replace: bool = True) -> int:
         """Write a DataFrame as the dataset's rows. ``replace`` (the
         default) swaps out any existing rows — the dataType service

@@ -266,6 +266,51 @@ class NeuralModel:
                 "(or load a trained artifact)")
 
     # ------------------------------------------------------------------
+    # pretrained / real-artifact weight interop (models/weights_io.py;
+    # reference parity: binary_executor_image/utils.py:195-221 reloads
+    # real Keras artifacts across services)
+    # ------------------------------------------------------------------
+    def save_weights(self, path: str) -> None:
+        """Export weights (and batch-norm stats) to an npz file."""
+        from learningorchestra_tpu.models import weights_io
+
+        self._require_built()
+        weights_io.export_npz(self.params, path,
+                              model_state=self.model_state)
+
+    def load_weights(self, path: str,
+                     input_shape: Optional[Sequence[int]] = None) -> None:
+        """Load weights from ``.npz`` (this framework's export) or a
+        real Keras ``.h5`` / ``.weights.h5`` Sequential weights file
+        (ordered layer mapping, shape-checked). Builds parameters
+        first if needed — ``input_shape`` (without the batch dim) is
+        required then unless the model already knows it."""
+        from learningorchestra_tpu.models import weights_io
+
+        if self.params is None:
+            shape = list(input_shape or self.input_shape or [])
+            if not shape:
+                raise ValueError(
+                    "model has no parameters yet; pass input_shape= so "
+                    "they can be built before loading")
+            dtype = np.int32 if self.layer_configs and \
+                self.layer_configs[0].get("kind") == "embedding" \
+                else np.float32
+            self._build_params(np.zeros((1, *shape), dtype))
+        if path.endswith(".npz"):
+            loaded, state = weights_io.import_npz(path)
+            self.params = weights_io.apply_to_tree(self.params, loaded)
+            if state:
+                self.model_state = weights_io.apply_to_tree(
+                    self.model_state, state)
+        else:
+            self.params, self.model_state = \
+                weights_io.load_keras_h5_into_sequential(
+                    self.layer_configs, self.params, self.model_state,
+                    path)
+        self._state = None  # stale engine state would shadow the load
+
+    # ------------------------------------------------------------------
     def summary(self) -> str:
         lines = [f"NeuralModel '{self.name}'"]
         for i, cfg in enumerate(self.layer_configs):

@@ -106,37 +106,52 @@ def _stage_fn_for(n_heads: int, layers_per_stage: int):
     return stage_fn
 
 
-def forward(params: Dict[str, Any], tokens: jnp.ndarray, mesh,
-            n_heads: int, num_microbatches: int = 4) -> jnp.ndarray:
-    """tokens (b, s) int32 -> logits (b, s, V); blocks pipelined over
-    ``pp``, embedding and tied head outside the pipeline."""
-    blocks = params["blocks"]
-    n_layers = blocks["qkv"].shape[0]
-    pp = mesh.shape.get(mesh_lib.PP, 1) if mesh is not None else 1
-    if n_layers % pp:
-        raise ValueError(f"{n_layers} layers not divisible by pp={pp}")
-    layers_per_stage = n_layers // pp
-
-    embed = params["embed"]
+def _embed_in(embed: jnp.ndarray, tokens: jnp.ndarray) -> jnp.ndarray:
+    """Embedding + fixed sinusoidal positions (params-free positions
+    keep the pipelined stages uniform)."""
     x = embed[tokens]
-    # fixed sinusoidal positions — params-free keeps stages uniform
     d = x.shape[-1]
     pos = jnp.arange(x.shape[1], dtype=jnp.float32)
     freqs = jnp.exp(-jnp.arange(0, d, 2, dtype=jnp.float32) / d *
                     math.log(10000.0))
     ang = pos[:, None] * freqs[None, :]
     pe = jnp.concatenate([jnp.sin(ang), jnp.cos(ang)], axis=-1)[None]
-    x = x + pe.astype(x.dtype)
+    return x + pe.astype(x.dtype)
 
+
+def _stage_setup(params: Dict[str, Any], mesh):
+    """Shared pipeline prologue: pp size, stage layout validation, and
+    the (pp, layers_per_stage, ...) stage-param reshape — one place so
+    the GPipe and 1F1B schedules can't desynchronize."""
+    blocks = params["blocks"]
+    n_layers = blocks["qkv"].shape[0]
+    pp = mesh.shape.get(mesh_lib.PP, 1) if mesh is not None else 1
+    if n_layers % pp:
+        raise ValueError(f"{n_layers} layers not divisible by pp={pp}")
+    layers_per_stage = n_layers // pp
+    stage_params = None
     if pp > 1:
         stage_params = jax.tree_util.tree_map(
             lambda a: a.reshape((pp, layers_per_stage) + a.shape[1:]),
             blocks)
+    return pp, layers_per_stage, stage_params
+
+
+def forward(params: Dict[str, Any], tokens: jnp.ndarray, mesh,
+            n_heads: int, num_microbatches: int = 4) -> jnp.ndarray:
+    """tokens (b, s) int32 -> logits (b, s, V); blocks pipelined over
+    ``pp``, embedding and tied head outside the pipeline."""
+    pp, layers_per_stage, stage_params = _stage_setup(params, mesh)
+    blocks = params["blocks"]
+    embed = params["embed"]
+    x = _embed_in(embed, tokens)
+
+    if pp > 1:
         x = pp_lib.pipeline_apply(
             _stage_fn_for(n_heads, layers_per_stage), stage_params, x,
             mesh, num_microbatches=num_microbatches)
     else:
-        for i in range(n_layers):
+        for i in range(blocks["qkv"].shape[0]):
             x = _block(jax.tree_util.tree_map(lambda a, i=i: a[i], blocks),
                        x, n_heads)
     return x @ embed.T  # tied head
@@ -153,23 +168,71 @@ def next_token_loss(params, tokens, mesh, n_heads: int,
     return jnp.sum(per_tok * mask) / jnp.maximum(jnp.sum(mask), 1e-9)
 
 
+def _head_loss(embed: jnp.ndarray, out: jnp.ndarray,
+               y_mb: jnp.ndarray) -> jnp.ndarray:
+    """Tied-head next-token loss for one microbatch (mean over its
+    unpadded tokens). 1F1B's total loss is the mean over microbatches
+    — identical to the full-batch mean when microbatches carry equal
+    mask counts (no padding), the standard practice tradeoff."""
+    logits = out @ embed.T
+    tgt = y_mb[:, 1:]
+    lg = logits[:, :-1].astype(jnp.float32)
+    per_tok = optax.softmax_cross_entropy_with_integer_labels(lg, tgt)
+    mask = (tgt != 0).astype(jnp.float32)
+    return jnp.sum(per_tok * mask) / jnp.maximum(jnp.sum(mask), 1e-9)
+
+
+def value_and_grad_1f1b(params, tokens: jnp.ndarray, mesh, n_heads: int,
+                        num_microbatches: int = 4):
+    """Hand-assembled train pass on the 1F1B schedule
+    (parallel/pipeline.py): the pipelined middle returns its stage
+    grads plus dx; the embedding's gradient combines the tied head's
+    contribution with the lookup scatter — no outer autodiff through
+    the pipeline loop."""
+    pp, layers_per_stage, stage_params = _stage_setup(params, mesh)
+    if pp <= 1:
+        raise ValueError("1F1B needs a pp axis of size >= 2")
+    embed = params["embed"]
+    n_layers = params["blocks"]["qkv"].shape[0]
+    x = _embed_in(embed, tokens)
+    loss, dstage, dembed_head, dx = pp_lib.pipeline_value_and_grad_1f1b(
+        _stage_fn_for(n_heads, layers_per_stage), _head_loss,
+        stage_params, embed, x, tokens, mesh,
+        num_microbatches=num_microbatches)
+    dblocks = jax.tree_util.tree_map(
+        lambda g: g.reshape((n_layers,) + g.shape[2:]), dstage)
+    d = embed.shape[-1]
+    dembed = dembed_head + jnp.zeros_like(embed, jnp.float32).at[
+        tokens.reshape(-1)].add(dx.reshape(-1, d))
+    return loss, {"embed": dembed.astype(embed.dtype), "blocks": dblocks}
+
+
 def fit(params, tokens: np.ndarray, mesh, n_heads: int, steps: int = 4,
         batch_size: Optional[int] = None, learning_rate: float = 1e-3,
-        num_microbatches: int = 4) -> Tuple[Dict[str, Any], List[float]]:
+        num_microbatches: int = 4, schedule: str = "gpipe",
+        ) -> Tuple[Dict[str, Any], List[float]]:
     """Minimal jitted training loop (dryrun / test harness — the full
     REST-facing engine path uses LanguageModel; this validates the PP
-    compute path, forward AND backward, end to end)."""
+    compute path, forward AND backward, end to end).
+
+    ``schedule``: ``"gpipe"`` (autodiff through the fill/drain scan)
+    or ``"1f1b"`` (hand-scheduled one-forward-one-backward with
+    bounded activation stash)."""
     optimizer = optax.adam(learning_rate)
     opt_state = optimizer.init(params)
     bs = batch_size or tokens.shape[0]
 
     @jax.jit
     def step(p, o, batch):
-        def loss_of(t):
-            return next_token_loss(t, batch, mesh, n_heads,
-                                   num_microbatches)
+        if schedule == "1f1b":
+            loss, grads = value_and_grad_1f1b(p, batch, mesh, n_heads,
+                                              num_microbatches)
+        else:
+            def loss_of(t):
+                return next_token_loss(t, batch, mesh, n_heads,
+                                       num_microbatches)
 
-        loss, grads = jax.value_and_grad(loss_of)(p)
+            loss, grads = jax.value_and_grad(loss_of)(p)
         updates, o = optimizer.update(grads, o, p)
         return optax.apply_updates(p, updates), o, loss
 

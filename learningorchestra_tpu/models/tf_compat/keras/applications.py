@@ -3,14 +3,17 @@
 The reference's north-star tune config loads
 ``tensorflow.keras.applications.ResNet50`` by module path
 (BASELINE.md config 5). Here ResNet50 is a flax implementation
-(models/resnet.py). Pretrained ImageNet weights cannot be downloaded
-in this offline environment — ``weights="imagenet"`` falls back to
-random init with a warning (transfer-learning parity is the API shape
-+ fine-tune path, not the weight values).
+(models/resnet.py). ``weights=`` accepts a **file path** to an npz
+weight export (models/weights_io.py) so pretrained transfer is real:
+export any trained ResNet50 with ``model.save_weights(path)`` and
+reload it here bit-exactly. ``weights="imagenet"`` still falls back
+to random init with a warning — the canonical weights cannot be
+downloaded in this zero-egress environment.
 """
 
 from __future__ import annotations
 
+import os
 import warnings
 from typing import Any, Optional, Sequence
 
@@ -21,14 +24,21 @@ def ResNet50(include_top: bool = True, weights: Optional[str] = None,
              classes: int = 1000,
              input_shape: Optional[Sequence[int]] = None,
              **_: Any) -> NeuralModel:
-    if weights == "imagenet":
-        warnings.warn(
-            "pretrained ImageNet weights are unavailable offline; "
-            "ResNet50 initialized randomly", stacklevel=2)
     model = NeuralModel(
         [{"kind": "resnet50", "classes": int(classes),
           "include_top": bool(include_top)}],
         name="resnet50")
     if input_shape:
         model.input_shape = list(input_shape)
+    if weights == "imagenet":
+        warnings.warn(
+            "pretrained ImageNet weights are unavailable offline; "
+            "ResNet50 initialized randomly", stacklevel=2)
+    elif weights:
+        if not os.path.exists(weights):
+            raise FileNotFoundError(
+                f"weights file not found: {weights!r} (pass a path to "
+                "an npz export from model.save_weights())")
+        model.load_weights(weights,
+                           input_shape=input_shape or (224, 224, 3))
     return model

@@ -28,6 +28,7 @@ names and kwargs are the reference's REST contract
 
 from __future__ import annotations
 
+import functools
 import json
 import math
 import os
@@ -537,6 +538,10 @@ class LanguageModel:
             prompt = prompt[:, -(self.max_len - 1):]
             s = prompt.shape[1]
         total = min(self.max_len, s + max_new_tokens)
+        if total <= s:
+            # nothing to generate — prefill would clamp buf[:, s] onto
+            # the last prompt column and corrupt it
+            return prompt
         buf = np.zeros((b, total), np.int32)
         buf[:, :s] = prompt
         buf = jnp.asarray(buf)

@@ -1,12 +1,22 @@
 """Mixture-of-experts with expert parallelism over the ``ep`` axis.
 
-GShard/Switch-style dense dispatch: top-k gating builds a fixed-shape
-(tokens × experts × capacity) dispatch tensor and all routing becomes
-three einsums — no ragged shapes, no data-dependent control flow, so
-XLA tiles everything onto the MXU and, when the expert dim is sharded
-over ``ep``, lowers the dispatch/combine einsums to all-to-alls over
-ICI. Tokens over capacity are dropped (standard; capacity_factor
-controls the drop rate).
+Two dispatch schedules with IDENTICAL routing semantics (top-k,
+shared per-expert capacity with choice-0 priority, token-order
+tie-break, renormalized gate weights):
+
+- ``route="sparse"`` (default) — sort/segment routing: the (T·k)
+  token-copies are stably sorted by expert id (choice-major, so
+  earlier choices win capacity), each copy's slot inside its expert's
+  (capacity, d) buffer comes from a cumsum of per-expert counts, and
+  dispatch/combine are two O(T·k·d) scatter/gathers. Peak routing
+  memory is O(E·C·d + T·k) — no (T, E, C) tensor ever exists, so
+  T=8k, E=32 routes fine.
+- ``route="dense"`` — GShard-style (T, E, C) one-hot dispatch where
+  routing is three einsums; simplest lowering to all-to-alls under
+  GSPMD but O(T·E·C) memory. Kept for small-shape parity checks.
+
+Both are static-shape and jit/vjp-safe (sort indices are constants of
+the backward pass; gradients flow through values and gate weights).
 
 Functional params layout (stacked experts, shardable by
 sharding.TRANSFORMER_RULES):
@@ -45,15 +55,29 @@ def init_moe_params(rng, d_model: int, d_ff: int, n_experts: int,
     }
 
 
+def _topk_renorm(logits: jax.Array, k: int,
+                 ) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Shared router head: softmax -> top-k -> renormalize + Switch
+    aux loss. ONE implementation so the sparse and dense schedules
+    cannot drift apart. Returns (gate_idx (T,k), gate_vals (T,k),
+    aux)."""
+    e = logits.shape[-1]
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+    gate_vals, gate_idx = jax.lax.top_k(probs, k)
+    gate_vals = gate_vals / jnp.maximum(
+        jnp.sum(gate_vals, axis=-1, keepdims=True), 1e-9)
+    # load-balancing aux loss (Switch: E * mean(frac_tokens*mean_prob))
+    top1 = jax.nn.one_hot(gate_idx[:, 0], e, dtype=jnp.float32)
+    aux = e * jnp.mean(jnp.mean(top1, axis=0) * jnp.mean(probs, axis=0))
+    return gate_idx, gate_vals, aux
+
+
 def top_k_gating(logits: jax.Array, k: int, capacity: int,
                  ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """Returns (dispatch (T,E,C) {0,1}, combine (T,E,C) weights,
     aux_loss scalar) from router logits (T, E)."""
     t, e = logits.shape
-    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
-    gate_vals, gate_idx = jax.lax.top_k(probs, k)          # (T, k)
-    gate_vals = gate_vals / jnp.maximum(
-        jnp.sum(gate_vals, axis=-1, keepdims=True), 1e-9)  # renormalize
+    gate_idx, gate_vals, aux = _topk_renorm(logits, k)
 
     dispatch = jnp.zeros((t, e, capacity), jnp.float32)
     combine = jnp.zeros((t, e, capacity), jnp.float32)
@@ -73,22 +97,44 @@ def top_k_gating(logits: jax.Array, k: int, capacity: int,
         hot = hot * keep[:, None, None]
         dispatch = dispatch + hot
         combine = combine + hot * gate_vals[:, choice, None, None]
-
-    # load-balancing aux loss (Switch: E * mean(frac_tokens * mean_prob))
-    top1 = jax.nn.one_hot(gate_idx[:, 0], e, dtype=jnp.float32)
-    aux = e * jnp.mean(jnp.mean(top1, axis=0) * jnp.mean(probs, axis=0))
     return dispatch, combine, aux
+
+
+def sparse_route(gate_idx: jax.Array, gate_vals: jax.Array, e: int,
+                 capacity: int,
+                 ) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
+    """Sort/segment routing plan (no (T,E,C) tensor).
+
+    Returns ``(tok, slot, keep, w)``, each (T·k,), in expert-sorted
+    order: ``tok`` is each kept copy's source token, ``slot`` its flat
+    index into the (E·C, d) expert buffer, ``keep`` the capacity mask,
+    ``w`` the gate weight. Stable choice-major sort reproduces the
+    dense schedule's priority exactly (choice 0 first, then token id).
+    """
+    t, k = gate_idx.shape
+    flat_e = gate_idx.T.reshape(-1)           # (k·T,) choice-major
+    flat_w = gate_vals.T.reshape(-1)
+    flat_tok = jnp.tile(jnp.arange(t, dtype=jnp.int32), k)
+    order = jnp.argsort(flat_e, stable=True)  # choice/token priority
+    se = flat_e[order]
+    counts = jnp.bincount(flat_e, length=e)
+    starts = jnp.concatenate([jnp.zeros((1,), counts.dtype),
+                              jnp.cumsum(counts)[:-1]])
+    pos = jnp.arange(k * t, dtype=jnp.int32) - starts[se].astype(jnp.int32)
+    keep = pos < capacity
+    slot = se * capacity + jnp.clip(pos, 0, capacity - 1)
+    return flat_tok[order], slot, keep, flat_w[order]
 
 
 def moe_layer(params: Dict[str, Any], x: jax.Array, *, k: int = 2,
               capacity_factor: float = 1.25,
-              mesh: Optional[Mesh] = None,
+              mesh: Optional[Mesh] = None, route: str = "sparse",
               ) -> Tuple[jax.Array, jax.Array]:
     """x: (..., d_model) -> (same shape, aux_loss).
 
     With ``mesh`` given, expert-stacked tensors are constrained to the
     ``ep`` axis so GSPMD executes each expert's FFN on its own mesh
-    slice (dispatch/combine become all-to-alls).
+    slice (dispatch/combine become all-to-alls / collective scatters).
     """
     orig_shape = x.shape
     d = orig_shape[-1]
@@ -98,10 +144,18 @@ def moe_layer(params: Dict[str, Any], x: jax.Array, *, k: int = 2,
     capacity = max(1, int(capacity_factor * k * t / e))
 
     logits = tokens @ params["gate"].astype(tokens.dtype)
-    dispatch, combine, aux = top_k_gating(logits, k, capacity)
+    if route == "sparse":
+        gate_idx, gate_vals, aux = _topk_renorm(logits, k)
+        tok, slot, keep, w = sparse_route(gate_idx, gate_vals, e, capacity)
+        buf = jnp.zeros((e * capacity, d), tokens.dtype)
+        expert_in = buf.at[slot].add(
+            tokens[tok] * keep[:, None].astype(tokens.dtype)
+        ).reshape(e, capacity, d)
+    else:
+        dispatch, combine, aux = top_k_gating(logits, k, capacity)
+        expert_in = jnp.einsum("tec,td->ecd",
+                               dispatch.astype(tokens.dtype), tokens)
 
-    expert_in = jnp.einsum("tec,td->ecd", dispatch.astype(tokens.dtype),
-                           tokens)
     if mesh is not None:
         expert_in = sharding_lib.constrain(
             expert_in, mesh, mesh_lib.EP, None, None)
@@ -115,6 +169,12 @@ def moe_layer(params: Dict[str, Any], x: jax.Array, *, k: int = 2,
     if mesh is not None:
         expert_out = sharding_lib.constrain(
             expert_out.astype(tokens.dtype), mesh, mesh_lib.EP, None, None)
-    out = jnp.einsum("tec,ecd->td", combine.astype(jnp.float32),
-                     expert_out.astype(jnp.float32))
+
+    if route == "sparse":
+        copies = expert_out.astype(jnp.float32).reshape(e * capacity, d)[slot]
+        copies = copies * (w * keep.astype(jnp.float32))[:, None]
+        out = jnp.zeros((t, d), jnp.float32).at[tok].add(copies)
+    else:
+        out = jnp.einsum("tec,ecd->td", combine.astype(jnp.float32),
+                         expert_out.astype(jnp.float32))
     return out.reshape(orig_shape).astype(x.dtype), aux

@@ -1,12 +1,25 @@
-"""Pipeline parallelism (GPipe schedule) over the ``pp`` axis.
+"""Pipeline parallelism over the ``pp`` axis: GPipe and 1F1B.
 
 Layer stages live on different devices; microbatches flow through the
 ring of stages with activations handed to the next stage by
-``ppermute`` each tick. The schedule is the classic GPipe fill/drain:
-``M + n_stages - 1`` ticks for M microbatches, bubble fraction
-``(n-1)/(M+n-1)``. Every device runs the same jitted tick body (SPMD —
-no MPMD program needed); invalid bubble ticks compute on garbage and
-are masked out of the result, which keeps control flow static for XLA.
+``ppermute`` each tick. Every device runs the same jitted tick body
+(SPMD — no MPMD program needed). Two schedules:
+
+- **GPipe** (:func:`pipeline_apply`): forward-only fill/drain,
+  ``M + n - 1`` ticks; backward comes from plain autodiff through the
+  scan (the transpose of ``ppermute`` is the reverse rotation).
+  Simple, composes with any outer loss, but autodiff stashes every
+  scan-tick residual — activation memory grows with M.
+- **1F1B** (:func:`pipeline_value_and_grad_1f1b`): the classic
+  one-forward-one-backward schedule — each tick a stage runs one
+  microbatch forward AND one backward; microbatch j's backward starts
+  as soon as its forward leaves the last stage, so the input stash is
+  a ring buffer of depth ``2n - 1`` **independent of M**. The loss
+  head runs INSIDE the last stage's tick (``lax.cond`` on the stage
+  index, so only that device pays the head matmul), gradients are
+  hand-assembled from per-tick ``jax.vjp`` with activation recompute,
+  and the function returns ``(loss, dstage_params, dhead_params,
+  dx)`` directly — no outer autodiff through the loop.
 
 Stage parameters are stacked on a leading ``n_stages`` dim and sharded
 over ``pp``, so each device holds exactly its stage's weights.
@@ -84,6 +97,222 @@ def _static_size(n) -> int:
 def _forward_perm(n) -> list:
     size = _static_size(n)
     return [(i, i + 1) for i in range(size - 1)]
+
+
+def _backward_perm(n) -> list:
+    size = _static_size(n)
+    return [(i, i - 1) for i in range(1, size)]
+
+
+def pipeline_1f1b_local(stage_fn: Callable[[Any, jax.Array], jax.Array],
+                        head_fn: Callable[[Any, jax.Array, jax.Array],
+                                          jax.Array],
+                        stage_params: Any, head_params: Any,
+                        x: jax.Array, y: jax.Array,
+                        num_microbatches: int,
+                        axis_name: str = mesh_lib.PP,
+                        mesh_axes: tuple = (mesh_lib.PP,)):
+    """Inside shard_map: one 1F1B training pass.
+
+    Schedule (stage ``s`` of ``n``, tick ``t``): forward of microbatch
+    ``f = t - s`` and backward of microbatch ``b = t - 2(n-1) + s``,
+    both skipped via ``lax.cond`` outside their ranges. The last stage
+    finishes microbatch j's forward and starts its backward in the
+    SAME tick (``b == f`` at ``s = n-1``), which is what bounds the
+    in-flight window: a stage holds at most ``2(n-1-s) + 1`` stashed
+    inputs, so the ring buffer depth ``2n - 1`` suffices for any M.
+    Backward recomputes the stage forward from the stashed INPUT
+    (``jax.vjp`` per tick) rather than stashing internals —
+    memory O(n·microbatch), compute ≈ 4/3× (the standard
+    rematerialized-pipeline tradeoff).
+
+    ``head_fn(head_params, out_mb, y_mb) -> scalar`` is the
+    per-microbatch loss (mean over the microbatch); the total loss is
+    the mean over microbatches. Returns ``(loss, dstage_params_local,
+    dhead_params, dx)`` where ``dx`` is the gradient w.r.t. ``x`` (for
+    an embedding backward outside the pipeline).
+    """
+    n = _static_size(lax.psum(1, axis_name))
+    idx = lax.axis_index(axis_name)
+    m = num_microbatches
+    if x.shape[0] % m:
+        raise ValueError(f"batch {x.shape[0]} not divisible by "
+                         f"microbatches {m}")
+    micro_x = x.reshape(m, x.shape[0] // m, *x.shape[1:])
+    micro_y = y.reshape(m, y.shape[0] // m, *y.shape[1:])
+    depth = max(1, 2 * n - 1)
+    f32 = jnp.float32
+    extra_axes = tuple(a for a in mesh_axes if a != axis_name)
+
+    def varying(v):
+        # mark values as device-varying over EVERY mesh axis (adding
+        # only the axes each leaf is missing) — the vjp calls below
+        # must see only varying inputs, or AD inserts psums for the
+        # replicated ones INSIDE the lax.cond branches (a collective
+        # not all devices reach); reductions happen explicitly at the
+        # end of the pass instead
+        def one(x):
+            vma = getattr(jax.typeof(x), "vma", frozenset())
+            missing = tuple(a for a in mesh_axes if a not in vma)
+            return lax.pcast(x, missing, to="varying") if missing else x
+
+        return jax.tree_util.tree_map(one, v)
+
+    params = varying(jax.tree_util.tree_map(lambda p: p[0], stage_params))
+    head_params = varying(head_params)
+
+    def zeros_f32(tree):
+        return jax.tree_util.tree_map(
+            lambda p: jnp.zeros(p.shape, f32), tree)
+
+    mb_shape = micro_x[0]
+    init = (
+        varying(jnp.zeros_like(mb_shape)),                 # act_in
+        varying(jnp.zeros_like(mb_shape)),                 # grad_in
+        varying(jnp.zeros((depth,) + mb_shape.shape, mb_shape.dtype)),
+        varying(zeros_f32(params)),                        # dparams
+        varying(zeros_f32(head_params)),                   # dhead
+        varying(jnp.zeros((m,) + mb_shape.shape, f32)),    # dx buffer
+        varying(jnp.zeros((), f32)),                       # loss acc
+    )
+
+    def tick(carry, t):
+        act_in, grad_in, stash, dparams, dhead, dx_buf, loss_acc = carry
+
+        # ---- forward half: microbatch f = t - s -----------------------
+        f = t - idx
+        fvalid = (f >= 0) & (f < m)
+        inp = jnp.where(idx == 0,
+                        lax.dynamic_index_in_dim(
+                            micro_x, jnp.clip(f, 0, m - 1), 0,
+                            keepdims=False),
+                        act_in)
+        y_out = lax.cond(fvalid,
+                         lambda i: stage_fn(params, i),
+                         lambda i: jnp.zeros_like(i), inp)
+        fslot = jnp.where(fvalid, f, 0) % depth
+        prev = lax.dynamic_index_in_dim(stash, fslot, 0, keepdims=False)
+        stash = lax.dynamic_update_index_in_dim(
+            stash, jnp.where(fvalid, inp, prev), fslot, 0)
+
+        # ---- backward half: microbatch b = t - 2(n-1) + s -------------
+        b = t - 2 * (n - 1) + idx
+        bvalid = (b >= 0) & (b < m)
+        bslot = jnp.where(bvalid, b, 0) % depth
+        binp = lax.dynamic_index_in_dim(stash, bslot, 0, keepdims=False)
+        yb = lax.dynamic_index_in_dim(micro_y, jnp.clip(b, 0, m - 1), 0,
+                                      keepdims=False)
+
+        def do_bwd(_):
+            out_b, vjp = jax.vjp(stage_fn, params, binp)
+
+            def last_stage(_):
+                def hl(hp, o):
+                    return head_fn(hp, o, yb)
+
+                loss_b, (dh, go) = jax.value_and_grad(
+                    hl, argnums=(0, 1))(head_params, out_b)
+                scale = 1.0 / m
+                dh = jax.tree_util.tree_map(
+                    lambda g: g.astype(f32) * scale, dh)
+                return (loss_b.astype(f32) * scale, dh,
+                        (go * scale).astype(out_b.dtype))
+
+            def mid_stage(_):
+                # fresh zeros are axis-unvarying; pcast them so both
+                # cond branches carry the same varying type
+                return (varying(jnp.zeros((), f32)),
+                        varying(zeros_f32(head_params)),
+                        grad_in.astype(out_b.dtype))
+
+            loss_b, dh, gout = lax.cond(idx == n - 1, last_stage,
+                                        mid_stage, None)
+            dp, dinp = vjp(gout)
+            dp = jax.tree_util.tree_map(lambda g: g.astype(f32), dp)
+            return loss_b, dh, dp, dinp.astype(mb_shape.dtype)
+
+        def no_bwd(_):
+            return (varying(jnp.zeros((), f32)),
+                    varying(zeros_f32(head_params)),
+                    varying(zeros_f32(params)),
+                    varying(jnp.zeros_like(mb_shape)))
+
+        loss_b, dh, dp, dinp = lax.cond(bvalid, do_bwd, no_bwd, None)
+        dparams = jax.tree_util.tree_map(jnp.add, dparams, dp)
+        dhead = jax.tree_util.tree_map(jnp.add, dhead, dh)
+        loss_acc = loss_acc + loss_b
+
+        # stage 0 owns dx (the embedding backward's input)
+        dslot = jnp.clip(b, 0, m - 1)
+        old_dx = lax.dynamic_index_in_dim(dx_buf, dslot, 0,
+                                          keepdims=False)
+        dx_buf = lax.dynamic_update_index_in_dim(
+            dx_buf,
+            jnp.where(bvalid & (idx == 0), dinp.astype(f32), old_dx),
+            dslot, 0)
+
+        # unconditional comms keep the collective schedule static
+        act_next = lax.ppermute(y_out, axis_name, _forward_perm(n))
+        grad_next = lax.ppermute(dinp, axis_name, _backward_perm(n))
+        return (act_next, grad_next, stash, dparams, dhead, dx_buf,
+                loss_acc), None
+
+    ticks = jnp.arange(m + 2 * (n - 1))
+    (_, _, _, dparams, dhead, dx_buf, loss_acc), _ = lax.scan(
+        tick, init, ticks)
+
+    # replicate across pp: loss/dhead live on the last stage only; each
+    # stage's dparams stay local (restacked by the caller's out_specs);
+    # dx lives on stage 0 only
+    loss = lax.psum(loss_acc, axis_name)
+    dhead = jax.tree_util.tree_map(
+        lambda g: lax.psum(g, axis_name), dhead)
+    dx = lax.psum(dx_buf, axis_name)
+    dparams = jax.tree_util.tree_map(lambda g: g[None], dparams)
+    return loss, dparams, dhead, dx.reshape(x.shape[0], *x.shape[1:])
+
+
+def pipeline_value_and_grad_1f1b(
+        stage_fn: Callable[[Any, jax.Array], jax.Array],
+        head_fn: Callable[[Any, jax.Array, jax.Array], jax.Array],
+        stage_params: Any, head_params: Any,
+        x: jax.Array, y: jax.Array, mesh: Mesh,
+        num_microbatches: int = 4):
+    """pjit-level 1F1B train pass: returns ``(loss, dstage_params
+    (stacked like the input), dhead_params, dx)``. ``x``/``dx`` are
+    sharded over the data axes; gradients are averaged over them."""
+    if mesh_lib.PP not in mesh.axis_names:
+        raise ValueError("mesh has no 'pp' axis")
+    data = mesh_lib.data_axes(mesh)
+    xspec = P(data if data else None)
+    pspec = jax.tree_util.tree_map(
+        lambda p: P(*((mesh_lib.PP,) + (None,) * (p.ndim - 1))),
+        stage_params)
+    hspec = jax.tree_util.tree_map(lambda p: P(), head_params)
+
+    def body(sp, hp, xx, yy):
+        loss, dsp, dhp, dx = pipeline_1f1b_local(
+            stage_fn, head_fn, sp, hp, xx, yy,
+            num_microbatches=num_microbatches,
+            mesh_axes=tuple(mesh.axis_names))
+        # mean over data shards (per-shard losses are per-shard means)
+        if data:
+            loss = lax.pmean(loss, data)
+            dsp = jax.tree_util.tree_map(
+                lambda g: lax.pmean(g, data), dsp)
+            dhp = jax.tree_util.tree_map(
+                lambda g: lax.pmean(g, data), dhp)
+            # dx rows belong to this shard's batch slice — no averaging
+            # across shards, but the global loss carries the same 1/n
+            # factor pmean applied to the param grads
+            dx = dx / lax.psum(1, data)
+        return loss, dsp, dhp, dx
+
+    fn = jax.shard_map(
+        body, mesh=mesh,
+        in_specs=(pspec, hspec, xspec, xspec),
+        out_specs=(P(), pspec, hspec, xspec))
+    return fn(stage_params, head_params, x, y)
 
 
 def pipeline_apply(stage_fn: Callable[[Any, jax.Array], jax.Array],

@@ -37,9 +37,18 @@ TRAIN_FIELD = "trainDatasetName"
 TEST_FIELD = "testDatasetName"
 MODELING_CODE_FIELD = "modelingCode"
 CLASSIFIERS_FIELD = "classifiersList"
+STREAMING_FIELD = "streaming"
+LABEL_FIELD = "labelColumn"
+FEATURES_FIELD = "featureColumns"
+EVAL_DATASET_FIELD = "evaluationDatasetName"
+BATCH_SIZE_FIELD = "batchSize"
 LABEL_COLUMN = "label"
 
 CLASSIFIER_NAMES = ("LR", "DT", "RF", "GB", "NB")
+
+# non-incremental families train on a bounded reservoir sample in
+# streaming mode; incremental families see every row via partial_fit
+_RESERVOIR_CAP = 500_000
 
 
 def _make_classifier(name: str):
@@ -56,6 +65,65 @@ def _make_classifier(name: str):
         "GB": GradientBoostingClassifier,
         "NB": GaussianNB,
     }[name]()
+
+
+def _make_streaming_classifier(name: str):
+    """(estimator, supports_partial_fit). Incremental twins where
+    sklearn has them; histogram boosting (the Spark GBT replacement)
+    and the tree family train on the bounded reservoir."""
+    from sklearn.ensemble import (HistGradientBoostingClassifier,
+                                  RandomForestClassifier)
+    from sklearn.linear_model import SGDClassifier
+    from sklearn.naive_bayes import GaussianNB
+    from sklearn.tree import DecisionTreeClassifier
+
+    return {
+        "LR": lambda: (SGDClassifier(loss="log_loss"), True),
+        "NB": lambda: (GaussianNB(), True),
+        "GB": lambda: (HistGradientBoostingClassifier(), False),
+        "RF": lambda: (RandomForestClassifier(n_jobs=1), False),
+        "DT": lambda: (DecisionTreeClassifier(), False),
+    }[name]()
+
+
+def _reservoir_update(res_x, res_y, x, y, seen: int, cap: int, rng):
+    """Classic reservoir sampling over batches: keeps a uniform sample
+    of at most ``cap`` rows with O(cap) memory. Grows until the cap is
+    reached, then switches to randomized replacement."""
+    if res_x is None:
+        res_x = np.empty((0,) + x.shape[1:], dtype=np.float64)
+        res_y = np.empty((0,), dtype=np.asarray(y).dtype)
+    fill = min(cap - len(res_x), len(x))
+    if fill > 0:
+        res_x = np.concatenate([res_x, x[:fill]])
+        res_y = np.concatenate([res_y, y[:fill]])
+        seen += fill
+        x, y = x[fill:], y[fill:]
+    n = len(x)
+    if n:
+        idx = seen + np.arange(n)
+        pos = (rng.random(n) * (idx + 1)).astype(np.int64)
+        replace = pos < cap
+        res_x[pos[replace]] = x[replace]
+        res_y[pos[replace]] = y[replace]
+        seen += n
+    return res_x, res_y, seen
+
+
+def _confusion_metrics(confusion: np.ndarray) -> Dict[str, float]:
+    """accuracy + weighted F1 from an accumulated confusion matrix
+    (streaming twin of sklearn.metrics on the materialized arrays)."""
+    total = confusion.sum()
+    if total == 0:
+        return {}
+    tp = np.diag(confusion).astype(np.float64)
+    support = confusion.sum(axis=1).astype(np.float64)
+    pred_c = confusion.sum(axis=0).astype(np.float64)
+    f1 = np.where(2 * tp + (pred_c - tp) + (support - tp) > 0,
+                  2 * tp / np.maximum(2 * tp + (pred_c - tp) +
+                                      (support - tp), 1e-12), 0.0)
+    return {"accuracy": float(tp.sum() / total),
+            "f1": float((f1 * support).sum() / max(support.sum(), 1e-12))}
 
 
 def _split_xy(features: Any, needs_label: bool,
@@ -91,15 +159,20 @@ class BuilderService:
 
     def create(self, body: Dict[str, Any], tool: str = "sparkml",
                ) -> Tuple[int, Dict[str, Any]]:
-        self._validator.required_fields(
-            body, [TRAIN_FIELD, TEST_FIELD, MODELING_CODE_FIELD,
-                   CLASSIFIERS_FIELD])
+        streaming = bool(body.get(STREAMING_FIELD))
+        required = [TRAIN_FIELD, TEST_FIELD, CLASSIFIERS_FIELD]
+        if not streaming:
+            required.append(MODELING_CODE_FIELD)
+        self._validator.required_fields(body, required)
         train_name = body[TRAIN_FIELD]
         test_name = body[TEST_FIELD]
-        code = body[MODELING_CODE_FIELD]
+        code = body.get(MODELING_CODE_FIELD, "")
         classifiers = body[CLASSIFIERS_FIELD]
         self._validator.existing_finished(train_name)
         self._validator.existing_finished(test_name)
+        eval_name = body.get(EVAL_DATASET_FIELD)
+        if eval_name:
+            self._validator.existing_finished(eval_name)
         if not isinstance(classifiers, list) or not classifiers:
             raise V.HttpError(V.HTTP_NOT_ACCEPTABLE, "invalid classifier")
         for c in classifiers:
@@ -120,11 +193,21 @@ class BuilderService:
                     "testDatasetName": test_name})
             outputs[c] = out
         first = outputs[classifiers[0]]
+        if streaming:
+            label_col = body.get(LABEL_FIELD, LABEL_COLUMN)
+            feat_cols = body.get(FEATURES_FIELD)
+            batch_size = int(body.get(BATCH_SIZE_FIELD, 65536))
+            run = lambda: self._run_streaming(  # noqa: E731
+                train_name, test_name, eval_name, outputs, label_col,
+                feat_cols, batch_size)
+        else:
+            run = lambda: self._run(  # noqa: E731
+                train_name, test_name, code, outputs)
         self._ctx.jobs.submit(
-            first,
-            lambda: self._run(train_name, test_name, code, outputs),
+            first, run,
             description="builder pipeline",
-            parameters={CLASSIFIERS_FIELD: classifiers},
+            parameters={CLASSIFIERS_FIELD: classifiers,
+                        STREAMING_FIELD: streaming},
             mark_finished=False)  # each classifier marks its own output
         return V.HTTP_CREATED, {"result": [
             f"/api/learningOrchestra/v1/builder/{tool}/{out}"
@@ -143,7 +226,8 @@ class BuilderService:
             features_testing = ctx_vars["features_testing"]
             features_evaluation = ctx_vars.get("features_evaluation")
         except KeyError as missing:
-            raise ValueError(
+            raise sandbox.missing_variable_error(
+                ctx_vars, missing.args[0],
                 f"modelingCode must define {missing.args[0]}")
         x_train, y_train = _split_xy(features_training, needs_label=True)
         x_test, _ = _split_xy(features_testing, needs_label=False)
@@ -168,6 +252,120 @@ class BuilderService:
                             exception=repr(e)))
         if errors:
             raise RuntimeError(f"classifier failures: {errors}")
+
+    # ------------------------------------------------------------------
+    # out-of-core path (reference config 4: GBTClassifier on 10M rows
+    # through the Spark Builder, builder_image/builder.py:107-146;
+    # BASELINE.md:30). One pass per classifier over Parquet record
+    # batches (catalog.iter_batches) — RSS stays bounded by
+    # batch_size + the non-incremental reservoir cap.
+    # ------------------------------------------------------------------
+    def _run_streaming(self, train_name: str, test_name: str,
+                       eval_name: Optional[str], outputs: Dict[str, str],
+                       label_col: str, feat_cols: Optional[List[str]],
+                       batch_size: int) -> None:
+        cat = self._ctx.catalog
+        fields = cat.dataset_fields(train_name)
+        if label_col not in fields:
+            raise ValueError(
+                f"streaming builder needs a {label_col!r} column in "
+                f"{train_name} (or pass {LABEL_FIELD!r})")
+        feats = [c for c in (feat_cols or fields)
+                 if c not in ("_id", label_col)]
+        # classes must be known before the first partial_fit: one cheap
+        # label-column-only pass
+        classes: set = set()
+        for batch in cat.iter_batches(train_name, columns=[label_col],
+                                      batch_size=batch_size):
+            classes.update(
+                np.unique(batch.column(0).to_numpy(zero_copy_only=False)))
+        classes_arr = np.array(sorted(classes))
+
+        with ThreadPoolExecutor(max_workers=len(outputs)) as pool:
+            futures = {
+                c: pool.submit(self._fit_one_streaming, c, train_name,
+                               test_name, eval_name, outputs[c],
+                               label_col, feats, classes_arr, batch_size)
+                for c in outputs}
+            errors = {}
+            for c, fut in futures.items():
+                try:
+                    fut.result()
+                except Exception as e:  # noqa: BLE001
+                    errors[c] = e
+                    self._ctx.catalog.append_document(
+                        outputs[c], D.execution_document(
+                            "builder classifier", None,
+                            exception=repr(e)))
+        if errors:
+            raise RuntimeError(f"classifier failures: {errors}")
+
+    def _batches_xy(self, name: str, label_col: str, feats: List[str],
+                    batch_size: int, with_label: bool = True):
+        cols = feats + ([label_col] if with_label else [])
+        for batch in self._ctx.catalog.iter_batches(
+                name, columns=cols, batch_size=batch_size):
+            df = batch.to_pandas()
+            x = df[feats].to_numpy(dtype=np.float64, copy=False)
+            y = df[label_col].to_numpy() if with_label else None
+            yield x, y, df
+
+    def _fit_one_streaming(self, classifier_name: str, train_name: str,
+                           test_name: str, eval_name: Optional[str],
+                           out_name: str, label_col: str,
+                           feats: List[str], classes: np.ndarray,
+                           batch_size: int) -> None:
+        clf, incremental = _make_streaming_classifier(classifier_name)
+        rng = np.random.default_rng(17)
+        res_x = res_y = None
+        seen = 0
+        t0 = time.perf_counter()
+        for x, y, _ in self._batches_xy(train_name, label_col, feats,
+                                        batch_size):
+            if incremental:
+                clf.partial_fit(x, y, classes=classes)
+            else:
+                res_x, res_y, seen = _reservoir_update(
+                    res_x, res_y, x, y, seen, _RESERVOIR_CAP, rng)
+        if not incremental:
+            clf.fit(res_x, res_y)
+        fit_time = time.perf_counter() - t0
+        metrics: Dict[str, Any] = {
+            "classifier": classifier_name,
+            "fitTime": round(fit_time, 6),
+            "streaming": True,
+            "trainedOnSample": (not incremental
+                               and seen > _RESERVOIR_CAP)}
+
+        if eval_name:
+            c = len(classes)
+            cls_index = {v: i for i, v in enumerate(classes)}
+            confusion = np.zeros((c, c), np.int64)
+            for x, y, _ in self._batches_xy(eval_name, label_col, feats,
+                                            batch_size):
+                pred = clf.predict(x)
+                ti = np.array([cls_index.get(v, -1) for v in y])
+                pi = np.array([cls_index.get(v, -1) for v in pred])
+                ok = (ti >= 0) & (pi >= 0)
+                np.add.at(confusion, (ti[ok], pi[ok]), 1)
+            metrics.update(_confusion_metrics(confusion))
+
+        # stream predictions straight back out — never the whole table
+        with self._ctx.catalog.dataset_writer(out_name) as w:
+            import pyarrow as pa
+
+            for x, _, df in self._batches_xy(test_name, label_col, feats,
+                                             batch_size,
+                                             with_label=False):
+                out_df = df.copy()
+                out_df["prediction"] = clf.predict(x)
+                w.write_batch(pa.Table.from_pandas(out_df,
+                                                   preserve_index=False))
+        self._ctx.catalog.update_metadata(out_name, metrics)
+        self._ctx.catalog.mark_finished(out_name)
+        self._ctx.catalog.append_document(out_name, D.execution_document(
+            f"builder {classifier_name} (streaming)", None,
+            extra=metrics))
 
     def _fit_one(self, classifier_name: str, x_train, y_train, x_test,
                  x_eval, y_eval, testing_df, out_name: str) -> None:

@@ -183,7 +183,15 @@ def _inject_epoch_log(ctx, name: str, instance: Any, method: str,
     if "log_fn" not in params:
         return
 
+    seen = {"n": 0}
+
     def log_record(record: Dict[str, Any]) -> None:
+        # bounded stream: every epoch up to 512, then every 16th — a
+        # 10k-epoch fit appends ~1.1k docs, not 10k (job-history DoS cap)
+        i = seen["n"]
+        seen["n"] = i + 1
+        if i >= 512 and i % 16 != 0:
+            return
         try:
             ctx.catalog.append_document(name, {"epochRecord": record})
         except Exception:  # noqa: BLE001 — logging must never sink a fit

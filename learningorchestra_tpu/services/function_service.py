@@ -103,8 +103,10 @@ class FunctionService:
             ctx_vars, stdout = sandbox.run_user_code(
                 code, treated, mode=self._ctx.config.sandbox_mode)
             if RESPONSE_VARIABLE not in ctx_vars:
-                raise ValueError(
-                    f"function must assign a {RESPONSE_VARIABLE!r} variable")
+                raise sandbox.missing_variable_error(
+                    ctx_vars, RESPONSE_VARIABLE,
+                    f"function must assign a {RESPONSE_VARIABLE!r} "
+                    "variable")
             result = ctx_vars[RESPONSE_VARIABLE]
             self._ctx.artifacts.save(result, name, type_string)
             self._ctx.catalog.append_document(

@@ -103,6 +103,13 @@ class JobManager:
 
         future = self._pool.submit(run)
         with self._lock:
+            # prune finished entries so a long-lived server doesn't
+            # leak a Future per job (results live in the catalog; wait()
+            # on a pruned job returns immediately)
+            done = [k for k, f in self._futures.items()
+                    if f.done() and k != name]
+            for k in done:
+                del self._futures[k]
             self._futures[name] = future
         return future
 

@@ -169,7 +169,13 @@ def eval_hash_expressions(exprs: List[str], trusted: bool = False,
     lines = [e.replace("#", f"__lo_hash_{i} = ", 1)
              for i, e in enumerate(exprs)]
     g, _ = run_user_code("\n".join(lines), trusted=trusted, mode=mode)
-    return [g[f"__lo_hash_{i}"] for i in range(len(exprs))]
+    out = []
+    for i, expr in enumerate(exprs):
+        var = f"__lo_hash_{i}"
+        if var not in g:
+            raise missing_variable_error(g, var, f"'#' expression {expr!r}")
+        out.append(g[var])
+    return out
 
 
 def eval_hash_expression(class_code: str, trusted: bool = False,
@@ -206,6 +212,31 @@ _PICKLE_CLASS_PREFIX = "learningorchestra_tpu.models.tf_compat"
 
 class _Unencodable(Exception):
     pass
+
+
+# reserved ctx key listing child variables that failed the typed
+# encoding (live objects, exotic types); consumers use it via
+# missing_variable_error so the user sees WHY a result went missing
+DROPPED_KEY = "__lo_dropped__"
+
+
+def missing_variable_error(ctx_vars: Dict[str, Any], var: str,
+                           what: str) -> Exception:
+    """Typed error for ``var`` absent from a sandbox result — names the
+    variables the jail dropped (unencodable live objects) and points at
+    the escalation path, instead of a bare 'must assign' message."""
+    dropped = ctx_vars.get(DROPPED_KEY) or []
+    if var in dropped:
+        return TypeError(
+            f"{what}: variable {var!r} was assigned but could not "
+            f"cross the subprocess-sandbox boundary (only primitives, "
+            f"ndarrays, DataFrames, and tf_compat specs do); set "
+            f"sandbox_mode='restricted' or 'trusted' to return live "
+            f"objects")
+    hint = (f" (unrelated variable(s) {dropped} were dropped at the "
+            f"sandbox boundary)" if dropped else "")
+    return ValueError(f"{what}: variable {var!r} was never "
+                      f"assigned{hint}")
 
 
 def _encode_value(v: Any, depth: int = 0) -> Any:
@@ -391,6 +422,10 @@ def _run_in_subprocess(code: str, parameters: Optional[Dict[str, Any]],
                 f"{err.get('traceback', '')}")
         ctx_vars = {k: _decode_value(v)
                     for k, v in envelope.get("vars", {}).items()}
+        if envelope.get("dropped"):
+            # surface vars that could not cross the boundary so a
+            # missing `response` says WHY (advisor round-2 finding)
+            ctx_vars[DROPPED_KEY] = sorted(envelope["dropped"])
         return ctx_vars, envelope.get("stdout", "")
     finally:
         shutil.rmtree(scratch, ignore_errors=True)
@@ -465,7 +500,15 @@ def _install_guard(scratch: str, read_prefixes: Tuple[str, ...]) -> None:
                                   "urllib.", "http.")):
             raise PermissionError(f"sandbox: {event} denied")
         elif event in _GUARD_WRITE_EVENTS:
-            check_path(args[0] if args else None, True)
+            # multi-path events (os.rename/os.replace, os.link,
+            # os.symlink, shutil.move) pass (src, dst, ...): every
+            # path-typed argument must stay in the jail or renaming a
+            # scratch file onto an outside path is an arbitrary write
+            # escape. Non-path args (modes, dir_fds, utime tuples) are
+            # skipped by type, not position.
+            for a in (args or ()):
+                if isinstance(a, (str, bytes, os.PathLike)):
+                    check_path(a, True)
         elif event in _GUARD_READ_EVENTS:
             check_path(args[0] if args else None, False)
 

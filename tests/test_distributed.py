@@ -155,6 +155,115 @@ _TRAIN = textwrap.dedent("""
 """)
 
 
+def test_two_process_entry_point_serves_rest(tmp_path):
+    """The packaged launcher (`lo-server` / `python -m
+    learningorchestra_tpu`, docs/DEPLOY.md): two processes form a pod
+    via CLI flags; the coordinator serves REST and answers /health
+    with the pod topology; a /train round-trips over real HTTP."""
+    import json
+    import shutil
+    import time
+    import urllib.request
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        coord_port = s.getsockname()[1]
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        rest_port = s.getsockname()[1]
+    home = str(tmp_path / "shared_home")
+    env = {"PATH": "/usr/bin:/bin:/opt/venv/bin",
+           "JAX_PLATFORMS": "cpu",
+           "XLA_FLAGS": "--xla_force_host_platform_device_count=2",
+           "PYTHONPATH": "/root/repo",
+           "LO_MESH_SHAPE": "auto", "LO_COMPUTE_DTYPE": "float32"}
+    launcher = shutil.which("lo-server", path=env["PATH"])
+    base_cmd = [launcher] if launcher else \
+        [sys.executable, "-m", "learningorchestra_tpu"]
+    procs = []
+    for pid in range(2):
+        procs.append(subprocess.Popen(
+            base_cmd + ["--home", home, "--host", "127.0.0.1",
+                        "--port", str(rest_port),
+                        "--coordinator", f"127.0.0.1:{coord_port}",
+                        "--num-hosts", "2", "--host-id", str(pid)],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, env=env))
+    base = f"http://127.0.0.1:{rest_port}"
+    api = "/api/learningOrchestra/v1"
+
+    def req(method, path, body=None, timeout=30):
+        data = json.dumps(body).encode() if body is not None else None
+        r = urllib.request.Request(
+            base + path, data=data, method=method,
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(r, timeout=timeout) as resp:
+            return resp.status, json.loads(resp.read())
+
+    try:
+        health = None
+        deadline = time.time() + 240
+        while time.time() < deadline:
+            if any(p.poll() is not None for p in procs):
+                outs = [p.communicate()[0].decode(errors="replace")
+                        for p in procs]
+                raise AssertionError(f"a pod process died:\n{outs}")
+            try:
+                _, health = req("GET", "/health", timeout=5)
+                break
+            except OSError:
+                time.sleep(0.5)
+        assert health is not None, "REST never came up"
+        assert health["processCount"] == 2, health
+        assert health["globalDevices"] == 4, health
+
+        st, body = req("POST", api + "/function/python", {
+            "name": "ep_data", "functionParameters": {},
+            "function": ("import numpy as np\n"
+                         "rng = np.random.default_rng(0)\n"
+                         "x = rng.normal(size=(32, 8)).astype"
+                         "(np.float32)\n"
+                         "y = (x[:, 0] > 0).astype(np.int32)\n"
+                         "response = {'x': x, 'y': y}\n")})
+        assert st == 201, body
+
+        def poll(uri, timeout=240):
+            t0 = time.time()
+            while time.time() - t0 < timeout:
+                st2, b2 = req("GET", uri + "?limit=1")
+                if st2 == 200 and b2["metadata"].get("finished"):
+                    return b2
+                time.sleep(0.3)
+            raise AssertionError(f"timeout polling {uri}")
+
+        poll(body["result"])
+        st, body = req("POST", api + "/model/tensorflow", {
+            "modelName": "ep_model",
+            "modulePath": "learningorchestra_tpu.models",
+            "class": "NeuralModel",
+            "classParameters": {"layer_configs": [
+                {"kind": "dense", "units": 4, "activation": "relu"},
+                {"kind": "dense", "units": 2,
+                 "activation": "softmax"}]}})
+        assert st == 201, body
+        poll(body["result"])
+        st, body = req("POST", api + "/train/tensorflow", {
+            "name": "ep_train", "modelName": "ep_model",
+            "method": "fit",
+            "methodParameters": {"x": "$ep_data.x", "y": "$ep_data.y",
+                                 "epochs": 1, "batch_size": 8}})
+        assert st == 201, body
+        poll(body["result"])
+    finally:
+        for p in procs:
+            p.terminate()
+        for p in procs:
+            try:
+                p.communicate(timeout=20)
+            except subprocess.TimeoutExpired:
+                p.kill()
+                p.communicate()
+
+
 def test_two_process_rest_train_replay(tmp_path):
     """A /train REST job on the coordinator fans out to the worker via
     the HostBridge and the fit jits over the GLOBAL 4-device mesh."""

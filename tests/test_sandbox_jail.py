@@ -108,6 +108,63 @@ def test_jail_blocks_write_outside_scratch(tmp_config, tmp_path):
     assert not target.exists()
 
 
+def test_jail_blocks_rename_out_of_scratch(tmp_config, tmp_path):
+    """Write escape via multi-path events: create a file INSIDE scratch
+    then os.rename / os.replace / shutil.move it onto an outside path.
+    The hook must check every path argument, not just args[0]
+    (advisor round-2 high finding)."""
+    target = tmp_path / "renamed_out"
+    for fn in ("os_mod.rename", "os_mod.replace"):
+        code = (
+            "cls = [c for c in ().__class__.__base__.__subclasses__()"
+            " if c.__name__ == 'BuiltinImporter'][0]\n"
+            "io_mod = cls().load_module('io')\n"
+            "os_mod = cls().load_module('os')\n"
+            "f = io_mod.open('inside.txt', 'w')\n"
+            "f.write('x')\n"
+            "f.close()\n"
+            f"{fn}('inside.txt', '{target}')\n"
+            "response = 1\n")
+        with pytest.raises(PermissionError, match="denied"):
+            sandbox.run_user_code(code, mode="subprocess")
+        assert not target.exists()
+
+
+def test_jail_blocks_symlink_and_link_out(tmp_config, tmp_path):
+    target = tmp_path / "linked_out"
+    for call in (f"os_mod.link('inside.txt', '{target}')",
+                 f"os_mod.symlink('inside.txt', '{target}')"):
+        code = (
+            "cls = [c for c in ().__class__.__base__.__subclasses__()"
+            " if c.__name__ == 'BuiltinImporter'][0]\n"
+            "io_mod = cls().load_module('io')\n"
+            "os_mod = cls().load_module('os')\n"
+            "f = io_mod.open('inside.txt', 'w')\n"
+            "f.write('x')\n"
+            "f.close()\n"
+            f"{call}\n"
+            "response = 1\n")
+        with pytest.raises(PermissionError, match="denied"):
+            sandbox.run_user_code(code, mode="subprocess")
+        assert not target.exists()
+
+
+def test_jail_dropped_vars_surface_reason(tmp_config):
+    """A live object assigned to `response` can't cross the boundary;
+    the error must say so and point at the escalation path instead of
+    the misleading 'must assign a response variable' (advisor round-2
+    medium finding)."""
+    g, _ = sandbox.run_user_code(
+        "class Foo:\n"
+        "    pass\n"
+        "response = Foo()\n", mode="subprocess")
+    assert "response" in g.get(sandbox.DROPPED_KEY, [])
+    err = sandbox.missing_variable_error(
+        g, "response", "function must assign a 'response' variable")
+    assert isinstance(err, TypeError)
+    assert "response" in str(err) and "restricted" in str(err)
+
+
 def test_jail_import_allowlist_still_applies(tmp_config):
     with pytest.raises(ImportError):
         sandbox.run_user_code("import os\nresponse = 1",

@@ -122,6 +122,10 @@ def test_save_load_generate(tmp_path):
     gen = loaded.generate(x[0, :4], max_new_tokens=4)
     assert gen.shape == (1, 8)
     assert (gen[:, :4] == x[0, :4]).all()
+    # max_new_tokens=0 must return the prompt untouched (the prefill
+    # buf.at[:, s] set would clamp onto the final prompt column)
+    gen0 = loaded.generate(x[0, :4], max_new_tokens=0)
+    assert (gen0 == x[0, :4][None]).all()
 
 
 def test_flash_sharded_fit(tmp_path):
