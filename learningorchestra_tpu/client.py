@@ -171,11 +171,16 @@ class Tool:
 
     def run_function(self, name: str, function: str,
                      parameters: Optional[Dict[str, Any]] = None,
-                     description: str = "") -> Any:
-        return self.post({
-            "name": name, "function": function,
-            "functionParameters": parameters or {},
-            "description": description})
+                     description: str = "",
+                     sandbox_mode: Optional[str] = None) -> Any:
+        """``sandbox_mode`` escalates this request up to the server's
+        ceiling (needed to pass live objects like stored models)."""
+        body = {"name": name, "function": function,
+                "functionParameters": parameters or {},
+                "description": description}
+        if sandbox_mode:
+            body["sandboxMode"] = sandbox_mode
+        return self.post(body)
 
     def run_projection(self, input_dataset: str, output_dataset: str,
                        fields: List[str]) -> Any:
@@ -190,12 +195,16 @@ class Tool:
         return self.post({"datasetName": dataset_name, "types": types})
 
     def run_builder(self, train_dataset: str, test_dataset: str,
-                    modeling_code: str, classifiers: List[str]) -> Any:
+                    modeling_code: str, classifiers: List[str],
+                    **extra: Any) -> Any:
+        """``extra`` passes the out-of-core knobs through:
+        ``streaming=True``, ``labelColumn=``, ``featureColumns=``,
+        ``evaluationDatasetName=``, ``batchSize=``."""
         return self.post({
             "trainDatasetName": train_dataset,
             "testDatasetName": test_dataset,
             "modelingCode": modeling_code,
-            "classifiersList": classifiers})
+            "classifiersList": classifiers, **extra})
 
 
 _TOOL_ROUTES = {

@@ -76,6 +76,16 @@ class Config:
     # exec, reference-equivalent behavior, code_execution.py:169-196).
     sandbox_mode: str = dataclasses.field(
         default_factory=lambda: os.environ.get("LO_SANDBOX", "subprocess"))
+    # Per-request escalation ceiling: a Function POST may carry
+    # "sandboxMode" up to this trust level (subprocess < restricted <
+    # trusted) — the reference's live-object Function flow
+    # (code_execution.py:169-196) needs in-process execution. Default
+    # EMPTY = no escalation beyond sandbox_mode: the in-process modes
+    # are escapable by design (sandbox.py:19-24), so opening them to
+    # unauthenticated API callers must be an explicit operator opt-in
+    # (LO_SANDBOX_MAX=restricted|trusted).
+    sandbox_max_mode: str = dataclasses.field(
+        default_factory=lambda: os.environ.get("LO_SANDBOX_MAX", ""))
     # subprocess-jail resource limits
     sandbox_cpu_seconds: int = dataclasses.field(
         default_factory=lambda: int(os.environ.get(

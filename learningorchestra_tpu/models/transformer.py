@@ -449,6 +449,20 @@ class LanguageModel:
                 if get_config().compute_dtype == "bfloat16" else jnp.float32
             mesh = self._mesh()
             seq_axis = self._resolved_attention() in ("ring", "ulysses")
+            def flops_floor(batch):
+                # analytic train-step lower bound (6 flops per matmul
+                # param per token + the causal-attention quad term):
+                # pallas_call is a custom call XLA's cost analysis
+                # counts as ZERO flops, so the flash path would
+                # otherwise report a deflated MFU. The embedding table
+                # is excluded — its lookup is a gather, not a matmul
+                # (lm_head is a separate, counted matrix).
+                b, s = batch["x"].shape[:2]
+                matmul_params = (self.num_params()
+                                 - self.vocab_size * self.d_model)
+                attn = 6.0 * self.n_layers * b * s * s * self.d_model
+                return 6.0 * max(matmul_params, 0) * b * s + attn
+
             self._engine = engine_lib.Engine(
                 apply_fn=self._apply_fn,
                 loss_fn=next_token_loss(self.aux_coef),
@@ -459,7 +473,8 @@ class LanguageModel:
                 param_rules=sharding_lib.TRANSFORMER_RULES,
                 batch_sharding=jax.sharding.NamedSharding(
                     mesh, sharding_lib.batch_spec(mesh, seq_axis=seq_axis)),
-                predict_transform=lambda outputs: outputs[0])
+                predict_transform=lambda outputs: outputs[0],
+                flops_floor_fn=flops_floor)
         return self._engine
 
     # ------------------------------------------------------------------

@@ -38,14 +38,23 @@ _KERAS_VAR_ORDERS = {
     "batchnorm": ("scale", "bias", "mean", "var"),  # gamma/beta/mm/mv
 }
 
+# our layer kind -> the group-name prefix keras auto-assigns the twin
+# layer ("dense", "dense_1", ... in MODEL order within a kind). h5
+# group iteration is alphabetical with no order attribute, so layers
+# are matched kind-by-kind, not positionally across kinds.
+_KERAS_NAME_PREFIX = {
+    "dense": "dense",
+    "conv2d": "conv2d",
+    "embedding": "embedding",
+    "batchnorm": "batch_normalization",
+}
+
 
 def flatten_params(tree: Any, prefix: str = "") -> Dict[str, np.ndarray]:
     out: Dict[str, np.ndarray] = {}
     if isinstance(tree, dict):
         for k in sorted(tree):
-            out.update(flatten_params(tree[k],
-                                      f"{prefix}{k}/" if prefix or True
-                                      else k))
+            out.update(flatten_params(tree[k], f"{prefix}{k}/"))
     else:
         out[prefix[:-1]] = np.asarray(tree)
     return out
@@ -109,10 +118,11 @@ def _natural_key(s: str):
     return [int(t) if t.isdigit() else t for t in re.split(r"(\d+)", s)]
 
 
-def read_keras_h5(path: str) -> List[List[np.ndarray]]:
-    """Ordered per-layer variable lists from a Keras 3 weights file
+def read_keras_h5(path: str) -> List[Tuple[str, List[np.ndarray]]]:
+    """(group_name, variable list) pairs from a Keras 3 weights file
     (``/layers/<name>/vars/<i>``; legacy tf.keras files use per-layer
-    top groups with ``<name>/<var>:0`` datasets)."""
+    top groups with ``<name>/<var>:0`` datasets), natural-sorted by
+    group name. Parameter-free layers (flatten, pooling) are dropped."""
     import h5py
 
     layers: List[Tuple[str, List[np.ndarray]]] = []
@@ -136,7 +146,7 @@ def read_keras_h5(path: str) -> List[List[np.ndarray]]:
             collect(grp)
             if vals:
                 layers.append((lname, vals))
-    return [v for _, v in layers]
+    return layers
 
 
 def load_keras_h5_into_sequential(layer_configs, params: Dict[str, Any],
@@ -144,12 +154,23 @@ def load_keras_h5_into_sequential(layer_configs, params: Dict[str, Any],
                                   ) -> Tuple[Dict[str, Any],
                                              Dict[str, Any]]:
     """Map a real Keras Sequential weights file onto the tf_compat
-    Sequential's flax params, layer-by-layer in order. Returns new
-    (params, model_state)."""
+    Sequential's flax params. h5 groups sort ALPHABETICALLY (keras
+    writes no order attribute), so layers are matched by KIND: within
+    a kind keras numbers groups in model order (``conv2d``,
+    ``conv2d_1``, ...), which natural sort preserves — each of our
+    parameterized layers consumes the next unused group of its kind's
+    keras name prefix. Returns new (params, model_state)."""
     h5_layers = read_keras_h5(path)
+    by_kind: Dict[str, List[List[np.ndarray]]] = {}
+    matched = 0
+    for gname, vals in h5_layers:
+        for kind, prefix in _KERAS_NAME_PREFIX.items():
+            if re.fullmatch(re.escape(prefix) + r"(_\d+)?", gname):
+                by_kind.setdefault(kind, []).append(vals)
+                break
     params = jax.tree_util.tree_map(np.asarray, params)
     state = jax.tree_util.tree_map(np.asarray, dict(model_state or {}))
-    li = 0
+    taken: Dict[str, int] = {}
     for i, cfg in enumerate(layer_configs):
         kind = cfg["kind"]
         name = f"{kind}_{i}"
@@ -159,12 +180,16 @@ def load_keras_h5_into_sequential(layer_configs, params: Dict[str, Any],
             raise ValueError(
                 f"h5 import does not support layer kind {kind!r} "
                 f"(layer {i}); export/import via npz instead")
-        if li >= len(h5_layers):
+        pool = by_kind.get(kind, [])
+        pos = taken.get(kind, 0)
+        if pos >= len(pool):
             raise ValueError(
-                f"h5 file has {len(h5_layers)} parameterized layers but "
-                f"the model needs more (at {name})")
-        vals = h5_layers[li]
-        li += 1
+                f"h5 file has {len(pool)} "
+                f"{_KERAS_NAME_PREFIX[kind]!r} layer(s) but the model "
+                f"needs more (at {name})")
+        vals = pool[pos]
+        taken[kind] = pos + 1
+        matched += 1
         order = _KERAS_VAR_ORDERS[kind]
         if len(vals) != len(order):
             raise ValueError(
@@ -185,10 +210,17 @@ def load_keras_h5_into_sequential(layer_configs, params: Dict[str, Any],
                 if pname in params[name]:
                     params[name][pname] = _check(
                         name, pname, params[name][pname], arr)
-    if li != len(h5_layers):
+    total = sum(len(v) for v in by_kind.values())
+    if matched != total:
         raise ValueError(
-            f"h5 file has {len(h5_layers) - li} trailing layer(s) the "
+            f"h5 file has {total - matched} parameterized layer(s) the "
             f"model does not declare")
+    if matched != len(h5_layers):
+        unknown = [g for g, _ in h5_layers
+                   if not any(re.fullmatch(re.escape(p) + r"(_\d+)?", g)
+                              for p in _KERAS_NAME_PREFIX.values())]
+        raise ValueError(
+            f"h5 file has unsupported keras layer group(s): {unknown}")
     return params, state
 
 

@@ -60,7 +60,8 @@ class Engine:
                  param_rules=None,
                  fsdp: bool = True,
                  batch_sharding=None,
-                 predict_transform: Optional[Callable] = None):
+                 predict_transform: Optional[Callable] = None,
+                 flops_floor_fn: Optional[Callable] = None):
         self._apply_fn = apply_fn
         self._loss_fn = loss_fn
         self._optimizer = optimizer
@@ -82,6 +83,11 @@ class Engine:
         self._predict_transform = predict_transform
         self._step_flops: Optional[float] = None
         self._flops_key = None
+        # analytic lower bound on per-step flops given a batch dict —
+        # XLA cost analysis reports ZERO flops for custom calls
+        # (pallas_call), so a flash-attention model's MFU would be
+        # deflated without it
+        self._flops_floor_fn = flops_floor_fn
 
     # ------------------------------------------------------------------
     def init_state(self, params, model_state=None) -> TrainState:
@@ -262,6 +268,12 @@ class Engine:
             self._step_flops = flops if flops > 0 else 0.0
         except Exception:  # noqa: BLE001 — accounting must never sink a run
             self._step_flops = 0.0
+        if self._flops_floor_fn is not None:
+            try:
+                floor = float(self._flops_floor_fn(batch))
+                self._step_flops = max(self._step_flops or 0.0, floor)
+            except Exception:  # noqa: BLE001
+                pass
 
     def _should_scan(self, batcher: data_lib.ArrayBatcher) -> bool:
         from learningorchestra_tpu.config import get_config

@@ -18,7 +18,7 @@ reference-equivalent trust.
 
 from __future__ import annotations
 
-from typing import Any, Dict, Tuple
+from typing import Any, Dict, Optional, Tuple
 
 from learningorchestra_tpu.catalog import documents as D
 from learningorchestra_tpu.services import sandbox
@@ -28,7 +28,36 @@ NAME_FIELD = "name"
 DESCRIPTION_FIELD = "description"
 FUNCTION_FIELD = "function"
 FUNCTION_PARAMETERS_FIELD = "functionParameters"
+SANDBOX_MODE_FIELD = "sandboxMode"
 RESPONSE_VARIABLE = "response"
+
+# trust ordering for per-request escalation (config.sandbox_max_mode
+# is the ceiling; config.sandbox_mode the default)
+_TRUST_ORDER = {"subprocess": 0, "restricted": 1, "trusted": 2}
+
+
+def resolve_sandbox_mode(config, requested: str | None) -> str:
+    """The mode a request actually runs under: the config default, or
+    the requested escalation if it stays at or below the operator's
+    ceiling (406 otherwise). With no explicit ``sandbox_max_mode``
+    the ceiling IS ``sandbox_mode`` — escalation past the default
+    jail is an operator opt-in, never an API-caller choice."""
+    if not requested:
+        return config.sandbox_mode
+    if requested not in _TRUST_ORDER:
+        raise V.HttpError(
+            V.HTTP_NOT_ACCEPTABLE,
+            f"invalid sandboxMode {requested!r} (one of "
+            f"{sorted(_TRUST_ORDER)})")
+    base = _TRUST_ORDER.get(config.sandbox_mode, 0)
+    ceiling = max(_TRUST_ORDER.get(config.sandbox_max_mode, base), base)
+    if _TRUST_ORDER[requested] > ceiling:
+        raise V.HttpError(
+            V.HTTP_NOT_ACCEPTABLE,
+            f"sandboxMode {requested!r} exceeds this server's ceiling "
+            f"(sandbox_max_mode={config.sandbox_max_mode or 'unset'}); "
+            f"set LO_SANDBOX_MAX to allow it")
+    return requested
 
 
 def fetch_function_code(function: str) -> str:
@@ -60,13 +89,17 @@ class FunctionService:
         function = body[FUNCTION_FIELD]
         parameters = body[FUNCTION_PARAMETERS_FIELD] or {}
         description = body.get(DESCRIPTION_FIELD, "")
+        mode = resolve_sandbox_mode(self._ctx.config,
+                                    body.get(SANDBOX_MODE_FIELD))
         type_string = f"function/{tool}"
         self._ctx.catalog.create_collection(name, type_string, {
             D.FUNCTION_FIELD: function,
             D.FUNCTION_PARAMETERS_FIELD: parameters,
             D.DESCRIPTION_FIELD: description,
+            SANDBOX_MODE_FIELD: mode,  # boot requeue replays the same mode
         })
-        self._submit(name, type_string, function, parameters, description)
+        self._submit(name, type_string, function, parameters, description,
+                     mode=mode)
         return V.HTTP_CREATED, {
             "result": f"/api/learningOrchestra/v1/function/{tool}/{name}"}
 
@@ -78,12 +111,15 @@ class FunctionService:
             FUNCTION_PARAMETERS_FIELD,
             meta.get(D.FUNCTION_PARAMETERS_FIELD)) or {}
         description = body.get(DESCRIPTION_FIELD, "")
+        mode = resolve_sandbox_mode(self._ctx.config,
+                                    body.get(SANDBOX_MODE_FIELD))
         self._ctx.catalog.update_metadata(
             name, {D.FUNCTION_FIELD: function,
                    D.FUNCTION_PARAMETERS_FIELD: parameters,
+                   SANDBOX_MODE_FIELD: mode,
                    D.FINISHED_FIELD: False})
         self._submit(name, meta[D.TYPE_FIELD], function, parameters,
-                     description)
+                     description, mode=mode)
         return V.HTTP_SUCCESS, {
             "result": f"/api/learningOrchestra/v1/function/{tool}/{name}"}
 
@@ -96,12 +132,13 @@ class FunctionService:
 
     # ------------------------------------------------------------------
     def _submit(self, name: str, type_string: str, function: str,
-                parameters: Dict[str, Any], description: str) -> None:
+                parameters: Dict[str, Any], description: str,
+                mode: Optional[str] = None) -> None:
         def run():
             code = fetch_function_code(function)
             treated = self._ctx.params.treat(parameters)
             ctx_vars, stdout = sandbox.run_user_code(
-                code, treated, mode=self._ctx.config.sandbox_mode)
+                code, treated, mode=mode or self._ctx.config.sandbox_mode)
             if RESPONSE_VARIABLE not in ctx_vars:
                 raise sandbox.missing_variable_error(
                     ctx_vars, RESPONSE_VARIABLE,

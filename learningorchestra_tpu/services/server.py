@@ -126,10 +126,20 @@ class Api:
                     requeued.append(name)
                 elif verb == "function" and \
                         meta.get(D.FUNCTION_FIELD) is not None:
+                    from learningorchestra_tpu.services import (
+                        function_service as fsvc)
+
+                    # replay under the originally granted mode — but
+                    # re-resolve against the CURRENT ceiling, so a
+                    # lowered LO_SANDBOX_MAX is honored (failure lands
+                    # in the catch below as a typed requeue error)
+                    mode = fsvc.resolve_sandbox_mode(
+                        self.ctx.config,
+                        meta.get(fsvc.SANDBOX_MODE_FIELD))
                     self.function._submit(
                         name, type_string, meta[D.FUNCTION_FIELD],
                         meta.get(D.FUNCTION_PARAMETERS_FIELD) or {},
-                        meta.get(D.DESCRIPTION_FIELD, ""))
+                        meta.get(D.DESCRIPTION_FIELD, ""), mode=mode)
                     requeued.append(name)
                 else:
                     self.ctx.catalog.append_document(

@@ -209,6 +209,78 @@ def test_restricted_unpickler_blocks_gadgets(tmp_config):
         sandbox._safe_load_envelope(raw2)
 
 
+def test_stored_model_through_function_capability_seam(tmp_config):
+    """The reference's live-object Function flow (a stored model passed
+    as a `$` parameter, code_execution.py:169-196): in the default
+    subprocess jail the live object cannot cross and the job fails
+    with a typed pointer at the escalation path; a per-request
+    `sandboxMode: "restricted"` (within the operator ceiling) runs it
+    in-process and succeeds; `trusted` is above the default ceiling
+    and is rejected at POST time with 406."""
+    import dataclasses
+
+    import numpy as np
+
+    from learningorchestra_tpu.services import validators as V
+    from learningorchestra_tpu.services.context import ServiceContext
+    from learningorchestra_tpu.services.function_service import (
+        FunctionService)
+    from learningorchestra_tpu.models.neural import NeuralModel
+
+    # escalation is an operator opt-in: with the DEFAULT ceiling even
+    # "restricted" is refused at POST time
+    ctx0 = ServiceContext(tmp_config)
+    try:
+        with pytest.raises(V.HttpError) as exc0:
+            FunctionService(ctx0).create({
+                "name": "no_opt_in", "function": "response = 1",
+                "functionParameters": {}, "sandboxMode": "restricted"})
+        assert exc0.value.status == V.HTTP_NOT_ACCEPTABLE
+    finally:
+        ctx0.close()
+
+    ctx = ServiceContext(dataclasses.replace(
+        tmp_config, sandbox_max_mode="restricted"))
+    try:
+        model = NeuralModel([{"kind": "dense", "units": 2,
+                              "activation": "softmax"}], name="m")
+        model._build_params(np.zeros((1, 4), np.float32))
+        ctx.catalog.create_collection("stored_model", "model/tensorflow",
+                                      {})
+        ctx.artifacts.save(model, "stored_model", "model/tensorflow")
+        ctx.catalog.mark_finished("stored_model")
+        fs = FunctionService(ctx)
+        code = "response = float(model.num_params())"
+
+        # 1. default jail: live object cannot cross -> typed error
+        fs.create({"name": "live_default", "function": code,
+                   "functionParameters": {"model": "$stored_model"}})
+        ctx.jobs.wait("live_default", timeout=120)
+        docs = ctx.catalog.get_documents("live_default")
+        errs = [d.get("exception") for d in docs if d.get("exception")]
+        assert errs and "restricted" in errs[0] and "TypeError" in errs[0]
+
+        # 2. per-request escalation to restricted (within the default
+        # ceiling) runs the same flow in-process
+        fs.create({"name": "live_restricted", "function": code,
+                   "functionParameters": {"model": "$stored_model"},
+                   "sandboxMode": "restricted"})
+        ctx.jobs.wait("live_restricted", timeout=120)
+        assert ctx.catalog.get_metadata("live_restricted")["finished"]
+        result = ctx.artifacts.load("live_restricted", "function/python")
+        assert result == float(model.num_params())
+
+        # 3. trusted exceeds the default ceiling -> 406 at POST time
+        with pytest.raises(V.HttpError) as exc:
+            fs.create({"name": "live_trusted", "function": code,
+                       "functionParameters": {"model": "$stored_model"},
+                       "sandboxMode": "trusted"})
+        assert exc.value.status == V.HTTP_NOT_ACCEPTABLE
+        assert "ceiling" in exc.value.message
+    finally:
+        ctx.close()
+
+
 def test_jail_function_service_end_to_end(tmp_config):
     """FunctionService under the default (subprocess) mode: jobs fail
     closed on escape attempts and succeed on real work."""

@@ -333,6 +333,64 @@ def test_tune_grid_search_pipeline(server):
     assert meta["finished"]
 
 
+def test_resnet50_transfer_tune_pipeline(server, tmp_path):
+    """BASELINE config 5 end-to-end: a pretrained ResNet-50 (weights
+    loaded from a real npz export, not silent random init) created by
+    module path through /model, then a learning-rate sweep through
+    /tune — the reference's transfer-learn + GridSearchCV flow."""
+    import os
+
+    from learningorchestra_tpu.models.tf_compat.keras import applications
+
+    # "pretrained" artifact: an exported ResNet-50 weight file
+    pre = applications.ResNet50(classes=3, input_shape=(32, 32, 3))
+    pre._build_params(np.zeros((1, 32, 32, 3), np.float32))
+    weights_path = os.path.join(tmp_path, "resnet50_pretrained.npz")
+    pre.save_weights(weights_path)
+
+    st, body = _call(server, "POST", f"{API}/function/python", body={
+        "name": "rn_data", "functionParameters": {},
+        "function": ("import numpy as np\n"
+                     "rng = np.random.default_rng(0)\n"
+                     "x = rng.normal(size=(12, 32, 32, 3))"
+                     ".astype(np.float32)\n"
+                     "y = rng.integers(0, 3, size=12).astype(np.int32)\n"
+                     "response = {'x': x, 'y': y}\n")})
+    assert st == 201, body
+    _poll_finished(server, f"{API}/function/python/rn_data")
+
+    st, body = _call(server, "POST", f"{API}/model/tensorflow", body={
+        "modelName": "rn_model",
+        "modulePath": "tensorflow.keras.applications",
+        "class": "ResNet50",
+        "classParameters": {"classes": 3, "weights": weights_path,
+                            "input_shape": [32, 32, 3]}})
+    assert st == 201, body
+    _poll_finished(server, f"{API}/model/tensorflow/rn_model", timeout=300)
+
+    st, body = _call(server, "POST", f"{API}/model/tensorflow", body={
+        "modelName": "rn_sweep",
+        "modulePath": "learningorchestra_tpu.models",
+        "class": "GridSearch",
+        "classParameters": {"estimator": "$rn_model",
+                            "param_grid": {"learning_rate": [1e-3, 1e-4]},
+                            "validation_split": 0.25}})
+    assert st == 201, body
+    _poll_finished(server, f"{API}/model/tensorflow/rn_sweep")
+
+    st, body = _call(server, "POST", f"{API}/tune/tensorflow", body={
+        "name": "rn_tune", "modelName": "rn_sweep", "method": "fit",
+        "methodParameters": {"x": "$rn_data.x", "y": "$rn_data.y",
+                             "epochs": 1, "batch_size": 4}})
+    assert st == 201, body
+    meta = _poll_finished(server, f"{API}/tune/tensorflow/rn_tune",
+                          timeout=900)
+    assert meta["finished"]
+    sweep = server.api.ctx.artifacts.load("rn_tune", "tune/tensorflow")
+    assert sweep.best_params_ is not None
+    assert len(sweep.cv_results_["params"]) == 2
+
+
 def test_train_checkpoint_and_patch_resume(server):
     """checkpoint: true saves per-epoch orbax steps under the execution
     name; PATCH re-runs the same execution and resumes from them."""

@@ -110,6 +110,37 @@ def test_real_keras_h5_import_matches_tf_predictions(tmp_path, f32_config):
     np.testing.assert_allclose(got, want, atol=1e-5)
 
 
+def test_real_keras_h5_mixed_kinds_match_by_kind(tmp_path, f32_config):
+    """h5 groups iterate ALPHABETICALLY (batch_normalization < conv2d
+    < dense), not in model order — the loader must match layers by
+    kind, or a [Conv2D, BatchNorm, Dense] model would be handed
+    batchnorm's variables for the conv layer."""
+    keras = pytest.importorskip("keras")
+    from keras import layers
+
+    km = keras.Sequential([
+        layers.Input((8, 8, 3)),
+        layers.Conv2D(4, 3, padding="same", activation="relu"),
+        layers.Flatten(),
+        layers.Dense(6, activation="relu"),
+        layers.Dense(2)])
+    x = np.random.default_rng(4).normal(
+        size=(3, 8, 8, 3)).astype(np.float32)
+    want = np.asarray(km(x))
+    path = str(tmp_path / "mixed.weights.h5")
+    km.save_weights(path)
+
+    ours = NeuralModel([
+        {"kind": "conv2d", "filters": 4, "kernel": [3, 3],
+         "activation": "relu"},
+        {"kind": "flatten"},
+        {"kind": "dense", "units": 6, "activation": "relu"},
+        {"kind": "dense", "units": 2}], name="mixed")
+    ours.load_weights(path, input_shape=(8, 8, 3))
+    got = ours.predict(x, batch_size=3)
+    np.testing.assert_allclose(got, want, atol=1e-4)
+
+
 def test_keras_h5_layer_mismatch_rejected(tmp_path):
     keras = pytest.importorskip("keras")
     from keras import layers
