@@ -83,6 +83,9 @@ class ArtifactStore:
 
     # ------------------------------------------------------------------
     def save(self, obj: Any, name: str, type_string: str) -> str:
+        from learningorchestra_tpu.services import faults
+
+        faults.maybe_inject("artifact_save")
         d = self._dir(name, type_string)
         if os.path.isdir(d):
             shutil.rmtree(d)

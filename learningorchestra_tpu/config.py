@@ -97,6 +97,16 @@ class Config:
         default_factory=lambda: int(os.environ.get(
             "LO_SANDBOX_FILE_BYTES", str(1 << 30))))
 
+    # Failure handling: automatic re-runs of a failed job pipeline
+    # (each attempt appends its own execution document; the reference's
+    # only analogue is swarm restart_policy, docker-compose.yml:3-6),
+    # and deterministic fault injection for testing those paths
+    # (services/faults.py; e.g. "artifact_save:2").
+    job_max_retries: int = dataclasses.field(
+        default_factory=lambda: int(os.environ.get("LO_JOB_RETRIES", "0")))
+    fault_inject: str = dataclasses.field(
+        default_factory=lambda: os.environ.get("LO_FAULT_INJECT", ""))
+
     # Observability.
     log_level: str = dataclasses.field(
         default_factory=lambda: os.environ.get("LO_LOG_LEVEL", "INFO"))

@@ -160,7 +160,8 @@ class ExecutionService:
 
         self._ctx.jobs.submit(
             name, run, description=description,
-            parameters=method_parameters, needs_mesh=True)
+            parameters=method_parameters, needs_mesh=True,
+            max_retries=self._ctx.config.job_max_retries)
 
 
 def _inject_epoch_log(ctx, name: str, instance: Any, method: str,

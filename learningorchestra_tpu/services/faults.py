@@ -1,0 +1,47 @@
+"""Deterministic fault injection (SURVEY §5: the reference has no
+fault injection anywhere; its swarm restart_policy is the only failure
+response). ``Config.fault_inject`` (env ``LO_FAULT_INJECT``) names
+injection sites and counts — ``"artifact_save:2"`` makes the first two
+artifact-store writes raise — so failure-handling paths (retries,
+failure execution documents, boot requeue) are testable end-to-end
+through the real REST/job stack instead of only with hand-made flaky
+callables."""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict
+
+_lock = threading.Lock()
+_used: Dict[str, int] = {}
+
+
+class InjectedFault(IOError):
+    pass
+
+
+def reset() -> None:
+    with _lock:
+        _used.clear()
+
+
+def maybe_inject(site: str) -> None:
+    """Raise InjectedFault if ``site`` still has injection budget in
+    ``Config.fault_inject`` (comma-separated ``site:count`` entries)."""
+    from learningorchestra_tpu.config import get_config
+
+    spec = getattr(get_config(), "fault_inject", "") or ""
+    if not spec:
+        return
+    for part in spec.split(","):
+        name, _, count = part.strip().partition(":")
+        if name != site:
+            continue
+        budget = int(count or 1)
+        with _lock:
+            used = _used.get(site, 0)
+            if used < budget:
+                _used[site] = used + 1
+                raise InjectedFault(
+                    f"injected fault at {site} ({used + 1}/{budget})")
+        return

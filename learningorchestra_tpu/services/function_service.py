@@ -151,4 +151,5 @@ class FunctionService:
             return result
 
         self._ctx.jobs.submit(name, run, description=description,
-                              parameters=parameters)
+                              parameters=parameters,
+                              max_retries=self._ctx.config.job_max_retries)

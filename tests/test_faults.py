@@ -1,0 +1,129 @@
+"""Fault injection through the real job stack (SURVEY §5: the
+reference has none — failed jobs are just lost). LO_FAULT_INJECT
+deterministically fails chosen sites; job_max_retries re-runs the
+pipeline; execution documents record every attempt."""
+
+import dataclasses
+
+import numpy as np
+
+from learningorchestra_tpu.services import faults
+from learningorchestra_tpu.services.context import ServiceContext
+from learningorchestra_tpu.services.function_service import FunctionService
+
+
+def _ctx(tmp_config, **overrides):
+    """Install the overridden config GLOBALLY (faults.maybe_inject and
+    the sandbox read get_config()) and build a context on it."""
+    from learningorchestra_tpu import config as config_mod
+
+    cfg = dataclasses.replace(tmp_config, **overrides)
+    config_mod.set_config(cfg)
+    return ServiceContext(cfg)
+
+
+def test_injected_fault_fails_job_and_records_attempt(tmp_config):
+    faults.reset()
+    ctx = _ctx(tmp_config, fault_inject="artifact_save:1")
+    try:
+        fs = FunctionService(ctx)
+        fs.create({"name": "f_once", "function": "response = 41",
+                   "functionParameters": {}})
+        ctx.jobs.wait("f_once", timeout=60)
+        meta = ctx.catalog.get_metadata("f_once")
+        assert meta["finished"] is False  # no retries configured
+        docs = ctx.catalog.get_documents("f_once")
+        errs = [d for d in docs if d.get("exception")]
+        assert errs and "injected fault at artifact_save" in \
+            errs[-1]["exception"]
+    finally:
+        faults.reset()
+        ctx.close()
+
+
+def test_retry_survives_injected_fault(tmp_config):
+    """First attempt dies at the artifact store; the configured retry
+    re-runs the whole pipeline and completes — both attempts visible
+    in the execution documents."""
+    faults.reset()
+    ctx = _ctx(tmp_config, fault_inject="artifact_save:1",
+               job_max_retries=1)
+    try:
+        fs = FunctionService(ctx)
+        fs.create({"name": "f_retry", "function": "response = 42",
+                   "functionParameters": {}})
+        ctx.jobs.wait("f_retry", timeout=60)
+        assert ctx.catalog.get_metadata("f_retry")["finished"] is True
+        assert ctx.artifacts.load("f_retry", "function/python") == 42
+        docs = ctx.catalog.get_documents("f_retry")
+        attempts = [d.get("attempt") for d in docs if d.get("attempt")]
+        assert attempts == [1, 2]
+        assert any("injected fault" in (d.get("exception") or "")
+                   for d in docs)
+    finally:
+        faults.reset()
+        ctx.close()
+
+
+def test_train_retry_through_execution_service(tmp_config):
+    """The mesh-leased execution path retries too: a train whose
+    artifact save fails once still produces the fitted model."""
+    import dataclasses as dc
+
+    from learningorchestra_tpu import config as config_mod
+
+    faults.reset()
+    # seed data + model with NO injection armed; retries configured
+    # up front (the context's config is fixed at submit time)
+    ctx = _ctx(tmp_config, job_max_retries=1)
+    try:
+        from learningorchestra_tpu.services.execution import (
+            ExecutionService)
+        from learningorchestra_tpu.services.model_service import (
+            ModelService)
+
+        rng = np.random.default_rng(0)
+        x = rng.normal(size=(32, 8)).astype(np.float32)
+        y = (x[:, 0] > 0).astype(np.int32)
+        fs = FunctionService(ctx)
+        fs.create({"name": "ft_data",
+                   "function": "import numpy as np\n"
+                               "rng = np.random.default_rng(0)\n"
+                               "x = rng.normal(size=(32, 8))"
+                               ".astype(np.float32)\n"
+                               "y = (x[:, 0] > 0).astype(np.int32)\n"
+                               "response = {'x': x, 'y': y}\n",
+                   "functionParameters": {}})
+        ctx.jobs.wait("ft_data", timeout=120)
+        assert ctx.catalog.get_metadata("ft_data")["finished"]
+
+        ms = ModelService(ctx)
+        ms.create({"modelName": "ft_model",
+                   "modulePath": "learningorchestra_tpu.models",
+                   "class": "NeuralModel",
+                   "classParameters": {"layer_configs": [
+                       {"kind": "dense", "units": 2,
+                        "activation": "softmax"}]}}, "tensorflow")
+        ctx.jobs.wait("ft_model", timeout=120)
+        assert ctx.catalog.get_metadata("ft_model")["finished"]
+
+        # NOW arm the injector (global config is what maybe_inject
+        # reads): the train's first artifact save dies, the retry
+        # completes
+        config_mod.set_config(dc.replace(ctx.config,
+                                         fault_inject="artifact_save:1"))
+        faults.reset()
+        ex = ExecutionService(ctx)
+        ex.create({"name": "ft_train", "modelName": "ft_model",
+                   "method": "fit",
+                   "methodParameters": {"x": "$ft_data.x",
+                                        "y": "$ft_data.y",
+                                        "epochs": 1, "batch_size": 8}},
+                  "train", "tensorflow")
+        ctx.jobs.wait("ft_train", timeout=240)
+        assert ctx.catalog.get_metadata("ft_train")["finished"] is True
+        model = ctx.artifacts.load("ft_train", "train/tensorflow")
+        assert model.history
+    finally:
+        faults.reset()
+        ctx.close()
