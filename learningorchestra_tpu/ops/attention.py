@@ -270,8 +270,17 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 
 def _bwd_pallas(q, k, v, o, lse, do, *, scale: float, causal: bool,
-                block_q: int, block_k: int, interpret: bool):
-    """q/k/v/o/do: (bh, s, d), lse: (bh, sq). Returns (dq, dk, dv)."""
+                block_q: int, block_k: int, interpret: bool,
+                dlse=None):
+    """q/k/v/o/do: (bh, s, d), lse: (bh, sq). Returns (dq, dk, dv).
+
+    ``dlse`` (bh, sq), when given, is the upstream gradient on the
+    log-sum-exp output (ring-flash merges consume lse, so it carries
+    real gradient there). Math: dL/ds_ij gains the term
+    ``dlse_i · ∂lse_i/∂s_ij = dlse_i · p_ij``, so
+    ``ds = p·(dp - delta + dlse)`` — exactly the existing kernels with
+    ``delta - dlse`` fed in place of ``delta``. No kernel change.
+    """
     bh, sq, d = q.shape
     sk = k.shape[1]
     block_q = min(block_q, _round_up(sq, 8))
@@ -285,6 +294,8 @@ def _bwd_pallas(q, k, v, o, lse, do, *, scale: float, causal: bool,
     # dk/dv vanish without an explicit row mask.
     delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
                     axis=-1)                                 # (bh, sq)
+    if dlse is not None:
+        delta = delta - dlse.astype(jnp.float32)
 
     q = jnp.pad(q, ((0, 0), (0, sq_p - sq), (0, d_p - d)))
     k = jnp.pad(k, ((0, 0), (0, sk_p - sk), (0, d_p - d)))
@@ -361,6 +372,37 @@ def _flash_bwd(causal, scale, block_q, block_k, interpret, res, g):
 _flash.defvjp(_flash_fwd, _flash_bwd)
 
 
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash_lse(q, k, v, causal, scale, block_q, block_k, interpret):
+    """Like ``_flash`` but also returns the log-sum-exp rows — the
+    merge quantity sequence-parallel (ring) composition needs. lse
+    carries real gradient through the merge weights, handled in the
+    vjp via the ``delta - dlse`` identity (see _bwd_pallas)."""
+    return _fwd_pallas(q, k, v, scale=scale, causal=causal,
+                       block_q=block_q, block_k=block_k,
+                       interpret=interpret)
+
+
+def _flash_lse_fwd(q, k, v, causal, scale, block_q, block_k, interpret):
+    o, lse = _fwd_pallas(q, k, v, scale=scale, causal=causal,
+                         block_q=block_q, block_k=block_k,
+                         interpret=interpret)
+    return (o, lse), (q, k, v, o, lse)
+
+
+def _flash_lse_bwd(causal, scale, block_q, block_k, interpret, res, g):
+    q, k, v, o, lse = res
+    do, dlse = g
+    dq, dk, dv = _bwd_pallas(q, k, v, o, lse, do, scale=scale,
+                             causal=causal, block_q=block_q,
+                             block_k=block_k, interpret=interpret,
+                             dlse=dlse)
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+_flash_lse.defvjp(_flash_lse_fwd, _flash_lse_bwd)
+
+
 # ----------------------------------------------------------------------
 # public API
 # ----------------------------------------------------------------------
@@ -386,6 +428,33 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
     o = _flash(merge(q), merge(k), merge(v), causal, float(scale),
                int(block_q), int(block_k), bool(interpret))
     return o.reshape(b, h, sq, d).transpose(0, 2, 1, 3)
+
+
+def flash_attention_with_lse(q: jax.Array, k: jax.Array, v: jax.Array,
+                             *, causal: bool = False,
+                             scale: Optional[float] = None,
+                             block_q: int = 128, block_k: int = 128,
+                             interpret: Optional[bool] = None,
+                             ) -> Tuple[jax.Array, jax.Array]:
+    """(out (b, sq, h, d), lse (b, sq, h)) — the blockwise form ring
+    attention composes across devices (parallel/ring.py): hop outputs
+    merge exactly via log-sum-exp weights. Differentiable in both
+    outputs (lse gradient flows through the merge)."""
+    b, sq, h, d = q.shape
+    if scale is None:
+        scale = 1.0 / (d ** 0.5)
+    if interpret is None:
+        interpret = _auto_interpret()
+
+    def merge_heads(x):
+        return x.transpose(0, 2, 1, 3).reshape(b * h, x.shape[1], d)
+
+    o, lse = _flash_lse(merge_heads(q), merge_heads(k), merge_heads(v),
+                        causal, float(scale), int(block_q),
+                        int(block_k), bool(interpret))
+    o = o.reshape(b, h, sq, d).transpose(0, 2, 1, 3)
+    lse = lse.reshape(b, h, sq).transpose(0, 2, 1)
+    return o, lse
 
 
 def reference_attention(q, k, v, causal: bool = False,

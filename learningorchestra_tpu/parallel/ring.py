@@ -121,19 +121,105 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     return out.astype(q.dtype)
 
 
+def ring_flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                         axis_name: str = mesh_lib.SP,
+                         causal: bool = False,
+                         scale: Optional[float] = None,
+                         interpret: Optional[bool] = None) -> jax.Array:
+    """Ring attention with the PALLAS FLASH KERNEL as the per-hop
+    block (call inside ``shard_map``; same contract as
+    :func:`ring_attention`).
+
+    The dense ring materializes a (b, sq_local, h, sk_local) score
+    tile per hop; here each hop is a fused flash call — intra-shard
+    memory stays O(block), so local shards can themselves be long.
+    Hop results merge EXACTLY via log-sum-exp weights (the kernel
+    returns lse; its custom VJP carries the merge gradient through
+    ``delta - dlse``). With equal shard sizes every causal hop is one
+    of three static shapes: fully-past (unmasked flash), diagonal
+    (aligned causal flash), or fully-future (skipped) — no
+    offset-mask kernel variant is needed.
+    """
+    from learningorchestra_tpu.ops import attention as attn_ops
+
+    n = lax.psum(1, axis_name)
+    my_idx = lax.axis_index(axis_name)
+    b, sq, h, d = q.shape
+    sk = k.shape[1]
+    if sk != sq:
+        raise ValueError("ring_flash_attention needs equal shards "
+                         f"(sq={sq}, sk={sk})")
+    if scale is None:
+        scale = 1.0 / (d ** 0.5)
+
+    def flash_hop(k_blk, v_blk, hop_causal: bool):
+        o, lse = attn_ops.flash_attention_with_lse(
+            q, k_blk, v_blk, causal=hop_causal, scale=scale,
+            interpret=interpret)
+        return o.astype(jnp.float32), lse
+
+    def step(carry, hop):
+        o_acc, lse_acc, k_blk, v_blk = carry
+        kv_idx = (my_idx - hop) % n
+
+        if causal:
+            # 0 = fully past (unmasked), 1 = diagonal (aligned
+            # causal), 2 = fully future (skip — zero weight)
+            case = jnp.where(kv_idx < my_idx, 0,
+                             jnp.where(kv_idx == my_idx, 1, 2))
+            o_hop, lse_hop = lax.switch(
+                case,
+                [lambda kb, vb: flash_hop(kb, vb, False),
+                 lambda kb, vb: flash_hop(kb, vb, True),
+                 lambda kb, vb: (jnp.zeros((b, sq, h, d), jnp.float32),
+                                 jnp.full((b, sq, h), NEG_INF))],
+                k_blk, v_blk)
+        else:
+            o_hop, lse_hop = flash_hop(k_blk, v_blk, False)
+
+        new_lse = jnp.logaddexp(lse_acc, lse_hop)
+        w_acc = jnp.exp(lse_acc - new_lse)
+        w_hop = jnp.exp(lse_hop - new_lse)
+        o_acc = o_acc * w_acc[..., None] + o_hop * w_hop[..., None]
+        k_blk = lax.ppermute(k_blk, axis_name, _ring_perm(n))
+        v_blk = lax.ppermute(v_blk, axis_name, _ring_perm(n))
+        return (o_acc, new_lse, k_blk, v_blk), None
+
+    o0 = q.astype(jnp.float32) * 0.0
+    lse0 = q[..., 0].astype(jnp.float32) * 0.0 + NEG_INF
+    (o, _, _, _), _ = lax.scan(step, (o0, lse0, k, v), jnp.arange(n))
+    return o.astype(q.dtype)
+
+
+def _ring_perm(n) -> list:
+    return [(i, (i + 1) % int(n)) for i in range(int(n))]
+
+
 def ring_attention_sharded(q: jax.Array, k: jax.Array, v: jax.Array,
                            mesh: Mesh, causal: bool = False,
-                           scale: Optional[float] = None) -> jax.Array:
+                           scale: Optional[float] = None,
+                           block_impl: str = "auto") -> jax.Array:
     """pjit-level entry: global (b, seq, h, d) arrays, sequence sharded
-    over ``sp``, batch over the data axes."""
+    over ``sp``, batch over the data axes.
+
+    ``block_impl``: ``"dense"`` (XLA einsum tiles), ``"flash"``
+    (Pallas kernel per hop), or ``"auto"`` (flash on TPU, dense
+    elsewhere — interpret-mode pallas is for tests, not speed)."""
     if mesh_lib.SP not in mesh.axis_names:
         raise ValueError("mesh has no 'sp' axis")
+    if block_impl == "auto":
+        block_impl = "flash" if jax.default_backend() == "tpu" else "dense"
     data = mesh_lib.data_axes(mesh)
     spec = P(data if data else None, mesh_lib.SP, None, None)
+    inner = (ring_flash_attention if block_impl == "flash"
+             else ring_attention)
+    # pallas_call emits ShapeDtypeStructs with no varying-mesh-axes
+    # info, which the vma checker rejects (same as the tp flash path)
+    extra = {"check_vma": False} if block_impl == "flash" else {}
     fn = jax.shard_map(
-        functools.partial(ring_attention, axis_name=mesh_lib.SP,
+        functools.partial(inner, axis_name=mesh_lib.SP,
                           causal=causal, scale=scale),
-        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec, **extra)
     return fn(q, k, v)
 
 

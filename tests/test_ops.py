@@ -11,6 +11,7 @@ import numpy as np
 import pytest
 
 from learningorchestra_tpu.ops import flash_attention, reference_attention
+from learningorchestra_tpu.ops.attention import flash_attention_with_lse
 
 
 def _rand(shape, key):
@@ -42,6 +43,59 @@ def test_gradients_match_reference(causal):
 
     def loss_ref(q, k, v):
         return jnp.sum(jnp.sin(reference_attention(q, k, v, causal=causal)))
+
+    g1 = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    g2 = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b_ in zip(g1, g2):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                   atol=5e-5, rtol=5e-4)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_lse_output_matches_oracle(causal):
+    """The lse rows ring composition merges on must equal the
+    full-softmax log-sum-exp."""
+    b, s, h, d = 2, 32, 2, 16
+    q, k, v = (_rand((b, s, h, d), 30 + i) for i in range(3))
+    _, lse = flash_attention_with_lse(q, k, v, causal=causal,
+                                      block_q=16, block_k=16)
+    scale = 1.0 / np.sqrt(d)
+    scores = jnp.einsum("bqhd,bkhd->bqhk", q, k) * scale
+    if causal:
+        mask = jnp.arange(s)[:, None] >= jnp.arange(s)[None, :]
+        scores = jnp.where(mask[None, :, None, :], scores, -1e30)
+    want = jax.scipy.special.logsumexp(scores, axis=-1)  # (b, sq, h)
+    np.testing.assert_allclose(np.asarray(lse), np.asarray(want),
+                               atol=2e-5, rtol=2e-5)
+
+
+def test_lse_gradient_flows_through_merge():
+    """A loss that consumes BOTH outputs (the ring-merge pattern):
+    grads must match autodiff of the dense oracle computing the same
+    (o, lse) pair — this exercises the `delta - dlse` path in the
+    backward kernels."""
+    b, s, h, d = 1, 16, 2, 8
+    q, k, v = (_rand((b, s, h, d), 40 + i) for i in range(3))
+    scale = 1.0 / np.sqrt(d)
+
+    def merge_loss(o, lse):
+        # lse-weighted combination, like a ring hop merge
+        w = jax.nn.sigmoid(lse)
+        return jnp.sum(jnp.sin(o) * w[..., None]) + jnp.sum(lse ** 2) * 0.1
+
+    def loss_flash(q, k, v):
+        o, lse = flash_attention_with_lse(q, k, v, causal=True,
+                                          block_q=8, block_k=8)
+        return merge_loss(o, lse)
+
+    def loss_ref(q, k, v):
+        scores = jnp.einsum("bqhd,bkhd->bqhk", q, k) * scale
+        mask = jnp.arange(s)[:, None] >= jnp.arange(s)[None, :]
+        scores = jnp.where(mask[None, :, None, :], scores, -1e30)
+        lse = jax.scipy.special.logsumexp(scores, axis=-1)
+        p = jnp.exp(scores - lse[..., None])
+        o = jnp.einsum("bqhk,bkhd->bqhd", p, v)
+        return merge_loss(o, lse)
 
     g1 = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
     g2 = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
