@@ -167,6 +167,13 @@ def _steady_stats(history, n_chips):
         out["final_loss"] = round(float(best["loss"]), 4)
     if "accuracy" in best:
         out["final_train_accuracy"] = round(float(best["accuracy"]), 4)
+    # BASELINE.json metric pair: samples/sec/chip AND time-to-accuracy
+    total = 0.0
+    for h in history:
+        total += float(h.get("epochSeconds", 0) or 0)
+        if float(h.get("accuracy", 0) or 0) >= 0.97:
+            out["time_to_97pct_train_acc_s"] = round(total, 3)
+            break
     return out
 
 

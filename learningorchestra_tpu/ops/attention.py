@@ -156,6 +156,11 @@ def _fwd_pallas(q, k, v, *, scale: float, causal: bool,
             jax.ShapeDtypeStruct((bh, sq_p, lanes), jnp.float32),
         ],
         scratch_shapes=scratch,
+        # bh and the Q-tile axis own disjoint outputs/accumulator
+        # streaks -> Mosaic may split them across megacore; the KV
+        # stream axis accumulates and must stay sequential
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
     )(q, k, v)
     return o[:, :sq, :d], lse[:, :sq, 0]
@@ -319,6 +324,8 @@ def _bwd_pallas(q, k, v, o, lse, do, *, scale: float, causal: bool,
         out_specs=q_spec_i,
         out_shape=jax.ShapeDtypeStruct((bh, sq_p, d_p), jnp.float32),
         scratch_shapes=[pltpu.VMEM((block_q, d_p), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
     )(q, k, v, do, lse_l, delta_l)
 
@@ -338,6 +345,8 @@ def _bwd_pallas(q, k, v, o, lse, do, *, scale: float, causal: bool,
                    jax.ShapeDtypeStruct((bh, sk_p, d_p), jnp.float32)],
         scratch_shapes=[pltpu.VMEM((block_k, d_p), jnp.float32),
                         pltpu.VMEM((block_k, d_p), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
     )(q, k, v, do, lse_l, delta_l)
     return (dq[:, :sq, :d], dk[:, :sk, :d], dv[:, :sk, :d])

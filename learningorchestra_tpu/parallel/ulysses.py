@@ -38,8 +38,18 @@ def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array,
         raise ValueError(
             f"heads {q.shape[2]} not divisible by sp={n}")
     if attn_fn is None:
-        attn_fn = functools.partial(ring_lib.full_attention_reference,
-                                    causal=causal, scale=scale)
+        if jax.default_backend() == "tpu":
+            # local attention over the gathered sequence runs the
+            # fused flash kernel — O(block) memory for the full-seq
+            # score rows instead of a dense (s, s) tile per head
+            from learningorchestra_tpu.ops import attention as attn_ops
+
+            attn_fn = functools.partial(attn_ops.flash_attention,
+                                        causal=causal, scale=scale)
+        else:
+            attn_fn = functools.partial(
+                ring_lib.full_attention_reference, causal=causal,
+                scale=scale)
 
     def scatter_heads(x):  # (b, s/n, h, d) -> (b, s, h/n, d)
         return lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1,

@@ -101,6 +101,29 @@ def test_ulysses_matches_full(causal):
                                rtol=2e-5, atol=2e-5)
 
 
+def test_ulysses_with_flash_blocks_matches_full():
+    """The TPU default: after the head-scatter all-to-all, local
+    attention runs the Pallas kernel (interpret mode here)."""
+    import functools
+
+    from learningorchestra_tpu.ops import attention as attn_ops
+
+    mesh = _mesh("sp=4")
+    q, k, v = _qkv(s=32, seed=11)
+    want = ring.full_attention_reference(q, k, v, causal=True)
+    spec = P(None, "sp", None, None)
+    fn = jax.shard_map(
+        functools.partial(
+            ulysses.ulysses_attention, causal=True,
+            attn_fn=functools.partial(attn_ops.flash_attention,
+                                      causal=True)),
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        check_vma=False)
+    got = fn(q, k, v)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
+
+
 # ----------------------------------------------------------------------
 # pipeline
 # ----------------------------------------------------------------------
