@@ -80,7 +80,7 @@ TLM_ATTENTION = os.environ.get("LO_BENCH_TLM_ATTENTION", "auto")
 # per-phase wall-clock bounds (seconds); overridable for local smoke
 # runs via LO_BENCH_TIMEOUT_<PHASE>
 PHASE_TIMEOUTS = {"cnn": 600, "lstm": 600, "tlm": 900, "proxy": 120,
-                  "builder": 600, "flash": 600}
+                  "builder": 600, "flash": 600, "ingest": 600}
 
 # out-of-core Builder (reference config 4: 10M-row GBT via Spark)
 BUILDER_ROWS = int(os.environ.get("LO_BENCH_BUILDER_ROWS", "10000000"))
@@ -408,6 +408,62 @@ def phase_builder():
     return out
 
 
+def phase_ingest():
+    """Dataset-ingest throughput via POST /dataset/csv (SURVEY §3.1
+    calls the reference's per-row insert_one loop "a known throughput
+    cliff to beat", database.py:144): rows/sec from file on disk to
+    queryable Parquet, via the streamed C++-parsed pipeline."""
+    import numpy as np
+
+    rows = int(os.environ.get("LO_BENCH_INGEST_ROWS", "2000000"))
+    api, prefix = _make_api()
+    path = os.path.join(tempfile.mkdtemp(prefix="lo_ingest_"), "big.csv")
+    rng = np.random.default_rng(0)
+    t_gen = time.perf_counter()
+    with open(path, "w") as f:
+        f.write("id,a,b,c,label\n")
+        left, i0 = rows, 0
+        while left:
+            n = min(left, 200_000)
+            a = rng.normal(size=n)
+            b = rng.normal(size=n)
+            c = rng.integers(0, 100, size=n)
+            y = (a > 0).astype(np.int64)
+            ids = np.arange(i0, i0 + n)
+            block = "\n".join(
+                f"{i},{x:.6f},{z:.6f},{w},{t}"
+                for i, x, z, w, t in zip(ids, a, b, c, y))
+            f.write(block + "\n")
+            left -= n
+            i0 += n
+    gen_seconds = time.perf_counter() - t_gen
+
+    t0 = time.perf_counter()
+    status, body, _ = api.dispatch("POST", f"{prefix}/dataset/csv", {}, {
+        "datasetName": "ingest_bench", "datasetURI": path})
+    _expect_created(status, body)
+    _wait(api, body["result"], timeout=420)
+    elapsed = time.perf_counter() - t0
+    n_rows = api.ctx.catalog.count_rows("ingest_bench")
+    api.ctx.jobs.shutdown()
+    if n_rows != rows:
+        return {"error": f"ingest row mismatch: {n_rows} != {rows}"}
+    return {"rows": rows,
+            "ingest_seconds": round(elapsed, 2),
+            "rows_per_sec": round(rows / elapsed, 2),
+            "csv_gen_seconds": round(gen_seconds, 2),
+            "native_core": _native_available()}
+
+
+def _native_available() -> bool:
+    try:
+        from learningorchestra_tpu import native
+
+        return native.available()
+    except Exception:  # noqa: BLE001
+        return False
+
+
 def _torch_from_layer_configs(configs):
     """Build the torch twin FROM the shared flagship config so the
     proxy can't drift from the measured model."""
@@ -491,7 +547,7 @@ def phase_proxy(max_seconds=60.0):
 
 PHASES = {"cnn": phase_cnn, "lstm": phase_lstm, "tlm": phase_tlm,
           "proxy": phase_proxy, "builder": phase_builder,
-          "flash": phase_flash}
+          "flash": phase_flash, "ingest": phase_ingest}
 
 _RESULT_MARK = "@@LO_BENCH_RESULT@@"
 
@@ -647,6 +703,7 @@ def main(argv=None):
             retry["flash_error"] = models["transformer_lm"]["error"]
             models["transformer_lm"] = retry
     models["builder_10m_streaming"] = _run_phase("builder", env)
+    models["csv_ingest"] = _run_phase("ingest", env)
     # interpret-mode kernel timing is meaningless — flash runs on TPU only
     flash = _run_phase("flash") if tpu_ok else {
         "skipped": "TPU unreachable; interpret-mode timing is not "
@@ -715,6 +772,13 @@ def _write_md(path, report):
                 f"{stats.get('gb', {}).get('accuracy')} "
                 f"| rows={stats.get('rows')}, peak_rss_mb="
                 f"{stats.get('peak_rss_mb')} |")
+            continue
+        if name == "csv_ingest":
+            lines.append(
+                f"| {name} (host data plane) | cpu "
+                f"| {stats.get('rows_per_sec', '—')} rows/s | — | — | — "
+                f"| rows={stats.get('rows')}, native_core="
+                f"{stats.get('native_core')} |")
             continue
         cfg = configs.get(name, {})
         cfg_s = ", ".join(f"{k}={v}" for k, v in sorted(cfg.items()))
