@@ -43,6 +43,7 @@ from __future__ import annotations
 import json
 import re
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Dict, Optional, Tuple
 from urllib.parse import parse_qs, urlparse
@@ -83,6 +84,14 @@ class Api:
         self.builder = BuilderService(self.ctx)
         self._profile_dir: Optional[str] = None  # active jax trace
         self._profile_lock = threading.Lock()
+        # gateway metrics (KrakenD exposes a metrics collector on
+        # :8090, krakend.json:1752-1760; here it's first-party)
+        self._metrics_lock = threading.Lock()
+        self._started = time.monotonic()
+        self._requests: Dict[str, int] = {}
+        self._statuses: Dict[str, int] = {}
+        self._latency_sum = 0.0
+        self._latency_count = 0
         self.recover_unfinished()
 
     # ------------------------------------------------------------------
@@ -163,13 +172,48 @@ class Api:
                  ) -> Tuple[int, Any, str]:
         """Returns (status, payload, content_type). payload is a dict
         (JSON) or raw bytes when content_type is not JSON."""
+        t0 = time.monotonic()
         try:
-            return self._route(method, path, params, body)
+            out = self._route(method, path, params, body)
         except V.HttpError as e:
-            return e.status, {"result": e.message}, "application/json"
+            out = e.status, {"result": e.message}, "application/json"
         except Exception as e:  # noqa: BLE001
-            return 500, {"result": f"internal error: {e!r}"}, \
+            out = 500, {"result": f"internal error: {e!r}"}, \
                 "application/json"
+        self._record_metrics(method, path, out[0],
+                             time.monotonic() - t0)
+        return out
+
+    def _record_metrics(self, method: str, path: str, status: int,
+                        seconds: float) -> None:
+        prefix = self.ctx.config.api_prefix
+        parts = [p for p in path[len(prefix):].split("/") if p] \
+            if path.startswith(prefix + "/") else []
+        service = parts[0] if parts else path.lstrip("/").split("/")[0] \
+            or "root"
+        with self._metrics_lock:
+            key = f"{method} {service}"
+            self._requests[key] = self._requests.get(key, 0) + 1
+            sk = str(status)
+            self._statuses[sk] = self._statuses.get(sk, 0) + 1
+            self._latency_sum += seconds
+            self._latency_count += 1
+
+    def metrics(self) -> Dict[str, Any]:
+        with self._metrics_lock:
+            n = self._latency_count
+            out = {
+                "uptimeSeconds": round(
+                    time.monotonic() - self._started, 3),
+                "requestsTotal": n,
+                "requestsByRoute": dict(sorted(self._requests.items())),
+                "responsesByStatus": dict(sorted(self._statuses.items())),
+                "meanDispatchSeconds": round(
+                    self._latency_sum / n, 6) if n else None,
+            }
+        out["jobsRunning"] = self.ctx.jobs.running()
+        out["collections"] = len(self.ctx.catalog.list_collections())
+        return out
 
     # ------------------------------------------------------------------
     def _route(self, method: str, path: str, params: Dict[str, Any],
@@ -178,6 +222,8 @@ class Api:
         prefix = self.ctx.config.api_prefix
         if path == "/health":
             return 200, self._health(), "application/json"
+        if path == "/metrics":
+            return 200, self.metrics(), "application/json"
         if not path.startswith(prefix + "/"):
             return 404, {"result": "unknown route"}, "application/json"
         parts = [p for p in path[len(prefix):].split("/") if p]

@@ -92,6 +92,23 @@ def test_unknown_route(server):
     assert status == 404
 
 
+def test_metrics_endpoint(server):
+    """Gateway-metrics parity (KrakenD collector, krakend.json:1752):
+    request counters by route/status, latency, job and collection
+    gauges."""
+    _call(server, "GET", "/health")
+    _call(server, "GET", f"{API}/dataset/csv")   # listing (200)
+    _call(server, "GET", f"{API}/nonsense/x")    # 404
+    status, m = _call(server, "GET", "/metrics")
+    assert status == 200
+    assert m["requestsTotal"] >= 3
+    assert m["requestsByRoute"].get("GET dataset", 0) >= 1
+    assert m["responsesByStatus"].get("404", 0) >= 1
+    assert m["meanDispatchSeconds"] is not None
+    assert m["uptimeSeconds"] > 0
+    assert "jobsRunning" in m and "collections" in m
+
+
 def test_dataset_rest_roundtrip(server, titanic_csv):
     status, body = _call(server, "POST", f"{API}/dataset/csv", {
         "datasetName": "titanic", "datasetURI": str(titanic_csv)})
