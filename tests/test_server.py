@@ -408,6 +408,51 @@ def test_resnet50_transfer_tune_pipeline(server, tmp_path):
     assert len(sweep.cv_results_["params"]) == 2
 
 
+def test_generate_through_predict_verb(server):
+    """Token generation is reachable through the reference's generic
+    call-method-X-on-stored-object-Y contract: POST /predict with
+    method="generate" runs the KV-cache decode loop and the sampled
+    ids surface in the execution documents via the universal GET."""
+    st, body = _call(server, "POST", f"{API}/function/python", body={
+        "name": "gen_data", "functionParameters": {},
+        "function": ("import numpy as np\n"
+                     "response = {'x': ((np.arange(32*12)"
+                     ".reshape(32,12)*7) % 31 + 1).astype('int32')}\n")})
+    assert st == 201, body
+    _poll_finished(server, f"{API}/function/python/gen_data")
+    st, body = _call(server, "POST", f"{API}/model/tensorflow", body={
+        "modelName": "gen_lm",
+        "modulePath": "learningorchestra_tpu.models",
+        "class": "LanguageModel",
+        "classParameters": {"vocab_size": 32, "d_model": 16,
+                            "n_layers": 1, "n_heads": 2, "max_len": 12,
+                            "attention": "dot"}})
+    assert st == 201, body
+    _poll_finished(server, f"{API}/model/tensorflow/gen_lm")
+    st, body = _call(server, "POST", f"{API}/train/tensorflow", body={
+        "name": "gen_train", "modelName": "gen_lm", "method": "fit",
+        "methodParameters": {"x": "$gen_data.x", "epochs": 1,
+                             "batch_size": 16}})
+    assert st == 201, body
+    _poll_finished(server, f"{API}/train/tensorflow/gen_train",
+                   timeout=300)
+
+    st, body = _call(server, "POST", f"{API}/predict/tensorflow", body={
+        "name": "gen_out", "modelName": "gen_train",
+        "method": "generate",
+        "methodParameters": {"prompt": [[1, 2, 3]],
+                             "max_new_tokens": 5}})
+    assert st == 201, body
+    _poll_finished(server, f"{API}/predict/tensorflow/gen_out",
+                   timeout=300)
+    st, body = _call(server, "GET", f"{API}/predict/tensorflow/gen_out",
+                     params="?skip=0&limit=20")
+    results = [d["result"] for d in body["result"] if d.get("result")]
+    assert results, body
+    tokens = results[-1][0]
+    assert tokens[:3] == [1, 2, 3] and len(tokens) == 8
+
+
 def test_train_checkpoint_and_patch_resume(server):
     """checkpoint: true saves per-epoch orbax steps under the execution
     name; PATCH re-runs the same execution and resumes from them."""
