@@ -331,6 +331,17 @@ class Catalog:
             raise
         return self.count_rows(name)
 
+    def dataset_version(self, name: str) -> Tuple:
+        """Cheap content version for a dataset: the (path, mtime_ns,
+        size) of its Parquet parts. Changes whenever rows are appended
+        or the dataset is rewritten — the cache key for ``$name``
+        DataFrame resolution."""
+        out = []
+        for f in self._dataset_files(name):
+            st = os.stat(f)
+            out.append((f, st.st_mtime_ns, st.st_size))
+        return tuple(out)
+
     def dataset_fields(self, name: str) -> List[str]:
         files = self._dataset_files(name)
         if not files:

@@ -104,6 +104,10 @@ class Config:
     # (services/faults.py; e.g. "artifact_save:2").
     job_max_retries: int = dataclasses.field(
         default_factory=lambda: int(os.environ.get("LO_JOB_RETRIES", "0")))
+    # byte budget for the $name DataFrame resolution cache (0 disables)
+    param_cache_bytes: int = dataclasses.field(
+        default_factory=lambda: int(os.environ.get(
+            "LO_PARAM_CACHE", str(256 << 20))))
     fault_inject: str = dataclasses.field(
         default_factory=lambda: os.environ.get("LO_FAULT_INJECT", ""))
 

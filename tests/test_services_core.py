@@ -107,6 +107,36 @@ def test_dollar_resolves_dataframe(ctx):
     assert list(out["data"]["a"]) == [1, 2]
 
 
+def test_dollar_dataframe_cache_hits_and_invalidates(ctx, monkeypatch):
+    """Repeated ``$name`` resolutions serve the cached frame (one
+    physical read per dataset version); appends/rewrites invalidate;
+    column mutations on a resolved frame never leak into the cache."""
+    ctx.catalog.create_collection("cds", "dataset/csv")
+    ctx.catalog.write_dataframe("cds", pd.DataFrame({"a": [1, 2, 3]}))
+    reads = {"n": 0}
+    real = type(ctx.catalog).read_dataframe
+
+    def counting(self, name, columns=None):
+        reads["n"] += 1
+        return real(self, name, columns)
+
+    monkeypatch.setattr(type(ctx.catalog), "read_dataframe", counting)
+    df1 = ctx.params.treat({"d": "$cds"})["d"]
+    df2 = ctx.params.treat({"d": "$cds"})["d"]
+    assert reads["n"] == 1
+    assert list(df2["a"]) == [1, 2, 3]
+    # caller-side column mutation must not poison the cache
+    df1["extra"] = 9
+    df3 = ctx.params.treat({"d": "$cds"})["d"]
+    assert "extra" not in df3.columns
+    assert reads["n"] == 1
+    # rewrite -> new version -> fresh read
+    ctx.catalog.write_dataframe("cds", pd.DataFrame({"a": [7]}))
+    df4 = ctx.params.treat({"d": "$cds"})["d"]
+    assert list(df4["a"]) == [7]
+    assert reads["n"] == 2
+
+
 def test_dollar_dot_indexes_object(ctx):
     ctx.catalog.create_collection("split", "function/python")
     ctx.artifacts.save({"train": [1, 2], "test": [3]}, "split",
