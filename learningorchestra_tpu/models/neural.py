@@ -213,8 +213,24 @@ class NeuralModel:
     def fit(self, x=None, y=None, batch_size: Optional[int] = None,
             epochs: int = 1, verbose: int = 0,
             validation_data: Optional[Tuple] = None,
+            validation_split: float = 0.0,
             shuffle: bool = True, checkpointer=None,
             log_fn=None, **_: Any) -> "History":
+        if validation_split and validation_data is None:
+            # keras-parity convenience: hold out the TAIL fraction
+            # (keras also splits before shuffling)
+            x = self._coerce_x(x)
+            y = self._coerce_y(y) if y is not None else None
+            n_val = max(1, int(len(x) * float(validation_split)))
+            if n_val >= len(x):
+                raise ValueError(
+                    f"validation_split={validation_split} leaves no "
+                    "training data")
+            validation_data = (x[-n_val:],
+                               y[-n_val:] if y is not None else None)
+            x = x[:-n_val]
+            if y is not None:
+                y = y[:-n_val]
         batcher = self._batcher(x, y, batch_size, shuffle=shuffle)
         if self.params is None:
             self._build_params(batcher.array("x"))

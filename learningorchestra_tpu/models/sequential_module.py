@@ -71,6 +71,18 @@ class SequentialModule(nn.Module):
                             padding=cfg.get("padding", "SAME"),
                             name=name)(x)
                 x = activation(cfg.get("activation"))(x)
+            elif kind == "conv1d":
+                k = cfg.get("kernel", 3)
+                k = (int(k[0]) if isinstance(k, (list, tuple)) else int(k),)
+                x = nn.Conv(cfg["filters"], k,
+                            strides=(int(cfg.get("strides", 1)),),
+                            padding=cfg.get("padding", "SAME"),
+                            name=name)(x)
+                x = activation(cfg.get("activation"))(x)
+            elif kind == "maxpool1d":
+                pool = int(cfg.get("pool", 2))
+                x = nn.max_pool(x, (pool,),
+                                strides=(int(cfg.get("strides", pool)),))
             elif kind == "maxpool2d":
                 pool = tuple(cfg.get("pool", (2, 2)))
                 x = nn.max_pool(x, pool,

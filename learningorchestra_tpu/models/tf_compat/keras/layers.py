@@ -39,6 +39,26 @@ class Conv2D(Layer):
         self.input_shape = list(input_shape) if input_shape else None
 
 
+class Conv1D(Layer):
+    def __init__(self, filters: int, kernel_size=3, strides=1,
+                 padding: str = "valid", activation: Optional[str] = None,
+                 input_shape: Optional[Sequence[int]] = None, **_: Any):
+        super().__init__({
+            "kind": "conv1d", "filters": int(filters),
+            "kernel": int(kernel_size) if not isinstance(
+                kernel_size, (list, tuple)) else int(kernel_size[0]),
+            "strides": int(strides) if not isinstance(
+                strides, (list, tuple)) else int(strides[0]),
+            "padding": padding.upper(), "activation": activation})
+        self.input_shape = list(input_shape) if input_shape else None
+
+
+class MaxPooling1D(Layer):
+    def __init__(self, pool_size=2, strides=None, **_: Any):
+        super().__init__({"kind": "maxpool1d", "pool": int(pool_size),
+                          "strides": int(strides or pool_size)})
+
+
 class MaxPooling2D(Layer):
     def __init__(self, pool_size=2, strides=None, **_: Any):
         super().__init__({"kind": "maxpool2d", "pool": _pair(pool_size),

@@ -74,6 +74,26 @@ def test_lstm_smoke(tmp_config):
     assert preds.shape == (5, 2)
 
 
+def test_fit_validation_split(tmp_config):
+    """keras-parity validation_split: tail holdout, per-fit val_*
+    metrics in the history, and the holdout never trains."""
+    from learningorchestra_tpu.models.neural import NeuralModel
+
+    x, y = _toy_classification()
+    model = NeuralModel([
+        {"kind": "dense", "units": 16, "activation": "relu"},
+        {"kind": "dense", "units": 3, "activation": "softmax"}])
+    hist = model.fit(x, y, epochs=5, batch_size=32,
+                     validation_split=0.25)
+    assert "val_loss" in hist.history
+    assert "val_accuracy" in hist.history
+    assert np.isfinite(hist.history["val_loss"][-1])
+    import pytest as _pytest
+
+    with _pytest.raises(ValueError, match="no training data"):
+        model.fit(x[:4], y[:4], epochs=1, validation_split=1.0)
+
+
 def test_binary_crossentropy_head(tmp_config):
     from learningorchestra_tpu.models.tf_compat import keras
 
@@ -140,6 +160,26 @@ def test_resnet50_shim_builds(tmp_config):
     with pytest.warns(UserWarning, match="offline"):
         model = keras.applications.ResNet50(weights="imagenet", classes=10)
     assert model.layer_configs[0]["kind"] == "resnet50"
+
+
+def test_conv1d_text_model_smoke(tmp_config):
+    """Embedding -> Conv1D -> pool -> dense (the keras text-CNN
+    pattern): builds, trains, predicts."""
+    from learningorchestra_tpu.models.neural import NeuralModel
+
+    rng = np.random.default_rng(0)
+    x = rng.integers(1, 50, size=(64, 20)).astype(np.int32)
+    y = (x[:, :10].mean(axis=1) > 25).astype(np.int32)
+    model = NeuralModel([
+        {"kind": "embedding", "vocab": 50, "dim": 16},
+        {"kind": "conv1d", "filters": 8, "kernel": 3,
+         "activation": "relu"},
+        {"kind": "maxpool1d", "pool": 2},
+        {"kind": "globalavgpool1d"},
+        {"kind": "dense", "units": 2, "activation": "softmax"}])
+    hist = model.fit(x, y, epochs=3, batch_size=32)
+    assert np.isfinite(hist.history["loss"][-1])
+    assert model.predict(x[:4], batch_size=4).shape == (4, 2)
 
 
 def test_embedding_accepts_keras_key_names(tmp_config):
