@@ -553,6 +553,17 @@ def main(argv=None) -> None:
     host, port = server.address
     print(f"learningOrchestra-TPU REST on http://{host}:{port}"
           f"{get_config().api_prefix}", flush=True)
+
+    # SIGTERM (the k8s/systemd stop signal) drains like Ctrl-C: stop
+    # accepting requests, then the shutdown path below runs. In-flight
+    # jobs left unfinished are requeued by the next boot's
+    # recover_unfinished().
+    import signal as signal_mod
+
+    def _terminate(signum, frame):  # noqa: ARG001
+        raise KeyboardInterrupt
+
+    signal_mod.signal(signal_mod.SIGTERM, _terminate)
     try:
         server.serve_forever()
     except KeyboardInterrupt:

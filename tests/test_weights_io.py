@@ -155,6 +155,40 @@ def test_keras_h5_layer_mismatch_rejected(tmp_path):
         ours.load_weights(path, input_shape=(6,))
 
 
+def test_training_accuracy_parity_with_real_tf(tmp_path, f32_config):
+    """BASELINE north star: "eval accuracy matching the TF path". The
+    same architecture trained on the same separable data must reach
+    comparable accuracy under real tf.keras and the JAX engine."""
+    keras = pytest.importorskip("keras")
+    from keras import layers
+
+    rng = np.random.default_rng(5)
+    x = rng.normal(size=(256, 10)).astype(np.float32)
+    w = rng.normal(size=(10,))
+    y = (x @ w > 0).astype(np.int32)
+
+    km = keras.Sequential([
+        layers.Input((10,)),
+        layers.Dense(16, activation="relu"),
+        layers.Dense(2, activation="softmax")])
+    km.compile(optimizer=keras.optimizers.Adam(0.01),
+               loss="sparse_categorical_crossentropy",
+               metrics=["accuracy"])
+    km.fit(x, y, epochs=12, batch_size=64, verbose=0)
+    tf_acc = float(km.evaluate(x, y, verbose=0)[1])
+
+    ours = NeuralModel([
+        {"kind": "dense", "units": 16, "activation": "relu"},
+        {"kind": "dense", "units": 2, "activation": "softmax"}])
+    ours.compile(optimizer={"kind": "adam", "learning_rate": 0.01},
+                 loss="sparse_categorical_crossentropy")
+    ours.fit(x, y, epochs=12, batch_size=64)
+    our_acc = float(ours.evaluate(x, y)["accuracy"])
+
+    assert tf_acc > 0.9 and our_acc > 0.9
+    assert abs(tf_acc - our_acc) < 0.08, (tf_acc, our_acc)
+
+
 def test_flatten_unflatten_inverse():
     tree = {"a": {"b": np.arange(3), "c": np.ones((2, 2))},
             "d": np.zeros(1)}
