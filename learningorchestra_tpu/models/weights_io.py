@@ -34,8 +34,11 @@ import numpy as np
 _KERAS_VAR_ORDERS = {
     "dense": ("kernel", "bias"),
     "conv2d": ("kernel", "bias"),
+    "conv1d": ("kernel", "bias"),
     "embedding": ("embedding",),
     "batchnorm": ("scale", "bias", "mean", "var"),  # gamma/beta/mm/mv
+    # keras packs the 4 gates column-wise in (i, f, c, o) order
+    "lstm": ("kernel", "recurrent_kernel", "bias"),
 }
 
 # our layer kind -> the group-name prefix keras auto-assigns the twin
@@ -45,9 +48,14 @@ _KERAS_VAR_ORDERS = {
 _KERAS_NAME_PREFIX = {
     "dense": "dense",
     "conv2d": "conv2d",
+    "conv1d": "conv1d",
     "embedding": "embedding",
     "batchnorm": "batch_normalization",
+    "lstm": "lstm",
 }
+
+# flax OptimizedLSTMCell gate order matching keras's (i, f, c->g, o)
+_LSTM_GATES = ("i", "f", "g", "o")
 
 
 def flatten_params(tree: Any, prefix: str = "") -> Dict[str, np.ndarray]:
@@ -171,10 +179,16 @@ def load_keras_h5_into_sequential(layer_configs, params: Dict[str, Any],
     params = jax.tree_util.tree_map(np.asarray, params)
     state = jax.tree_util.tree_map(np.asarray, dict(model_state or {}))
     taken: Dict[str, int] = {}
+    # LSTM cells scope under OptimizedLSTMCell_<k> (the nn.RNN wrapper
+    # does not add a name level), in creation order
+    cell_keys = sorted(
+        (k for k in params if k.startswith("OptimizedLSTMCell")),
+        key=_natural_key)
+    cells_taken = 0
     for i, cfg in enumerate(layer_configs):
         kind = cfg["kind"]
         name = f"{kind}_{i}"
-        if name not in params and kind != "batchnorm":
+        if name not in params and kind not in ("batchnorm", "lstm"):
             continue  # parameter-free layer
         if kind not in _KERAS_VAR_ORDERS:
             raise ValueError(
@@ -195,7 +209,30 @@ def load_keras_h5_into_sequential(layer_configs, params: Dict[str, Any],
             raise ValueError(
                 f"{name}: h5 layer has {len(vals)} variables, "
                 f"expected {len(order)} ({order})")
-        if kind == "batchnorm":
+        if kind == "lstm":
+            if cells_taken >= len(cell_keys):
+                raise ValueError(f"{name}: model has no LSTM cell "
+                                 f"params left to fill")
+            cell = params[cell_keys[cells_taken]]
+            cells_taken += 1
+            kern, rec, bias = vals
+            u = rec.shape[0]
+            if kern.shape[1] != 4 * u or bias.shape[0] != 4 * u:
+                raise ValueError(
+                    f"{name}: keras LSTM vars have shapes "
+                    f"{kern.shape}/{rec.shape}/{bias.shape}, expected "
+                    f"(in,4u)/(u,4u)/(4u,)")
+            for gi, g in enumerate(_LSTM_GATES):
+                cell[f"i{g}"]["kernel"] = _check(
+                    name, f"i{g}/kernel", cell[f"i{g}"]["kernel"],
+                    kern[:, gi * u:(gi + 1) * u])
+                cell[f"h{g}"]["kernel"] = _check(
+                    name, f"h{g}/kernel", cell[f"h{g}"]["kernel"],
+                    rec[:, gi * u:(gi + 1) * u])
+                cell[f"h{g}"]["bias"] = _check(
+                    name, f"h{g}/bias", cell[f"h{g}"]["bias"],
+                    bias[gi * u:(gi + 1) * u])
+        elif kind == "batchnorm":
             gamma, beta, mean, var = vals
             params[name]["scale"] = _check(name, "scale",
                                            params[name]["scale"], gamma)

@@ -141,6 +141,34 @@ def test_real_keras_h5_mixed_kinds_match_by_kind(tmp_path, f32_config):
     np.testing.assert_allclose(got, want, atol=1e-4)
 
 
+def test_real_keras_lstm_h5_matches_tf_predictions(tmp_path, f32_config):
+    """The IMDb-LSTM interop path (BASELINE config 3): embedding +
+    LSTM + dense weights saved by real tf.keras load into the shim —
+    keras packs the gates column-wise (i, f, c, o); flax keeps
+    per-gate dense params — and reproduce keras's predictions."""
+    keras = pytest.importorskip("keras")
+    from keras import layers
+
+    km = keras.Sequential([
+        layers.Input((7,)),
+        layers.Embedding(30, 8),
+        layers.LSTM(5),
+        layers.Dense(3, activation="softmax")])
+    x = np.random.default_rng(6).integers(1, 30, size=(4, 7))
+    want = np.asarray(km(x))
+    path = str(tmp_path / "lstm.weights.h5")
+    km.save_weights(path)
+
+    ours = NeuralModel([
+        {"kind": "embedding", "vocab": 30, "dim": 8},
+        {"kind": "lstm", "units": 5},
+        {"kind": "dense", "units": 3, "activation": "softmax"}],
+        name="from_keras_lstm")
+    ours.load_weights(path, input_shape=(7,))
+    got = ours.predict(x.astype(np.int32), batch_size=4)
+    np.testing.assert_allclose(got, want, atol=1e-5)
+
+
 def test_keras_h5_layer_mismatch_rejected(tmp_path):
     keras = pytest.importorskip("keras")
     from keras import layers
