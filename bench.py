@@ -292,16 +292,58 @@ def phase_tlm():
     return out
 
 
+def _scrub_exc(exc) -> str:
+    """One-line, ANSI-free rendering of a phase-internal exception."""
+    import re
+    text = f"{type(exc).__name__}: {exc}"
+    text = re.sub(r"\x1b\[[0-9;]*m", "", text)
+    return " ".join(text.split())[:300]
+
+
 def phase_flash():
     """Kernel micro-bench: Pallas flash attention vs the fused-dot
     oracle, forward AND backward, seq 1k-8k, causal and not (verdict
     round-2 weak #4/#6 — the bwd kernels need on-chip wall-clock
-    evidence, not just interpret-mode numerics)."""
+    evidence, not just interpret-mode numerics).
+
+    Timing methodology: a Python loop over ``jit(grad(f))`` with a
+    final ``block_until_ready`` under-measures on relayed/async
+    backends (observed: 0.03 ms "per iter" at seq 8192 — physically
+    impossible). Instead each measurement runs ``n_iter`` fwd+bwd
+    passes **inside one jit** via ``lax.fori_loop``, chaining each
+    iteration's gradients into the next iteration's inputs (so no
+    pass can be elided) and returning a scalar that the host reads
+    back — the wall-clock therefore brackets the full device
+    execution, amortized over n_iter.
+    """
     import jax
     import jax.numpy as jnp
     import numpy as np
 
     from learningorchestra_tpu.ops import attention as attn
+
+    def timed_ms_per_iter(fn, q, k, v, causal, n_iter=8):
+        grad = jax.grad(
+            lambda q, k, v: jnp.sum(fn(q, k, v, causal=causal)),
+            argnums=(0, 1, 2))
+
+        def body(_, carry):
+            q, k, v, acc = carry
+            dq, dk, dv = grad(q, k, v)
+            # chain grads into the next iteration's operands so XLA
+            # cannot hoist or elide any of the n_iter passes
+            return (q + 1e-6 * dq, k + 1e-6 * dk, v + 1e-6 * dv,
+                    acc + jnp.sum(dq))
+
+        @jax.jit
+        def looped(q, k, v):
+            init = (q, k, v, jnp.float32(0))
+            return jax.lax.fori_loop(0, n_iter, body, init)[3]
+
+        float(looped(q, k, v))  # compile + warm; readback syncs
+        t0 = time.perf_counter()
+        float(looped(q, k, v))  # scalar readback: full device sync
+        return (time.perf_counter() - t0) / n_iter * 1e3
 
     b, h, d = 4, 8, 64
     results = {}
@@ -311,31 +353,15 @@ def phase_flash():
                 jnp.asarray(np.random.default_rng(i).normal(
                     size=(b, seq, h, d)).astype(np.float32) * 0.1)
                 for i in range(3))
-
-            def loss_flash(q, k, v):
-                return jnp.sum(attn.flash_attention(q, k, v,
-                                                    causal=causal))
-
-            def loss_dot(q, k, v):
-                return jnp.sum(attn.reference_attention(q, k, v,
-                                                        causal=causal))
-
             key = f"seq{seq}_{'causal' if causal else 'full'}"
             entry = {}
-            for name, fn in (("flash", loss_flash), ("dot", loss_dot)):
-                g = jax.jit(jax.grad(fn, argnums=(0, 1, 2)))
+            for name, fn in (("flash", attn.flash_attention),
+                             ("dot", attn.reference_attention)):
                 try:
-                    g(q, k, v)[0].block_until_ready()  # compile
-                    t0 = time.perf_counter()
-                    n_iter = 10
-                    for _ in range(n_iter):
-                        out = g(q, k, v)
-                    out[0].block_until_ready()
                     entry[f"{name}_fwd_bwd_ms"] = round(
-                        (time.perf_counter() - t0) / n_iter * 1e3, 3)
+                        timed_ms_per_iter(fn, q, k, v, causal), 3)
                 except Exception as exc:  # noqa: BLE001 — record, go on
-                    entry[f"{name}_error"] = f"{type(exc).__name__}: " \
-                                             f"{exc}"[:300]
+                    entry[f"{name}_error"] = _scrub_exc(exc)
             if "flash_fwd_bwd_ms" in entry and "dot_fwd_bwd_ms" in entry:
                 entry["speedup"] = round(
                     entry["dot_fwd_bwd_ms"] / entry["flash_fwd_bwd_ms"], 3)
