@@ -7,10 +7,14 @@ model artifact is JSON + weights, never a pickle.
 
 TPU notes: convs/matmuls map to the MXU; LSTM runs as ``nn.RNN``
 (``lax.scan`` under jit — no Python loop); everything is static-shape.
+Recurrent scans unroll ``_RNN_UNROLL`` timesteps per loop iteration so
+XLA can fuse the per-step gate math across steps instead of paying the
+loop latency 200 times for a 200-token review.
 """
 
 from __future__ import annotations
 
+import os
 from typing import Any, Dict, Sequence, Tuple
 
 import jax
@@ -38,6 +42,23 @@ def activation(name, is_output: bool = False):
     if name not in _ACTIVATIONS:
         raise ValueError(f"unknown activation: {name!r}")
     return _ACTIVATIONS[name]
+
+
+# unidirectional recurrent kinds; weights_io's h5 import keys on the
+# OptimizedLSTMCell scope name, so "lstm" must keep that cell class
+_RNN_CELLS = {"lstm": nn.OptimizedLSTMCell, "gru": nn.GRUCell,
+              "simple_rnn": nn.SimpleCell}
+
+
+def _rnn_unroll() -> int:
+    """Timesteps per scan-loop iteration. Default 8 on TPU (per-step
+    loop latency dominates the tiny gate matmuls there), 1 elsewhere —
+    measured on CPU, an unrolled body is ~30% SLOWER (cache thrash),
+    so the knob only engages where it pays."""
+    raw = os.environ.get("LO_RNN_UNROLL")
+    if raw is not None:
+        return max(1, int(raw))
+    return 8 if jax.default_backend() == "tpu" else 1
 
 
 def _output_layer_index(layer_configs) -> int:
@@ -97,6 +118,26 @@ class SequentialModule(nn.Module):
                 x = jnp.mean(x, axis=1)
             elif kind == "globalmaxpool1d":
                 x = jnp.max(x, axis=1)
+            elif kind == "globalmaxpool2d":
+                x = jnp.max(x, axis=(1, 2))
+            elif kind == "conv2d_transpose":
+                kern = tuple(cfg.get("kernel", (3, 3)))
+                strides = tuple(cfg.get("strides", (1, 1)))
+                pad = cfg.get("padding", "SAME")
+                in_hw = x.shape[1:3]
+                x = nn.ConvTranspose(
+                    cfg["filters"], kern, strides=strides,
+                    padding=pad, name=name)(x)
+                if pad.upper() == "VALID":
+                    # keras VALID transpose output is (i-1)*s + k;
+                    # flax computes i*s + max(k-s, 0), which is larger
+                    # by (s-k) per dim when k < s — crop the trailing
+                    # rows/cols (tf.nn.conv2d_transpose crops the same
+                    # way when given an explicit output_shape)
+                    want = [(i - 1) * s + k for i, s, k in
+                            zip(in_hw, strides, kern)]
+                    x = x[:, :want[0], :want[1], :]
+                x = activation(cfg.get("activation"))(x)
             elif kind == "flatten":
                 x = x.reshape((x.shape[0], -1))
             elif kind == "reshape":
@@ -121,15 +162,9 @@ class SequentialModule(nn.Module):
                         "embedding layer needs vocab/dim (or keras "
                         f"input_dim/output_dim); got {dict(cfg)}")
                 x = nn.Embed(vocab, dim, name=name)(x.astype(jnp.int32))
-            elif kind == "lstm":
-                units = cfg["units"]
-                rnn = nn.RNN(nn.OptimizedLSTMCell(units), name=name)
-                x = rnn(x)
-                if not cfg.get("return_sequences", False):
-                    x = x[:, -1, :]
-            elif kind == "gru":
-                units = cfg["units"]
-                rnn = nn.RNN(nn.GRUCell(units), name=name)
+            elif kind in _RNN_CELLS:
+                rnn = nn.RNN(_RNN_CELLS[kind](cfg["units"]), name=name,
+                             unroll=_rnn_unroll())
                 x = rnn(x)
                 if not cfg.get("return_sequences", False):
                     x = x[:, -1, :]
@@ -137,9 +172,11 @@ class SequentialModule(nn.Module):
                 units = cfg["units"]
                 make_cell = (nn.GRUCell if kind.endswith("gru")
                              else nn.OptimizedLSTMCell)
-                fwd = nn.RNN(make_cell(units), name=f"{name}_fwd")
+                fwd = nn.RNN(make_cell(units), name=f"{name}_fwd",
+                             unroll=_rnn_unroll())
                 bwd = nn.RNN(make_cell(units), reverse=True,
-                             keep_order=True, name=f"{name}_bwd")
+                             keep_order=True, name=f"{name}_bwd",
+                             unroll=_rnn_unroll())
                 seq = jnp.concatenate([fwd(x), bwd(x)], axis=-1)
                 x = seq if cfg.get("return_sequences", False) \
                     else seq[:, -1, :]

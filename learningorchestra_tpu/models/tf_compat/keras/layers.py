@@ -86,6 +86,22 @@ class GlobalMaxPooling1D(Layer):
         super().__init__({"kind": "globalmaxpool1d"})
 
 
+class GlobalMaxPooling2D(Layer):
+    def __init__(self, **_: Any):
+        super().__init__({"kind": "globalmaxpool2d"})
+
+
+class Conv2DTranspose(Layer):
+    def __init__(self, filters: int, kernel_size=3, strides=1,
+                 padding: str = "valid", activation: Optional[str] = None,
+                 input_shape: Optional[Sequence[int]] = None, **_: Any):
+        super().__init__({
+            "kind": "conv2d_transpose", "filters": int(filters),
+            "kernel": _pair(kernel_size), "strides": _pair(strides),
+            "padding": padding.upper(), "activation": activation})
+        self.input_shape = list(input_shape) if input_shape else None
+
+
 class Flatten(Layer):
     def __init__(self, **_: Any):
         super().__init__({"kind": "flatten"})
@@ -130,6 +146,13 @@ class GRU(Layer):
     def __init__(self, units: int, return_sequences: bool = False,
                  **_: Any):
         super().__init__({"kind": "gru", "units": int(units),
+                          "return_sequences": bool(return_sequences)})
+
+
+class SimpleRNN(Layer):
+    def __init__(self, units: int, return_sequences: bool = False,
+                 **_: Any):
+        super().__init__({"kind": "simple_rnn", "units": int(units),
                           "return_sequences": bool(return_sequences)})
 
 

@@ -400,19 +400,31 @@ class LanguageModel:
         return self._mesh_override or mesh_lib.get_default_mesh()
 
     # ------------------------------------------------------------------
-    def _resolved_attention(self) -> str:
+    def _resolved_attention(self, seq_len: Optional[int] = None) -> str:
         if self.attention != "auto":
             return self.attention
-        return "flash" if jax.default_backend() == "tpu" else "dot"
+        # On-chip micro-bench (BENCHMARKS.md "Flash kernel"): XLA's
+        # fused dot wins below ~2k tokens (10.4 vs 11.3 ms at 1k),
+        # the Pallas flash kernel wins from ~4k (21.2 vs 36.4 ms) and
+        # is the only path that compiles at 8k+ (dot materializes the
+        # (bh, s, s) scores). Cross over at 2048 on the ACTUAL
+        # sequence length when known (a max_len=4096 model fed
+        # 512-token windows should still take the dot path).
+        if jax.default_backend() == "tpu":
+            return "flash" if (seq_len or self.max_len) >= 2048 else "dot"
+        return "dot"
 
-    @property
-    def module(self) -> TransformerLM:
+    def _module_for(self, seq_len: Optional[int] = None) -> TransformerLM:
         return TransformerLM(
             vocab_size=self.vocab_size, d_model=self.d_model,
             n_layers=self.n_layers, n_heads=self.n_heads, d_ff=self.d_ff,
-            attention=self._resolved_attention(), causal=True,
+            attention=self._resolved_attention(seq_len), causal=True,
             n_experts=self.n_experts, moe_k=self.moe_k,
             dropout=self.dropout, mesh=self._mesh_override)
+
+    @property
+    def module(self) -> TransformerLM:
+        return self._module_for(None)
 
     def compile(self, optimizer: Any = "adamw", loss: Any = None,
                 metrics: Any = None, **_: Any) -> None:
@@ -430,8 +442,11 @@ class LanguageModel:
     def _apply_fn(self, params, model_state, batch, train, rng):
         rngs = {"dropout": rng} if (train and rng is not None and
                                     self.dropout) else None
-        out = self.module.apply({"params": params}, batch["x"],
-                                train=train, rngs=rngs)
+        # batch["x"].shape is static under jit, so "auto" attention
+        # resolves against the real window length at trace time
+        module = self._module_for(int(batch["x"].shape[1]))
+        out = module.apply({"params": params}, batch["x"],
+                           train=train, rngs=rngs)
         return out, model_state
 
     def _build_params(self, sample_x: np.ndarray) -> None:

@@ -198,3 +198,61 @@ def test_embedding_accepts_keras_key_names(tmp_config):
         m.compile("adam", loss="binary_crossentropy")
         h = m.fit(x, y, batch_size=8, epochs=1)
         assert np.isfinite(h.history["loss"][0])
+
+def test_simple_rnn_smoke(tmp_config):
+    from learningorchestra_tpu.models.tf_compat import keras
+
+    rng = np.random.default_rng(1)
+    x = rng.integers(0, 30, size=(96, 10)).astype(np.int32)
+    y = (x[:, 0] > 14).astype(np.int32)
+    model = keras.Sequential([
+        keras.layers.Embedding(30, 8),
+        keras.layers.SimpleRNN(16),
+        keras.layers.Dense(2, activation="softmax"),
+    ])
+    model.compile(optimizer=keras.optimizers.Adam(0.01),
+                  loss="sparse_categorical_crossentropy",
+                  metrics=["accuracy"])
+    history = model.fit(x, y, epochs=2, batch_size=32)
+    assert len(history.history["loss"]) == 2
+    assert model.predict(x[:4]).shape == (4, 2)
+
+
+def test_conv2d_transpose_and_globalmaxpool2d(tmp_config):
+    from learningorchestra_tpu.models.tf_compat import keras
+
+    rng = np.random.default_rng(2)
+    x = rng.normal(size=(64, 8, 8, 1)).astype(np.float32)
+    y = (x.mean(axis=(1, 2, 3)) > 0).astype(np.int32)
+    model = keras.Sequential([
+        keras.layers.Conv2D(4, 3, activation="relu",
+                            input_shape=(8, 8, 1)),
+        keras.layers.Conv2DTranspose(4, 3, strides=2,
+                                     activation="relu"),
+        keras.layers.GlobalMaxPooling2D(),
+        keras.layers.Dense(2, activation="softmax"),
+    ])
+    model.compile(optimizer=keras.optimizers.Adam(0.01),
+                  loss="sparse_categorical_crossentropy",
+                  metrics=["accuracy"])
+    history = model.fit(x, y, epochs=2, batch_size=32)
+    assert len(history.history["loss"]) == 2
+    assert model.predict(x[:4]).shape == (4, 2)
+
+
+def test_conv2d_transpose_valid_matches_keras_shape(tmp_config):
+    """keras VALID transpose output is (i-1)*s + k per dim — with
+    k < s flax pads to i*s, so the module must crop (k=1, s=2 on 8x8
+    gives 15x15, not 16x16)."""
+    import jax
+    import numpy as np
+    from learningorchestra_tpu.models.sequential_module import (
+        SequentialModule)
+
+    mod = SequentialModule((
+        {"kind": "conv2d_transpose", "filters": 2, "kernel": [1, 1],
+         "strides": [2, 2], "padding": "VALID"},))
+    x = np.zeros((1, 8, 8, 1), np.float32)
+    var = mod.init(jax.random.PRNGKey(0), x)
+    out = mod.apply(var, x)
+    assert out.shape == (1, 15, 15, 2)
