@@ -550,18 +550,37 @@ class LanguageModel:
         return eng.predict(state, self._batcher(x, batch_size))
 
     def generate(self, prompt, max_new_tokens: int = 32,
-                 temperature: float = 0.0, seed: int = 0) -> np.ndarray:
+                 temperature: float = 0.0, seed: int = 0,
+                 top_k: Optional[int] = None,
+                 top_p: Optional[float] = None) -> np.ndarray:
         """Greedy / temperature sampling with an incremental KV cache:
         the prompt runs ONCE (prefill fills every layer's K/V cache),
         then each new token is a single-position forward attending
         over the cache — O(L) per token instead of the O(L²) full
         re-forward. prompt: (b, s) token ids.
 
+        ``top_k`` keeps only the k highest-logit tokens and ``top_p``
+        keeps the smallest nucleus whose probability mass reaches p;
+        both apply only when ``temperature > 0`` (greedy decoding
+        ignores them) and compose (k-filter first, then nucleus).
+
         Prompts longer than ``max_len`` keep their last ``max_len - 1``
         tokens (sliding-window truncation). Token id 0 is reserved as
         padding by ``next_token_loss`` and is masked out of sampling.
         """
         self._require_built()
+        if top_k is not None:
+            top_k = int(top_k)
+            if top_k < 1:
+                raise ValueError(f"top_k must be >= 1, got {top_k}")
+            if top_k >= self.vocab_size:
+                top_k = None  # keeps everything — same compile as None
+        if top_p is not None:
+            top_p = float(top_p)
+            if not 0.0 < top_p <= 1.0:
+                raise ValueError(f"top_p must be in (0, 1], got {top_p}")
+            if top_p == 1.0:
+                top_p = None  # keeps everything — same compile as None
         prompt = np.atleast_2d(np.asarray(prompt)).astype(np.int32)
         b, s = prompt.shape
         if s >= self.max_len:
@@ -575,7 +594,8 @@ class LanguageModel:
         buf = np.zeros((b, total), np.int32)
         buf[:, :s] = prompt
         buf = jnp.asarray(buf)
-        prefill, step = self._gen_fns(b, s, total, float(temperature))
+        prefill, step = self._gen_fns(
+            b, s, total, float(temperature), top_k, top_p)
         params = self.params
         key = jax.random.PRNGKey(seed)
         key, sub = jax.random.split(key)
@@ -586,14 +606,32 @@ class LanguageModel:
         return np.asarray(buf)
 
     @staticmethod
-    def _sample(last, temperature: float, key):
+    def _sample(last, temperature: float, key,
+                top_k: Optional[int] = None,
+                top_p: Optional[float] = None):
         # id 0 is the padding/loss-mask token — never emit it
         last = last.astype(jnp.float32).at[..., 0].set(ring_lib.NEG_INF)
-        if temperature > 0:
-            return jax.random.categorical(key, last / temperature, axis=-1)
-        return jnp.argmax(last, axis=-1)
+        if temperature <= 0:
+            return jnp.argmax(last, axis=-1)
+        logits = last / temperature
+        if top_k is not None and top_k < logits.shape[-1]:
+            kth = jnp.sort(logits, axis=-1)[..., -top_k, None]
+            logits = jnp.where(logits < kth, ring_lib.NEG_INF, logits)
+        if top_p is not None and top_p < 1.0:
+            order = jnp.argsort(-logits, axis=-1)
+            ranked = jnp.take_along_axis(logits, order, axis=-1)
+            probs = jax.nn.softmax(ranked, axis=-1)
+            # keep tokens whose EXCLUSIVE prefix mass is < p, so the
+            # token that crosses the threshold stays in the nucleus
+            keep = (jnp.cumsum(probs, axis=-1) - probs) < top_p
+            ranked = jnp.where(keep, ranked, ring_lib.NEG_INF)
+            inv = jnp.argsort(order, axis=-1)
+            logits = jnp.take_along_axis(ranked, inv, axis=-1)
+        return jax.random.categorical(key, logits, axis=-1)
 
-    def _gen_fns(self, b: int, s: int, total: int, temperature: float):
+    def _gen_fns(self, b: int, s: int, total: int, temperature: float,
+                 top_k: Optional[int] = None,
+                 top_p: Optional[float] = None):
         """Jitted (prefill, decode_step) per (batch, prompt_len, total,
         temperature) — params/cache are arguments, not closures, so
         weights stay device-resident and repeated generate() calls
@@ -602,7 +640,8 @@ class LanguageModel:
         fns = getattr(self, "_gen_cache_fns", None)
         if fns is None:
             fns = self._gen_cache_fns = {}
-        sig = (b, s, total, temperature, self._resolved_attention())
+        sig = (b, s, total, temperature, top_k, top_p,
+               self._resolved_attention())
         if sig in fns:
             return fns[sig]
         module = self.module
@@ -612,7 +651,8 @@ class LanguageModel:
             (logits, _), mut = module.apply(
                 {"params": params}, buf[:, :s], train=False,
                 cache_len=total, mutable=["cache"])
-            nxt = self._sample(logits[:, -1], temperature, key)
+            nxt = self._sample(logits[:, -1], temperature, key,
+                               top_k, top_p)
             buf = buf.at[:, s].set(nxt.astype(jnp.int32))
             return buf, mut["cache"]
 
@@ -622,7 +662,8 @@ class LanguageModel:
             (logits, _), mut = module.apply(
                 {"params": params, "cache": cache}, tok, train=False,
                 decode_pos=pos - 1, cache_len=total, mutable=["cache"])
-            nxt = self._sample(logits[:, 0], temperature, key)
+            nxt = self._sample(logits[:, 0], temperature, key,
+                               top_k, top_p)
             buf = jax.lax.dynamic_update_slice(
                 buf, nxt[:, None].astype(jnp.int32), (0, pos))
             return buf, mut["cache"]

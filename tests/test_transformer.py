@@ -150,3 +150,64 @@ def test_flash_attention_in_module(tmp_path):
     out_flash, _ = mk("flash").apply({"params": params}, tokens)
     np.testing.assert_allclose(np.asarray(out_dot), np.asarray(out_flash),
                                atol=1e-4, rtol=1e-4)
+
+
+def test_sample_top_k_top_p_filters():
+    """top_k=1 at temperature>0 must equal greedy; a tight nucleus
+    (top_p -> 0) likewise keeps only the argmax token; and the pad
+    token 0 is never emitted by any mode."""
+    import jax
+    import jax.numpy as jnp
+
+    logits = jnp.asarray([[5.0, 1.0, 4.0, 3.0, 2.0],
+                          [0.0, 2.0, 9.0, 1.0, 8.0]])
+    key = jax.random.PRNGKey(0)
+    greedy = LanguageModel._sample(logits, 0.0, key)
+    k1 = LanguageModel._sample(logits, 1.0, key, top_k=1)
+    p_tiny = LanguageModel._sample(logits, 1.0, key, top_p=1e-6)
+    assert jnp.array_equal(greedy, k1)
+    assert jnp.array_equal(greedy, p_tiny)
+    # pad-token mask: a logits row where 0 dominates must not pick it
+    pad_heavy = jnp.asarray([[99.0, 1.0, 2.0, 3.0, 4.0]])
+    for draw in range(4):
+        out = LanguageModel._sample(
+            pad_heavy, 1.0, jax.random.PRNGKey(draw), top_k=3)
+        assert int(out[0]) != 0
+    # a loose nucleus still samples inside the top mass
+    wide = LanguageModel._sample(logits, 1.0, key, top_k=3, top_p=0.9)
+    assert wide.shape == (2,)
+
+
+def test_generate_with_sampling_filters(tmp_path):
+    _mesh_config(tmp_path, "dp=2")
+    model = LanguageModel(vocab_size=16, d_model=16, n_layers=1,
+                          n_heads=2, max_len=12, attention="dot",
+                          name="lm_topk")
+    x = _toy_tokens(n=16, seq=8, vocab=16)
+    model.fit(x=x, epochs=1, batch_size=8)
+    out = model.generate(x[:2, :4], max_new_tokens=4, temperature=0.8,
+                         top_k=4, top_p=0.9, seed=3)
+    assert out.shape == (2, 8)
+    assert (out[:, :4] == x[:2, :4]).all()
+    assert (out > 0).all()
+
+
+def test_generate_sampling_validation(tmp_path):
+    _mesh_config(tmp_path, "dp=2")
+    model = LanguageModel(vocab_size=16, d_model=16, n_layers=1,
+                          n_heads=2, max_len=12, attention="dot",
+                          name="lm_val")
+    x = _toy_tokens(n=16, seq=8, vocab=16)
+    model.fit(x=x, epochs=1, batch_size=8)
+    with pytest.raises(ValueError):
+        model.generate(x[:1, :4], temperature=1.0, top_k=0)
+    with pytest.raises(ValueError):
+        model.generate(x[:1, :4], temperature=1.0, top_p=0.0)
+    with pytest.raises(ValueError):
+        model.generate(x[:1, :4], temperature=1.0, top_p=1.5)
+    # no-op values normalize to the unfiltered compile (same sig)
+    model.generate(x[:1, :4], max_new_tokens=2, temperature=1.0)
+    n_compiles = len(model._gen_cache_fns)
+    model.generate(x[:1, :4], max_new_tokens=2, temperature=1.0,
+                   top_k=16, top_p=1.0)
+    assert len(model._gen_cache_fns) == n_compiles
