@@ -569,6 +569,10 @@ class LanguageModel:
         padding by ``next_token_loss`` and is masked out of sampling.
         """
         self._require_built()
+        if temperature <= 0:
+            # greedy argmax never reads the filters — normalize so
+            # generate(.., top_k=50) shares the greedy compile
+            top_k = top_p = None
         if top_k is not None:
             top_k = int(top_k)
             if top_k < 1:

@@ -39,6 +39,9 @@ _KERAS_VAR_ORDERS = {
     "batchnorm": ("scale", "bias", "mean", "var"),  # gamma/beta/mm/mv
     # keras packs the 4 gates column-wise in (i, f, c, o) order
     "lstm": ("kernel", "recurrent_kernel", "bias"),
+    # keras packs the 3 gates column-wise in (z, r, h) order; bias is
+    # (2, 3u) when reset_after=True (input row + recurrent row)
+    "gru": ("kernel", "recurrent_kernel", "bias"),
 }
 
 # our layer kind -> the group-name prefix keras auto-assigns the twin
@@ -52,6 +55,7 @@ _KERAS_NAME_PREFIX = {
     "embedding": "embedding",
     "batchnorm": "batch_normalization",
     "lstm": "lstm",
+    "gru": "gru",
 }
 
 # flax OptimizedLSTMCell gate order matching keras's (i, f, c->g, o)
@@ -179,16 +183,26 @@ def load_keras_h5_into_sequential(layer_configs, params: Dict[str, Any],
     params = jax.tree_util.tree_map(np.asarray, params)
     state = jax.tree_util.tree_map(np.asarray, dict(model_state or {}))
     taken: Dict[str, int] = {}
-    # LSTM cells scope under OptimizedLSTMCell_<k> (the nn.RNN wrapper
-    # does not add a name level), in creation order
-    cell_keys = sorted(
-        (k for k in params if k.startswith("OptimizedLSTMCell")),
-        key=_natural_key)
-    cells_taken = 0
+    # recurrent cells scope under <CellClass>_<k> (the nn.RNN wrapper
+    # does not add a name level), in creation order; one pool per kind
+    def _cell_pool(prefix):
+        return iter(sorted((k for k in params if k.startswith(prefix)),
+                           key=_natural_key))
+
+    cell_pools = {"lstm": _cell_pool("OptimizedLSTMCell"),
+                  "gru": _cell_pool("GRUCell")}
+
+    def _next_cell(kind, name):
+        try:
+            return params[next(cell_pools[kind])]
+        except StopIteration:
+            raise ValueError(f"{name}: model has no {kind.upper()} "
+                             f"cell params left to fill") from None
     for i, cfg in enumerate(layer_configs):
         kind = cfg["kind"]
         name = f"{kind}_{i}"
-        if name not in params and kind not in ("batchnorm", "lstm"):
+        if name not in params and kind not in ("batchnorm", "lstm",
+                                               "gru"):
             continue  # parameter-free layer
         if kind not in _KERAS_VAR_ORDERS:
             raise ValueError(
@@ -210,11 +224,7 @@ def load_keras_h5_into_sequential(layer_configs, params: Dict[str, Any],
                 f"{name}: h5 layer has {len(vals)} variables, "
                 f"expected {len(order)} ({order})")
         if kind == "lstm":
-            if cells_taken >= len(cell_keys):
-                raise ValueError(f"{name}: model has no LSTM cell "
-                                 f"params left to fill")
-            cell = params[cell_keys[cells_taken]]
-            cells_taken += 1
+            cell = _next_cell("lstm", name)
             kern, rec, bias = vals
             u = rec.shape[0]
             if kern.shape[1] != 4 * u or bias.shape[0] != 4 * u:
@@ -232,6 +242,45 @@ def load_keras_h5_into_sequential(layer_configs, params: Dict[str, Any],
                 cell[f"h{g}"]["bias"] = _check(
                     name, f"h{g}/bias", cell[f"h{g}"]["bias"],
                     bias[gi * u:(gi + 1) * u])
+        elif kind == "gru":
+            cell = _next_cell("gru", name)
+            kern, rec, bias = vals
+            u = rec.shape[0]
+            if kern.shape[1] != 3 * u:
+                raise ValueError(
+                    f"{name}: keras GRU vars have shapes "
+                    f"{kern.shape}/{rec.shape}, expected (in,3u)/(u,3u)")
+            if bias.ndim != 2 or bias.shape != (2, 3 * u):
+                raise ValueError(
+                    f"{name}: keras GRU bias has shape {bias.shape}; "
+                    "only reset_after=True ((2, 3u) bias) maps onto "
+                    "flax GRUCell, which applies the reset gate after "
+                    "the recurrent matmul")
+            b_in, b_rec = bias[0], bias[1]
+            # keras packs (z, r, h) columns; flax scopes iz/ir/in +
+            # hz/hr/hn. Input and recurrent gate biases collapse into
+            # the single flax i{z,r} bias (the sums are what the math
+            # adds anyway); hn keeps its own bias because the reset
+            # gate multiplies it: n = tanh(in(x) + r * (hn(h) + b)).
+            for col, g in enumerate(("z", "r", "n")):
+                lo, hi = col * u, (col + 1) * u
+                ik = "in" if g == "n" else f"i{g}"
+                cell[ik]["kernel"] = _check(
+                    name, f"{ik}/kernel", cell[ik]["kernel"],
+                    kern[:, lo:hi])
+                cell[f"h{g}"]["kernel"] = _check(
+                    name, f"h{g}/kernel", cell[f"h{g}"]["kernel"],
+                    rec[:, lo:hi])
+                if g == "n":
+                    cell["in"]["bias"] = _check(
+                        name, "in/bias", cell["in"]["bias"], b_in[lo:hi])
+                    cell["hn"]["bias"] = _check(
+                        name, "hn/bias", cell["hn"]["bias"],
+                        b_rec[lo:hi])
+                else:
+                    cell[ik]["bias"] = _check(
+                        name, f"{ik}/bias", cell[ik]["bias"],
+                        b_in[lo:hi] + b_rec[lo:hi])
         elif kind == "batchnorm":
             gamma, beta, mean, var = vals
             params[name]["scale"] = _check(name, "scale",

@@ -224,3 +224,31 @@ def test_flatten_unflatten_inverse():
     back = weights_io.unflatten_params(flat)
     assert set(flat) == {"a/b", "a/c", "d"}
     np.testing.assert_array_equal(back["a"]["b"], tree["a"]["b"])
+
+
+def test_real_keras_gru_h5_matches_tf_predictions(tmp_path, f32_config):
+    """GRU interop: keras packs (z, r, h) columns with a (2, 3u)
+    reset_after bias; flax GRUCell keeps per-gate dense params and
+    applies the reset gate after the recurrent matmul — the same math
+    as reset_after=True, so predictions must match exactly."""
+    keras = pytest.importorskip("keras")
+    from keras import layers
+
+    km = keras.Sequential([
+        layers.Input((7,)),
+        layers.Embedding(30, 8),
+        layers.GRU(5),
+        layers.Dense(3, activation="softmax")])
+    x = np.random.default_rng(9).integers(1, 30, size=(4, 7))
+    want = np.asarray(km(x))
+    path = str(tmp_path / "gru.weights.h5")
+    km.save_weights(path)
+
+    ours = NeuralModel([
+        {"kind": "embedding", "vocab": 30, "dim": 8},
+        {"kind": "gru", "units": 5},
+        {"kind": "dense", "units": 3, "activation": "softmax"}],
+        name="from_keras_gru")
+    ours.load_weights(path, input_shape=(7,))
+    got = ours.predict(x.astype(np.int32), batch_size=4)
+    np.testing.assert_allclose(got, want, atol=1e-5)
