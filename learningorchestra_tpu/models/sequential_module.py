@@ -163,8 +163,13 @@ class SequentialModule(nn.Module):
                         f"input_dim/output_dim); got {dict(cfg)}")
                 x = nn.Embed(vocab, dim, name=name)(x.astype(jnp.int32))
             elif kind in _RNN_CELLS:
-                rnn = nn.RNN(_RNN_CELLS[kind](cfg["units"]), name=name,
-                             unroll=_rnn_unroll())
+                cell_kwargs = {}
+                if kind == "simple_rnn":
+                    cell_kwargs["activation_fn"] = activation(
+                        cfg.get("activation", "tanh"))
+                rnn = nn.RNN(_RNN_CELLS[kind](cfg["units"],
+                                              **cell_kwargs),
+                             name=name, unroll=_rnn_unroll())
                 x = rnn(x)
                 if not cfg.get("return_sequences", False):
                     x = x[:, -1, :]

@@ -135,24 +135,40 @@ class Embedding(Layer):
                           "dim": int(output_dim)})
 
 
+def _require_default_gates(kind: str, activation: str,
+                           recurrent_activation: str) -> None:
+    """flax {OptimizedLSTM,GRU}Cell hard-code tanh/sigmoid gates;
+    fail loudly instead of silently computing different math."""
+    if activation != "tanh" or recurrent_activation != "sigmoid":
+        raise ValueError(
+            f"{kind}: only activation='tanh' with recurrent_activation="
+            f"'sigmoid' is supported (got {activation!r}/"
+            f"{recurrent_activation!r})")
+
+
 class LSTM(Layer):
     def __init__(self, units: int, return_sequences: bool = False,
-                 **_: Any):
+                 activation: str = "tanh",
+                 recurrent_activation: str = "sigmoid", **_: Any):
+        _require_default_gates("LSTM", activation, recurrent_activation)
         super().__init__({"kind": "lstm", "units": int(units),
                           "return_sequences": bool(return_sequences)})
 
 
 class GRU(Layer):
     def __init__(self, units: int, return_sequences: bool = False,
-                 **_: Any):
+                 activation: str = "tanh",
+                 recurrent_activation: str = "sigmoid", **_: Any):
+        _require_default_gates("GRU", activation, recurrent_activation)
         super().__init__({"kind": "gru", "units": int(units),
                           "return_sequences": bool(return_sequences)})
 
 
 class SimpleRNN(Layer):
     def __init__(self, units: int, return_sequences: bool = False,
-                 **_: Any):
+                 activation: str = "tanh", **_: Any):
         super().__init__({"kind": "simple_rnn", "units": int(units),
+                          "activation": activation,
                           "return_sequences": bool(return_sequences)})
 
 

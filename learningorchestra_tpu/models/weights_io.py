@@ -42,6 +42,7 @@ _KERAS_VAR_ORDERS = {
     # keras packs the 3 gates column-wise in (z, r, h) order; bias is
     # (2, 3u) when reset_after=True (input row + recurrent row)
     "gru": ("kernel", "recurrent_kernel", "bias"),
+    "simple_rnn": ("kernel", "recurrent_kernel", "bias"),
 }
 
 # our layer kind -> the group-name prefix keras auto-assigns the twin
@@ -56,6 +57,7 @@ _KERAS_NAME_PREFIX = {
     "batchnorm": "batch_normalization",
     "lstm": "lstm",
     "gru": "gru",
+    "simple_rnn": "simple_rnn",
 }
 
 # flax OptimizedLSTMCell gate order matching keras's (i, f, c->g, o)
@@ -190,7 +192,8 @@ def load_keras_h5_into_sequential(layer_configs, params: Dict[str, Any],
                            key=_natural_key))
 
     cell_pools = {"lstm": _cell_pool("OptimizedLSTMCell"),
-                  "gru": _cell_pool("GRUCell")}
+                  "gru": _cell_pool("GRUCell"),
+                  "simple_rnn": _cell_pool("SimpleCell")}
 
     def _next_cell(kind, name):
         try:
@@ -202,7 +205,7 @@ def load_keras_h5_into_sequential(layer_configs, params: Dict[str, Any],
         kind = cfg["kind"]
         name = f"{kind}_{i}"
         if name not in params and kind not in ("batchnorm", "lstm",
-                                               "gru"):
+                                               "gru", "simple_rnn"):
             continue  # parameter-free layer
         if kind not in _KERAS_VAR_ORDERS:
             raise ValueError(
@@ -281,6 +284,16 @@ def load_keras_h5_into_sequential(layer_configs, params: Dict[str, Any],
                     cell[ik]["bias"] = _check(
                         name, f"{ik}/bias", cell[ik]["bias"],
                         b_in[lo:hi] + b_rec[lo:hi])
+        elif kind == "simple_rnn":
+            cell = _next_cell("simple_rnn", name)
+            kern, rec, bias = vals
+            # keras h' = tanh(x@W + b + h@U) == flax i(x) + h(h)
+            cell["i"]["kernel"] = _check(name, "i/kernel",
+                                         cell["i"]["kernel"], kern)
+            cell["i"]["bias"] = _check(name, "i/bias",
+                                       cell["i"]["bias"], bias)
+            cell["h"]["kernel"] = _check(name, "h/kernel",
+                                         cell["h"]["kernel"], rec)
         elif kind == "batchnorm":
             gamma, beta, mean, var = vals
             params[name]["scale"] = _check(name, "scale",

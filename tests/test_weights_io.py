@@ -252,3 +252,66 @@ def test_real_keras_gru_h5_matches_tf_predictions(tmp_path, f32_config):
     ours.load_weights(path, input_shape=(7,))
     got = ours.predict(x.astype(np.int32), batch_size=4)
     np.testing.assert_allclose(got, want, atol=1e-5)
+
+
+def test_real_keras_simple_rnn_h5_matches_tf_predictions(tmp_path,
+                                                         f32_config):
+    """SimpleRNN interop: keras h' = tanh(x@W + b + h@U) is exactly
+    flax SimpleCell's i(x) + h(h) — a direct copy."""
+    keras = pytest.importorskip("keras")
+    from keras import layers
+
+    km = keras.Sequential([
+        layers.Input((7,)),
+        layers.Embedding(30, 8),
+        layers.SimpleRNN(5),
+        layers.Dense(3, activation="softmax")])
+    x = np.random.default_rng(11).integers(1, 30, size=(4, 7))
+    want = np.asarray(km(x))
+    path = str(tmp_path / "srnn.weights.h5")
+    km.save_weights(path)
+
+    ours = NeuralModel([
+        {"kind": "embedding", "vocab": 30, "dim": 8},
+        {"kind": "simple_rnn", "units": 5},
+        {"kind": "dense", "units": 3, "activation": "softmax"}],
+        name="from_keras_srnn")
+    ours.load_weights(path, input_shape=(7,))
+    got = ours.predict(x.astype(np.int32), batch_size=4)
+    np.testing.assert_allclose(got, want, atol=1e-5)
+
+
+def test_real_keras_simple_rnn_relu_activation_respected(tmp_path,
+                                                         f32_config):
+    """A non-default SimpleRNN activation must flow through the shim
+    into flax SimpleCell (not be silently dropped as tanh)."""
+    keras = pytest.importorskip("keras")
+    from keras import layers
+
+    km = keras.Sequential([
+        layers.Input((6,)),
+        layers.Embedding(20, 4),
+        layers.SimpleRNN(4, activation="relu"),
+        layers.Dense(2, activation="softmax")])
+    x = np.random.default_rng(13).integers(1, 20, size=(3, 6))
+    want = np.asarray(km(x))
+    path = str(tmp_path / "srnn_relu.weights.h5")
+    km.save_weights(path)
+
+    ours = NeuralModel([
+        {"kind": "embedding", "vocab": 20, "dim": 4},
+        {"kind": "simple_rnn", "units": 4, "activation": "relu"},
+        {"kind": "dense", "units": 2, "activation": "softmax"}],
+        name="from_keras_srnn_relu")
+    ours.load_weights(path, input_shape=(6,))
+    got = ours.predict(x.astype(np.int32), batch_size=3)
+    np.testing.assert_allclose(got, want, atol=1e-5)
+
+
+def test_keras_shim_rejects_unsupported_gate_activations():
+    from learningorchestra_tpu.models.tf_compat import keras
+
+    with pytest.raises(ValueError):
+        keras.layers.LSTM(8, activation="relu")
+    with pytest.raises(ValueError):
+        keras.layers.GRU(8, recurrent_activation="hard_sigmoid")
