@@ -326,6 +326,36 @@ class NeuralModel:
                     path)
         self._state = None  # stale engine state would shadow the load
 
+    @classmethod
+    def from_keras(cls, path: str, name: Optional[str] = None,
+                   input_shape: Optional[Sequence[int]] = None
+                   ) -> "NeuralModel":
+        """Build a model from a full keras-3 ``.keras`` archive —
+        architecture (config.json) AND weights (model.weights.h5) in
+        one call, the reference's load-a-real-Keras-artifact flow
+        (binary_executor_image/utils.py:195-221). Sequential
+        topologies only; unmapped layer classes fail loudly."""
+        import os
+        import tempfile
+
+        from learningorchestra_tpu.models import weights_io
+
+        configs, archive_shape, h5_bytes = \
+            weights_io.read_keras_archive(path)
+        input_shape = list(input_shape or archive_shape or []) or None
+        model = cls(configs, name=name or
+                    os.path.splitext(os.path.basename(path))[0])
+        if input_shape:
+            model.input_shape = list(input_shape)
+        fd, tmp = tempfile.mkstemp(suffix=".weights.h5")
+        try:
+            with os.fdopen(fd, "wb") as f:
+                f.write(h5_bytes)
+            model.load_weights(tmp, input_shape=input_shape)
+        finally:
+            os.unlink(tmp)
+        return model
+
     # ------------------------------------------------------------------
     def summary(self) -> str:
         lines = [f"NeuralModel '{self.name}'"]

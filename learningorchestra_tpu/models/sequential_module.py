@@ -125,9 +125,12 @@ class SequentialModule(nn.Module):
                 strides = tuple(cfg.get("strides", (1, 1)))
                 pad = cfg.get("padding", "SAME")
                 in_hw = x.shape[1:3]
+                # transpose_kernel=True is TF/keras semantics (the
+                # gradient of a conv; kernel stored (kh, kw, out, in))
+                # — flax's default False computes a different op
                 x = nn.ConvTranspose(
                     cfg["filters"], kern, strides=strides,
-                    padding=pad, name=name)(x)
+                    padding=pad, transpose_kernel=True, name=name)(x)
                 if pad.upper() == "VALID":
                     # keras VALID transpose output is (i-1)*s + k;
                     # flax computes i*s + max(k-s, 0), which is larger
@@ -151,7 +154,8 @@ class SequentialModule(nn.Module):
                                  epsilon=cfg.get("epsilon", 1e-3),
                                  name=name)(x)
             elif kind == "layernorm":
-                x = nn.LayerNorm(name=name)(x)
+                x = nn.LayerNorm(epsilon=cfg.get("epsilon", 1e-6),
+                                 name=name)(x)
             elif kind == "embedding":
                 # accept native (vocab/dim) and keras (input_dim/
                 # output_dim) key names; fail loud when both missing
@@ -182,9 +186,16 @@ class SequentialModule(nn.Module):
                 bwd = nn.RNN(make_cell(units), reverse=True,
                              keep_order=True, name=f"{name}_bwd",
                              unroll=_rnn_unroll())
-                seq = jnp.concatenate([fwd(x), bwd(x)], axis=-1)
-                x = seq if cfg.get("return_sequences", False) \
-                    else seq[:, -1, :]
+                fseq, bseq = fwd(x), bwd(x)
+                if cfg.get("return_sequences", False):
+                    x = jnp.concatenate([fseq, bseq], axis=-1)
+                else:
+                    # keras concatenates each direction's FULL-pass
+                    # state: forward's sits at the last position,
+                    # backward's at position 0 (keep_order=True flips
+                    # the reversed outputs back to input order)
+                    x = jnp.concatenate([fseq[:, -1, :],
+                                         bseq[:, 0, :]], axis=-1)
             elif kind == "activation":
                 x = activation(cfg.get("fn"), is_output=(i == out_idx))(x)
             elif kind == "input":

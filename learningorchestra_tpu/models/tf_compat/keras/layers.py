@@ -125,8 +125,11 @@ class BatchNormalization(Layer):
 
 
 class LayerNormalization(Layer):
-    def __init__(self, **_: Any):
-        super().__init__({"kind": "layernorm"})
+    def __init__(self, epsilon: float = 1e-3, **_: Any):
+        # keras's default epsilon is 1e-3 (flax's is 1e-6) — carry it
+        # in the config so imported models normalize identically
+        super().__init__({"kind": "layernorm",
+                          "epsilon": float(epsilon)})
 
 
 class Embedding(Layer):

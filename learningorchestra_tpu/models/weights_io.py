@@ -35,14 +35,22 @@ _KERAS_VAR_ORDERS = {
     "dense": ("kernel", "bias"),
     "conv2d": ("kernel", "bias"),
     "conv1d": ("kernel", "bias"),
+    # keras stores (kh, kw, out, in) == flax ConvTranspose with
+    # transpose_kernel=True (sequential_module builds it that way)
+    "conv2d_transpose": ("kernel", "bias"),
     "embedding": ("embedding",),
     "batchnorm": ("scale", "bias", "mean", "var"),  # gamma/beta/mm/mv
+    "layernorm": ("scale", "bias"),  # gamma/beta; flax names coincide
     # keras packs the 4 gates column-wise in (i, f, c, o) order
     "lstm": ("kernel", "recurrent_kernel", "bias"),
     # keras packs the 3 gates column-wise in (z, r, h) order; bias is
     # (2, 3u) when reset_after=True (input row + recurrent row)
     "gru": ("kernel", "recurrent_kernel", "bias"),
     "simple_rnn": ("kernel", "recurrent_kernel", "bias"),
+    # keras h5 nests backward_layer then forward_layer (alphabetical):
+    # 6 vars = backward (k, r, b) + forward (k, r, b)
+    "bidirectional_lstm": ("kernel", "recurrent_kernel", "bias") * 2,
+    "bidirectional_gru": ("kernel", "recurrent_kernel", "bias") * 2,
 }
 
 # our layer kind -> the group-name prefix keras auto-assigns the twin
@@ -53,11 +61,15 @@ _KERAS_NAME_PREFIX = {
     "dense": "dense",
     "conv2d": "conv2d",
     "conv1d": "conv1d",
+    "conv2d_transpose": "conv2d_transpose",
     "embedding": "embedding",
     "batchnorm": "batch_normalization",
+    "layernorm": "layer_normalization",
     "lstm": "lstm",
     "gru": "gru",
     "simple_rnn": "simple_rnn",
+    "bidirectional_lstm": "bidirectional",
+    "bidirectional_gru": "bidirectional",
 }
 
 # flax OptimizedLSTMCell gate order matching keras's (i, f, c->g, o)
@@ -175,12 +187,17 @@ def load_keras_h5_into_sequential(layer_configs, params: Dict[str, Any],
     parameterized layers consumes the next unused group of its kind's
     keras name prefix. Returns new (params, model_state)."""
     h5_layers = read_keras_h5(path)
-    by_kind: Dict[str, List[List[np.ndarray]]] = {}
+    # bucket by the keras GROUP PREFIX (not our kind): two kinds can
+    # share one keras prefix (bidirectional lstm/gru both serialize
+    # under "bidirectional"), and groups consume in natural-sort ==
+    # model order either way
+    by_prefix: Dict[str, List[List[np.ndarray]]] = {}
     matched = 0
+    prefixes = sorted(set(_KERAS_NAME_PREFIX.values()))
     for gname, vals in h5_layers:
-        for kind, prefix in _KERAS_NAME_PREFIX.items():
+        for prefix in prefixes:
             if re.fullmatch(re.escape(prefix) + r"(_\d+)?", gname):
-                by_kind.setdefault(kind, []).append(vals)
+                by_prefix.setdefault(prefix, []).append(vals)
                 break
     params = jax.tree_util.tree_map(np.asarray, params)
     state = jax.tree_util.tree_map(np.asarray, dict(model_state or {}))
@@ -204,96 +221,40 @@ def load_keras_h5_into_sequential(layer_configs, params: Dict[str, Any],
     for i, cfg in enumerate(layer_configs):
         kind = cfg["kind"]
         name = f"{kind}_{i}"
-        if name not in params and kind not in ("batchnorm", "lstm",
-                                               "gru", "simple_rnn"):
+        if name not in params and kind not in (
+                "batchnorm", "lstm", "gru", "simple_rnn",
+                "bidirectional_lstm", "bidirectional_gru"):
             continue  # parameter-free layer
         if kind not in _KERAS_VAR_ORDERS:
             raise ValueError(
                 f"h5 import does not support layer kind {kind!r} "
                 f"(layer {i}); export/import via npz instead")
-        pool = by_kind.get(kind, [])
-        pos = taken.get(kind, 0)
+        prefix = _KERAS_NAME_PREFIX[kind]
+        pool = by_prefix.get(prefix, [])
+        pos = taken.get(prefix, 0)
         if pos >= len(pool):
             raise ValueError(
-                f"h5 file has {len(pool)} "
-                f"{_KERAS_NAME_PREFIX[kind]!r} layer(s) but the model "
-                f"needs more (at {name})")
+                f"h5 file has {len(pool)} {prefix!r} layer(s) but the "
+                f"model needs more (at {name})")
         vals = pool[pos]
-        taken[kind] = pos + 1
+        taken[prefix] = pos + 1
         matched += 1
         order = _KERAS_VAR_ORDERS[kind]
         if len(vals) != len(order):
             raise ValueError(
                 f"{name}: h5 layer has {len(vals)} variables, "
                 f"expected {len(order)} ({order})")
-        if kind == "lstm":
-            cell = _next_cell("lstm", name)
-            kern, rec, bias = vals
-            u = rec.shape[0]
-            if kern.shape[1] != 4 * u or bias.shape[0] != 4 * u:
-                raise ValueError(
-                    f"{name}: keras LSTM vars have shapes "
-                    f"{kern.shape}/{rec.shape}/{bias.shape}, expected "
-                    f"(in,4u)/(u,4u)/(4u,)")
-            for gi, g in enumerate(_LSTM_GATES):
-                cell[f"i{g}"]["kernel"] = _check(
-                    name, f"i{g}/kernel", cell[f"i{g}"]["kernel"],
-                    kern[:, gi * u:(gi + 1) * u])
-                cell[f"h{g}"]["kernel"] = _check(
-                    name, f"h{g}/kernel", cell[f"h{g}"]["kernel"],
-                    rec[:, gi * u:(gi + 1) * u])
-                cell[f"h{g}"]["bias"] = _check(
-                    name, f"h{g}/bias", cell[f"h{g}"]["bias"],
-                    bias[gi * u:(gi + 1) * u])
-        elif kind == "gru":
-            cell = _next_cell("gru", name)
-            kern, rec, bias = vals
-            u = rec.shape[0]
-            if kern.shape[1] != 3 * u:
-                raise ValueError(
-                    f"{name}: keras GRU vars have shapes "
-                    f"{kern.shape}/{rec.shape}, expected (in,3u)/(u,3u)")
-            if bias.ndim != 2 or bias.shape != (2, 3 * u):
-                raise ValueError(
-                    f"{name}: keras GRU bias has shape {bias.shape}; "
-                    "only reset_after=True ((2, 3u) bias) maps onto "
-                    "flax GRUCell, which applies the reset gate after "
-                    "the recurrent matmul")
-            b_in, b_rec = bias[0], bias[1]
-            # keras packs (z, r, h) columns; flax scopes iz/ir/in +
-            # hz/hr/hn. Input and recurrent gate biases collapse into
-            # the single flax i{z,r} bias (the sums are what the math
-            # adds anyway); hn keeps its own bias because the reset
-            # gate multiplies it: n = tanh(in(x) + r * (hn(h) + b)).
-            for col, g in enumerate(("z", "r", "n")):
-                lo, hi = col * u, (col + 1) * u
-                ik = "in" if g == "n" else f"i{g}"
-                cell[ik]["kernel"] = _check(
-                    name, f"{ik}/kernel", cell[ik]["kernel"],
-                    kern[:, lo:hi])
-                cell[f"h{g}"]["kernel"] = _check(
-                    name, f"h{g}/kernel", cell[f"h{g}"]["kernel"],
-                    rec[:, lo:hi])
-                if g == "n":
-                    cell["in"]["bias"] = _check(
-                        name, "in/bias", cell["in"]["bias"], b_in[lo:hi])
-                    cell["hn"]["bias"] = _check(
-                        name, "hn/bias", cell["hn"]["bias"],
-                        b_rec[lo:hi])
-                else:
-                    cell[ik]["bias"] = _check(
-                        name, f"{ik}/bias", cell[ik]["bias"],
-                        b_in[lo:hi] + b_rec[lo:hi])
-        elif kind == "simple_rnn":
-            cell = _next_cell("simple_rnn", name)
-            kern, rec, bias = vals
-            # keras h' = tanh(x@W + b + h@U) == flax i(x) + h(h)
-            cell["i"]["kernel"] = _check(name, "i/kernel",
-                                         cell["i"]["kernel"], kern)
-            cell["i"]["bias"] = _check(name, "i/bias",
-                                       cell["i"]["bias"], bias)
-            cell["h"]["kernel"] = _check(name, "h/kernel",
-                                         cell["h"]["kernel"], rec)
+        if kind in ("lstm", "gru", "simple_rnn"):
+            _FILL_CELL[kind](name, _next_cell(kind, name), *vals)
+        elif kind in ("bidirectional_lstm", "bidirectional_gru"):
+            base = kind.split("_", 1)[1]
+            # keras h5 nests backward_layer before forward_layer
+            # (alphabetical); our fwd cell was created first, so it
+            # holds the LOWER cell index in the pool
+            fwd_cell = _next_cell(base, name)
+            bwd_cell = _next_cell(base, name)
+            _FILL_CELL[base](f"{name}/backward", bwd_cell, *vals[:3])
+            _FILL_CELL[base](f"{name}/forward", fwd_cell, *vals[3:])
         elif kind == "batchnorm":
             gamma, beta, mean, var = vals
             params[name]["scale"] = _check(name, "scale",
@@ -309,7 +270,7 @@ def load_keras_h5_into_sequential(layer_configs, params: Dict[str, Any],
                 if pname in params[name]:
                     params[name][pname] = _check(
                         name, pname, params[name][pname], arr)
-    total = sum(len(v) for v in by_kind.values())
+    total = sum(len(v) for v in by_prefix.values())
     if matched != total:
         raise ValueError(
             f"h5 file has {total - matched} parameterized layer(s) the "
@@ -329,3 +290,184 @@ def _check(layer: str, pname: str, target, arr: np.ndarray) -> np.ndarray:
             f"{layer}/{pname}: h5 has shape {tuple(arr.shape)}, model "
             f"needs {tuple(np.shape(target))}")
     return np.asarray(arr, dtype=np.asarray(target).dtype)
+
+
+def _fill_lstm_cell(name, cell, kern, rec, bias) -> None:
+    u = rec.shape[0]
+    if kern.shape[1] != 4 * u or bias.shape[0] != 4 * u:
+        raise ValueError(
+            f"{name}: keras LSTM vars have shapes "
+            f"{kern.shape}/{rec.shape}/{bias.shape}, expected "
+            f"(in,4u)/(u,4u)/(4u,)")
+    for gi, g in enumerate(_LSTM_GATES):
+        cell[f"i{g}"]["kernel"] = _check(
+            name, f"i{g}/kernel", cell[f"i{g}"]["kernel"],
+            kern[:, gi * u:(gi + 1) * u])
+        cell[f"h{g}"]["kernel"] = _check(
+            name, f"h{g}/kernel", cell[f"h{g}"]["kernel"],
+            rec[:, gi * u:(gi + 1) * u])
+        cell[f"h{g}"]["bias"] = _check(
+            name, f"h{g}/bias", cell[f"h{g}"]["bias"],
+            bias[gi * u:(gi + 1) * u])
+
+
+def _fill_gru_cell(name, cell, kern, rec, bias) -> None:
+    u = rec.shape[0]
+    if kern.shape[1] != 3 * u:
+        raise ValueError(
+            f"{name}: keras GRU vars have shapes "
+            f"{kern.shape}/{rec.shape}, expected (in,3u)/(u,3u)")
+    if bias.ndim != 2 or bias.shape != (2, 3 * u):
+        raise ValueError(
+            f"{name}: keras GRU bias has shape {bias.shape}; only "
+            "reset_after=True ((2, 3u) bias) maps onto flax GRUCell, "
+            "which applies the reset gate after the recurrent matmul")
+    b_in, b_rec = bias[0], bias[1]
+    # keras packs (z, r, h) columns; flax scopes iz/ir/in + hz/hr/hn.
+    # Input and recurrent gate biases collapse into the single flax
+    # i{z,r} bias (the sums are what the math adds anyway); hn keeps
+    # its own bias because the reset gate multiplies it:
+    # n = tanh(in(x) + r * (hn(h) + b)).
+    for col, g in enumerate(("z", "r", "n")):
+        lo, hi = col * u, (col + 1) * u
+        ik = "in" if g == "n" else f"i{g}"
+        cell[ik]["kernel"] = _check(
+            name, f"{ik}/kernel", cell[ik]["kernel"], kern[:, lo:hi])
+        cell[f"h{g}"]["kernel"] = _check(
+            name, f"h{g}/kernel", cell[f"h{g}"]["kernel"],
+            rec[:, lo:hi])
+        if g == "n":
+            cell["in"]["bias"] = _check(
+                name, "in/bias", cell["in"]["bias"], b_in[lo:hi])
+            cell["hn"]["bias"] = _check(
+                name, "hn/bias", cell["hn"]["bias"], b_rec[lo:hi])
+        else:
+            cell[ik]["bias"] = _check(
+                name, f"{ik}/bias", cell[ik]["bias"],
+                b_in[lo:hi] + b_rec[lo:hi])
+
+
+def _fill_simple_cell(name, cell, kern, rec, bias) -> None:
+    # keras h' = act(x@W + b + h@U) == flax i(x) + h(h)
+    cell["i"]["kernel"] = _check(name, "i/kernel",
+                                 cell["i"]["kernel"], kern)
+    cell["i"]["bias"] = _check(name, "i/bias", cell["i"]["bias"], bias)
+    cell["h"]["kernel"] = _check(name, "h/kernel",
+                                 cell["h"]["kernel"], rec)
+
+
+_FILL_CELL = {"lstm": _fill_lstm_cell, "gru": _fill_gru_cell,
+              "simple_rnn": _fill_simple_cell}
+
+
+# ----------------------------------------------------------------------
+# full .keras archive import (architecture + weights)
+# ----------------------------------------------------------------------
+# keras-3 class name -> the tf_compat shim class that already encodes
+# the keras-arg -> layer-config mapping (tf_compat/keras/layers.py).
+# Instantiating shim(**layer_config) and taking its .config keeps ONE
+# conversion path; shim constructors swallow cosmetic keras keys
+# (initializers, regularizers, names) via **_, so semantics-changing
+# keys the shims do NOT model are explicitly rejected below instead of
+# silently producing different math. The reference passes whole Keras
+# artifacts between services (binary_executor_image/utils.py:195-221);
+# this is the equivalent: one call re-creates the model AND weights.
+_KERAS_SHIM_CLASS_NAMES = (
+    "Dense", "Conv2D", "Conv1D", "Conv2DTranspose", "MaxPooling2D",
+    "AveragePooling2D", "MaxPooling1D", "GlobalAveragePooling2D",
+    "GlobalAveragePooling1D", "GlobalMaxPooling1D",
+    "GlobalMaxPooling2D", "Flatten", "Reshape", "Dropout",
+    "BatchNormalization", "LayerNormalization", "Embedding", "LSTM",
+    "GRU", "SimpleRNN", "Activation", "Bidirectional",
+)
+
+# keras config keys whose NON-default values change layer math the
+# shims/module do not model -> loading would silently diverge from
+# the keras original ("fail loudly rather than load garbage")
+_DEFAULT_ONLY_KEYS = {
+    "dilation_rate": lambda v: v in (1, [1, 1], (1, 1), [1], (1,)),
+    "groups": lambda v: v in (1, None),
+    "go_backwards": lambda v: not v,
+    "stateful": lambda v: not v,
+    "use_bias": lambda v: v in (True, None),
+    "data_format": lambda v: v in (None, "channels_last"),
+    "reset_after": lambda v: v in (True, None),
+    # norm layers without a learned scale/offset change the param set
+    "center": lambda v: v in (True, None),
+    "scale": lambda v: v in (True, None),
+}
+# pooling layers: the module pools without padding, so only "valid"
+_POOL_CLASS_NAMES = ("MaxPooling1D", "MaxPooling2D",
+                     "AveragePooling2D")
+
+
+def _reject_non_defaults(cls_name: str, lcfg: Dict[str, Any]) -> None:
+    for key, is_default in _DEFAULT_ONLY_KEYS.items():
+        if key in lcfg and lcfg[key] is not None \
+                and not is_default(lcfg[key]):
+            raise ValueError(
+                f"{cls_name}: unsupported non-default "
+                f"{key}={lcfg[key]!r} — importing would silently "
+                f"change the layer math")
+    if cls_name in _POOL_CLASS_NAMES and \
+            str(lcfg.get("padding") or "valid").lower() != "valid":
+        raise ValueError(
+            f"{cls_name}: only padding='valid' pooling is supported")
+
+
+def read_keras_archive(path: str):
+    """Parse a keras-3 ``.keras`` archive (zip of config.json +
+    model.weights.h5) into ``(layer_configs, input_shape,
+    weights_h5_bytes)``. Only Sequential topologies map onto the
+    layer-config vocabulary; anything else fails loudly."""
+    import json
+    import zipfile
+
+    from learningorchestra_tpu.models.tf_compat.keras import (
+        layers as shim_layers)
+
+    with zipfile.ZipFile(path) as z:
+        cfg = json.loads(z.read("config.json"))
+        weights = z.read("model.weights.h5")
+    if cfg.get("class_name") != "Sequential":
+        raise ValueError(
+            f"only Sequential .keras archives are supported, got "
+            f"{cfg.get('class_name')!r}")
+    seq_cfg = cfg["config"]
+    input_shape = None
+    build_shape = seq_cfg.get("build_input_shape")
+    if build_shape:
+        # recorded when the model was built without an explicit
+        # InputLayer in the serialized layer list
+        input_shape = list(build_shape[1:])
+    configs: List[Dict[str, Any]] = []
+    for layer in seq_cfg["layers"]:
+        cls = layer["class_name"]
+        lcfg = layer.get("config", {})
+        if cls == "InputLayer":
+            shape = lcfg.get("batch_shape") or lcfg.get(
+                "batch_input_shape")
+            if shape:
+                input_shape = list(shape[1:])
+            continue
+        if cls == "Bidirectional":
+            # keras nests the wrapped RNN layer's own serialization
+            inner = lcfg.get("layer", {})
+            _reject_non_defaults(inner.get("class_name", "?"),
+                                 inner.get("config", {}))
+            inner_shim = getattr(shim_layers,
+                                 inner.get("class_name", ""), None)
+            if inner_shim is None:
+                raise ValueError(
+                    f"Bidirectional wraps unsupported layer "
+                    f"{inner.get('class_name')!r}")
+            configs.append(shim_layers.Bidirectional(
+                inner_shim(**inner.get("config", {}))).config)
+            continue
+        if cls not in _KERAS_SHIM_CLASS_NAMES:
+            raise ValueError(
+                f"keras layer {cls!r} has no layer-config mapping "
+                f"(supported: {sorted(_KERAS_SHIM_CLASS_NAMES)})")
+        _reject_non_defaults(cls, lcfg)
+        configs.append(getattr(shim_layers, cls)(**lcfg).config)
+    return configs, input_shape, weights

@@ -315,3 +315,155 @@ def test_keras_shim_rejects_unsupported_gate_activations():
         keras.layers.LSTM(8, activation="relu")
     with pytest.raises(ValueError):
         keras.layers.GRU(8, recurrent_activation="hard_sigmoid")
+
+
+def test_from_keras_archive_rebuilds_model_and_weights(tmp_path,
+                                                       f32_config):
+    """NeuralModel.from_keras(.keras) re-creates BOTH the architecture
+    and the weights from a real keras save() archive — the reference's
+    whole-artifact reload (utils.py:195-221) in one call."""
+    keras = pytest.importorskip("keras")
+    from keras import layers
+
+    km = keras.Sequential([
+        layers.Input((10,)),
+        layers.Embedding(25, 6),
+        layers.GRU(4),
+        layers.Dense(3, activation="softmax")])
+    x = np.random.default_rng(17).integers(1, 25, size=(5, 10))
+    want = np.asarray(km(x))
+    path = str(tmp_path / "whole_model.keras")
+    km.save(path)
+
+    ours = NeuralModel.from_keras(path)
+    kinds = [c["kind"] for c in ours.layer_configs]
+    assert kinds == ["embedding", "gru", "dense"]
+    got = ours.predict(x.astype(np.int32), batch_size=5)
+    np.testing.assert_allclose(got, want, atol=1e-5)
+
+
+def test_from_keras_archive_rejects_unknown_layer(tmp_path):
+    keras = pytest.importorskip("keras")
+    from keras import layers
+
+    km = keras.Sequential([
+        layers.Input((4, 8)),
+        layers.UnitNormalization(),
+        layers.Flatten(),
+        layers.Dense(2)])
+    path = str(tmp_path / "unsupported.keras")
+    km.save(path)
+    with pytest.raises(ValueError, match="no layer-config mapping"):
+        NeuralModel.from_keras(path)
+
+
+def test_real_keras_bidirectional_lstm_h5_parity(tmp_path, f32_config):
+    """Bidirectional parity: keras concatenates forward's final state
+    with backward's FULL-pass state (which our keep_order=True RNN
+    leaves at position 0, not -1)."""
+    keras = pytest.importorskip("keras")
+    from keras import layers
+
+    km = keras.Sequential([
+        layers.Input((7,)),
+        layers.Embedding(20, 4),
+        layers.Bidirectional(layers.LSTM(3)),
+        layers.Dense(2, activation="softmax")])
+    x = np.random.default_rng(21).integers(1, 20, size=(4, 7))
+    want = np.asarray(km(x))
+    path = str(tmp_path / "bidir.weights.h5")
+    km.save_weights(path)
+
+    ours = NeuralModel([
+        {"kind": "embedding", "vocab": 20, "dim": 4},
+        {"kind": "bidirectional_lstm", "units": 3},
+        {"kind": "dense", "units": 2, "activation": "softmax"}],
+        name="from_keras_bidir")
+    ours.load_weights(path, input_shape=(7,))
+    got = ours.predict(x.astype(np.int32), batch_size=4)
+    np.testing.assert_allclose(got, want, atol=1e-5)
+
+
+def test_real_keras_bidirectional_return_sequences_h5_parity(
+        tmp_path, f32_config):
+    keras = pytest.importorskip("keras")
+    from keras import layers
+
+    km = keras.Sequential([
+        layers.Input((6,)),
+        layers.Embedding(15, 4),
+        layers.Bidirectional(layers.GRU(3, return_sequences=True)),
+        layers.Flatten(),
+        layers.Dense(2, activation="softmax")])
+    x = np.random.default_rng(23).integers(1, 15, size=(3, 6))
+    want = np.asarray(km(x))
+    path = str(tmp_path / "bidir_seq.weights.h5")
+    km.save_weights(path)
+
+    ours = NeuralModel([
+        {"kind": "embedding", "vocab": 15, "dim": 4},
+        {"kind": "bidirectional_gru", "units": 3,
+         "return_sequences": True},
+        {"kind": "flatten"},
+        {"kind": "dense", "units": 2, "activation": "softmax"}],
+        name="from_keras_bidir_seq")
+    ours.load_weights(path, input_shape=(6,))
+    got = ours.predict(x.astype(np.int32), batch_size=3)
+    np.testing.assert_allclose(got, want, atol=1e-5)
+
+
+def test_from_keras_conv_transpose_layernorm_parity(tmp_path,
+                                                    f32_config):
+    """Whole-archive import covering Conv2DTranspose (keras stores
+    (kh,kw,out,in) — axes swap) and LayerNormalization (keras epsilon
+    1e-3 must carry over)."""
+    keras = pytest.importorskip("keras")
+    from keras import layers
+
+    km = keras.Sequential([
+        layers.Input((6, 6, 2)),
+        layers.Conv2DTranspose(3, 3, strides=2, activation="relu"),
+        layers.LayerNormalization(),
+        layers.GlobalAveragePooling2D(),
+        layers.Dense(2, activation="softmax")])
+    x = np.random.default_rng(29).normal(size=(3, 6, 6, 2)) \
+        .astype(np.float32)
+    want = np.asarray(km(x))
+    path = str(tmp_path / "convt.keras")
+    km.save(path)
+
+    ours = NeuralModel.from_keras(path)
+    got = ours.predict(x, batch_size=3)
+    np.testing.assert_allclose(got, want, atol=1e-5)
+
+
+def test_from_keras_build_input_shape_fallback(tmp_path, f32_config):
+    """Archives saved WITHOUT an explicit Input layer record the shape
+    in build_input_shape — from_keras must pick it up."""
+    keras = pytest.importorskip("keras")
+    from keras import layers
+
+    km = keras.Sequential([layers.Dense(4, activation="relu"),
+                           layers.Dense(2, activation="softmax")])
+    x = np.random.default_rng(31).normal(size=(3, 5)).astype(np.float32)
+    want = np.asarray(km(x))  # builds the model
+    path = str(tmp_path / "nobuildinput.keras")
+    km.save(path)
+
+    ours = NeuralModel.from_keras(path)
+    got = ours.predict(x, batch_size=3)
+    np.testing.assert_allclose(got, want, atol=1e-5)
+
+
+def test_from_keras_rejects_semantics_changing_configs(tmp_path):
+    keras = pytest.importorskip("keras")
+    from keras import layers
+
+    km = keras.Sequential([
+        layers.Input((8, 8, 1)),
+        layers.Conv2D(2, 3, dilation_rate=2),
+        layers.Flatten(), layers.Dense(2)])
+    path = str(tmp_path / "dilated.keras")
+    km.save(path)
+    with pytest.raises(ValueError, match="dilation_rate"):
+        NeuralModel.from_keras(path)
