@@ -46,4 +46,9 @@ Model = Sequential
 
 
 def load_model(path: str) -> NeuralModel:
+    """Real keras-3 ``.keras`` archives rebuild architecture+weights
+    (NeuralModel.from_keras); other paths load this framework's own
+    saved artifacts."""
+    if str(path).endswith(".keras"):
+        return NeuralModel.from_keras(path)
     return NeuralModel.__lo_load__(path)

@@ -467,3 +467,20 @@ def test_from_keras_rejects_semantics_changing_configs(tmp_path):
     km.save(path)
     with pytest.raises(ValueError, match="dilation_rate"):
         NeuralModel.from_keras(path)
+
+
+def test_load_model_shim_opens_keras_archives(tmp_path, f32_config):
+    keras = pytest.importorskip("keras")
+    from keras import layers
+
+    km = keras.Sequential([layers.Input((4,)),
+                           layers.Dense(2, activation="softmax")])
+    x = np.random.default_rng(37).normal(size=(2, 4)).astype(np.float32)
+    want = np.asarray(km(x))
+    path = str(tmp_path / "lm.keras")
+    km.save(path)
+
+    from learningorchestra_tpu.models.tf_compat import keras as shim
+    ours = shim.models.load_model(path)
+    got = ours.predict(x, batch_size=2)
+    np.testing.assert_allclose(got, want, atol=1e-5)
