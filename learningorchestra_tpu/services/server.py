@@ -210,10 +210,46 @@ class Api:
                 "responsesByStatus": dict(sorted(self._statuses.items())),
                 "meanDispatchSeconds": round(
                     self._latency_sum / n, 6) if n else None,
+                "dispatchSecondsSum": round(self._latency_sum, 6),
             }
         out["jobsRunning"] = self.ctx.jobs.running()
         out["collections"] = len(self.ctx.catalog.list_collections())
         return out
+
+    def metrics_prometheus(self) -> bytes:
+        """Prometheus text exposition of :meth:`metrics` (KrakenD's
+        collector on :8090 is the reference's version of this,
+        krakend.json:1752-1760; text format is what the ecosystem's
+        scrapers actually ingest)."""
+        # sum and count come from the same metrics() snapshot so
+        # rate(sum)/rate(count) stays consistent under load
+        m = self.metrics()
+
+        def esc(v: str) -> str:
+            return str(v).replace("\\", r"\\").replace('"', r'\"')
+
+        lines = [
+            "# TYPE lo_uptime_seconds gauge",
+            f"lo_uptime_seconds {m['uptimeSeconds']}",
+            "# TYPE lo_requests_total counter",
+        ]
+        for route, n in m["requestsByRoute"].items():
+            lines.append(
+                f'lo_requests_total{{route="{esc(route)}"}} {n}')
+        lines.append("# TYPE lo_responses_total counter")
+        for status, n in m["responsesByStatus"].items():
+            lines.append(
+                f'lo_responses_total{{status="{esc(status)}"}} {n}')
+        lines += [
+            "# TYPE lo_dispatch_seconds summary",
+            f"lo_dispatch_seconds_sum {m['dispatchSecondsSum']}",
+            f"lo_dispatch_seconds_count {m['requestsTotal']}",
+            "# TYPE lo_jobs_running gauge",
+            f"lo_jobs_running {m['jobsRunning']}",
+            "# TYPE lo_collections gauge",
+            f"lo_collections {m['collections']}",
+        ]
+        return ("\n".join(lines) + "\n").encode()
 
     # ------------------------------------------------------------------
     def _route(self, method: str, path: str, params: Dict[str, Any],
@@ -223,6 +259,9 @@ class Api:
         if path == "/health":
             return 200, self._health(), "application/json"
         if path == "/metrics":
+            if params.get("format") == "prometheus":
+                return (200, self.metrics_prometheus(),
+                        "text/plain; version=0.0.4; charset=utf-8")
             return 200, self.metrics(), "application/json"
         if not path.startswith(prefix + "/"):
             return 404, {"result": "unknown route"}, "application/json"

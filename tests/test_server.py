@@ -531,3 +531,22 @@ def test_profile_trace_capture(server):
     st, body = _call(server, "POST", f"{API}/profile",
                      body={"action": "stop"})
     assert st == 406
+
+
+def test_metrics_prometheus_exposition(server):
+    status, _ = _call(server, "GET", "/health")
+    assert status == 200
+    import urllib.request
+    with urllib.request.urlopen(
+            f"{server.base_url}/metrics?format=prometheus") as r:
+        assert r.status == 200
+        assert r.headers["Content-Type"].startswith("text/plain")
+        text = r.read().decode()
+    assert "lo_uptime_seconds" in text
+    assert 'lo_requests_total{route=' in text
+    assert "lo_jobs_running" in text
+    # every sample line is "name{labels} value" or "name value"
+    for line in text.strip().splitlines():
+        if line.startswith("#"):
+            continue
+        assert len(line.rsplit(" ", 1)) == 2, line
