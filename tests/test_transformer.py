@@ -211,3 +211,32 @@ def test_generate_sampling_validation(tmp_path):
     model.generate(x[:1, :4], max_new_tokens=2, temperature=1.0,
                    top_k=16, top_p=1.0)
     assert len(model._gen_cache_fns) == n_compiles
+
+
+def test_ring_attention_32k_step_lowers(tmp_path):
+    """Long-context static-shape proof: the full sharded train step at
+    seq 32768 over an sp=8 ring LOWERS (trace + SPMD partitioning)
+    without materializing any (s, s) buffer — execution would be the
+    TPU's job; the lowering is what must not depend on sequence
+    length fitting in one device's memory."""
+    _mesh_config(tmp_path, "sp=8")
+    model = LanguageModel(vocab_size=64, d_model=32, n_layers=1,
+                          n_heads=4, d_ff=64, max_len=32768,
+                          attention="ring", name="lm32k")
+    x = np.ones((1, 32768), np.int32)
+    model._build_params(x[:, :8])  # tiny init; shapes are per-call
+    eng = model._get_engine()
+    state = eng.init_state(model.params)
+    step = jax.jit(eng._train_step_body)
+    lowered = step.lower(state, {"x": jax.ShapeDtypeStruct(
+        (1, 32768), jnp.int32)}, jax.random.PRNGKey(0))
+    text = lowered.as_text()
+    # the ring runs inside a shard_map manual computation over the
+    # 8-way sp mesh (the ppermute appears only after XLA partitioning,
+    # which .compile() would run — lowering is the static-shape proof)
+    assert "num_partitions = 8" in text
+    assert "manual_computation" in text
+    # the invariant that makes 32k viable: nothing in the lowered
+    # program materializes the (s, s) score/mask tensor (the dot path
+    # lowers a 32768x32768 buffer here; the ring must not)
+    assert "32768x32768" not in text
