@@ -60,6 +60,8 @@ _LOSSES = {
 _METRICS = {
     "accuracy": engine_lib.accuracy_metric,
     "acc": engine_lib.accuracy_metric,
+    "precision": engine_lib.precision_metric,
+    "recall": engine_lib.recall_metric,
 }
 
 

@@ -579,19 +579,61 @@ def mse_loss(outputs, batch, weights):
     return _weighted_mean(losses, weights)
 
 
-def accuracy_metric(outputs, batch, weights):
-    """Returns (correct_sum, count) for exact masked aggregation."""
+def _hard_predictions(outputs, batch):
+    """(pred, y) as float32 class ids — argmax for multi-class heads,
+    threshold-at-0 for single-logit heads (one decision rule shared by
+    accuracy/precision/recall)."""
     logits = outputs.astype(jnp.float32)
     y = batch["y"]
     if logits.ndim >= 2 and logits.shape[-1] > 1:
-        pred = jnp.argmax(logits, axis=-1)
-        correct = (pred == y.astype(pred.dtype)).astype(jnp.float32)
+        pred = jnp.argmax(logits, axis=-1).astype(jnp.float32)
     else:
         if logits.ndim == y.ndim + 1:
             logits = logits[..., 0]
         pred = (logits > 0).astype(jnp.float32)
-        correct = (pred == y.astype(jnp.float32)).astype(jnp.float32)
+    return pred, y.astype(jnp.float32)
+
+
+def accuracy_metric(outputs, batch, weights):
+    """Returns (correct_sum, count) for exact masked aggregation."""
+    pred, y = _hard_predictions(outputs, batch)
+    correct = (pred == y).astype(jnp.float32)
     if weights is None:
         return jnp.sum(correct), jnp.asarray(correct.size, jnp.float32)
     w = weights.astype(jnp.float32)
     return jnp.sum(correct * w), jnp.sum(w)
+
+
+def _require_binary_head(outputs, metric: str) -> None:
+    # shapes are static at trace time, so this raises at compile —
+    # class-1-vs-rest on a >2-class head matches neither keras nor any
+    # macro/micro average and must not be reported silently
+    if outputs.ndim >= 2 and outputs.shape[-1] > 2:
+        raise ValueError(
+            f"metric {metric!r} is binary (positive = class 1); the "
+            f"model head has {outputs.shape[-1]} classes — use "
+            f"'accuracy' or a custom metric for multi-class")
+
+
+def precision_metric(outputs, batch, weights):
+    """Binary precision as an exact (sum, count) pair: TP over
+    predicted-positive, positive = class 1 (keras Precision default)."""
+    _require_binary_head(outputs, "precision")
+    pred, y = _hard_predictions(outputs, batch)
+    w = (jnp.ones_like(pred) if weights is None
+         else weights.astype(jnp.float32))
+    pred_pos = (pred == 1.0).astype(jnp.float32) * w
+    tp = pred_pos * (y == 1.0).astype(jnp.float32)
+    return jnp.sum(tp), jnp.sum(pred_pos)
+
+
+def recall_metric(outputs, batch, weights):
+    """Binary recall as an exact (sum, count) pair: TP over
+    actual-positive, positive = class 1 (keras Recall default)."""
+    _require_binary_head(outputs, "recall")
+    pred, y = _hard_predictions(outputs, batch)
+    w = (jnp.ones_like(pred) if weights is None
+         else weights.astype(jnp.float32))
+    actual_pos = (y == 1.0).astype(jnp.float32) * w
+    tp = actual_pos * (pred == 1.0).astype(jnp.float32)
+    return jnp.sum(tp), jnp.sum(actual_pos)

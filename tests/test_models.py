@@ -256,3 +256,45 @@ def test_conv2d_transpose_valid_matches_keras_shape(tmp_config):
     var = mod.init(jax.random.PRNGKey(0), x)
     out = mod.apply(var, x)
     assert out.shape == (1, 15, 15, 2)
+
+
+def test_precision_recall_metrics_match_sklearn(tmp_config):
+    """compile(metrics=[...,'precision','recall']) values must equal
+    sklearn's on the model's own hard predictions."""
+    from sklearn.metrics import precision_score, recall_score
+
+    rng = np.random.default_rng(3)
+    x = rng.normal(size=(256, 8)).astype(np.float32)
+    y = (x[:, 0] + 0.3 * rng.normal(size=256) > 0).astype(np.int32)
+    from learningorchestra_tpu.models.neural import NeuralModel
+
+    model = NeuralModel([
+        {"kind": "dense", "units": 8, "activation": "relu"},
+        {"kind": "dense", "units": 2, "activation": "softmax"}],
+        name="pr")
+    model.compile(optimizer={"kind": "adam", "learning_rate": 0.01},
+                  loss="sparse_categorical_crossentropy",
+                  metrics=["accuracy", "precision", "recall"])
+    model.fit(x=x, y=y, epochs=3, batch_size=64, shuffle=False)
+    res = model.evaluate(x=x, y=y, batch_size=64)
+    pred = np.argmax(model.predict(x, batch_size=64), axis=-1)
+    np.testing.assert_allclose(res["precision"],
+                               precision_score(y, pred), atol=1e-6)
+    np.testing.assert_allclose(res["recall"],
+                               recall_score(y, pred), atol=1e-6)
+
+
+def test_precision_rejects_multiclass_head(tmp_config):
+    from learningorchestra_tpu.models.neural import NeuralModel
+
+    rng = np.random.default_rng(4)
+    x = rng.normal(size=(64, 4)).astype(np.float32)
+    y = rng.integers(0, 5, size=64).astype(np.int32)
+    model = NeuralModel([
+        {"kind": "dense", "units": 5, "activation": "softmax"}],
+        name="mc")
+    model.compile(optimizer={"kind": "adam"},
+                  loss="sparse_categorical_crossentropy",
+                  metrics=["precision"])
+    with pytest.raises(ValueError, match="binary"):
+        model.fit(x=x, y=y, epochs=1, batch_size=32)
