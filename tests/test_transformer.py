@@ -240,3 +240,26 @@ def test_ring_attention_32k_step_lowers(tmp_path):
     # program materializes the (s, s) score/mask tensor (the dot path
     # lowers a 32768x32768 buffer here; the ring must not)
     assert "32768x32768" not in text
+
+
+def test_ulysses_16k_mixed_mesh_step_lowers(tmp_path):
+    """Ulysses head-sharded SP composed with dp on one mesh: the
+    seq-16384 train step partitions over sp=4,dp=2 with the
+    head-scatter/seq-gather all_to_all pair in the manual
+    computation. (On TPU the inner per-head attention is the flash
+    kernel — no (s, s) buffer, ulysses.py:41-48; the dense tile in
+    this CPU lowering is the test backend's reference fallback.)"""
+    _mesh_config(tmp_path, "dp=2,sp=4")
+    model = LanguageModel(vocab_size=64, d_model=32, n_layers=1,
+                          n_heads=4, d_ff=64, max_len=16384,
+                          attention="ulysses", name="lm16k")
+    x = np.ones((2, 16384), np.int32)
+    model._build_params(x[:, :8])
+    eng = model._get_engine()
+    state = eng.init_state(model.params)
+    step = jax.jit(eng._train_step_body)
+    text = step.lower(state, {"x": jax.ShapeDtypeStruct(
+        (2, 16384), jnp.int32)}, jax.random.PRNGKey(0)).as_text()
+    assert "num_partitions = 8" in text
+    assert "manual_computation" in text
+    assert "all_to_all" in text
