@@ -51,14 +51,12 @@ _RNN_CELLS = {"lstm": nn.OptimizedLSTMCell, "gru": nn.GRUCell,
 
 
 def _rnn_unroll() -> int:
-    """Timesteps per scan-loop iteration. Default 8 on TPU (per-step
-    loop latency dominates the tiny gate matmuls there), 1 elsewhere —
-    measured on CPU, an unrolled body is ~30% SLOWER (cache thrash),
-    so the knob only engages where it pays."""
-    raw = os.environ.get("LO_RNN_UNROLL")
-    if raw is not None:
-        return max(1, int(raw))
-    return 8 if jax.default_backend() == "tpu" else 1
+    """Timesteps per scan-loop iteration (LO_RNN_UNROLL). Default 1:
+    measured on CPU an unrolled body is ~30% SLOWER (cache thrash),
+    and the TPU win (amortizing per-step loop latency over the tiny
+    gate matmuls) is plausible but not yet measured on-chip — flip
+    the default only with a number."""
+    return max(1, int(os.environ.get("LO_RNN_UNROLL", "1")))
 
 
 def _output_layer_index(layer_configs) -> int:
