@@ -7,9 +7,9 @@ model artifact is JSON + weights, never a pickle.
 
 TPU notes: convs/matmuls map to the MXU; LSTM runs as ``nn.RNN``
 (``lax.scan`` under jit — no Python loop); everything is static-shape.
-Recurrent scans unroll ``_RNN_UNROLL`` timesteps per loop iteration so
-XLA can fuse the per-step gate math across steps instead of paying the
-loop latency 200 times for a 200-token review.
+Recurrent scans honor ``LO_RNN_UNROLL`` (timesteps per loop iteration,
+default 1 — see :func:`_rnn_unroll`) and ``LO_LSTM_HOIST=1`` swaps the
+per-step LSTM cell for :class:`HoistedLSTM`.
 """
 
 from __future__ import annotations
@@ -48,6 +48,52 @@ def activation(name, is_output: bool = False):
 # OptimizedLSTMCell scope name, so "lstm" must keep that cell class
 _RNN_CELLS = {"lstm": nn.OptimizedLSTMCell, "gru": nn.GRUCell,
               "simple_rnn": nn.SimpleCell}
+
+
+class HoistedLSTM(nn.Module):
+    """LSTM with the input projection hoisted out of the scan: one
+    (B*T, F) x (F, 4H) MXU matmul covers every timestep's x-half, so
+    the sequential loop carries only the (B, H) x (H, 4H) recurrent
+    matmul — half the scan FLOPs of a per-step cell and a far better
+    MXU shape for the input half. Params use the KERAS packed layout
+    (kernel/recurrent_kernel/bias, gate columns i, f, g(c), o) so real
+    h5 weights copy in directly. Opt-in via LO_LSTM_HOIST=1; the
+    param tree differs from the OptimizedLSTMCell path, so flipping
+    the flag changes checkpoint layout (documented trade)."""
+
+    units: int
+
+    @nn.compact
+    def __call__(self, x):  # (B, T, F) -> (B, T, H)
+        h = self.units
+        kern = self.param("kernel", nn.initializers.lecun_normal(),
+                          (x.shape[-1], 4 * h))
+        rec = self.param("recurrent_kernel",
+                         nn.initializers.orthogonal(), (h, 4 * h))
+        bias = self.param("bias", nn.initializers.zeros, (4 * h,))
+        xw = x @ kern + bias                      # (B, T, 4H), hoisted
+        b = x.shape[0]
+        carry = (jnp.zeros((b, h), xw.dtype), jnp.zeros((b, h),
+                                                        xw.dtype))
+
+        def step(carry, xw_t):
+            c, hs = carry
+            z = xw_t + hs @ rec
+            zi, zf, zg, zo = jnp.split(z, 4, axis=-1)
+            i, f, o = (nn.sigmoid(zi), nn.sigmoid(zf), nn.sigmoid(zo))
+            g = jnp.tanh(zg)
+            c = f * c + i * g
+            hs = o * jnp.tanh(c)
+            return (c, hs), hs
+
+        _, ys = jax.lax.scan(step, carry, xw.swapaxes(0, 1),
+                             unroll=_rnn_unroll())
+        return ys.swapaxes(0, 1)
+
+
+def _lstm_hoist() -> bool:
+    return os.environ.get("LO_LSTM_HOIST", "").lower() in (
+        "1", "true", "yes")
 
 
 def _rnn_unroll() -> int:
@@ -165,6 +211,11 @@ class SequentialModule(nn.Module):
                         f"input_dim/output_dim); got {dict(cfg)}")
                 x = nn.Embed(vocab, dim, name=name)(x.astype(jnp.int32))
             elif kind in _RNN_CELLS:
+                if kind == "lstm" and _lstm_hoist():
+                    x = HoistedLSTM(cfg["units"], name=name)(x)
+                    if not cfg.get("return_sequences", False):
+                        x = x[:, -1, :]
+                    continue
                 cell_kwargs = {}
                 if kind == "simple_rnn":
                     cell_kwargs["activation_fn"] = activation(

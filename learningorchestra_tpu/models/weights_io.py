@@ -244,7 +244,12 @@ def load_keras_h5_into_sequential(layer_configs, params: Dict[str, Any],
             raise ValueError(
                 f"{name}: h5 layer has {len(vals)} variables, "
                 f"expected {len(order)} ({order})")
-        if kind in ("lstm", "gru", "simple_rnn"):
+        # HoistedLSTM (LO_LSTM_HOIST=1) stores the keras packed layout
+        # directly under the layer name, so it takes the generic copy
+        # branch below; only cell-scoped recurrent layers (name absent
+        # from params) go through the gate-splitting fillers
+        if kind in ("lstm", "gru", "simple_rnn") \
+                and name not in params:
             _FILL_CELL[kind](name, _next_cell(kind, name), *vals)
         elif kind in ("bidirectional_lstm", "bidirectional_gru"):
             base = kind.split("_", 1)[1]
