@@ -358,6 +358,28 @@ class NeuralModel:
             os.unlink(tmp)
         return model
 
+    def to_keras(self, input_shape: Optional[Sequence[int]] = None):
+        """A REAL keras model with this model's weights (inverse gate
+        packing) — requires the ``keras`` package. The returned model
+        predicts identically and serializes with ``.save()``."""
+        from learningorchestra_tpu.models import weights_io
+
+        self._require_built()
+        shape = list(input_shape or self.input_shape or [])
+        if not shape:
+            raise ValueError("pass input_shape= (the model never saw "
+                             "a sample to record it)")
+        return weights_io.build_keras_model(
+            self.layer_configs, self.params, self.model_state, shape)
+
+    def save_keras(self, path: str,
+                   input_shape: Optional[Sequence[int]] = None) -> None:
+        """Write a real ``.keras`` archive loadable by stock keras —
+        the reverse of :meth:`from_keras` (the reference ships real
+        Keras artifacts between services, utils.py:195-221; this keeps
+        the exit door open too)."""
+        self.to_keras(input_shape=input_shape).save(path)
+
     # ------------------------------------------------------------------
     def summary(self) -> str:
         lines = [f"NeuralModel '{self.name}'"]

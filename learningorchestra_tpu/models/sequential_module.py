@@ -21,10 +21,18 @@ import jax
 import jax.numpy as jnp
 from flax import linen as nn
 
+# this vocabulary is the KERAS-compat surface, so entries whose flax
+# defaults differ from keras's are pinned to the keras semantics:
+# keras gelu is exact (approximate=False; flax defaults to the tanh
+# approximation) and keras leaky_relu uses negative_slope=0.2 (flax
+# defaults to 0.01) — real-artifact import/export depends on the SAME
+# function both sides (tests pin prediction parity at 1e-5)
 _ACTIVATIONS = {
     "relu": nn.relu, "tanh": jnp.tanh, "sigmoid": nn.sigmoid,
-    "gelu": nn.gelu, "elu": nn.elu, "softplus": nn.softplus,
-    "leaky_relu": nn.leaky_relu, "silu": nn.silu, "swish": nn.silu,
+    "gelu": lambda x: nn.gelu(x, approximate=False),
+    "elu": nn.elu, "softplus": nn.softplus,
+    "leaky_relu": lambda x: nn.leaky_relu(x, negative_slope=0.2),
+    "silu": nn.silu, "swish": nn.silu,
     "softmax": nn.softmax,
     "linear": lambda x: x, None: lambda x: x,
 }

@@ -75,6 +75,29 @@ _KERAS_NAME_PREFIX = {
 # flax OptimizedLSTMCell gate order matching keras's (i, f, c->g, o)
 _LSTM_GATES = ("i", "f", "g", "o")
 
+# flax scope-name prefix per recurrent kind (activation parity note:
+# gelu/leaky_relu are pinned keras-exact in
+# sequential_module._ACTIVATIONS, so activation strings round-trip)
+_CELL_SCOPE_PREFIXES = {"lstm": "OptimizedLSTMCell", "gru": "GRUCell",
+                        "simple_rnn": "SimpleCell"}
+
+
+def _recurrent_cell_pools(params):
+    """Per-kind iterators over recurrent cell scopes in creation order
+    (cells scope under <CellClass>_<k>; the nn.RNN wrapper does not
+    add a name level)."""
+    return {kind: iter(sorted(
+        (k for k in params if k.startswith(prefix)), key=_natural_key))
+        for kind, prefix in _CELL_SCOPE_PREFIXES.items()}
+
+
+def _take_cell(params, pools, kind, name):
+    try:
+        return params[next(pools[kind])]
+    except StopIteration:
+        raise ValueError(f"{name}: model has no {kind.upper()} "
+                         f"cell params left to fill") from None
+
 
 def flatten_params(tree: Any, prefix: str = "") -> Dict[str, np.ndarray]:
     out: Dict[str, np.ndarray] = {}
@@ -202,22 +225,10 @@ def load_keras_h5_into_sequential(layer_configs, params: Dict[str, Any],
     params = jax.tree_util.tree_map(np.asarray, params)
     state = jax.tree_util.tree_map(np.asarray, dict(model_state or {}))
     taken: Dict[str, int] = {}
-    # recurrent cells scope under <CellClass>_<k> (the nn.RNN wrapper
-    # does not add a name level), in creation order; one pool per kind
-    def _cell_pool(prefix):
-        return iter(sorted((k for k in params if k.startswith(prefix)),
-                           key=_natural_key))
-
-    cell_pools = {"lstm": _cell_pool("OptimizedLSTMCell"),
-                  "gru": _cell_pool("GRUCell"),
-                  "simple_rnn": _cell_pool("SimpleCell")}
+    cell_pools = _recurrent_cell_pools(params)
 
     def _next_cell(kind, name):
-        try:
-            return params[next(cell_pools[kind])]
-        except StopIteration:
-            raise ValueError(f"{name}: model has no {kind.upper()} "
-                             f"cell params left to fill") from None
+        return _take_cell(params, cell_pools, kind, name)
     for i, cfg in enumerate(layer_configs):
         kind = cfg["kind"]
         name = f"{kind}_{i}"
@@ -476,3 +487,203 @@ def read_keras_archive(path: str):
         _reject_non_defaults(cls, lcfg)
         configs.append(getattr(shim_layers, cls)(**lcfg).config)
     return configs, input_shape, weights
+
+
+# ----------------------------------------------------------------------
+# export TO real keras (.keras archive / live keras model)
+# ----------------------------------------------------------------------
+def build_keras_model(layer_configs, params, model_state,
+                      input_shape):
+    """Construct a REAL keras model mirroring the Sequential layer
+    configs and copy this framework's weights into it (inverse of the
+    h5 import's gate packing). Requires the ``keras`` package (any
+    backend); raises ImportError otherwise. Keras then owns the
+    serialization — ``.save(path)`` writes a loadable ``.keras``
+    archive, so the export format can never drift from keras itself."""
+    try:
+        import keras
+        from keras import layers as kl
+    except ImportError as exc:
+        raise ImportError(
+            "exporting to keras requires the 'keras' package "
+            "(pip install keras — the jax backend suffices)") from exc
+
+    if not input_shape:
+        raise ValueError("input_shape is required to build the keras "
+                         "twin (weights are shape-checked per layer)")
+
+    def dense_like(cfg, cls, **kw):
+        act = cfg.get("activation")
+        return cls(activation=None if act in (None, "linear") else act,
+                   **kw)
+
+    built = [kl.Input(tuple(input_shape))]
+    makers = []
+    for i, cfg in enumerate(layer_configs):
+        kind = cfg["kind"]
+        name = f"{kind}_{i}"
+        if kind == "dense":
+            layer = dense_like(cfg, kl.Dense, units=cfg["units"])
+        elif kind == "conv2d":
+            layer = dense_like(
+                cfg, kl.Conv2D, filters=cfg["filters"],
+                kernel_size=tuple(cfg.get("kernel", (3, 3))),
+                strides=tuple(cfg.get("strides", (1, 1))),
+                padding=str(cfg.get("padding", "SAME")).lower())
+        elif kind == "conv1d":
+            k1 = cfg.get("kernel", 3)
+            s1 = cfg.get("strides", 1)
+            layer = dense_like(
+                cfg, kl.Conv1D, filters=cfg["filters"],
+                kernel_size=int(k1[0]) if isinstance(
+                    k1, (list, tuple)) else int(k1),
+                strides=int(s1[0]) if isinstance(
+                    s1, (list, tuple)) else int(s1),
+                padding=str(cfg.get("padding", "SAME")).lower())
+        elif kind == "conv2d_transpose":
+            layer = dense_like(
+                cfg, kl.Conv2DTranspose, filters=cfg["filters"],
+                kernel_size=tuple(cfg.get("kernel", (3, 3))),
+                strides=tuple(cfg.get("strides", (1, 1))),
+                padding=str(cfg.get("padding", "SAME")).lower())
+        elif kind == "maxpool1d":
+            layer = kl.MaxPooling1D(cfg.get("pool", 2),
+                                    strides=cfg.get("strides"))
+        elif kind == "maxpool2d":
+            layer = kl.MaxPooling2D(tuple(cfg.get("pool", (2, 2))),
+                                    strides=tuple(cfg.get(
+                                        "strides", cfg.get("pool",
+                                                           (2, 2)))))
+        elif kind == "avgpool2d":
+            layer = kl.AveragePooling2D(
+                tuple(cfg.get("pool", (2, 2))),
+                strides=tuple(cfg.get("strides",
+                                      cfg.get("pool", (2, 2)))))
+        elif kind == "globalavgpool2d":
+            layer = kl.GlobalAveragePooling2D()
+        elif kind == "globalavgpool1d":
+            layer = kl.GlobalAveragePooling1D()
+        elif kind == "globalmaxpool1d":
+            layer = kl.GlobalMaxPooling1D()
+        elif kind == "globalmaxpool2d":
+            layer = kl.GlobalMaxPooling2D()
+        elif kind == "flatten":
+            layer = kl.Flatten()
+        elif kind == "reshape":
+            layer = kl.Reshape(tuple(cfg["shape"]))
+        elif kind == "dropout":
+            layer = kl.Dropout(cfg.get("rate", 0.5))
+        elif kind == "batchnorm":
+            layer = kl.BatchNormalization(
+                momentum=cfg.get("momentum", 0.99),
+                epsilon=cfg.get("epsilon", 1e-3))
+        elif kind == "layernorm":
+            layer = kl.LayerNormalization(
+                epsilon=cfg.get("epsilon", 1e-6))
+        elif kind == "embedding":
+            layer = kl.Embedding(cfg.get("vocab", cfg.get("input_dim")),
+                                 cfg.get("dim", cfg.get("output_dim")))
+        elif kind == "lstm":
+            layer = kl.LSTM(cfg["units"], return_sequences=cfg.get(
+                "return_sequences", False))
+        elif kind == "gru":
+            layer = kl.GRU(cfg["units"], return_sequences=cfg.get(
+                "return_sequences", False))
+        elif kind in ("bidirectional_lstm", "bidirectional_gru"):
+            inner = (kl.GRU if kind.endswith("gru") else kl.LSTM)(
+                cfg["units"],
+                return_sequences=cfg.get("return_sequences", False))
+            layer = kl.Bidirectional(inner)
+        elif kind == "simple_rnn":
+            layer = kl.SimpleRNN(
+                cfg["units"],
+                activation=cfg.get("activation", "tanh"),
+                return_sequences=cfg.get("return_sequences", False))
+        elif kind == "activation":
+            layer = kl.Activation(cfg.get("fn", "linear"))
+        elif kind == "input":
+            continue
+        else:
+            raise ValueError(
+                f"layer kind {kind!r} has no keras export mapping")
+        built.append(layer)
+        makers.append((kind, name, layer))
+    km = keras.Sequential(built)
+    km.build((None, *input_shape))
+
+    params = jax.tree_util.tree_map(np.asarray, params)
+    state = jax.tree_util.tree_map(np.asarray,
+                                   dict(model_state or {}))
+    cell_pools = _recurrent_cell_pools(params)
+    for kind, name, layer in makers:
+        w = _export_layer_weights(kind, name, params, state,
+                                  cell_pools)
+        if w is not None:
+            layer.set_weights(w)
+    return km
+
+
+def _export_layer_weights(kind, name, params, state, cell_pools):
+    """keras set_weights list for one layer, or None if weight-free."""
+    if kind == "lstm" and name in params:  # HoistedLSTM packed layout
+        p = params[name]
+        return [p["kernel"], p["recurrent_kernel"], p["bias"]]
+    if kind in ("bidirectional_lstm", "bidirectional_gru"):
+        base = kind.split("_", 1)[1]
+        # our fwd cell was created first (lower scope index); keras
+        # Bidirectional orders weights forward then backward
+        fwd = _take_cell(params, cell_pools, base, f"{name}/forward")
+        bwd = _take_cell(params, cell_pools, base, f"{name}/backward")
+        return (_cell_keras_weights(base, fwd)
+                + _cell_keras_weights(base, bwd))
+    if kind in ("lstm", "gru", "simple_rnn"):
+        cell = _take_cell(params, cell_pools, kind, name)
+        return _cell_keras_weights(kind, cell)
+    if name not in params and kind != "batchnorm":
+        return None
+    p = params.get(name, {})
+    if kind in ("dense", "conv2d", "conv1d", "conv2d_transpose"):
+        return [p["kernel"], p["bias"]]
+    if kind == "embedding":
+        return [p["embedding"]]
+    if kind == "layernorm":
+        return [p["scale"], p["bias"]]
+    if kind == "batchnorm":
+        bn = state.get("batch_stats", {}).get(name, {})
+        return [p["scale"], p["bias"],
+                bn.get("mean", np.zeros_like(p["bias"])),
+                bn.get("var", np.ones_like(p["bias"]))]
+    return None
+
+
+def _cell_keras_weights(kind, cell):
+    """[kernel, recurrent_kernel, bias] in keras packing for one
+    recurrent cell's params."""
+    if kind == "lstm":
+        kern = np.concatenate(
+            [cell[f"i{g}"]["kernel"] for g in _LSTM_GATES], axis=1)
+        rec = np.concatenate(
+            [cell[f"h{g}"]["kernel"] for g in _LSTM_GATES], axis=1)
+        bias = np.concatenate(
+            [cell[f"h{g}"]["bias"] for g in _LSTM_GATES])
+        return [kern, rec, bias]
+    if kind == "gru":
+        order = (("z", "iz", "hz"), ("r", "ir", "hr"),
+                 ("n", "in", "hn"))
+        kern = np.concatenate([cell[ik]["kernel"]
+                               for _, ik, _h in order], axis=1)
+        rec = np.concatenate([cell[hk]["kernel"]
+                              for _, _ik, hk in order], axis=1)
+        u = rec.shape[0]
+        # our i{z,r} bias holds keras's input+recurrent rows summed;
+        # splitting as (input=ours, recurrent=0) is the same math.
+        # n keeps separate rows (reset_after).
+        b_in = np.concatenate([cell["iz"]["bias"],
+                               cell["ir"]["bias"],
+                               cell["in"]["bias"]])
+        b_rec = np.concatenate([np.zeros(u, b_in.dtype),
+                                np.zeros(u, b_in.dtype),
+                                cell["hn"]["bias"]])
+        return [kern, rec, np.stack([b_in, b_rec])]
+    return [cell["i"]["kernel"], cell["h"]["kernel"],
+            cell["i"]["bias"]]  # simple_rnn

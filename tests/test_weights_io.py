@@ -484,3 +484,67 @@ def test_load_model_shim_opens_keras_archives(tmp_path, f32_config):
     ours = shim.models.load_model(path)
     got = ours.predict(x, batch_size=2)
     np.testing.assert_allclose(got, want, atol=1e-5)
+
+
+def test_save_keras_roundtrip_through_real_keras(tmp_path, f32_config):
+    """The exit door: a model trained HERE exports as a real .keras
+    archive that stock keras loads and predicts identically —
+    covering the lstm gate-unpacking, gru bias-split, embedding and
+    dense paths in reverse."""
+    keras = pytest.importorskip("keras")
+
+    rng = np.random.default_rng(43)
+    x = rng.integers(1, 25, size=(32, 9)).astype(np.int32)
+    y = (x[:, 0] > 12).astype(np.int32)
+    ours = NeuralModel([
+        {"kind": "embedding", "vocab": 25, "dim": 6},
+        {"kind": "lstm", "units": 5, "return_sequences": True},
+        {"kind": "gru", "units": 4},
+        {"kind": "dense", "units": 2, "activation": "softmax"}],
+        name="exported")
+    ours.compile(optimizer={"kind": "adam", "learning_rate": 0.01},
+                 loss="sparse_categorical_crossentropy",
+                 metrics=["accuracy"])
+    ours.fit(x=x, y=y, epochs=1, batch_size=16)
+    want = ours.predict(x, batch_size=16)
+
+    path = str(tmp_path / "exported.keras")
+    ours.save_keras(path, input_shape=(9,))
+    km = keras.models.load_model(path)
+    got = np.asarray(km(x))
+    np.testing.assert_allclose(got, want, atol=1e-5)
+
+    # and back in through our own importer
+    back = NeuralModel.from_keras(path)
+    np.testing.assert_allclose(back.predict(x, batch_size=16), want,
+                               atol=1e-5)
+
+
+def test_save_keras_bidirectional_and_gelu_roundtrip(tmp_path,
+                                                     f32_config):
+    """Bidirectional export + keras-exact activations: gelu and
+    leaky_relu must round-trip at 1e-5 (flax defaults differ from
+    keras's — approximate tanh gelu and slope 0.01 — so the
+    vocabulary pins the keras math)."""
+    keras = pytest.importorskip("keras")
+
+    rng = np.random.default_rng(47)
+    x = rng.integers(1, 20, size=(8, 7)).astype(np.int32)
+    ours = NeuralModel([
+        {"kind": "embedding", "vocab": 20, "dim": 4},
+        {"kind": "bidirectional_lstm", "units": 3},
+        {"kind": "dense", "units": 4, "activation": "gelu"},
+        {"kind": "dense", "units": 3, "activation": "leaky_relu"},
+        {"kind": "dense", "units": 2, "activation": "softmax"}],
+        name="bexp")
+    ours.compile(optimizer={"kind": "adam"},
+                 loss="sparse_categorical_crossentropy",
+                 metrics=["accuracy"])
+    ours.fit(x=x, y=(x[:, 0] > 10).astype(np.int32), epochs=1,
+             batch_size=8)
+    want = ours.predict(x, batch_size=8)
+
+    path = str(tmp_path / "bexp.keras")
+    ours.save_keras(path, input_shape=(7,))
+    km = keras.models.load_model(path)
+    np.testing.assert_allclose(np.asarray(km(x)), want, atol=1e-5)
