@@ -72,9 +72,10 @@ TLM_CFG = {"vocab_size": TLM_VOCAB,
            "n_heads": int(os.environ.get("LO_BENCH_TLM_HEADS", "8")),
            "d_ff": int(os.environ.get("LO_BENCH_TLM_FF", "2048")),
            "max_len": TLM_SEQ}
-# "auto" resolves to the Pallas flash kernel on TPU; the parent
-# retries a timed-out tlm phase with "dot" so a pathological remote
-# kernel compile still yields a transformer number
+# "auto" picks dot vs the Pallas flash kernel by the measured on-chip
+# crossover (seq >= 2048 -> flash); the parent still retries a
+# timed-out tlm phase with "dot" so a pathological remote kernel
+# compile cannot cost the round its transformer number
 TLM_ATTENTION = os.environ.get("LO_BENCH_TLM_ATTENTION", "auto")
 
 # per-phase wall-clock bounds (seconds); overridable for local smoke
