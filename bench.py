@@ -694,19 +694,25 @@ def _prior_tpu_numbers():
             text = f.read()
     except OSError:
         return {"note": "no committed BENCHMARKS.md found"}
-    out = {"source": "BENCHMARKS.md (committed table, measured on the "
-                     "real chip by an earlier run — NOT this run)"}
-    m = re_mod.search(r"\| mnist_cnn \| tpu[^|]*\| ([\d,]+)", text)
-    if m:
-        out["mnist_cnn_samples_per_sec_per_chip"] = int(
-            m.group(1).replace(",", ""))
+    out = {}
+    # tolerant of both the hand-authored table (bold marks, "tpu
+    # (v5e)" platform) and _write_md's generated rows ("tpu", plain)
     m = re_mod.search(
-        r"mfu sweep[^\n]*\| \*\*([\d.]+)\*\* \| \*\*([\d.]+)%\*\*",
-        text)
+        r"\| mnist_cnn \| tpu[^|]*\| ([\d,]+(?:\.\d+)?)", text)
     if m:
-        out["transformer_lm_tflops_per_sec_per_chip"] = float(
-            m.group(1))
-        out["transformer_lm_mfu"] = round(float(m.group(2)) / 100, 4)
+        out["mnist_cnn_samples_per_sec_per_chip"] = float(
+            m.group(1).replace(",", ""))
+    rows = re_mod.findall(
+        r"\| transformer_lm[^|]*\| tpu[^|]*\|[^|]*"
+        r"\| \*{0,2}([\d.]+)\*{0,2} \| \*{0,2}([\d.]+)%", text)
+    if rows:
+        tflops, mfu = max(rows, key=lambda r: float(r[1]))
+        out["transformer_lm_tflops_per_sec_per_chip"] = float(tflops)
+        out["transformer_lm_mfu"] = round(float(mfu) / 100, 4)
+    if not out:
+        return {"note": "no TPU rows found in committed BENCHMARKS.md"}
+    out["source"] = ("BENCHMARKS.md (committed table, measured on the "
+                     "real chip by an earlier run — NOT this run)")
     return out
 
 
@@ -799,10 +805,17 @@ def main(argv=None):
         },
     }
     if args.write_md:
-        try:
-            _write_md(args.write_md, report)
-        except Exception as exc:  # noqa: BLE001 — md render must not sink it
-            print(f"BENCHMARKS.md render failed: {exc}", file=sys.stderr)
+        if not tpu_ok:
+            # never clobber the committed on-chip table with CPU smoke
+            # rows — the outage report depends on that file surviving
+            print("BENCHMARKS.md NOT rewritten: TPU unreachable, this "
+                  "run holds CPU smoke numbers only", file=sys.stderr)
+        else:
+            try:
+                _write_md(args.write_md, report)
+            except Exception as exc:  # noqa: BLE001 — must not sink it
+                print(f"BENCHMARKS.md render failed: {exc}",
+                      file=sys.stderr)
     print(json.dumps(report))
     return 0
 

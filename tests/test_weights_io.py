@@ -548,3 +548,24 @@ def test_save_keras_bidirectional_and_gelu_roundtrip(tmp_path,
     ours.save_keras(path, input_shape=(7,))
     km = keras.models.load_model(path)
     np.testing.assert_allclose(np.asarray(km(x)), want, atol=1e-5)
+
+
+def test_from_keras_archive_with_bidirectional(tmp_path, f32_config):
+    keras = pytest.importorskip("keras")
+    from keras import layers
+
+    km = keras.Sequential([
+        layers.Input((7,)),
+        layers.Embedding(20, 4),
+        layers.Bidirectional(layers.LSTM(3)),
+        layers.Dense(2, activation="softmax")])
+    x = np.random.default_rng(53).integers(1, 20, size=(4, 7))
+    want = np.asarray(km(x))
+    path = str(tmp_path / "bidir_arch.keras")
+    km.save(path)
+
+    ours = NeuralModel.from_keras(path)
+    kinds = [c["kind"] for c in ours.layer_configs]
+    assert kinds == ["embedding", "bidirectional_lstm", "dense"]
+    got = ours.predict(x.astype(np.int32), batch_size=4)
+    np.testing.assert_allclose(got, want, atol=1e-5)
