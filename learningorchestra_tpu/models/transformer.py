@@ -644,11 +644,14 @@ class LanguageModel:
         fns = getattr(self, "_gen_cache_fns", None)
         if fns is None:
             fns = self._gen_cache_fns = {}
+        # resolve flash-vs-dot from the PREFILL length, not max_len: a
+        # max_len>=2048 model generating from a short prompt attends
+        # over only s tokens, below the measured flash crossover
         sig = (b, s, total, temperature, top_k, top_p,
-               self._resolved_attention())
+               self._resolved_attention(s))
         if sig in fns:
             return fns[sig]
-        module = self.module
+        module = self._module_for(s)
 
         @jax.jit
         def prefill(params, buf, key):

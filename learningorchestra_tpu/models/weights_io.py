@@ -468,7 +468,36 @@ def read_keras_archive(path: str):
             continue
         if cls == "Bidirectional":
             # keras nests the wrapped RNN layer's own serialization
+            merge = lcfg.get("merge_mode", "concat")
+            if merge != "concat":
+                raise ValueError(
+                    f"Bidirectional: only merge_mode='concat' is "
+                    f"supported, got {merge!r} — importing would "
+                    f"silently change the layer math")
             inner = lcfg.get("layer", {})
+            bwd = lcfg.get("backward_layer")
+            if bwd is not None:
+                # keras serializes the auto-mirrored backward layer
+                # too; only a genuinely CUSTOM one changes the math
+
+                def _strip_ids(obj):
+                    if isinstance(obj, dict):
+                        return {k: _strip_ids(v) for k, v in obj.items()
+                                if k not in ("shared_object_id", "name")}
+                    if isinstance(obj, list):
+                        return [_strip_ids(v) for v in obj]
+                    return obj
+
+                def _mirror_key(layer_dict):
+                    c = _strip_ids(layer_dict.get("config", {}))
+                    c.pop("go_backwards", None)
+                    return (layer_dict.get("class_name"), c)
+
+                if _mirror_key(bwd) != _mirror_key(inner):
+                    raise ValueError(
+                        "Bidirectional: a custom backward_layer is "
+                        "not supported (the import mirrors the "
+                        "forward layer)")
             _reject_non_defaults(inner.get("class_name", "?"),
                                  inner.get("config", {}))
             inner_shim = getattr(shim_layers,

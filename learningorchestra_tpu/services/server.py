@@ -226,7 +226,8 @@ class Api:
         m = self.metrics()
 
         def esc(v: str) -> str:
-            return str(v).replace("\\", r"\\").replace('"', r'\"')
+            return (str(v).replace("\\", r"\\").replace('"', r'\"')
+                    .replace("\n", r"\n"))
 
         lines = [
             "# TYPE lo_uptime_seconds gauge",
