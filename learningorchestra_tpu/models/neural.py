@@ -358,6 +358,62 @@ class NeuralModel:
             os.unlink(tmp)
         return model
 
+    @classmethod
+    def from_savedmodel(cls, path: str, name: Optional[str] = None,
+                        input_shape: Optional[Sequence[int]] = None
+                        ) -> "NeuralModel":
+        """Build a model from a TF SavedModel DIRECTORY (stock
+        ``tf.keras.models.save_model`` output — the reference's
+        primary artifact format, binary_executor_image/utils.py:
+        201-220) without importing tensorflow: architecture from
+        keras_metadata.pb, weights from the variables/ TensorBundle.
+        Sequential topologies only."""
+        import os
+
+        from learningorchestra_tpu.models import weights_io
+
+        configs, sm_shape, layers = weights_io.read_savedmodel(path)
+        return cls._from_parsed_keras(
+            configs, layers, input_shape or sm_shape,
+            name or os.path.basename(os.path.normpath(path)))
+
+    @classmethod
+    def from_legacy_h5(cls, path: str, name: Optional[str] = None,
+                       input_shape: Optional[Sequence[int]] = None
+                       ) -> "NeuralModel":
+        """Build a model from a legacy tf.keras WHOLE-MODEL ``.h5``
+        file (``model_config`` attr + ``model_weights`` group)."""
+        import os
+
+        from learningorchestra_tpu.models import weights_io
+
+        configs, h5_shape, layers = weights_io.read_legacy_h5_model(
+            path)
+        return cls._from_parsed_keras(
+            configs, layers, input_shape or h5_shape,
+            name or os.path.splitext(os.path.basename(path))[0])
+
+    @classmethod
+    def _from_parsed_keras(cls, configs, layers, input_shape, name
+                           ) -> "NeuralModel":
+        from learningorchestra_tpu.models import weights_io
+
+        model = cls(configs, name=name)
+        if not input_shape:
+            raise ValueError(
+                "the artifact records no input shape; pass "
+                "input_shape= so parameters can be built")
+        model.input_shape = list(input_shape)
+        dtype = np.int32 if configs and \
+            configs[0].get("kind") == "embedding" else np.float32
+        model._build_params(np.zeros((1, *model.input_shape), dtype))
+        model.params, model.model_state = \
+            weights_io.load_keras_h5_into_sequential(
+                model.layer_configs, model.params, model.model_state,
+                h5_layers=layers)
+        model._state = None
+        return model
+
     def to_keras(self, input_shape: Optional[Sequence[int]] = None):
         """A REAL keras model with this model's weights (inverse gate
         packing) — requires the ``keras`` package. The returned model

@@ -46,9 +46,22 @@ Model = Sequential
 
 
 def load_model(path: str) -> NeuralModel:
-    """Real keras-3 ``.keras`` archives rebuild architecture+weights
-    (NeuralModel.from_keras); other paths load this framework's own
-    saved artifacts."""
-    if str(path).endswith(".keras"):
-        return NeuralModel.from_keras(path)
+    """Load any real-keras artifact format the reference round-trips
+    (binary_executor_image/utils.py:201-220) or this framework's own
+    saved artifacts: ``.keras`` archives, TF SavedModel directories,
+    legacy whole-model ``.h5`` files — all without importing
+    tensorflow."""
+    import os
+
+    p = str(path)
+    if p.endswith(".keras"):
+        return NeuralModel.from_keras(p)
+    if os.path.isdir(p) and (
+            os.path.exists(os.path.join(p, "saved_model.pb"))
+            or os.path.exists(os.path.join(p, "keras_metadata.pb"))):
+        return NeuralModel.from_savedmodel(p)
+    from learningorchestra_tpu.models import weights_io
+
+    if weights_io.is_legacy_h5_model(p):
+        return NeuralModel.from_legacy_h5(p)
     return NeuralModel.__lo_load__(path)

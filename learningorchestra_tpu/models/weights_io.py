@@ -24,7 +24,7 @@ the interop works in images without TF installed.
 from __future__ import annotations
 
 import re
-from typing import Any, Dict, List, Tuple
+from typing import Any, Dict, List, Optional, Tuple
 
 import jax
 import numpy as np
@@ -167,21 +167,42 @@ def _natural_key(s: str):
     return [int(t) if t.isdigit() else t for t in re.split(r"(\d+)", s)]
 
 
-def read_keras_h5(path: str) -> List[Tuple[str, List[np.ndarray]]]:
+def read_keras_h5(path: str, root_key: Optional[str] = None,
+                  ) -> List[Tuple[str, List[np.ndarray]]]:
     """(group_name, variable list) pairs from a Keras 3 weights file
     (``/layers/<name>/vars/<i>``; legacy tf.keras files use per-layer
     top groups with ``<name>/<var>:0`` datasets), natural-sorted by
-    group name. Parameter-free layers (flatten, pooling) are dropped."""
+    group name. Parameter-free layers (flatten, pooling) are dropped.
+    ``root_key`` overrides the group scan root (legacy whole-model
+    files keep weights under ``model_weights``)."""
     import h5py
 
     layers: List[Tuple[str, List[np.ndarray]]] = []
     with h5py.File(path, "r") as f:
-        root = f["layers"] if "layers" in f else f
+        if root_key is not None:
+            root = f[root_key]
+        else:
+            root = f["layers"] if "layers" in f else f
         for lname in sorted(root, key=_natural_key):
             grp = root[lname]
             if not isinstance(grp, h5py.Group):
                 continue
             vals: List[np.ndarray] = []
+            # legacy tf.keras groups record variable order explicitly
+            # (alphabetical dataset iteration would put bias:0 before
+            # kernel:0); keras-3 vars/<i> groups sort correctly
+            weight_names = grp.attrs.get("weight_names")
+            if weight_names is not None and len(weight_names):
+                names = [wn.decode("utf-8") if isinstance(wn, bytes)
+                         else str(wn) for wn in weight_names]
+                # the loader's Bidirectional convention is BACKWARD
+                # cell first (keras-3 h5 groups sort that way);
+                # legacy weight_names list forward first — reorder
+                names.sort(key=lambda n: 0 if "backward" in n else 1)
+                for wn in names:
+                    vals.append(np.asarray(grp[wn]))
+                layers.append((lname, vals))
+                continue
 
             def collect(g):
                 for k in sorted(g, key=_natural_key):
@@ -199,7 +220,10 @@ def read_keras_h5(path: str) -> List[Tuple[str, List[np.ndarray]]]:
 
 
 def load_keras_h5_into_sequential(layer_configs, params: Dict[str, Any],
-                                  model_state: Dict[str, Any], path: str,
+                                  model_state: Dict[str, Any],
+                                  path: Optional[str] = None,
+                                  h5_layers: Optional[List[Tuple[
+                                      str, List[np.ndarray]]]] = None,
                                   ) -> Tuple[Dict[str, Any],
                                              Dict[str, Any]]:
     """Map a real Keras Sequential weights file onto the tf_compat
@@ -208,8 +232,13 @@ def load_keras_h5_into_sequential(layer_configs, params: Dict[str, Any],
     a kind keras numbers groups in model order (``conv2d``,
     ``conv2d_1``, ...), which natural sort preserves — each of our
     parameterized layers consumes the next unused group of its kind's
-    keras name prefix. Returns new (params, model_state)."""
-    h5_layers = read_keras_h5(path)
+    keras name prefix. ``h5_layers`` supplies pre-extracted
+    (group_name, vals) pairs instead of a file (the SavedModel and
+    legacy-h5 importers use this). Returns new (params, model_state)."""
+    if h5_layers is None:
+        if path is None:
+            raise ValueError("pass either path or h5_layers")
+        h5_layers = read_keras_h5(path)
     # bucket by the keras GROUP PREFIX (not our kind): two kinds can
     # share one keras prefix (bidirectional lstm/gru both serialize
     # under "bidirectional"), and groups consume in natural-sort ==
@@ -431,23 +460,18 @@ def _reject_non_defaults(cls_name: str, lcfg: Dict[str, Any]) -> None:
             f"{cls_name}: only padding='valid' pooling is supported")
 
 
-def read_keras_archive(path: str):
-    """Parse a keras-3 ``.keras`` archive (zip of config.json +
-    model.weights.h5) into ``(layer_configs, input_shape,
-    weights_h5_bytes)``. Only Sequential topologies map onto the
-    layer-config vocabulary; anything else fails loudly."""
-    import json
-    import zipfile
-
+def parse_sequential_config(cfg: Dict[str, Any]):
+    """A serialized keras Sequential model config (keras-3
+    ``config.json`` or tf_keras SavedModel / legacy-h5
+    ``model_config`` dialect) -> ``(layer_configs, input_shape)`` in
+    this framework's layer-config vocabulary. Unsupported topologies
+    and math-changing non-default options fail loudly."""
     from learningorchestra_tpu.models.tf_compat.keras import (
         layers as shim_layers)
 
-    with zipfile.ZipFile(path) as z:
-        cfg = json.loads(z.read("config.json"))
-        weights = z.read("model.weights.h5")
     if cfg.get("class_name") != "Sequential":
         raise ValueError(
-            f"only Sequential .keras archives are supported, got "
+            f"only Sequential keras models are supported, got "
             f"{cfg.get('class_name')!r}")
     seq_cfg = cfg["config"]
     input_shape = None
@@ -515,7 +539,136 @@ def read_keras_archive(path: str):
                 f"(supported: {sorted(_KERAS_SHIM_CLASS_NAMES)})")
         _reject_non_defaults(cls, lcfg)
         configs.append(getattr(shim_layers, cls)(**lcfg).config)
+    return configs, input_shape
+
+
+def read_keras_archive(path: str):
+    """Parse a keras-3 ``.keras`` archive (zip of config.json +
+    model.weights.h5) into ``(layer_configs, input_shape,
+    weights_h5_bytes)``. Only Sequential topologies map onto the
+    layer-config vocabulary; anything else fails loudly."""
+    import json
+    import zipfile
+
+    with zipfile.ZipFile(path) as z:
+        cfg = json.loads(z.read("config.json"))
+        weights = z.read("model.weights.h5")
+    configs, input_shape = parse_sequential_config(cfg)
     return configs, input_shape, weights
+
+
+# ----------------------------------------------------------------------
+# TF SavedModel-directory import (reference utils.py:201-220 stores
+# Keras models exactly this way; read with zero tensorflow imports)
+# ----------------------------------------------------------------------
+# object-graph child paths per layer kind, ordered to match
+# _KERAS_VAR_ORDERS (bidirectional: backward first, the h5 convention)
+_CKPT_LAYER_PATHS = {
+    "dense": ("kernel", "bias"),
+    "conv2d": ("kernel", "bias"),
+    "conv1d": ("kernel", "bias"),
+    "conv2d_transpose": ("kernel", "bias"),
+    "embedding": ("embeddings",),
+    "batchnorm": ("gamma", "beta", "moving_mean", "moving_variance"),
+    "layernorm": ("gamma", "beta"),
+    "lstm": ("cell/kernel", "cell/recurrent_kernel", "cell/bias"),
+    "gru": ("cell/kernel", "cell/recurrent_kernel", "cell/bias"),
+    "simple_rnn": ("cell/kernel", "cell/recurrent_kernel",
+                   "cell/bias"),
+    "bidirectional_lstm": tuple(
+        f"{d}_layer/cell/{v}" for d in ("backward", "forward")
+        for v in ("kernel", "recurrent_kernel", "bias")),
+    "bidirectional_gru": tuple(
+        f"{d}_layer/cell/{v}" for d in ("backward", "forward")
+        for v in ("kernel", "recurrent_kernel", "bias")),
+}
+
+
+def read_savedmodel(path: str):
+    """Parse a Keras SavedModel DIRECTORY (stock
+    ``tf.keras.models.save_model`` output) into ``(layer_configs,
+    input_shape, h5_style_layers)`` without importing tensorflow —
+    the architecture comes from ``keras_metadata.pb`` and the weights
+    from the ``variables/`` TensorBundle, resolved through the
+    checkpoint object graph (the saver dedupes shared variables under
+    canonical keys, so literal name joins do not work)."""
+    import os as _os
+
+    from learningorchestra_tpu.models import tf_bundle
+
+    cfg = tf_bundle.read_saved_model_config(path)
+    configs, input_shape = parse_sequential_config(cfg)
+    prefix = _os.path.join(path, "variables", "variables")
+    # one index parse, then decode ONLY the resolved model variables
+    # (a trained checkpoint also holds optimizer slots ~2x the model)
+    entries = tf_bundle.read_index(prefix + ".index")
+    nodes = tf_bundle.read_object_graph(prefix, entries=entries)
+    layer_keys: List[Tuple[str, List[str]]] = []
+    counts: Dict[str, int] = {}
+    wi = 0
+    for c in configs:
+        kind = c["kind"]
+        if kind not in _CKPT_LAYER_PATHS:
+            continue  # parameter-free layer
+        keys = [tf_bundle.resolve_variable(
+            nodes, f"layer_with_weights-{wi}/{p}")
+            for p in _CKPT_LAYER_PATHS[kind]]
+        # synthesize keras-convention group names so the h5 loader's
+        # kind-by-kind prefix matching applies unchanged
+        kname = _KERAS_NAME_PREFIX[kind]
+        n = counts.get(kname, 0)
+        counts[kname] = n + 1
+        layer_keys.append((kname if n == 0 else f"{kname}_{n}", keys))
+        wi += 1
+    tensors = tf_bundle.read_tensors(
+        prefix, [k for _, ks in layer_keys for k in ks],
+        entries=entries)
+    layers = [(name, [tensors[k] for k in keys])
+              for name, keys in layer_keys]
+    return configs, input_shape, layers
+
+
+def read_legacy_h5_model(path: str):
+    """Parse a legacy tf.keras WHOLE-MODEL ``.h5`` file (root attrs
+    carry ``model_config`` JSON; weights live under the
+    ``model_weights`` group) into ``(layer_configs, input_shape,
+    h5_style_layers)``."""
+    import json
+
+    import h5py
+
+    from learningorchestra_tpu.models import tf_bundle
+
+    with h5py.File(path, "r") as f:
+        raw = f.attrs.get("model_config")
+        if raw is None:
+            raise ValueError(
+                f"{path}: no model_config attr — not a whole-model "
+                f"keras h5 file (weights-only files load via "
+                f"load_weights)")
+        if isinstance(raw, bytes):
+            raw = raw.decode("utf-8")
+        cfg = tf_bundle._untuple(json.loads(raw))
+    configs, input_shape = parse_sequential_config(cfg)
+    layers = read_keras_h5(path, root_key="model_weights")
+    return configs, input_shape, layers
+
+
+def is_legacy_h5_model(path: str) -> bool:
+    """True when ``path`` is an HDF5 file carrying a whole keras model
+    (``model_config`` attr), as written by tf.keras ``model.save``."""
+    import os
+
+    import h5py
+
+    if not (str(path).endswith((".h5", ".hdf5"))
+            and os.path.isfile(path)):
+        return False
+    try:
+        with h5py.File(path, "r") as f:
+            return "model_config" in f.attrs
+    except OSError:
+        return False
 
 
 # ----------------------------------------------------------------------

@@ -569,3 +569,149 @@ def test_from_keras_archive_with_bidirectional(tmp_path, f32_config):
     assert kinds == ["embedding", "bidirectional_lstm", "dense"]
     got = ours.predict(x.astype(np.int32), batch_size=4)
     np.testing.assert_allclose(got, want, atol=1e-5)
+
+
+# ----------------------------------------------------------------------
+# TF SavedModel-directory + legacy whole-model .h5 import (the two
+# formats the reference's binary executor actually writes,
+# utils.py:201-220) — read with ZERO tensorflow imports in the loader;
+# tests use stock tf_keras only to produce authentic fixtures.
+# ----------------------------------------------------------------------
+def _tfk():
+    tfk = pytest.importorskip("tf_keras")
+    return tfk
+
+
+def test_from_savedmodel_cnn_parity(tmp_path, f32_config):
+    """NeuralModel.from_savedmodel reads a stock tf.keras SavedModel
+    DIRECTORY (keras_metadata.pb + variables bundle) and predicts
+    identically — without importing tensorflow itself."""
+    keras = _tfk()
+    kl = keras.layers
+
+    km = keras.Sequential([
+        kl.Conv2D(4, (3, 3), activation="relu", input_shape=(8, 8, 1)),
+        kl.MaxPooling2D(),
+        kl.Flatten(),
+        kl.Dense(10, activation="relu"),
+        kl.Dense(2, activation="softmax")])
+    x = np.random.default_rng(3).normal(
+        size=(4, 8, 8, 1)).astype(np.float32)
+    want = np.asarray(km(x))
+    path = str(tmp_path / "sm_cnn")
+    km.save(path, save_format="tf")
+
+    ours = NeuralModel.from_savedmodel(path)
+    kinds = [c["kind"] for c in ours.layer_configs]
+    assert kinds == ["conv2d", "maxpool2d", "flatten", "dense", "dense"]
+    got = ours.predict(x, batch_size=4)
+    np.testing.assert_allclose(got, want, atol=1e-5)
+
+
+def test_from_savedmodel_rnn_stack_parity(tmp_path, f32_config):
+    """SavedModel import resolves RNN weights through the checkpoint
+    OBJECT GRAPH — the saver dedupes cell variables under flat
+    ``variables/N`` keys, so this covers the non-trivial path
+    (Bidirectional LSTM + GRU + BatchNorm)."""
+    keras = _tfk()
+    kl = keras.layers
+
+    km = keras.Sequential([
+        kl.Embedding(30, 5, input_length=9),
+        kl.Bidirectional(kl.LSTM(4, return_sequences=True)),
+        kl.GRU(3),
+        kl.BatchNormalization(),
+        kl.Dense(2, activation="softmax")])
+    km.build((None, 9))
+    toks = np.random.default_rng(5).integers(0, 30, size=(4, 9))
+    want = np.asarray(km(toks))
+    path = str(tmp_path / "sm_rnn")
+    km.save(path, save_format="tf")
+
+    ours = NeuralModel.from_savedmodel(path)
+    kinds = [c["kind"] for c in ours.layer_configs]
+    assert kinds == ["embedding", "bidirectional_lstm", "gru",
+                     "batchnorm", "dense"]
+    got = ours.predict(toks.astype(np.int32), batch_size=4)
+    np.testing.assert_allclose(got, want, atol=1e-5)
+
+
+def test_from_savedmodel_rejects_non_keras_dir(tmp_path):
+    """A SavedModel without keras_metadata.pb (plain tf.Module) fails
+    with a targeted error, not a parse crash."""
+    (tmp_path / "plain_sm").mkdir()
+    (tmp_path / "plain_sm" / "saved_model.pb").write_bytes(b"\x08\x01")
+    with pytest.raises(ValueError, match="keras_metadata"):
+        NeuralModel.from_savedmodel(str(tmp_path / "plain_sm"))
+
+
+def test_from_legacy_h5_whole_model_parity(tmp_path, f32_config):
+    """Legacy tf.keras whole-model ``.h5`` files (model_config attr +
+    model_weights group) rebuild architecture AND weights — the
+    advisor-flagged gap where these fell into the native loader with a
+    confusing error."""
+    keras = _tfk()
+    kl = keras.layers
+
+    km = keras.Sequential([
+        kl.Dense(8, activation="relu", input_shape=(6,)),
+        kl.Dense(3, activation="softmax")])
+    x = np.random.default_rng(11).normal(size=(5, 6)).astype(np.float32)
+    want = np.asarray(km(x))
+    path = str(tmp_path / "legacy_model.h5")
+    km.save(path, save_format="h5")
+
+    ours = NeuralModel.from_legacy_h5(path)
+    assert [c["kind"] for c in ours.layer_configs] == ["dense", "dense"]
+    got = ours.predict(x, batch_size=5)
+    np.testing.assert_allclose(got, want, atol=1e-5)
+
+
+def test_tf_compat_load_model_routes_all_real_formats(tmp_path,
+                                                      f32_config):
+    """The tf_compat ``keras.models.load_model`` shim dispatches every
+    real-keras artifact format: SavedModel dir, legacy whole-model
+    .h5, and .keras archives (reference parity: load_model is the
+    reference's single entry point, utils.py:210-220)."""
+    from learningorchestra_tpu.models.tf_compat.keras import models
+
+    keras = _tfk()
+    kl = keras.layers
+    km = keras.Sequential([kl.Dense(4, activation="relu",
+                                    input_shape=(3,)),
+                           kl.Dense(2)])
+    x = np.random.default_rng(7).normal(size=(4, 3)).astype(np.float32)
+    want = np.asarray(km(x))
+
+    sm = str(tmp_path / "as_savedmodel")
+    km.save(sm, save_format="tf")
+    h5 = str(tmp_path / "as_legacy.h5")
+    km.save(h5, save_format="h5")
+
+    for path in (sm, h5):
+        got = models.load_model(path).predict(x, batch_size=4)
+        np.testing.assert_allclose(got, want, atol=1e-5)
+
+
+def test_from_legacy_h5_bidirectional_direction_order(tmp_path,
+                                                      f32_config):
+    """Legacy h5 ``weight_names`` lists FORWARD cell vars first while
+    the loader convention is backward-first — the reorder must keep
+    directions straight or predictions silently diverge (review
+    round-4 finding)."""
+    keras = _tfk()
+    kl = keras.layers
+
+    km = keras.Sequential([
+        kl.Embedding(20, 4, input_length=7),
+        kl.Bidirectional(kl.LSTM(3)),
+        kl.Dense(2, activation="softmax")])
+    km.build((None, 7))
+    toks = np.random.default_rng(23).integers(0, 20, size=(4, 7))
+    want = np.asarray(km(toks))
+    path = str(tmp_path / "legacy_bidir.h5")
+    km.save(path, save_format="h5")
+
+    ours = NeuralModel.from_legacy_h5(path)
+    got = ours.predict(toks.astype(np.int32), batch_size=4)
+    np.testing.assert_allclose(got, want, atol=1e-5)
