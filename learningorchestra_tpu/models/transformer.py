@@ -32,7 +32,7 @@ import functools
 import json
 import math
 import os
-from typing import Any, Dict, List, Optional, Tuple
+from typing import Any, Dict, List, NamedTuple, Optional, Tuple
 
 import flax.linen as nn
 import jax
@@ -264,10 +264,40 @@ class _Block(nn.Module):
         return x + h, aux
 
 
+class FusedHeadOut(NamedTuple):
+    """Training output of a ``fused_head_chunk`` TransformerLM: the
+    final hidden states plus the lm_head kernel, so the loss can run
+    the vocab projection + cross-entropy in token chunks and the
+    (tokens, vocab) logits tensor never materializes in HBM (the
+    d_model=512/vocab-32k roofline gap named in BENCHMARKS.md)."""
+    hidden: Any     # (b, s, d) final-norm output
+    kernel: Any     # (d, vocab) lm_head weight
+    aux: Any        # MoE load-balance scalar
+
+
+class _LMHead(nn.Module):
+    """The vocab projection as its own submodule (param tree stays
+    ``lm_head/kernel``, identical to the previous nn.Dense) so the
+    fused-loss path can hand the kernel to the loss instead of
+    computing full logits."""
+    vocab_size: int
+
+    @nn.compact
+    def __call__(self, h, return_kernel: bool = False):
+        kernel = self.param("kernel", nn.initializers.lecun_normal(),
+                            (h.shape[-1], self.vocab_size))
+        if return_kernel:
+            return kernel
+        return h @ kernel.astype(h.dtype)
+
+
 class TransformerLM(nn.Module):
     """Decoder-only LM: tokens (b, s) int32 -> (logits (b, s, V), aux).
 
     ``aux`` is the summed MoE load-balance loss (zero for dense MLP).
+    With ``fused_head_chunk > 0`` the TRAIN forward returns
+    :class:`FusedHeadOut` instead of logits; eval/decode always
+    produce full logits.
     """
     vocab_size: int
     d_model: int = 256
@@ -280,6 +310,7 @@ class TransformerLM(nn.Module):
     moe_k: int = 2
     dropout: float = 0.0
     mesh: Any = None
+    fused_head_chunk: int = 0
 
     @nn.compact
     def __call__(self, tokens, train: bool = False, decode_pos=None,
@@ -306,28 +337,94 @@ class TransformerLM(nn.Module):
                 x, train, decode_pos=decode_pos, cache_len=cache_len)
             aux_total = aux_total + aux
         x = nn.RMSNorm(name="final_norm")(x)
-        logits = nn.Dense(self.vocab_size, use_bias=False,
-                          name="lm_head")(x)
-        return logits, aux_total
+        head = _LMHead(self.vocab_size, name="lm_head")
+        if self.fused_head_chunk and train and decode_pos is None:
+            return FusedHeadOut(hidden=x,
+                                kernel=head(x, return_kernel=True),
+                                aux=aux_total)
+        return head(x), aux_total
 
 
 # ----------------------------------------------------------------------
 # losses over (outputs=(logits, aux), batch, weights)
 # ----------------------------------------------------------------------
-def next_token_loss(aux_coef: float = 0.01):
+def _token_targets(batch, weights):
+    tokens = batch["x"].astype(jnp.int32)
+    tgt = tokens[:, 1:]
+    tok_mask = (tgt != 0).astype(jnp.float32)
+    if weights is not None:
+        tok_mask = tok_mask * weights.astype(jnp.float32)[:, None]
+    return tgt, tok_mask
+
+
+def _fused_head_loss(out: FusedHeadOut, batch, weights, chunk: int,
+                     aux_coef: float):
+    """Chunked vocab-projection + softmax cross-entropy: scans token
+    chunks of the final hidden states through the lm_head matmul, so
+    peak logits memory is (chunk, vocab) instead of (b*s, vocab) and
+    the full logits tensor never round-trips HBM between forward and
+    loss (BENCHMARKS.md names this epilogue as the d=512 roofline
+    gap: one (8192, 512) x (512, 32000) matmul per step feeding an
+    elementwise log-softmax over 262M f32 logits). The backward
+    recomputes each chunk's logits via jax.checkpoint. Accuracy is
+    computed inside the same scan and emitted as a loss metric, so
+    the engine does not re-run the projection for it."""
+    tgt, tok_mask = _token_targets(batch, weights)
+    hs = out.hidden[:, :-1]
+    b, sm1, d = hs.shape
+    t_total = b * sm1
+    chunk = max(1, min(chunk, t_total))  # no padding blowup on tiny shapes
+    hs = hs.reshape(t_total, d)
+    tg = tgt.reshape(t_total)
+    mk = tok_mask.reshape(t_total)
+    n_chunks = -(-t_total // chunk)
+    pad = n_chunks * chunk - t_total
+    if pad:
+        hs = jnp.pad(hs, ((0, pad), (0, 0)))
+        tg = jnp.pad(tg, (0, pad))
+        mk = jnp.pad(mk, (0, pad))
+    kernel = out.kernel.astype(hs.dtype)
+
+    def body(carry, xs):
+        h_c, t_c, m_c = xs
+        # bf16 inputs, f32 accumulate — the MXU-native layout
+        lg = jnp.einsum("cd,dv->cv", h_c, kernel,
+                        preferred_element_type=jnp.float32)
+        lse = jax.scipy.special.logsumexp(lg, axis=-1)
+        correct = jnp.take_along_axis(lg, t_c[:, None], axis=1)[:, 0]
+        ok = (jnp.argmax(lg, axis=-1) == t_c).astype(jnp.float32)
+        loss_sum, ok_sum = carry
+        return (loss_sum + jnp.sum((lse - correct) * m_c),
+                ok_sum + jnp.sum(ok * m_c)), None
+
+    (loss_sum, ok_sum), _ = jax.lax.scan(
+        jax.checkpoint(body),
+        (jnp.zeros((), jnp.float32), jnp.zeros((), jnp.float32)),
+        (hs.reshape(n_chunks, chunk, d),
+         tg.reshape(n_chunks, chunk),
+         mk.reshape(n_chunks, chunk)))
+    total = jnp.maximum(jnp.sum(mk), 1e-9)
+    loss = loss_sum / total + aux_coef * out.aux.astype(jnp.float32)
+    return loss, {"accuracy": (ok_sum, total)}
+
+
+def next_token_loss(aux_coef: float = 0.01, head_chunk: int = 1024):
     """Causal LM loss: predict token t+1 from prefix <= t; padding
-    tokens (id 0) and padded tail samples are masked out."""
+    tokens (id 0) and padded tail samples are masked out. On
+    :class:`FusedHeadOut` training outputs the projection + CE runs
+    chunked (``head_chunk`` tokens at a time) and the return value is
+    ``(loss, {"accuracy": (sum, count)})`` — the engine merges
+    loss-emitted metrics."""
     import optax
 
     def loss_fn(outputs, batch, weights):
+        if isinstance(outputs, FusedHeadOut):
+            return _fused_head_loss(outputs, batch, weights,
+                                    head_chunk, aux_coef)
         logits, aux = outputs
-        tokens = batch["x"].astype(jnp.int32)
-        tgt = tokens[:, 1:]
+        tgt, tok_mask = _token_targets(batch, weights)
         lg = logits[:, :-1].astype(jnp.float32)
         per_tok = optax.softmax_cross_entropy_with_integer_labels(lg, tgt)
-        tok_mask = (tgt != 0).astype(jnp.float32)
-        if weights is not None:
-            tok_mask = tok_mask * weights.astype(jnp.float32)[:, None]
         total = jnp.maximum(jnp.sum(tok_mask), 1e-9)
         loss = jnp.sum(per_tok * tok_mask) / total
         return loss + aux_coef * aux.astype(jnp.float32)
@@ -336,6 +433,12 @@ def next_token_loss(aux_coef: float = 0.01):
 
 
 def token_accuracy(outputs, batch, weights):
+    if isinstance(outputs, FusedHeadOut):
+        # the fused loss emits accuracy itself; recomputing it here
+        # would cost a second full vocab projection
+        raise RuntimeError(
+            "token_accuracy on FusedHeadOut — use the accuracy the "
+            "fused loss emits (the engine skips same-named metric fns)")
     logits, _ = outputs
     tokens = batch["x"].astype(jnp.int32)
     tgt = tokens[:, 1:]
@@ -359,14 +462,16 @@ class LanguageModel:
 
     _CONFIG_KEYS = ("vocab_size", "d_model", "n_layers", "n_heads",
                     "d_ff", "max_len", "attention", "n_experts", "moe_k",
-                    "dropout", "aux_coef")
+                    "dropout", "aux_coef", "head_chunk")
 
     def __init__(self, vocab_size: int, d_model: int = 256,
                  n_layers: int = 4, n_heads: int = 4, d_ff: int = 0,
                  max_len: int = 512, attention: str = "auto",
                  n_experts: int = 0, moe_k: int = 2, dropout: float = 0.0,
-                 aux_coef: float = 0.01, name: str = "language_model"):
+                 aux_coef: float = 0.01, head_chunk: Optional[int] = None,
+                 name: str = "language_model"):
         self.name = name
+        self.head_chunk = head_chunk
         self.vocab_size = int(vocab_size)
         self.d_model = int(d_model)
         self.n_layers = int(n_layers)
@@ -414,13 +519,32 @@ class LanguageModel:
             return "flash" if (seq_len or self.max_len) >= 2048 else "dot"
         return "dot"
 
+    def _head_chunk(self, seq_len: Optional[int] = None) -> int:
+        """Fused-head chunk size (0 = full logits). Auto rule: fuse
+        when the vocab is large enough that the (tokens, vocab) f32
+        logits tensor dominates the step's HBM traffic (the measured
+        d=512 roofline gap, BENCHMARKS.md), EXCEPT under
+        sequence-parallel attention — ring/Ulysses shard the sequence
+        dim, and the chunked scan's flatten would fight that layout.
+        ``LO_LM_HEAD_CHUNK`` overrides (0 disables, N sets tokens per
+        chunk)."""
+        env = os.environ.get("LO_LM_HEAD_CHUNK")
+        if env is not None:
+            return max(0, int(env))
+        if self.head_chunk is not None:
+            return max(0, int(self.head_chunk))
+        if self._resolved_attention(seq_len) in ("ring", "ulysses"):
+            return 0
+        return 1024 if self.vocab_size >= 8192 else 0
+
     def _module_for(self, seq_len: Optional[int] = None) -> TransformerLM:
         return TransformerLM(
             vocab_size=self.vocab_size, d_model=self.d_model,
             n_layers=self.n_layers, n_heads=self.n_heads, d_ff=self.d_ff,
             attention=self._resolved_attention(seq_len), causal=True,
             n_experts=self.n_experts, moe_k=self.moe_k,
-            dropout=self.dropout, mesh=self._mesh_override)
+            dropout=self.dropout, mesh=self._mesh_override,
+            fused_head_chunk=self._head_chunk(seq_len))
 
     @property
     def module(self) -> TransformerLM:
@@ -480,7 +604,9 @@ class LanguageModel:
 
             self._engine = engine_lib.Engine(
                 apply_fn=self._apply_fn,
-                loss_fn=next_token_loss(self.aux_coef),
+                loss_fn=next_token_loss(
+                    self.aux_coef,
+                    head_chunk=self._head_chunk() or 1024),
                 optimizer=build_optimizer(self.optimizer_spec),
                 mesh=mesh,
                 metrics={"accuracy": token_accuracy},
@@ -712,7 +838,10 @@ class LanguageModel:
 
         with open(os.path.join(path, "config.json")) as f:
             config = json.load(f)
-        model = cls(**{k: config[k] for k in cls._CONFIG_KEYS},
+        # .get-style filter: configs saved before a key existed fall
+        # back to the constructor default (e.g. head_chunk)
+        model = cls(**{k: config[k] for k in cls._CONFIG_KEYS
+                       if k in config},
                     name=config["name"])
         model.optimizer_spec = config["optimizer_spec"]
         model.seed = config["seed"]

@@ -130,16 +130,26 @@ class Engine:
             outputs, new_model_state = self._apply_fn(
                 self._cast(params), state.model_state,
                 self._cast(batch), True, rng)
-            loss = self._loss_fn(outputs, batch, weights)
-            return loss.astype(jnp.float32), (outputs, new_model_state)
+            res = self._loss_fn(outputs, batch, weights)
+            # a loss_fn may return (loss, {metric: (sum, count)}) to
+            # emit metrics it already computed — the fused-lm-head
+            # loss produces accuracy inside its chunked scan, and
+            # recomputing it from outputs would cost a second
+            # vocab-width matmul per step
+            loss, extra = res if isinstance(res, tuple) else (res, {})
+            return loss.astype(jnp.float32), (outputs, new_model_state,
+                                              extra)
 
-        (loss, (outputs, new_model_state)), grads = jax.value_and_grad(
-            loss_of, has_aux=True)(state.params)
+        (loss, (outputs, new_model_state, extra)), grads = \
+            jax.value_and_grad(loss_of, has_aux=True)(state.params)
         updates, new_opt = self._optimizer.update(
             grads, state.opt_state, state.params)
         new_params = optax.apply_updates(state.params, updates)
         metrics = {"loss": (loss * _total(weights), _total(weights))}
+        metrics.update(extra)
         for name, fn in self._metrics.items():
+            if name in extra:
+                continue  # the loss already emitted this metric
             metrics[name] = fn(outputs, batch, weights)
         new_state = state.replace(step=state.step + 1, params=new_params,
                                   opt_state=new_opt,
@@ -193,9 +203,14 @@ class Engine:
             outputs, _ = self._apply_fn(
                 self._cast(state.params), state.model_state,
                 self._cast(batch), False, None)
-            loss = self._loss_fn(outputs, batch, weights).astype(jnp.float32)
+            res = self._loss_fn(outputs, batch, weights)
+            loss, extra = res if isinstance(res, tuple) else (res, {})
+            loss = loss.astype(jnp.float32)
             metrics = {"loss": (loss * _total(weights), _total(weights))}
+            metrics.update(extra)
             for name, fn in self._metrics.items():
+                if name in extra:
+                    continue  # the loss already emitted this metric
                 metrics[name] = fn(outputs, batch, weights)
             return metrics
 

@@ -263,3 +263,79 @@ def test_ulysses_16k_mixed_mesh_step_lowers(tmp_path):
     assert "num_partitions = 8" in text
     assert "manual_computation" in text
     assert "all_to_all" in text
+
+
+# ----------------------------------------------------------------------
+# fused lm_head (chunked projection + CE: the d=512 roofline epilogue
+# fix — BENCHMARKS.md names the vocab-32k logits tensor as the gap)
+# ----------------------------------------------------------------------
+def test_fused_head_matches_full_logits_loss_and_grads(tmp_path):
+    """FusedHeadOut training path == full-logits path: same loss,
+    same grads (to float tolerance), accuracy emitted from the scan
+    equals token_accuracy on full logits."""
+    from learningorchestra_tpu.models import transformer as T
+
+    _mesh_config(tmp_path, "dp=2")
+    mod_full = T.TransformerLM(vocab_size=97, d_model=32, n_layers=2,
+                               n_heads=4, fused_head_chunk=0)
+    mod_fused = T.TransformerLM(vocab_size=97, d_model=32, n_layers=2,
+                                n_heads=4, fused_head_chunk=7)
+    toks = (np.arange(6 * 17).reshape(6, 17) % 96 + 1).astype(np.int32)
+    toks[2, 9:] = 0  # padding must stay masked in both paths
+    params = mod_full.init(jax.random.PRNGKey(0),
+                           jnp.asarray(toks[:1]), train=False)["params"]
+    loss_fn = T.next_token_loss(0.01, head_chunk=7)
+    batch = {"x": jnp.asarray(toks)}
+
+    def full_loss(p):
+        return loss_fn(mod_full.apply({"params": p}, batch["x"],
+                                      train=True), batch, None)
+
+    def fused_loss(p):
+        loss, extra = loss_fn(mod_fused.apply({"params": p}, batch["x"],
+                                              train=True), batch, None)
+        return loss, extra
+
+    lf, gf = jax.value_and_grad(full_loss)(params)
+    (lz, extra), gz = jax.value_and_grad(fused_loss, has_aux=True)(
+        params)
+    assert abs(float(lf) - float(lz)) < 1e-5
+    for a, b in zip(jax.tree_util.tree_leaves(gf),
+                    jax.tree_util.tree_leaves(gz)):
+        np.testing.assert_allclose(a, b, atol=1e-6)
+    acc_s, acc_c = T.token_accuracy(
+        mod_full.apply({"params": params}, batch["x"], train=True),
+        batch, None)
+    assert float(extra["accuracy"][0]) == float(acc_s)
+    assert float(extra["accuracy"][1]) == float(acc_c)
+
+
+def test_fused_head_auto_rule_and_training(tmp_path):
+    """Auto rule: large vocab fuses, small vocab and seq-parallel
+    attention do not; LO_LM_HEAD_CHUNK=0 force-disables. A fused fit
+    still reports loss AND accuracy through the engine."""
+    import os as _os
+
+    from learningorchestra_tpu.models.transformer import LanguageModel
+
+    _mesh_config(tmp_path, "dp=2")
+    big = LanguageModel(vocab_size=8192, d_model=32, n_layers=1,
+                        n_heads=4, max_len=16)
+    assert big._head_chunk() == 1024
+    small = LanguageModel(vocab_size=100, d_model=32, n_layers=1,
+                          n_heads=4, max_len=16)
+    assert small._head_chunk() == 0
+    ring = LanguageModel(vocab_size=8192, d_model=32, n_layers=1,
+                         n_heads=4, max_len=16, attention="ring")
+    assert ring._head_chunk() == 0
+    _os.environ["LO_LM_HEAD_CHUNK"] = "0"
+    try:
+        assert big._head_chunk() == 0
+    finally:
+        del _os.environ["LO_LM_HEAD_CHUNK"]
+
+    toks = (np.random.default_rng(0).integers(
+        1, 8192, size=(8, 12))).astype(np.int32)
+    hist = big.fit(toks, batch_size=4, epochs=1)
+    assert np.isfinite(hist.history["loss"][0])
+    assert "accuracy" in hist.history
