@@ -26,12 +26,17 @@ are usually auto-discoverable and may all be omitted —
 
 from __future__ import annotations
 
+import json
 import os
+import socket
 import threading
+import time
 import traceback
-from typing import Any, Dict, Optional
+from typing import Any, Dict, List, Optional
 
 _initialized = False
+_monitor: Optional["HeartbeatMonitor"] = None
+_sender_stop: Optional[threading.Event] = None
 # serializes the (length, payload) broadcast pair of each publish so
 # concurrent publishers (job thread vs shutdown path) cannot interleave
 # their collectives and desynchronize the workers' recv loop
@@ -69,17 +74,172 @@ def initialize(coordinator_address: Optional[str] = None,
         num_processes=num_processes,
         process_id=process_id)
     _initialized = True
+    if coordinator_address is None:
+        # cloud-provisioned pods auto-discover the coordinator; pull
+        # the resolved address from the runtime so worker-loss
+        # detection works in that deployment mode too
+        try:
+            from jax._src import distributed as _jdist
+
+            coordinator_address = _jdist.global_state.coordinator_address
+        except Exception:  # noqa: BLE001 — internal layout changed
+            coordinator_address = None
+    if jax.process_count() > 1:
+        if coordinator_address is not None:
+            _start_heartbeats(coordinator_address)
+        else:
+            print("worker-loss detection disabled: coordinator "
+                  "address unknown (set LO_COORDINATOR to enable "
+                  "heartbeats)", flush=True)
     return True
 
 
+# ----------------------------------------------------------------------
+# worker liveness (the capability Swarm's restart/re-placement provided
+# in the reference, README.md:200-202 + docker-compose.yml:3-6: node
+# loss must surface as a reported failure, not a hung collective)
+# ----------------------------------------------------------------------
+HEARTBEAT_INTERVAL = float(os.environ.get("LO_HEARTBEAT_INTERVAL", "1.0"))
+HEARTBEAT_TIMEOUT = float(os.environ.get(
+    "LO_HEARTBEAT_TIMEOUT", str(5 * HEARTBEAT_INTERVAL)))
+
+
+def _heartbeat_address(coordinator_address: str):
+    """Heartbeats ride a UDP side channel one port above the jax
+    coordinator (collectives cannot carry liveness: a dead peer makes
+    them HANG, which is exactly the failure mode being detected).
+    ``LO_HEARTBEAT_PORT`` overrides."""
+    host, _, port = coordinator_address.rpartition(":")
+    hb_port = int(os.environ.get("LO_HEARTBEAT_PORT", int(port) + 1))
+    return host or "127.0.0.1", hb_port
+
+
+class HeartbeatMonitor:
+    """Coordinator-side liveness tracker: workers datagram their host
+    id every ``HEARTBEAT_INTERVAL``; a worker silent for
+    ``HEARTBEAT_TIMEOUT`` is reported lost (and stays lost — a pod
+    with a dead member cannot re-admit it without re-forming)."""
+
+    def __init__(self, address, expected: List[int],
+                 timeout: float = HEARTBEAT_TIMEOUT):
+        self._timeout = timeout
+        now = time.monotonic()
+        self._last_seen = {int(h): now for h in expected}
+        self._lost: Dict[int, float] = {}
+        self._lock = threading.Lock()
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        self._sock.bind(address)
+        self._sock.settimeout(0.5)
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._recv_loop,
+                                        daemon=True,
+                                        name="lo-heartbeat-monitor")
+        self._thread.start()
+
+    def _recv_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                data, _addr = self._sock.recvfrom(512)
+                host_id = int(json.loads(data.decode("utf-8"))["hostId"])
+                with self._lock:
+                    # only ids from the pod's expected set count — a
+                    # stray datagram (stale sender from a previous
+                    # incarnation) must not poison liveness state
+                    if host_id in self._last_seen and \
+                            host_id not in self._lost:
+                        self._last_seen[host_id] = time.monotonic()
+            except socket.timeout:
+                continue
+            except (OSError, ValueError, KeyError):
+                if self._stop.is_set():
+                    return
+
+    def lost_workers(self) -> List[int]:
+        now = time.monotonic()
+        with self._lock:
+            for host_id, seen in self._last_seen.items():
+                if host_id not in self._lost and \
+                        now - seen > self._timeout:
+                    self._lost[host_id] = now
+            return sorted(self._lost)
+
+    def close(self) -> None:
+        self._stop.set()
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+
+def _start_heartbeats(coordinator_address: str) -> None:
+    """Coordinator: monitor. Workers: sender thread."""
+    global _monitor, _sender_stop
+    import jax
+
+    address = _heartbeat_address(coordinator_address)
+    if jax.process_index() == 0:
+        if _monitor is None:
+            try:
+                _monitor = HeartbeatMonitor(
+                    address, expected=list(range(1, jax.process_count())))
+            except OSError as exc:  # port taken — degrade loudly
+                print(f"heartbeat monitor disabled: {exc}", flush=True)
+        return
+    if _sender_stop is not None:
+        return
+    _sender_stop = threading.Event()
+    host_id = jax.process_index()
+    payload = json.dumps({"hostId": host_id}).encode("utf-8")
+
+    def send_loop(stop: threading.Event) -> None:
+        sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        while not stop.is_set():
+            try:
+                sock.sendto(payload, address)
+            except OSError:
+                pass
+            stop.wait(HEARTBEAT_INTERVAL)
+        sock.close()
+
+    threading.Thread(target=send_loop, args=(_sender_stop,),
+                     daemon=True, name="lo-heartbeat-sender").start()
+
+
+def pod_failure() -> Optional[str]:
+    """Human-readable description of a detected worker loss, or None
+    while the pod is whole. Once non-None it stays non-None: mesh jobs
+    must be refused until the pod re-forms (restart all processes)."""
+    if _monitor is None:
+        return None
+    lost = _monitor.lost_workers()
+    if not lost:
+        return None
+    return (f"worker host(s) {lost} stopped heartbeating "
+            f"(> {HEARTBEAT_TIMEOUT:.1f}s silent); in-flight mesh "
+            f"collectives cannot complete and new mesh jobs are "
+            f"refused until the pod re-forms")
+
+
 def shutdown() -> None:
-    global _initialized
+    global _initialized, _monitor, _sender_stop
+    if _monitor is not None:
+        _monitor.close()
+        _monitor = None
+    if _sender_stop is not None:
+        _sender_stop.set()
+        _sender_stop = None
     if not _initialized:
         return
     import jax
 
     jax.distributed.shutdown()
     _initialized = False
+
+
+def is_initialized() -> bool:
+    """True once a multi-host runtime has been formed in this
+    process (``initialize`` returned True)."""
+    return _initialized
 
 
 def host_info() -> Dict[str, Any]:

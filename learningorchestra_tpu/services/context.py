@@ -20,6 +20,7 @@ from learningorchestra_tpu.catalog.artifacts import ArtifactStore
 
 class ServiceContext:
     def __init__(self, config: Optional[Config] = None):
+        from learningorchestra_tpu.runtime import distributed as dist
         from learningorchestra_tpu.services.jobs import JobManager
         from learningorchestra_tpu.services.params import ParameterResolver
 
@@ -30,8 +31,10 @@ class ServiceContext:
         self.artifacts = ArtifactStore(self.config.artifacts_dir)
         self.jobs = JobManager(self.catalog,
                                max_workers=self.config.max_workers,
-                               mesh_leases=self.config.mesh_leases)
+                               mesh_leases=self.config.mesh_leases,
+                               pod_failure_fn=dist.pod_failure)
         self.params = ParameterResolver(self)
+        self._pod_guard = _start_pod_guard(self.jobs)
 
     @property
     def mesh(self):
@@ -43,5 +46,51 @@ class ServiceContext:
         return mesh_lib.get_default_mesh()
 
     def close(self) -> None:
+        if self._pod_guard is not None:
+            self._pod_guard.set()
         self.jobs.shutdown()
         self.catalog.close()
+
+
+def _start_pod_guard(jobs):
+    """Coordinator-side watchdog (multi-host only): the moment a
+    worker stops heartbeating, every in-flight mesh job gets a typed
+    ``WorkerLost`` execution document — clients polling see a terminal
+    failure within seconds instead of a silent hang on a collective
+    (the reference loses in-flight work on node failure and relies on
+    Swarm re-placement, README.md:194-202; surfacing the failure is
+    the single-controller equivalent)."""
+    import threading
+
+    from learningorchestra_tpu.runtime import distributed as dist
+
+    # only consult jax when the multi-host runtime already formed:
+    # touching jax.process_count() here would otherwise initialize the
+    # single-host backend and break a later dist.initialize() (the
+    # documented order is initialize-then-ServiceContext, as
+    # services/server.py main does)
+    if not dist.is_initialized():
+        return None
+    try:
+        import jax
+
+        if jax.process_count() <= 1 or jax.process_index() != 0:
+            return None
+    except Exception:  # noqa: BLE001 — no runtime formed yet
+        return None
+
+    stop = threading.Event()
+
+    def guard() -> None:
+        reported = False
+        while not stop.wait(dist.HEARTBEAT_INTERVAL):
+            failure = dist.pod_failure()
+            if failure and not reported:
+                reported = True
+                n = jobs.fail_running_mesh_jobs(failure)
+                print(f"pod guard: {failure} — marked {n} in-flight "
+                      f"mesh job(s) failed", flush=True)
+
+    threading.Thread(target=guard, daemon=True,
+                     name="lo-pod-guard").start()
+    return stop

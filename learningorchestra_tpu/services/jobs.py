@@ -37,13 +37,20 @@ from learningorchestra_tpu.catalog.store import Catalog
 
 class JobManager:
     def __init__(self, catalog: Catalog, max_workers: int = 8,
-                 mesh_leases: int = 1):
+                 mesh_leases: int = 1,
+                 pod_failure_fn: Optional[Callable[[], Optional[str]]]
+                 = None):
         self._catalog = catalog
         self._pool = ThreadPoolExecutor(max_workers=max_workers,
                                         thread_name_prefix="lo-job")
         self._mesh_sem = threading.BoundedSemaphore(mesh_leases)
         self._futures: Dict[str, Future] = {}
+        self._mesh_jobs: Dict[str, Dict[str, Any]] = {}
         self._lock = threading.Lock()
+        # returns a failure description when the multi-host pod has
+        # lost a worker (runtime.distributed.pod_failure); mesh jobs
+        # are then refused instead of hanging in a collective
+        self._pod_failure_fn = pod_failure_fn or (lambda: None)
 
     # ------------------------------------------------------------------
     def mesh_lease(self):
@@ -68,6 +75,19 @@ class JobManager:
             submitted = time.monotonic()
             attempts = max_retries + 1
             for attempt in range(attempts):
+                if needs_mesh:
+                    failure = self._pod_failure_fn()
+                    if failure:
+                        # a degraded pod cannot run mesh collectives:
+                        # record a TERMINAL typed failure instead of
+                        # entering a jit that would hang forever
+                        self._catalog.append_document(
+                            name, D.execution_document(
+                                description, parameters,
+                                exception=f"WorkerLost({failure!r})",
+                                extra={"workerLost": True,
+                                       "attempt": attempt + 1}))
+                        return None
                 lease = (self._mesh_sem if needs_mesh
                          else contextlib.nullcontext())
                 with lease:
@@ -110,8 +130,29 @@ class JobManager:
                     if f.done() and k != name]
             for k in done:
                 del self._futures[k]
+                self._mesh_jobs.pop(k, None)
             self._futures[name] = future
+            if needs_mesh:
+                self._mesh_jobs[name] = {"description": description,
+                                         "parameters": parameters}
         return future
+
+    def fail_running_mesh_jobs(self, reason: str) -> int:
+        """Append a terminal ``WorkerLost`` execution document to every
+        in-flight mesh job (their threads are stuck in collectives a
+        dead worker will never join — clients polling the documents
+        must see a typed failure, not silence). Returns the count."""
+        with self._lock:
+            stuck = [(k, v) for k, v in self._mesh_jobs.items()
+                     if k in self._futures
+                     and not self._futures[k].done()]
+        for name, info in stuck:
+            self._catalog.append_document(
+                name, D.execution_document(
+                    info["description"], info["parameters"],
+                    exception=f"WorkerLost({reason!r})",
+                    extra={"workerLost": True}))
+        return len(stuck)
 
     def resubmit(self, name: str, fn: Callable[[], Any],
                  **kwargs: Any) -> Future:

@@ -303,6 +303,10 @@ class Api:
             info.update(dist.host_info())
             info["deviceCount"] = info["globalDevices"]
             info["devicePlatform"] = info["platform"]
+            failure = dist.pod_failure()
+            if failure:
+                info["status"] = "degraded"
+                info["podFailure"] = failure
         except Exception as e:  # noqa: BLE001
             info["deviceError"] = repr(e)
         return info

