@@ -101,7 +101,7 @@ def initialize(coordinator_address: Optional[str] = None,
 # ----------------------------------------------------------------------
 HEARTBEAT_INTERVAL = float(os.environ.get("LO_HEARTBEAT_INTERVAL", "1.0"))
 HEARTBEAT_TIMEOUT = float(os.environ.get(
-    "LO_HEARTBEAT_TIMEOUT", str(5 * HEARTBEAT_INTERVAL)))
+    "LO_HEARTBEAT_TIMEOUT", str(10 * HEARTBEAT_INTERVAL)))
 
 
 def _heartbeat_address(coordinator_address: str):
@@ -117,15 +117,18 @@ def _heartbeat_address(coordinator_address: str):
 class HeartbeatMonitor:
     """Coordinator-side liveness tracker: workers datagram their host
     id every ``HEARTBEAT_INTERVAL``; a worker silent for
-    ``HEARTBEAT_TIMEOUT`` is reported lost (and stays lost — a pod
-    with a dead member cannot re-admit it without re-forming)."""
+    ``HEARTBEAT_TIMEOUT`` is reported lost. Loss is NOT sticky: UDP
+    is best-effort and a GC/network pause can silence a live worker,
+    so resumed heartbeats clear it — a false alarm costs spurious
+    WorkerLost documents on jobs that then still finish, while a
+    sticky false alarm would wedge a healthy pod until manual
+    restart."""
 
     def __init__(self, address, expected: List[int],
                  timeout: float = HEARTBEAT_TIMEOUT):
         self._timeout = timeout
         now = time.monotonic()
         self._last_seen = {int(h): now for h in expected}
-        self._lost: Dict[int, float] = {}
         self._lock = threading.Lock()
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
         self._sock.bind(address)
@@ -140,28 +143,28 @@ class HeartbeatMonitor:
         while not self._stop.is_set():
             try:
                 data, _addr = self._sock.recvfrom(512)
-                host_id = int(json.loads(data.decode("utf-8"))["hostId"])
-                with self._lock:
-                    # only ids from the pod's expected set count — a
-                    # stray datagram (stale sender from a previous
-                    # incarnation) must not poison liveness state
-                    if host_id in self._last_seen and \
-                            host_id not in self._lost:
-                        self._last_seen[host_id] = time.monotonic()
             except socket.timeout:
                 continue
-            except (OSError, ValueError, KeyError):
+            except OSError:
                 if self._stop.is_set():
                     return
+                continue
+            try:
+                host_id = int(json.loads(data.decode("utf-8"))["hostId"])
+            except Exception:  # noqa: BLE001 — the socket is
+                continue  # unauthenticated; junk must not kill the loop
+            with self._lock:
+                # only ids from the pod's expected set count — a
+                # stray datagram (stale sender from a previous
+                # incarnation) must not poison liveness state
+                if host_id in self._last_seen:
+                    self._last_seen[host_id] = time.monotonic()
 
     def lost_workers(self) -> List[int]:
         now = time.monotonic()
         with self._lock:
-            for host_id, seen in self._last_seen.items():
-                if host_id not in self._lost and \
-                        now - seen > self._timeout:
-                    self._lost[host_id] = now
-            return sorted(self._lost)
+            return sorted(h for h, seen in self._last_seen.items()
+                          if now - seen > self._timeout)
 
     def close(self) -> None:
         self._stop.set()
@@ -207,17 +210,19 @@ def _start_heartbeats(coordinator_address: str) -> None:
 
 def pod_failure() -> Optional[str]:
     """Human-readable description of a detected worker loss, or None
-    while the pod is whole. Once non-None it stays non-None: mesh jobs
-    must be refused until the pod re-forms (restart all processes)."""
+    while the pod is whole. Clears if the worker's heartbeats resume
+    (a transient network/GC pause must not wedge a healthy pod); a
+    really-dead worker never resumes, so for true failures this stays
+    non-None until the pod re-forms."""
     if _monitor is None:
         return None
     lost = _monitor.lost_workers()
     if not lost:
         return None
     return (f"worker host(s) {lost} stopped heartbeating "
-            f"(> {HEARTBEAT_TIMEOUT:.1f}s silent); in-flight mesh "
+            f"(> {_monitor._timeout:.1f}s silent); in-flight mesh "
             f"collectives cannot complete and new mesh jobs are "
-            f"refused until the pod re-forms")
+            f"refused until heartbeats resume or the pod re-forms")
 
 
 def shutdown() -> None:

@@ -90,6 +90,12 @@ def _start_pod_guard(jobs):
                 n = jobs.fail_running_mesh_jobs(failure)
                 print(f"pod guard: {failure} — marked {n} in-flight "
                       f"mesh job(s) failed", flush=True)
+            elif not failure and reported:
+                # heartbeats resumed (transient pause, not a death):
+                # re-arm so a later real loss is reported again
+                reported = False
+                print("pod guard: heartbeats resumed, pod healthy "
+                      "again", flush=True)
 
     threading.Thread(target=guard, daemon=True,
                      name="lo-pod-guard").start()

@@ -41,6 +41,7 @@ directly from the catalog's change feed as long-poll JSON.
 from __future__ import annotations
 
 import json
+import os
 import re
 import threading
 import time
@@ -297,16 +298,19 @@ class Api:
     def _health(self) -> Dict[str, Any]:
         info: Dict[str, Any] = {"status": "ok",
                                 "jobsRunning": self.ctx.jobs.running()}
-        try:
-            from learningorchestra_tpu.runtime import distributed as dist
+        from learningorchestra_tpu.runtime import distributed as dist
 
+        # pod liveness FIRST and outside the topology try: a broken
+        # distributed runtime is when host_info() is most likely to
+        # raise, and that must not mask the degraded status
+        failure = dist.pod_failure()
+        if failure:
+            info["status"] = "degraded"
+            info["podFailure"] = failure
+        try:
             info.update(dist.host_info())
             info["deviceCount"] = info["globalDevices"]
             info["devicePlatform"] = info["platform"]
-            failure = dist.pod_failure()
-            if failure:
-                info["status"] = "degraded"
-                info["podFailure"] = failure
         except Exception as e:  # noqa: BLE001
             info["deviceError"] = repr(e)
         return info
@@ -578,6 +582,16 @@ def main(argv=None) -> None:
         set_config(Config.from_file(args.config))
     if args.home:
         set_config(get_config().replace(home=args.home))
+
+    plat = os.environ.get("JAX_PLATFORMS")
+    if plat:
+        # honor the operator's platform choice even when a site hook
+        # force-registers an accelerator plugin through jax.config
+        # (config wins over the env var, so re-assert it here, before
+        # anything touches the backend)
+        import jax
+
+        jax.config.update("jax_platforms", plat)
 
     from learningorchestra_tpu.runtime import distributed as dist
 
