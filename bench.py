@@ -375,8 +375,9 @@ def phase_builder():
     """BASELINE config 4 (the reference's Spark path): 10M-row
     synthetic binary classification through POST /builder with
     streaming=true — batched Parquet iteration, partial_fit (LR) and
-    reservoir + histogram boosting (GB), bounded RSS. No accelerator
-    involved; this measures the out-of-core host data plane."""
+    FULL-DATA first-party histogram boosting (GB: every row trains,
+    csrc/locore.cpp lo_hgb_*), bounded RSS. No accelerator involved;
+    this measures the out-of-core host data plane."""
     import resource
 
     import numpy as np

@@ -425,6 +425,285 @@ void lo_gather_f32(const float* src, int64_t nrows, int64_t ncols,
   }
 }
 
-int32_t lo_abi_version() { return 1; }
+
+// ---------------------------------------------------------------------------
+// Histogram gradient boosting over pre-binned uint8 feature codes — the
+// full-data replacement for the reference's Spark GBTClassifier path
+// (builder_image/builder.py:118): every row contributes gradients on every
+// iteration (no reservoir), memory stays rows x nfeats bytes + one raw
+// score per row/class. Depth-wise growth in an implicit heap layout; one
+// pass over the data builds the histograms of every node of a level
+// (hist indexed by the row''s current node), logistic / softmax objective.
+// ---------------------------------------------------------------------------
+
+struct HgbModel {
+  int nfeats = 0;
+  int nclass = 0;        // 2 => single sigmoid tree per iter
+  int max_depth = 0;
+  double base = 0.0;     // binary: log-odds; multiclass: per-class in bases
+  std::vector<double> bases;
+  // trees laid out iteration-major; each tree is a full implicit heap of
+  // (2^(max_depth+1) - 1) slots: feat[i] >= 0 -> internal (go left if
+  // code <= bin[i]); feat[i] == -1 -> leaf with value val[i];
+  // feat[i] == -2 -> dead slot (under a leaf ancestor)
+  std::vector<int> feat;
+  std::vector<uint8_t> bin;
+  std::vector<double> val;
+  int slots_per_tree = 0;
+  int n_trees = 0;
+};
+
+static inline double hgb_leaf(double g, double h, double l2, double lr) {
+  return -lr * g / (h + l2 + 1e-12);
+}
+
+// builds ONE regression tree on (g, h); updates scores in place
+static void hgb_build_tree(const uint8_t* codes, int64_t nrows, int nfeats,
+                           const double* g, const double* h,
+                           double* scores, int64_t score_stride,
+                           int max_depth, int max_bins, double lr,
+                           double l2, int64_t min_leaf,
+                           std::vector<int>& feat_out,
+                           std::vector<uint8_t>& bin_out,
+                           std::vector<double>& val_out,
+                           std::vector<int32_t>& assign) {
+  const int slots = (1 << (max_depth + 1)) - 1;
+  const int base_slot = (int)feat_out.size();
+  feat_out.insert(feat_out.end(), slots, -2);
+  bin_out.insert(bin_out.end(), slots, 0);
+  val_out.insert(val_out.end(), slots, 0.0);
+  int* tfeat = feat_out.data() + base_slot;
+  uint8_t* tbin = bin_out.data() + base_slot;
+  double* tval = val_out.data() + base_slot;
+
+  std::fill(assign.begin(), assign.end(), 0);
+  tfeat[0] = -1;  // provisional leaf (filled from level-0 totals below)
+
+  for (int depth = 0; depth < max_depth; ++depth) {
+    const int first = (1 << depth) - 1;
+    const int count = 1 << depth;
+    // any node still marked provisional-leaf at this level is active
+    std::vector<int> active;
+    for (int n = first; n < first + count; ++n)
+      if (tfeat[n] == -1) active.push_back(n);
+    if (active.empty()) break;
+
+    // node-local histogram ids (small dense table for this level)
+    std::vector<int> hist_id(count, -1);
+    for (size_t a = 0; a < active.size(); ++a)
+      hist_id[active[a] - first] = (int)a;
+    const size_t hist_cells = active.size() * (size_t)nfeats * max_bins;
+    std::vector<double> hg(hist_cells, 0.0), hh(hist_cells, 0.0);
+    std::vector<int64_t> hc(active.size() * (size_t)nfeats * max_bins, 0);
+
+    // one pass over all rows fills every active node''s histograms
+    for (int64_t i = 0; i < nrows; ++i) {
+      const int32_t node = assign[i];
+      if (node < first || node >= first + count) continue;
+      const int id = hist_id[node - first];
+      if (id < 0) continue;
+      const uint8_t* row = codes + i * nfeats;
+      const double gi = g[i], hi = h[i];
+      double* hgp = hg.data() + (size_t)id * nfeats * max_bins;
+      double* hhp = hh.data() + (size_t)id * nfeats * max_bins;
+      int64_t* hcp = hc.data() + (size_t)id * nfeats * max_bins;
+      for (int f = 0; f < nfeats; ++f) {
+        const int b = row[f];
+        hgp[f * max_bins + b] += gi;
+        hhp[f * max_bins + b] += hi;
+        hcp[f * max_bins + b] += 1;
+      }
+    }
+
+    bool any_split = false;
+    for (size_t a = 0; a < active.size(); ++a) {
+      const int node = active[a];
+      const double* hgp = hg.data() + a * (size_t)nfeats * max_bins;
+      const double* hhp = hh.data() + a * (size_t)nfeats * max_bins;
+      const int64_t* hcp = hc.data() + a * (size_t)nfeats * max_bins;
+      double G = 0.0, H = 0.0;
+      int64_t C = 0;
+      for (int b = 0; b < max_bins; ++b) {
+        G += hgp[b]; H += hhp[b]; C += hcp[b];
+      }
+      // (feature 0 totals == node totals; every feature sums the same rows)
+      const double parent_obj = G * G / (H + l2 + 1e-12);
+      double best_gain = 1e-7;
+      int best_f = -1, best_b = -1;
+      for (int f = 0; f < nfeats; ++f) {
+        double GL = 0.0, HL = 0.0;
+        int64_t CL = 0;
+        const double* fg = hgp + (size_t)f * max_bins;
+        const double* fh = hhp + (size_t)f * max_bins;
+        const int64_t* fc = hcp + (size_t)f * max_bins;
+        for (int b = 0; b < max_bins - 1; ++b) {
+          GL += fg[b]; HL += fh[b]; CL += fc[b];
+          const int64_t CR = C - CL;
+          if (CL < min_leaf || CR < min_leaf) continue;
+          const double HR = H - HL, GR = G - GL;
+          const double gain = GL * GL / (HL + l2 + 1e-12) +
+                              GR * GR / (HR + l2 + 1e-12) - parent_obj;
+          if (gain > best_gain) { best_gain = gain; best_f = f; best_b = b; }
+        }
+      }
+      if (best_f < 0 || depth + 1 >= max_depth + 1) {
+        tval[node] = hgb_leaf(G, H, l2, lr);  // stays a leaf
+        continue;
+      }
+      tfeat[node] = best_f;
+      tbin[node] = (uint8_t)best_b;
+      const int left = 2 * node + 1, right = 2 * node + 2;
+      if (left < slots) { tfeat[left] = -1; tfeat[right] = -1; }
+      any_split = true;
+    }
+    if (!any_split) break;
+
+    // re-assign rows through this level''s new splits
+    for (int64_t i = 0; i < nrows; ++i) {
+      const int32_t node = assign[i];
+      if (node < first || node >= first + count) continue;
+      if (tfeat[node] >= 0) {
+        const uint8_t c = codes[i * nfeats + tfeat[node]];
+        assign[i] = (c <= tbin[node]) ? 2 * node + 1 : 2 * node + 2;
+      }
+    }
+
+    // deepest level: finalize provisional leaves from fresh totals next
+    if (depth + 1 == max_depth) {
+      const int lfirst = (1 << (depth + 1)) - 1;
+      const int lcount = 1 << (depth + 1);
+      std::vector<double> lg(lcount, 0.0), lh(lcount, 0.0);
+      for (int64_t i = 0; i < nrows; ++i) {
+        const int32_t node = assign[i];
+        if (node >= lfirst && node < lfirst + lcount) {
+          lg[node - lfirst] += g[i];
+          lh[node - lfirst] += h[i];
+        }
+      }
+      for (int n = 0; n < lcount; ++n)
+        if (tfeat[lfirst + n] == -1)
+          tval[lfirst + n] = hgb_leaf(lg[n], lh[n], l2, lr);
+    }
+  }
+
+  // update scores: every row adds its leaf''s value
+  for (int64_t i = 0; i < nrows; ++i) {
+    int node = assign[i];
+    // walk down if the row stopped on an internal node (can''t happen in
+    // this layout, but cheap to guard), walk up never needed
+    while (tfeat[node] >= 0) {
+      const uint8_t c = codes[i * nfeats + tfeat[node]];
+      node = (c <= tbin[node]) ? 2 * node + 1 : 2 * node + 2;
+    }
+    scores[i * score_stride] += tval[node];
+  }
+}
+
+void* lo_hgb_train(const uint8_t* codes, int64_t nrows, int nfeats,
+                   const int32_t* y, int nclass, int n_iter, int max_depth,
+                   int max_bins, double lr, double l2,
+                   int64_t min_samples_leaf) {
+  if (nrows <= 0 || nfeats <= 0 || nclass < 2 || max_bins > 256)
+    return nullptr;
+  HgbModel* m = new HgbModel();
+  m->nfeats = nfeats;
+  m->nclass = nclass;
+  m->max_depth = max_depth;
+  m->slots_per_tree = (1 << (max_depth + 1)) - 1;
+
+  const int K = (nclass == 2) ? 1 : nclass;
+  std::vector<double> scores((size_t)nrows * K, 0.0);
+  std::vector<int64_t> class_count(nclass, 0);
+  for (int64_t i = 0; i < nrows; ++i) ++class_count[y[i]];
+  m->bases.assign(K, 0.0);
+  if (nclass == 2) {
+    const double p = std::max(
+        1e-9, std::min(1.0 - 1e-9,
+                       (double)class_count[1] / (double)nrows));
+    m->bases[0] = std::log(p / (1.0 - p));
+  } else {
+    for (int k = 0; k < K; ++k)
+      m->bases[k] = std::log(std::max(
+          1e-9, (double)class_count[k] / (double)nrows));
+  }
+  for (int64_t i = 0; i < nrows; ++i)
+    for (int k = 0; k < K; ++k) scores[i * K + k] = m->bases[k];
+
+  std::vector<double> g(nrows), h(nrows);
+  std::vector<int32_t> assign(nrows);
+  std::vector<double> probs;  // multiclass: nrows x K, one softmax/iter
+  if (nclass > 2) probs.resize((size_t)nrows * K);
+
+  for (int it = 0; it < n_iter; ++it) {
+    if (nclass == 2) {
+      for (int64_t i = 0; i < nrows; ++i) {
+        const double p = 1.0 / (1.0 + std::exp(-scores[i]));
+        g[i] = p - (double)y[i];
+        h[i] = std::max(p * (1.0 - p), 1e-12);
+      }
+      hgb_build_tree(codes, nrows, nfeats, g.data(), h.data(),
+                     scores.data(), 1, max_depth, max_bins, lr, l2,
+                     min_samples_leaf, m->feat, m->bin, m->val, assign);
+      ++m->n_trees;
+    } else {
+      // standard softmax boosting: ONE softmax per iteration drives
+      // all K trees (matching the numpy fallback — per-class
+      // recomputation would make the two paths diverge)
+      for (int64_t i = 0; i < nrows; ++i) {
+        const double* s = scores.data() + i * K;
+        double mx = s[0];
+        for (int j = 1; j < K; ++j) mx = std::max(mx, s[j]);
+        double denom = 0.0;
+        double* p = probs.data() + i * K;
+        for (int j = 0; j < K; ++j) {
+          p[j] = std::exp(s[j] - mx);
+          denom += p[j];
+        }
+        for (int j = 0; j < K; ++j) p[j] /= denom;
+      }
+      for (int k = 0; k < K; ++k) {
+        for (int64_t i = 0; i < nrows; ++i) {
+          const double pk = probs[i * K + k];
+          g[i] = pk - (y[i] == k ? 1.0 : 0.0);
+          h[i] = std::max(pk * (1.0 - pk), 1e-12);
+        }
+        hgb_build_tree(codes, nrows, nfeats, g.data(), h.data(),
+                       scores.data() + k, K, max_depth, max_bins, lr, l2,
+                       min_samples_leaf, m->feat, m->bin, m->val, assign);
+        ++m->n_trees;
+      }
+    }
+  }
+  return m;
+}
+
+// raw scores: out has nrows x K (K = 1 for binary)
+void lo_hgb_predict(void* model, const uint8_t* codes, int64_t nrows,
+                    double* out) {
+  HgbModel* m = (HgbModel*)model;
+  const int K = (m->nclass == 2) ? 1 : m->nclass;
+  const int slots = m->slots_per_tree;
+  for (int64_t i = 0; i < nrows; ++i)
+    for (int k = 0; k < K; ++k) out[i * K + k] = m->bases[k];
+  for (int t = 0; t < m->n_trees; ++t) {
+    const int* tfeat = m->feat.data() + (size_t)t * slots;
+    const uint8_t* tbin = m->bin.data() + (size_t)t * slots;
+    const double* tval = m->val.data() + (size_t)t * slots;
+    const int k = t % K;
+    for (int64_t i = 0; i < nrows; ++i) {
+      const uint8_t* row = codes + i * m->nfeats;
+      int node = 0;
+      while (tfeat[node] >= 0)
+        node = (row[tfeat[node]] <= tbin[node]) ? 2 * node + 1
+                                                : 2 * node + 2;
+      out[i * K + k] += tval[node];
+    }
+  }
+}
+
+int32_t lo_hgb_nclass(void* model) { return ((HgbModel*)model)->nclass; }
+void lo_hgb_free(void* model) { delete (HgbModel*)model; }
+
+int32_t lo_abi_version() { return 2; }
 
 }  // extern "C"

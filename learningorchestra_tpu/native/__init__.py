@@ -24,7 +24,7 @@ from typing import Optional
 _LOCK = threading.Lock()
 _LIB: Optional[ctypes.CDLL] = None
 _TRIED = False
-_ABI_VERSION = 1
+_ABI_VERSION = 2
 
 _PKG_DIR = os.path.dirname(os.path.abspath(__file__))
 _REPO_ROOT = os.path.dirname(os.path.dirname(_PKG_DIR))

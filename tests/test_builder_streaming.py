@@ -117,3 +117,60 @@ def test_iter_batches_streams_all_rows(catalog):
     cols = next(iter(catalog.iter_batches(
         "ib", columns=["label"], batch_size=128))).schema.names
     assert cols == ["label"]
+
+
+def test_streaming_gb_trains_on_all_rows(ctx):
+    """GB no longer caps training at the reservoir: the full-data
+    histogram booster sees every row (reference parity — Spark GBT
+    trains on the whole dataset, builder.py:118), and metadata says
+    so."""
+    _write_synth(ctx.catalog, "fd_train", 150_000, seed=3)
+    _write_synth(ctx.catalog, "fd_test", 8_000, seed=4)
+    _write_synth(ctx.catalog, "fd_eval", 8_000, seed=5)
+    svc = BuilderService(ctx)
+    status, _ = svc.create({
+        "trainDatasetName": "fd_train", "testDatasetName": "fd_test",
+        "evaluationDatasetName": "fd_eval",
+        "classifiersList": ["GB"], "streaming": True,
+        "batchSize": 16384})
+    assert status == 201
+    ctx.jobs.wait("fd_testGB", timeout=600)
+    meta = ctx.catalog.get_metadata("fd_testGB")
+    assert meta["finished"] is True, meta
+    assert meta["trainedOnSample"] is False
+    assert meta["trainedRows"] == 150_000
+    assert meta["accuracy"] > 0.95, meta
+    assert meta["booster"]["iterations"] >= 1
+
+
+def test_hgb_python_fallback_matches_native_shape(monkeypatch):
+    """The numpy fallback trains and predicts when no toolchain
+    exists (native.get_lib() -> None), same API."""
+    from learningorchestra_tpu import native
+    from learningorchestra_tpu.native import hgb
+
+    monkeypatch.setattr(native, "get_lib", lambda: None)
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(4000, 3))
+    y = (x @ np.array([1.0, -1.5, 0.5]) > 0).astype(np.int64)
+    edges = hgb.quantile_edges(x)
+    codes = hgb.bin_codes(x, edges)
+    clf = hgb.HistGB(n_iter=15, max_depth=4).fit_binned(codes, y)
+    assert clf._model is None and clf._py is not None
+    acc = (clf.predict_binned(codes) == y).mean()
+    assert acc > 0.9, acc
+
+
+def test_hgb_multiclass_native(tmp_config):
+    from learningorchestra_tpu.native import hgb
+
+    rng = np.random.default_rng(1)
+    x = rng.normal(size=(30_000, 4))
+    margin = x @ np.array([1.0, -2.0, 0.5, 1.5])
+    y = np.digitize(margin, [-1.5, 1.5])  # 3 classes
+    edges = hgb.quantile_edges(x)
+    codes = hgb.bin_codes(x, edges)
+    clf = hgb.HistGB(n_iter=25, max_depth=5).fit_binned(codes, y)
+    assert list(clf.classes_) == [0, 1, 2]
+    acc = (clf.predict_binned(codes) == y).mean()
+    assert acc > 0.9, acc
