@@ -856,39 +856,43 @@ def _write_md(path, report):
         "Train → Evaluate), steady-state epoch (post-compile), per chip.",
         "",
         "| model | platform | samples/s/chip | tflops/s/chip | MFU | "
-        "eval acc | config |",
-        "|---|---|---|---|---|---|---|",
+        "eval acc | time-to-97% | config |",
+        "|---|---|---|---|---|---|---|---|",
     ]
     for name, stats in models.items():
         if "error" in stats:
             lines.append(f"| {name} | — | ERROR: {stats['error']} | — | "
-                         f"— | — | — |")
+                         f"— | — | — | — |")
             continue
         if name == "builder_10m_streaming":
+            gb = stats.get("gb", {})
             lines.append(
                 f"| {name} (host data plane) | cpu "
                 f"| {stats.get('train_rows_per_sec', '—')} rows/s | — | "
                 f"— | LR {stats.get('lr', {}).get('accuracy')} / GB "
-                f"{stats.get('gb', {}).get('accuracy')} "
+                f"{gb.get('accuracy')} | — "
                 f"| rows={stats.get('rows')}, peak_rss_mb="
-                f"{stats.get('peak_rss_mb')} |")
+                f"{stats.get('peak_rss_mb')}, gb_full_data="
+                f"{not gb.get('trainedOnSample', False)} |")
             continue
         if name == "csv_ingest":
             lines.append(
                 f"| {name} (host data plane) | cpu "
                 f"| {stats.get('rows_per_sec', '—')} rows/s | — | — | — "
-                f"| rows={stats.get('rows')}, native_core="
+                f"| — | rows={stats.get('rows')}, native_core="
                 f"{stats.get('native_core')} |")
             continue
         cfg = configs.get(name, {})
         cfg_s = ", ".join(f"{k}={v}" for k, v in sorted(cfg.items()))
         mfu = stats.get("mfu")
+        tta = stats.get("time_to_97pct_train_acc_s")
         lines.append(
             f"| {name} | {stats.get('platform', '?')} "
             f"| {stats.get('samples_per_sec_per_chip', '—')} "
             f"| {stats.get('tflops_per_sec_per_chip', '—')} "
             f"| {f'{mfu:.1%}' if mfu is not None else '—'} "
-            f"| {stats.get('eval_accuracy', '—')} | {cfg_s} |")
+            f"| {stats.get('eval_accuracy', '—')} "
+            f"| {f'{tta}s' if tta is not None else '—'} | {cfg_s} |")
     proxy = report["extra"]["reference_proxy_torch_cpu_samples_per_sec"]
     if proxy:
         lines += ["",

@@ -261,6 +261,8 @@ class SequentialModule(nn.Module):
                 from learningorchestra_tpu.models.resnet import ResNet50
                 x = ResNet50(num_classes=cfg.get("classes", 1000),
                              include_top=cfg.get("include_top", True),
+                             stage_sizes=tuple(cfg.get("stages")
+                                               or (3, 4, 6, 3)),
                              name=name)(x, train=train)
             else:
                 raise ValueError(f"unknown layer kind: {kind!r}")

@@ -23,11 +23,17 @@ from learningorchestra_tpu.models.neural import NeuralModel
 def ResNet50(include_top: bool = True, weights: Optional[str] = None,
              classes: int = 1000,
              input_shape: Optional[Sequence[int]] = None,
+             stage_sizes: Optional[Sequence[int]] = None,
              **_: Any) -> NeuralModel:
-    model = NeuralModel(
-        [{"kind": "resnet50", "classes": int(classes),
-          "include_top": bool(include_top)}],
-        name="resnet50")
+    """``stage_sizes`` (default (3, 4, 6, 3)) is an extension over
+    keras: shrunken variants (e.g. ``[1, 1, 1, 1]``) keep the exact
+    bottleneck architecture at a fraction of the compile/param cost —
+    used by fast tests and small-input transfer runs."""
+    cfg = {"kind": "resnet50", "classes": int(classes),
+           "include_top": bool(include_top)}
+    if stage_sizes is not None:
+        cfg["stages"] = [int(s) for s in stage_sizes]
+    model = NeuralModel([cfg], name="resnet50")
     if input_shape:
         model.input_shape = list(input_shape)
     if weights == "imagenet":

@@ -79,6 +79,12 @@ class HistGB:
 
     # ------------------------------------------------------------------
     def fit_binned(self, codes: np.ndarray, y: np.ndarray) -> "HistGB":
+        if self._model is not None and self._lib is not None:
+            # refit: release the previous native model's tree arrays
+            self._lib.lo_hgb_free.argtypes = [ctypes.c_void_p]
+            self._lib.lo_hgb_free(ctypes.c_void_p(self._model))
+            self._model = None
+        self._py = None
         codes = np.ascontiguousarray(codes, np.uint8)
         self.classes_, y_idx = np.unique(y, return_inverse=True)
         y_idx = np.ascontiguousarray(y_idx, np.int32)

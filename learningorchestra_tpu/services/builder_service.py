@@ -273,13 +273,20 @@ class BuilderService:
         feats = [c for c in (feat_cols or fields)
                  if c not in ("_id", label_col)]
         # classes must be known before the first partial_fit: one cheap
-        # label-column-only pass
-        classes: set = set()
-        for batch in cat.iter_batches(train_name, columns=[label_col],
-                                      batch_size=batch_size):
-            classes.update(
-                np.unique(batch.column(0).to_numpy(zero_copy_only=False)))
-        classes_arr = np.array(sorted(classes))
+        # label-column-only pass — skipped when no requested family is
+        # incremental (GB derives classes on its own full-data pass)
+        needs_classes = any(
+            _make_streaming_classifier(c)[1] for c in outputs
+            if c != "GB")
+        classes_arr = np.empty((0,))
+        if needs_classes:
+            classes: set = set()
+            for batch in cat.iter_batches(train_name,
+                                          columns=[label_col],
+                                          batch_size=batch_size):
+                classes.update(np.unique(
+                    batch.column(0).to_numpy(zero_copy_only=False)))
+            classes_arr = np.array(sorted(classes))
 
         with ThreadPoolExecutor(max_workers=len(outputs)) as pool:
             futures = {

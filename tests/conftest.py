@@ -22,6 +22,17 @@ import jax
 
 jax.config.update("jax_platforms", "cpu")
 
+# the suite is jit-compile-bound on the single-core CPU backend:
+# persist compiled executables across runs (keyed by HLO hash — safe
+# under code changes) so the per-commit `pytest -q` discipline costs
+# compile time once, not every run. LO_TEST_COMPILE_CACHE=0 disables.
+if os.environ.get("LO_TEST_COMPILE_CACHE", "1") != "0":
+    _cache = os.path.join(os.path.expanduser("~"), ".cache",
+                          "learningorchestra_tpu", "jax_test_cache")
+    os.makedirs(_cache, exist_ok=True)
+    jax.config.update("jax_compilation_cache_dir", _cache)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+
 import pytest
 
 

@@ -161,7 +161,7 @@ def test_hgb_python_fallback_matches_native_shape(monkeypatch):
     assert acc > 0.9, acc
 
 
-def test_hgb_multiclass_native(tmp_config):
+def test_hgb_multiclass_native():
     from learningorchestra_tpu.native import hgb
 
     rng = np.random.default_rng(1)
@@ -171,6 +171,9 @@ def test_hgb_multiclass_native(tmp_config):
     edges = hgb.quantile_edges(x)
     codes = hgb.bin_codes(x, edges)
     clf = hgb.HistGB(n_iter=25, max_depth=5).fit_binned(codes, y)
+    # the point is the C++ path — a silent numpy fallback would let a
+    # native multiclass regression pass unnoticed
+    assert clf._model is not None, "native lo_hgb_train not used"
     assert list(clf.classes_) == [0, 1, 2]
     acc = (clf.predict_binned(codes) == y).mean()
     assert acc > 0.9, acc

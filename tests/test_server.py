@@ -350,17 +350,21 @@ def test_tune_grid_search_pipeline(server):
     assert meta["finished"]
 
 
-def test_resnet50_transfer_tune_pipeline(server, tmp_path):
+def _resnet_transfer_tune(server, tmp_path, stage_sizes):
     """BASELINE config 5 end-to-end: a pretrained ResNet-50 (weights
     loaded from a real npz export, not silent random init) created by
     module path through /model, then a learning-rate sweep through
-    /tune — the reference's transfer-learn + GridSearchCV flow."""
+    /tune — the reference's transfer-learn + GridSearchCV flow.
+    ``stage_sizes`` shrinks the bottleneck stages for the fast run
+    (same architecture family, ~10x cheaper compile on the CPU test
+    backend)."""
     import os
 
     from learningorchestra_tpu.models.tf_compat.keras import applications
 
     # "pretrained" artifact: an exported ResNet-50 weight file
-    pre = applications.ResNet50(classes=3, input_shape=(32, 32, 3))
+    pre = applications.ResNet50(classes=3, input_shape=(32, 32, 3),
+                                stage_sizes=stage_sizes)
     pre._build_params(np.zeros((1, 32, 32, 3), np.float32))
     weights_path = os.path.join(tmp_path, "resnet50_pretrained.npz")
     pre.save_weights(weights_path)
@@ -381,7 +385,9 @@ def test_resnet50_transfer_tune_pipeline(server, tmp_path):
         "modulePath": "tensorflow.keras.applications",
         "class": "ResNet50",
         "classParameters": {"classes": 3, "weights": weights_path,
-                            "input_shape": [32, 32, 3]}})
+                            "input_shape": [32, 32, 3],
+                            **({"stage_sizes": stage_sizes}
+                               if stage_sizes else {})}})
     assert st == 201, body
     _poll_finished(server, f"{API}/model/tensorflow/rn_model", timeout=300)
 
@@ -406,6 +412,18 @@ def test_resnet50_transfer_tune_pipeline(server, tmp_path):
     sweep = server.api.ctx.artifacts.load("rn_tune", "tune/tensorflow")
     assert sweep.best_params_ is not None
     assert len(sweep.cv_results_["params"]) == 2
+
+
+def test_resnet_transfer_tune_pipeline_fast(server, tmp_path):
+    """Shrunken-stages variant ([1, 1, 1, 1] bottlenecks) — the whole
+    REST transfer+tune flow at a fraction of the compile cost."""
+    _resnet_transfer_tune(server, tmp_path, [1, 1, 1, 1])
+
+
+@pytest.mark.slow
+def test_resnet50_transfer_tune_pipeline(server, tmp_path):
+    """Full-size ResNet-50 (stages 3/4/6/3) — run with ``-m slow``."""
+    _resnet_transfer_tune(server, tmp_path, None)
 
 
 def test_generate_through_predict_verb(server):

@@ -276,12 +276,12 @@ def test_fused_head_matches_full_logits_loss_and_grads(tmp_path):
     from learningorchestra_tpu.models import transformer as T
 
     _mesh_config(tmp_path, "dp=2")
-    mod_full = T.TransformerLM(vocab_size=97, d_model=32, n_layers=2,
-                               n_heads=4, fused_head_chunk=0)
-    mod_fused = T.TransformerLM(vocab_size=97, d_model=32, n_layers=2,
-                                n_heads=4, fused_head_chunk=7)
-    toks = (np.arange(6 * 17).reshape(6, 17) % 96 + 1).astype(np.int32)
-    toks[2, 9:] = 0  # padding must stay masked in both paths
+    mod_full = T.TransformerLM(vocab_size=61, d_model=16, n_layers=1,
+                               n_heads=2, fused_head_chunk=0)
+    mod_fused = T.TransformerLM(vocab_size=61, d_model=16, n_layers=1,
+                                n_heads=2, fused_head_chunk=7)
+    toks = (np.arange(4 * 13).reshape(4, 13) % 60 + 1).astype(np.int32)
+    toks[2, 7:] = 0  # padding must stay masked in both paths
     params = mod_full.init(jax.random.PRNGKey(0),
                            jnp.asarray(toks[:1]), train=False)["params"]
     loss_fn = T.next_token_loss(0.01, head_chunk=7)

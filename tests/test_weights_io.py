@@ -61,7 +61,10 @@ def test_resnet50_pretrained_transfer_roundtrip(tmp_path):
     silent random init."""
     from learningorchestra_tpu.models.tf_compat.keras import applications
 
-    src = applications.ResNet50(classes=7, input_shape=(32, 32, 3))
+    # shrunken stages: same architecture family + load path, a
+    # fraction of the compile cost on the CPU test backend
+    src = applications.ResNet50(classes=7, input_shape=(32, 32, 3),
+                                stage_sizes=[1, 1, 1, 1])
     x = np.random.default_rng(1).normal(
         size=(2, 32, 32, 3)).astype(np.float32)
     src._build_params(x)
@@ -71,7 +74,8 @@ def test_resnet50_pretrained_transfer_roundtrip(tmp_path):
     src.save_weights(path)
 
     dst = applications.ResNet50(classes=7, weights=path,
-                                input_shape=(32, 32, 3))
+                                input_shape=(32, 32, 3),
+                                stage_sizes=[1, 1, 1, 1])
     p_src = src.predict(x, batch_size=2)
     p_dst = dst.predict(x, batch_size=2)
     np.testing.assert_allclose(p_dst, p_src, atol=1e-5)
