@@ -311,6 +311,10 @@ class TransformerLM(nn.Module):
     dropout: float = 0.0
     mesh: Any = None
     fused_head_chunk: int = 0
+    # per-layer rematerialization under training: "none" saves all
+    # activations, "dots" saves matmul outputs only (the standard TPU
+    # memory/FLOPs trade), "full" recomputes everything in backward
+    remat: str = "none"
 
     @nn.compact
     def __call__(self, tokens, train: bool = False, decode_pos=None,
@@ -328,13 +332,33 @@ class TransformerLM(nn.Module):
                 mesh_lib.SP if self.attention in ("ring", "ulysses")
                 else None,
                 None)
+        block_cls = _Block
+        if self.remat != "none" and train and decode_pos is None:
+            policies = {
+                "dots": jax.checkpoint_policies
+                .dots_with_no_batch_dims_saveable,
+                "full": jax.checkpoint_policies.nothing_saveable,
+            }
+            if self.remat not in policies:
+                raise ValueError(
+                    f"unknown remat policy {self.remat!r} "
+                    f"(none|dots|full)")
+            # args: (self, x, train, decode_pos, cache_len) — the
+            # non-array flags are static
+            # prevent_cse=True: outside nn.scan, XLA's CSE can undo
+            # the recomputation and keep activations live (the flax
+            # docs' reason it defaults True under jit)
+            block_cls = nn.remat(_Block, policy=policies[self.remat],
+                                 prevent_cse=True,
+                                 static_argnums=(2, 3, 4))
         aux_total = jnp.zeros((), jnp.float32)
         for i in range(self.n_layers):
-            x, aux = _Block(self.n_heads, head_dim, d_ff, self.attention,
-                            self.causal, self.n_experts, self.moe_k,
-                            self.dropout, self.mesh,
-                            name=f"layer_{i}")(
-                x, train, decode_pos=decode_pos, cache_len=cache_len)
+            x, aux = block_cls(self.n_heads, head_dim, d_ff,
+                               self.attention, self.causal,
+                               self.n_experts, self.moe_k,
+                               self.dropout, self.mesh,
+                               name=f"layer_{i}")(
+                x, train, decode_pos, cache_len)
             aux_total = aux_total + aux
         x = nn.RMSNorm(name="final_norm")(x)
         head = _LMHead(self.vocab_size, name="lm_head")
@@ -462,16 +486,20 @@ class LanguageModel:
 
     _CONFIG_KEYS = ("vocab_size", "d_model", "n_layers", "n_heads",
                     "d_ff", "max_len", "attention", "n_experts", "moe_k",
-                    "dropout", "aux_coef", "head_chunk")
+                    "dropout", "aux_coef", "head_chunk", "remat")
 
     def __init__(self, vocab_size: int, d_model: int = 256,
                  n_layers: int = 4, n_heads: int = 4, d_ff: int = 0,
                  max_len: int = 512, attention: str = "auto",
                  n_experts: int = 0, moe_k: int = 2, dropout: float = 0.0,
                  aux_coef: float = 0.01, head_chunk: Optional[int] = None,
+                 remat: Optional[str] = None,
                  name: str = "language_model"):
         self.name = name
         self.head_chunk = head_chunk
+        # LO_TLM_REMAT env overrides; default "none" (measure before
+        # paying recompute FLOPs — see BENCHMARKS.md queued table)
+        self.remat = remat
         self.vocab_size = int(vocab_size)
         self.d_model = int(d_model)
         self.n_layers = int(n_layers)
@@ -537,6 +565,16 @@ class LanguageModel:
             return 0
         return 1024 if self.vocab_size >= 8192 else 0
 
+    def _resolved_remat(self) -> str:
+        value = os.environ.get("LO_TLM_REMAT") or self.remat or "none"
+        if value not in ("none", "dots", "full"):
+            # fail at construction/resolution, not deep inside the
+            # first training trace — eval paths never hit the module's
+            # own check
+            raise ValueError(
+                f"unknown remat policy {value!r} (none|dots|full)")
+        return value
+
     def _module_for(self, seq_len: Optional[int] = None) -> TransformerLM:
         return TransformerLM(
             vocab_size=self.vocab_size, d_model=self.d_model,
@@ -544,7 +582,8 @@ class LanguageModel:
             attention=self._resolved_attention(seq_len), causal=True,
             n_experts=self.n_experts, moe_k=self.moe_k,
             dropout=self.dropout, mesh=self._mesh_override,
-            fused_head_chunk=self._head_chunk(seq_len))
+            fused_head_chunk=self._head_chunk(seq_len),
+            remat=self._resolved_remat())
 
     @property
     def module(self) -> TransformerLM:

@@ -1,0 +1,71 @@
+#!/usr/bin/env bash
+# Watch for the TPU to come back, then land every queued measurement
+# from BENCHMARKS.md "Queued measurements" in one pass. Safe to leave
+# running: it only probes (bounded) until the chip answers, runs each
+# experiment with its own wall-clock bound, and writes results under
+# $OUT (default ./queued_results) — one JSON file per experiment.
+#
+#   bash scripts/run_queued_measurements.sh [OUT_DIR]
+#
+# The probe is a subprocess with a hard timeout because a wedged chip
+# hangs backend init forever (the round-2/3/4 failure mode).
+set -u
+cd "$(dirname "$0")/.."
+OUT="${1:-queued_results}"
+mkdir -p "$OUT"
+PROBE_INTERVAL="${LO_PROBE_INTERVAL:-180}"
+PHASE_TIMEOUT="${LO_PHASE_TIMEOUT:-1800}"
+
+probe() {
+  timeout 90 python - <<'EOF' >/dev/null 2>&1
+import faulthandler
+faulthandler.dump_traceback_later(80, exit=True)
+import jax
+assert any(d.platform != "cpu" for d in jax.devices())
+import jax.numpy as jnp
+assert float(jnp.ones((8, 8)).sum()) == 64.0
+EOF
+}
+
+echo "$(date -u +%FT%TZ) waiting for the TPU to answer (probe every ${PROBE_INTERVAL}s)"
+until probe; do
+  sleep "$PROBE_INTERVAL"
+done
+echo "$(date -u +%FT%TZ) TPU is up — running queued measurements"
+
+run() {  # run NAME ENV... -- ARGS...
+  local name="$1"; shift
+  local envs=()
+  while [ "$1" != "--" ]; do envs+=("$1"); shift; done
+  shift
+  echo "$(date -u +%FT%TZ) [$name] env ${envs[*]-} bench $*"
+  env "${envs[@]}" timeout "$PHASE_TIMEOUT" \
+      python bench.py "$@" > "$OUT/$name.out" 2> "$OUT/$name.err"
+  echo "exit=$? $(tail -c 600 "$OUT/$name.out")"
+}
+
+# 1. flash table at the committed 512^2 auto default (all seqs)
+run flash_auto LO_NOOP=1 -- --phase flash
+# 2. LSTM scan-unroll + hoist decisions (vs the committed defaults)
+run lstm_default LO_NOOP=1 -- --phase lstm
+run lstm_unroll8 LO_RNN_UNROLL=8 -- --phase lstm
+run lstm_hoist LO_LSTM_HOIST=1 -- --phase lstm
+# 3. flagship d=512: fused lm_head (auto default) vs disabled
+run tlm_fused LO_NOOP=1 -- --phase tlm
+run tlm_unfused LO_LM_HEAD_CHUNK=0 -- --phase tlm
+# 4. long-context MFU on the flash path (seq 2048, d 1024)
+run tlm_longctx LO_BENCH_TLM_SEQ=2048 LO_BENCH_TLM_D=1024 \
+    LO_BENCH_TLM_LAYERS=12 LO_BENCH_TLM_HEADS=16 LO_BENCH_TLM_FF=4096 \
+    LO_BENCH_TLM_BATCH=8 LO_BENCH_TLM_N=1024 -- --phase tlm
+# 5. per-layer remat: can recompute-for-memory afford a bigger batch
+#    at the flagship d=512 shape?
+run tlm_remat_dots_b32 LO_TLM_REMAT=dots LO_BENCH_TLM_BATCH=32 \
+    -- --phase tlm
+run tlm_remat_full_b64 LO_TLM_REMAT=full LO_BENCH_TLM_BATCH=64 \
+    -- --phase tlm
+# 6. full run + regenerated table (only rewrites BENCHMARKS.md when
+#    the chip answered, by bench.py's own guard)
+echo "$(date -u +%FT%TZ) full bench + BENCHMARKS.md regeneration"
+timeout 5400 python bench.py --write-md BENCHMARKS.md \
+    > "$OUT/full_bench.out" 2> "$OUT/full_bench.err"
+echo "$(date -u +%FT%TZ) done (exit=$?) — results in $OUT/"

@@ -339,3 +339,27 @@ def test_fused_head_auto_rule_and_training(tmp_path):
     hist = big.fit(toks, batch_size=4, epochs=1)
     assert np.isfinite(hist.history["loss"][0])
     assert "accuracy" in hist.history
+
+
+def test_remat_policies_match_no_remat(tmp_path):
+    """Per-layer rematerialization (dots / full policies) changes
+    memory, never math: identical seeds give identical training
+    losses across all three settings."""
+    losses = {}
+    for remat in ("none", "dots", "full"):
+        _mesh_config(tmp_path, "dp=2")
+        from learningorchestra_tpu.models.transformer import (
+            LanguageModel)
+
+        lm = LanguageModel(vocab_size=64, d_model=32, n_layers=2,
+                           n_heads=4, max_len=16, attention="dot",
+                           remat=remat)
+        toks = (np.arange(8 * 12).reshape(8, 12) % 63 + 1
+                ).astype(np.int32)
+        hist = lm.fit(toks, batch_size=4, epochs=1, shuffle=False)
+        losses[remat] = hist.history["loss"][0]
+    assert np.isfinite(losses["none"])
+    np.testing.assert_allclose(losses["dots"], losses["none"],
+                               rtol=1e-5)
+    np.testing.assert_allclose(losses["full"], losses["none"],
+                               rtol=1e-5)
