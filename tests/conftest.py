@@ -32,6 +32,16 @@ if os.environ.get("LO_TEST_COMPILE_CACHE", "1") != "0":
     os.makedirs(_cache, exist_ok=True)
     jax.config.update("jax_compilation_cache_dir", _cache)
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+    # subprocess-spawning tests (durability/distributed/cluster server
+    # boots) inherit the cache through the env var jax reads natively
+    os.environ["JAX_COMPILATION_CACHE_DIR"] = _cache
+    os.environ["JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS"] = "0.5"
+
+# the exact cache vars, for tests that spawn children with a MINIMAL
+# env (everything else inherits os.environ and needs nothing)
+JAX_CACHE_ENV = {k: v for k, v in os.environ.items()
+                 if k.startswith(("JAX_COMPILATION",
+                                  "JAX_PERSISTENT"))}
 
 import pytest
 

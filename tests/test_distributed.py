@@ -2,6 +2,7 @@
 CPU backend — global device view, a cross-host collective, and a
 HostBridge publish/follow round-trip."""
 
+import os
 import socket
 import subprocess
 import sys
@@ -9,6 +10,7 @@ import textwrap
 
 import pytest
 
+from conftest import JAX_CACHE_ENV
 from learningorchestra_tpu.runtime import distributed as dist
 
 
@@ -71,7 +73,7 @@ def test_two_process_formation_and_bridge(tmp_path):
         procs.append(subprocess.Popen(
             [sys.executable, "-c", script],
             stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
-            env={"PATH": "/usr/bin:/bin"}))
+            env={"PATH": "/usr/bin:/bin", **JAX_CACHE_ENV}))
     outs = []
     for p in procs:
         try:
@@ -172,7 +174,8 @@ def test_two_process_entry_point_serves_rest(tmp_path):
         s.bind(("127.0.0.1", 0))
         rest_port = s.getsockname()[1]
     home = str(tmp_path / "shared_home")
-    env = {"PATH": "/usr/bin:/bin:/opt/venv/bin",
+    env = {**JAX_CACHE_ENV,
+           "PATH": "/usr/bin:/bin:/opt/venv/bin",
            "JAX_PLATFORMS": "cpu",
            "XLA_FLAGS": "--xla_force_host_platform_device_count=2",
            "PYTHONPATH": "/root/repo",
@@ -280,7 +283,7 @@ def test_two_process_rest_train_replay(tmp_path):
         procs.append(subprocess.Popen(
             [sys.executable, "-c", script],
             stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
-            env={"PATH": "/usr/bin:/bin"}))
+            env={"PATH": "/usr/bin:/bin", **JAX_CACHE_ENV}))
     outs = []
     for p in procs:
         try:
@@ -426,7 +429,7 @@ def test_worker_sigkill_reports_failure(tmp_path):
         procs.append(subprocess.Popen(
             [sys.executable, "-c", script],
             stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
-            env={"PATH": "/usr/bin:/bin"}))
+            env={"PATH": "/usr/bin:/bin", **JAX_CACHE_ENV}))
 
     started = os.path.join(home, "train_started")
     deadline = time.time() + 240
