@@ -111,6 +111,26 @@ class ArtifactStore:
             json.dump(meta, f)
         return d
 
+    def stored_class(self, name: str, type_string: str):
+        """The CLASS of a stored native artifact, resolved from
+        meta.json without deserializing the object (validation wants
+        the callable surface, not multi-GB weights on the request
+        thread). Returns None for dill/bytes artifacts — callers fall
+        back to a full load."""
+        d = self._dir(name, type_string)
+        meta_path = os.path.join(d, "meta.json")
+        if not os.path.exists(meta_path):
+            raise ArtifactNotFound(f"{type_string}/{name}")
+        with open(meta_path) as f:
+            meta = json.load(f)
+        if meta.get("kind") != "native":
+            return None
+        module = importlib.import_module(meta["module"])
+        cls = module
+        for part in meta["class"].split("."):
+            cls = getattr(cls, part)
+        return cls
+
     def load(self, name: str, type_string: Optional[str] = None) -> Any:
         if type_string is None:
             type_string = self.find(name)

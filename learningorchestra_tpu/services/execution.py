@@ -69,6 +69,28 @@ class ExecutionService:
                          method_parameters: Dict[str, Any]) -> None:
         cls = self._validator.valid_class(
             root_meta[D.MODULE_PATH_FIELD], root_meta[D.CLASS_FIELD])
+        if not isinstance(cls, type):
+            # the root was created by a FACTORY (e.g.
+            # tensorflow.keras.models.load_model on a SavedModel dir):
+            # methods live on the returned instance's class, not the
+            # factory — resolve it from the artifact's meta.json
+            # (never deserializing weights on the request thread;
+            # dill-stored foreign objects fall back to a full load)
+            try:
+                cls = self._ctx.artifacts.stored_class(
+                    root_meta[D.NAME_FIELD], root_meta[D.TYPE_FIELD])
+                if cls is None:
+                    cls = self._ctx.artifacts.load(
+                        root_meta[D.NAME_FIELD],
+                        root_meta[D.TYPE_FIELD])
+            except V.HttpError:
+                raise
+            except Exception as exc:  # noqa: BLE001 — a validation
+                # failure must be a 406, not a request-thread 500
+                raise V.HttpError(
+                    V.HTTP_NOT_ACCEPTABLE,
+                    f"cannot resolve stored model "
+                    f"{root_meta[D.NAME_FIELD]!r}: {exc!r}") from exc
         self._validator.valid_method(cls, method)
         self._validator.valid_method_parameters(
             cls, method, method_parameters)

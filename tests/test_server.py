@@ -568,3 +568,38 @@ def test_metrics_prometheus_exposition(server):
         if line.startswith("#"):
             continue
         assert len(line.rsplit(" ", 1)) == 2, line
+
+
+def test_savedmodel_import_through_model_service(server, tmp_path):
+    """The reference's primary artifact flow over REST: a stock
+    tf.keras SavedModel DIRECTORY imported by module path through
+    POST /model (``tensorflow.keras.models.load_model`` resolves to
+    the tf_compat shim, which reads the bundle with zero tensorflow
+    imports), then served for prediction."""
+    tfk = pytest.importorskip("tf_keras")
+    kl = tfk.layers
+
+    km = tfk.Sequential([
+        kl.Dense(6, activation="relu", input_shape=(4,)),
+        kl.Dense(2, activation="softmax")])
+    x = np.random.default_rng(9).normal(size=(5, 4)).astype(np.float32)
+    want = np.asarray(km(x))
+    sm_dir = str(tmp_path / "sm_dir")
+    km.save(sm_dir, save_format="tf")
+
+    st, body = _call(server, "POST", f"{API}/model/tensorflow", body={
+        "modelName": "smi",
+        "modulePath": "tensorflow.keras.models",
+        "class": "load_model",
+        "classParameters": {"path": sm_dir}})
+    assert st == 201, body
+    _poll_finished(server, f"{API}/model/tensorflow/smi")
+
+    st, body = _call(server, "POST", f"{API}/predict/tensorflow", body={
+        "name": "smi_pred", "modelName": "smi", "method": "predict",
+        "methodParameters": {"x": x.tolist(), "batch_size": 5}})
+    assert st == 201, body
+    _poll_finished(server, f"{API}/predict/tensorflow/smi_pred")
+    got = np.asarray(server.api.ctx.artifacts.load(
+        "smi_pred", "predict/tensorflow"))
+    np.testing.assert_allclose(got, want, atol=2e-2)  # bf16 default
