@@ -432,17 +432,148 @@ def _fused_head_loss(out: FusedHeadOut, batch, weights, chunk: int,
     return loss, {"accuracy": (ok_sum, total)}
 
 
-def next_token_loss(aux_coef: float = 0.01, head_chunk: int = 1024):
+def _fused_head_loss_sharded(out: FusedHeadOut, batch, weights,
+                             chunk: int, aux_coef: float, mesh):
+    """Sequence-parallel twin of :func:`_fused_head_loss`: under
+    ring/Ulysses the hidden states are sharded over ``sp`` (and batch
+    over dp/fsdp), which is exactly where the (tokens, vocab) logits
+    hurt most — a 32k-token, 32k-vocab step would materialize 4 GB of
+    f32 logits per batch row. The projection + CE runs INSIDE
+    ``shard_map``: each shard scans its local token chunks; with
+    tensor parallelism the lm_head columns stay sharded and the
+    softmax reduces over ``tp`` (Megatron-style parallel CE: pmax of
+    the local maxima, psum of the local exp-sums, psum of the local
+    one-hot correct logit). Loss/accuracy sums then psum over the
+    row-sharding axes, so the result is replicated and exact."""
+    tokens = batch["x"].astype(jnp.int32)
+    b, s = tokens.shape
+    # global shift OUTSIDE shard_map (a one-position halo the compiler
+    # handles); the appended 0 column self-masks via tgt != 0
+    tgt = jnp.concatenate(
+        [tokens[:, 1:], jnp.zeros((b, 1), jnp.int32)], axis=1)
+    tok_mask = (tgt != 0).astype(jnp.float32)
+    if weights is not None:
+        tok_mask = tok_mask * weights.astype(jnp.float32)[:, None]
+
+    data = mesh_lib.data_axes(mesh)
+    tp = mesh.shape.get(mesh_lib.TP, 1)
+    row_axes = tuple(a for a in (*data, mesh_lib.SP)
+                     if mesh.shape.get(a, 1) > 1)
+    h_spec = P(data if data else None, mesh_lib.SP, None)
+    t_spec = P(data if data else None, mesh_lib.SP)
+    k_spec = P(None, mesh_lib.TP if tp > 1 else None)
+    kernel = out.kernel.astype(out.hidden.dtype)
+
+    def local_loss(h, tg, mk, W):
+        d = h.shape[-1]
+        v_loc = W.shape[-1]
+        t_total = h.shape[0] * h.shape[1]
+        c = max(1, min(chunk, t_total))
+        n_chunks = -(-t_total // c)
+        pad = n_chunks * c - t_total
+        hs = h.reshape(t_total, d)
+        tgl = tg.reshape(t_total)
+        mkl = mk.reshape(t_total)
+        if pad:
+            hs = jnp.pad(hs, ((0, pad), (0, 0)))
+            tgl = jnp.pad(tgl, (0, pad))
+            mkl = jnp.pad(mkl, (0, pad))
+        if tp > 1:
+            v_off = jax.lax.axis_index(mesh_lib.TP) * v_loc
+        else:
+            v_off = 0
+
+        def body(carry, xs):
+            h_c, t_c, m_c = xs
+            lg = jnp.einsum("cd,dv->cv", h_c, W,
+                            preferred_element_type=jnp.float32)
+            lmax = jnp.max(lg, axis=-1)
+            # the max subtraction is a stability constant — keep it
+            # out of the grad graph; cross-tp reduction goes through
+            # all_gather (pmax has no differentiation rule, which the
+            # checkpointed scan's linearization requires even for
+            # zero-tangent values)
+            if tp > 1:
+                gmax = jnp.max(jax.lax.all_gather(
+                    lmax, mesh_lib.TP), axis=0)
+            else:
+                gmax = lmax
+            gmax = jax.lax.stop_gradient(gmax)
+            se = jnp.sum(jnp.exp(lg - gmax[:, None]), axis=-1)
+            if tp > 1:
+                se = jax.lax.psum(se, mesh_lib.TP)
+            lse = gmax + jnp.log(se)
+            loc = t_c - v_off
+            in_range = (loc >= 0) & (loc < v_loc)
+            corr = jnp.take_along_axis(
+                lg, jnp.clip(loc, 0, v_loc - 1)[:, None], axis=1)[:, 0]
+            corr = jnp.where(in_range, corr, 0.0)
+            if tp > 1:
+                corr = jax.lax.psum(corr, mesh_lib.TP)
+            lg_sg = jax.lax.stop_gradient(lg)  # accuracy carries no grad
+            amax_v = jnp.max(lg_sg, axis=-1)
+            amax_i = jnp.argmax(lg_sg, axis=-1) + v_off
+            if tp > 1:
+                vs = jax.lax.all_gather(amax_v, mesh_lib.TP)  # (tp, c)
+                is_ = jax.lax.all_gather(amax_i, mesh_lib.TP)
+                win = jnp.argmax(vs, axis=0)
+                amax_i = jnp.take_along_axis(
+                    is_, win[None, :], axis=0)[0]
+            ok = (amax_i == t_c).astype(jnp.float32)
+            loss_sum, ok_sum, n_sum = carry
+            return (loss_sum + jnp.sum((lse - corr) * m_c),
+                    ok_sum + jnp.sum(ok * m_c),
+                    n_sum + jnp.sum(m_c)), None
+
+        zeros = (jnp.zeros((), jnp.float32),) * 3
+        (loss_sum, ok_sum, n_sum), _ = jax.lax.scan(
+            jax.checkpoint(body), zeros,
+            (hs.reshape(n_chunks, c, d), tgl.reshape(n_chunks, c),
+             mkl.reshape(n_chunks, c)))
+        if row_axes:
+            loss_sum = jax.lax.psum(loss_sum, row_axes)
+            ok_sum = jax.lax.psum(ok_sum, row_axes)
+            n_sum = jax.lax.psum(n_sum, row_axes)
+        return loss_sum, ok_sum, n_sum
+
+    loss_sum, ok_sum, n_sum = jax.shard_map(
+        local_loss, mesh=mesh,
+        in_specs=(h_spec, t_spec, t_spec, k_spec),
+        out_specs=(P(), P(), P()), check_vma=False)(
+        out.hidden, tgt, tok_mask, kernel)
+    total = jnp.maximum(n_sum, 1e-9)
+    loss = loss_sum / total + aux_coef * out.aux.astype(jnp.float32)
+    return loss, {"accuracy": (ok_sum, total)}
+
+
+def next_token_loss(aux_coef: float = 0.01, head_chunk: int = 1024,
+                    mesh=None):
     """Causal LM loss: predict token t+1 from prefix <= t; padding
     tokens (id 0) and padded tail samples are masked out. On
     :class:`FusedHeadOut` training outputs the projection + CE runs
     chunked (``head_chunk`` tokens at a time) and the return value is
     ``(loss, {"accuracy": (sum, count)})`` — the engine merges
-    loss-emitted metrics."""
+    loss-emitted metrics. With a sequence-parallel mesh the chunked
+    scan runs inside ``shard_map`` (see
+    :func:`_fused_head_loss_sharded`)."""
     import optax
 
     def loss_fn(outputs, batch, weights):
         if isinstance(outputs, FusedHeadOut):
+            m = mesh or mesh_lib.get_default_mesh()
+            b, s = batch["x"].shape[:2]
+            sp = m.shape.get(mesh_lib.SP, 1)
+            tp = m.shape.get(mesh_lib.TP, 1)
+            vocab = outputs.kernel.shape[-1]
+            data_size = max(int(np.prod(
+                [m.shape[a] for a in mesh_lib.data_axes(m)] or [1])), 1)
+            # shard_map needs divisible mapped dims (incl. the vocab
+            # columns under tp); odd shapes fall back to the flat
+            # path (GSPMD gathers — correct, bigger)
+            if sp > 1 and b % data_size == 0 and s % sp == 0 \
+                    and vocab % tp == 0:
+                return _fused_head_loss_sharded(
+                    outputs, batch, weights, head_chunk, aux_coef, m)
             return _fused_head_loss(outputs, batch, weights,
                                     head_chunk, aux_coef)
         logits, aux = outputs
@@ -547,22 +678,20 @@ class LanguageModel:
             return "flash" if (seq_len or self.max_len) >= 2048 else "dot"
         return "dot"
 
-    def _head_chunk(self, seq_len: Optional[int] = None) -> int:
+    def _head_chunk(self) -> int:
         """Fused-head chunk size (0 = full logits). Auto rule: fuse
         when the vocab is large enough that the (tokens, vocab) f32
         logits tensor dominates the step's HBM traffic (the measured
-        d=512 roofline gap, BENCHMARKS.md), EXCEPT under
-        sequence-parallel attention — ring/Ulysses shard the sequence
-        dim, and the chunked scan's flatten would fight that layout.
-        ``LO_LM_HEAD_CHUNK`` overrides (0 disables, N sets tokens per
-        chunk)."""
+        d=512 roofline gap, BENCHMARKS.md). Under sequence-parallel
+        attention the loss runs its shard_map twin
+        (:func:`_fused_head_loss_sharded`), keeping the sequence dim
+        sharded. ``LO_LM_HEAD_CHUNK`` overrides (0 disables, N sets
+        tokens per chunk)."""
         env = os.environ.get("LO_LM_HEAD_CHUNK")
         if env is not None:
             return max(0, int(env))
         if self.head_chunk is not None:
             return max(0, int(self.head_chunk))
-        if self._resolved_attention(seq_len) in ("ring", "ulysses"):
-            return 0
         return 1024 if self.vocab_size >= 8192 else 0
 
     def _resolved_remat(self) -> str:
@@ -582,7 +711,7 @@ class LanguageModel:
             attention=self._resolved_attention(seq_len), causal=True,
             n_experts=self.n_experts, moe_k=self.moe_k,
             dropout=self.dropout, mesh=self._mesh_override,
-            fused_head_chunk=self._head_chunk(seq_len),
+            fused_head_chunk=self._head_chunk(),
             remat=self._resolved_remat())
 
     @property
@@ -645,7 +774,8 @@ class LanguageModel:
                 apply_fn=self._apply_fn,
                 loss_fn=next_token_loss(
                     self.aux_coef,
-                    head_chunk=self._head_chunk() or 1024),
+                    head_chunk=self._head_chunk() or 1024,
+                    mesh=mesh),
                 optimizer=build_optimizer(self.optimizer_spec),
                 mesh=mesh,
                 metrics={"accuracy": token_accuracy},

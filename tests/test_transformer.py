@@ -311,9 +311,10 @@ def test_fused_head_matches_full_logits_loss_and_grads(tmp_path):
 
 
 def test_fused_head_auto_rule_and_training(tmp_path):
-    """Auto rule: large vocab fuses, small vocab and seq-parallel
-    attention do not; LO_LM_HEAD_CHUNK=0 force-disables. A fused fit
-    still reports loss AND accuracy through the engine."""
+    """Auto rule: large vocab fuses (including under seq-parallel
+    attention — the shard_map loss twin), small vocab does not;
+    LO_LM_HEAD_CHUNK=0 force-disables. A fused fit still reports loss
+    AND accuracy through the engine."""
     import os as _os
 
     from learningorchestra_tpu.models.transformer import LanguageModel
@@ -327,7 +328,9 @@ def test_fused_head_auto_rule_and_training(tmp_path):
     assert small._head_chunk() == 0
     ring = LanguageModel(vocab_size=8192, d_model=32, n_layers=1,
                          n_heads=4, max_len=16, attention="ring")
-    assert ring._head_chunk() == 0
+    # SP meshes fuse too (the shard_map loss twin); auto rule is
+    # vocab-driven only
+    assert ring._head_chunk() == 1024
     _os.environ["LO_LM_HEAD_CHUNK"] = "0"
     try:
         assert big._head_chunk() == 0
@@ -363,3 +366,70 @@ def test_remat_policies_match_no_remat(tmp_path):
                                rtol=1e-5)
     np.testing.assert_allclose(losses["full"], losses["none"],
                                rtol=1e-5)
+
+
+@pytest.mark.parametrize("mesh_shape", ["dp=2,sp=4", "sp=2,tp=4"])
+def test_sharded_fused_head_matches_flat(tmp_path, mesh_shape):
+    """The shard_map fused loss (sequence-parallel + Megatron-style
+    tp vocab reduction) equals the flat chunked path: same loss, same
+    grads, same accuracy sums."""
+    from learningorchestra_tpu.models import transformer as T
+    from learningorchestra_tpu.runtime import mesh as mesh_lib
+
+    _mesh_config(tmp_path, mesh_shape)
+    mesh = mesh_lib.get_default_mesh()
+    mod = T.TransformerLM(vocab_size=64, d_model=16, n_layers=1,
+                          n_heads=2, fused_head_chunk=5,
+                          attention="dot")
+    toks = (np.arange(4 * 8).reshape(4, 8) % 63 + 1).astype(np.int32)
+    toks[1, 5:] = 0
+    params = mod.init(jax.random.PRNGKey(0), jnp.asarray(toks[:1]),
+                      train=False)["params"]
+    batch = {"x": jnp.asarray(toks)}
+    out = mod.apply({"params": params}, batch["x"], train=True)
+    assert isinstance(out, T.FusedHeadOut)
+
+    flat_loss, flat_extra = T._fused_head_loss(out, batch, None, 5,
+                                               0.01)
+    sh_loss, sh_extra = T._fused_head_loss_sharded(out, batch, None,
+                                                   5, 0.01, mesh)
+    np.testing.assert_allclose(float(sh_loss), float(flat_loss),
+                               rtol=1e-5)
+    np.testing.assert_allclose(float(sh_extra["accuracy"][0]),
+                               float(flat_extra["accuracy"][0]))
+    np.testing.assert_allclose(float(sh_extra["accuracy"][1]),
+                               float(flat_extra["accuracy"][1]),
+                               rtol=1e-6)
+
+    # grads agree through either loss
+    def loss_of(p, sharded):
+        o = mod.apply({"params": p}, batch["x"], train=True)
+        if sharded:
+            loss, _ = T._fused_head_loss_sharded(o, batch, None, 5,
+                                                 0.01, mesh)
+        else:
+            loss, _ = T._fused_head_loss(o, batch, None, 5, 0.01)
+        return loss
+
+    g_flat = jax.grad(lambda p: loss_of(p, False))(params)
+    g_sh = jax.grad(lambda p: loss_of(p, True))(params)
+    for a, b in zip(jax.tree_util.tree_leaves(g_flat),
+                    jax.tree_util.tree_leaves(g_sh)):
+        np.testing.assert_allclose(a, b, atol=1e-5)
+
+
+def test_ring_fit_uses_sharded_fused_head(tmp_path):
+    """End-to-end: a large-vocab ring-attention fit takes the fused
+    head (auto rule no longer excludes SP) and still reports loss +
+    accuracy through the engine."""
+    from learningorchestra_tpu.models.transformer import LanguageModel
+
+    _mesh_config(tmp_path, "dp=2,sp=4")
+    lm = LanguageModel(vocab_size=8192, d_model=32, n_layers=1,
+                       n_heads=4, max_len=16, attention="ring")
+    assert lm._head_chunk() == 1024
+    toks = (np.random.default_rng(0).integers(
+        1, 8192, size=(8, 16))).astype(np.int32)
+    hist = lm.fit(toks, batch_size=8, epochs=1)
+    assert np.isfinite(hist.history["loss"][0])
+    assert "accuracy" in hist.history
