@@ -451,3 +451,60 @@ def test_worker_sigkill_reports_failure(tmp_path):
     text = out.decode(errors="replace")
     assert procs[0].returncode == 0, f"coordinator failed:\n{text}"
     assert "GUARD_OK" in text, text
+
+
+def test_heartbeat_monitor_loss_and_resume():
+    """Unit-level liveness semantics: a silent worker is reported
+    lost, junk datagrams don't kill the monitor or poison state, and
+    resumed heartbeats CLEAR the loss (a transient pause must not
+    wedge a healthy pod)."""
+    import json as json_mod
+    import time
+
+    with socket.socket(socket.AF_INET, socket.SOCK_DGRAM) as probe:
+        probe.bind(("127.0.0.1", 0))
+        addr = probe.getsockname()
+    mon = dist.HeartbeatMonitor(addr, expected=[1, 2], timeout=0.6)
+    try:
+        sender = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+
+        def beat(host_id):
+            sender.sendto(json_mod.dumps(
+                {"hostId": host_id}).encode(), addr)
+
+        # both beating -> healthy
+        for _ in range(4):
+            beat(1)
+            beat(2)
+            # junk must be ignored, not fatal
+            sender.sendto(b"null", addr)
+            sender.sendto(b'{"hostId": "x"}', addr)
+            sender.sendto(b'{"hostId": 99}', addr)  # not in expected
+            time.sleep(0.1)
+        assert mon.lost_workers() == []
+
+        # worker 2 goes silent -> lost within the timeout bound
+        deadline = time.time() + 5
+        lost = []
+        while time.time() < deadline:
+            beat(1)
+            lost = mon.lost_workers()
+            if lost:
+                break
+            time.sleep(0.1)
+        # only assert membership: a scheduler stall on a loaded runner
+        # can transiently mark worker 1 too (it recovers below)
+        assert 2 in lost, lost
+
+        # worker 2 resumes -> loss clears
+        deadline = time.time() + 5
+        while time.time() < deadline:
+            beat(1)
+            beat(2)
+            if mon.lost_workers() == []:
+                break
+            time.sleep(0.1)
+        assert mon.lost_workers() == []
+        sender.close()
+    finally:
+        mon.close()
