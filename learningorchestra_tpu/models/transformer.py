@@ -95,14 +95,32 @@ class _Experts(nn.Module):
 
 
 class _Attention(nn.Module):
+    """Multi-head attention with optional grouped-query KV heads.
+
+    ``n_kv_heads < n_heads`` is GQA (``=1`` is MQA): K/V are projected
+    to fewer heads and each KV head serves a GROUP of query heads. On
+    TPU the win is HBM, not FLOPs — the KV cache (the whole memory
+    story of long-context decode) shrinks by ``n_heads/n_kv_heads``,
+    and the decode step reads proportionally less HBM per token. The
+    decode path computes grouped attention directly (no head repeat);
+    the train/prefill path repeats KV up to ``n_heads`` before
+    :func:`_dispatch_attention` so every impl (dot/flash/ring/ulysses)
+    sees uniform heads — XLA fuses the repeat into the consuming
+    matmul, so training costs the same as full-head attention."""
+
     n_heads: int
     head_dim: int
     impl: str
     causal: bool
     mesh: Any = None
+    n_kv_heads: int = 0      # 0 -> n_heads (standard MHA)
+
+    @property
+    def kv_heads(self) -> int:
+        return self.n_kv_heads or self.n_heads
 
     def _cache_vars(self, b: int, cache_len: int, dtype):
-        shape = (b, cache_len, self.n_heads, self.head_dim)
+        shape = (b, cache_len, self.kv_heads, self.head_dim)
         ck = self.variable("cache", "k", jnp.zeros, shape, dtype)
         cv = self.variable("cache", "v", jnp.zeros, shape, dtype)
         return ck, cv
@@ -110,14 +128,20 @@ class _Attention(nn.Module):
     @nn.compact
     def __call__(self, x, train: bool, decode_pos=None, cache_len: int = 0):
         d_model = x.shape[-1]
+        kv = self.kv_heads
+        if self.n_heads % kv:
+            raise ValueError(
+                f"n_kv_heads={kv} must divide n_heads={self.n_heads}")
+        group = self.n_heads // kv
         proj = self.n_heads * self.head_dim
         dense = lambda name, feats: nn.Dense(  # noqa: E731
             feats, use_bias=False, name=name)
         b, s, _ = x.shape
         shape4 = (b, s, self.n_heads, self.head_dim)
+        kv_shape4 = (b, s, kv, self.head_dim)
         q = dense("q_proj", proj)(x).reshape(shape4)
-        k = dense("k_proj", proj)(x).reshape(shape4)
-        v = dense("v_proj", proj)(x).reshape(shape4)
+        k = dense("k_proj", kv * self.head_dim)(x).reshape(kv_shape4)
+        v = dense("v_proj", kv * self.head_dim)(x).reshape(kv_shape4)
 
         if decode_pos is not None:
             # single-token step at absolute position decode_pos: rope
@@ -134,17 +158,22 @@ class _Attention(nn.Module):
                 ck.value, k.astype(x.dtype), (0, decode_pos, 0, 0))
             cv.value = jax.lax.dynamic_update_slice(
                 cv.value, v.astype(x.dtype), (0, decode_pos, 0, 0))
+            # grouped scores: each KV head serves its `group` query
+            # heads directly — the cache is never expanded to n_heads
+            qg = q.astype(jnp.float32).reshape(
+                b, s, kv, group, self.head_dim)
             scores = jnp.einsum(
-                "bqhd,bkhd->bqhk", q.astype(jnp.float32),
+                "bqhgd,bkhd->bqhgk", qg,
                 ck.value.astype(jnp.float32),
                 preferred_element_type=jnp.float32,
             ) / math.sqrt(self.head_dim)
             visible = jnp.arange(cache_len) <= decode_pos
-            scores = jnp.where(visible[None, None, None, :], scores,
+            scores = jnp.where(visible[None, None, None, None, :], scores,
                                ring_lib.NEG_INF)
             p = jax.nn.softmax(scores, axis=-1)
-            o = jnp.einsum("bqhk,bkhd->bqhd", p,
-                           cv.value.astype(jnp.float32)).astype(x.dtype)
+            o = jnp.einsum("bqhgk,bkhd->bqhgd", p,
+                           cv.value.astype(jnp.float32)
+                           ).reshape(shape4).astype(x.dtype)
         else:
             cos, sin = rope_tables(s, self.head_dim)
             q, k = apply_rope(q, cos, sin), apply_rope(k, cos, sin)
@@ -154,6 +183,9 @@ class _Attention(nn.Module):
                 ck, cv = self._cache_vars(b, cache_len, x.dtype)
                 ck.value = ck.value.at[:, :s].set(k.astype(x.dtype))
                 cv.value = cv.value.at[:, :s].set(v.astype(x.dtype))
+            if group > 1:
+                k = jnp.repeat(k, group, axis=2)
+                v = jnp.repeat(v, group, axis=2)
             o = _dispatch_attention(q, k, v, impl=self.impl,
                                     causal=self.causal, mesh=self.mesh)
         o = o.reshape(b, s, proj)
@@ -242,12 +274,14 @@ class _Block(nn.Module):
     moe_k: int
     dropout: float
     mesh: Any = None
+    n_kv_heads: int = 0
 
     @nn.compact
     def __call__(self, x, train: bool, decode_pos=None, cache_len: int = 0):
         h = nn.RMSNorm(name="attn_norm")(x)
         h = _Attention(self.n_heads, self.head_dim, self.attention,
-                       self.causal, self.mesh, name="attn")(
+                       self.causal, self.mesh,
+                       n_kv_heads=self.n_kv_heads, name="attn")(
             h, train, decode_pos=decode_pos, cache_len=cache_len)
         if self.dropout and train:
             h = nn.Dropout(self.dropout, deterministic=False)(h)
@@ -303,6 +337,7 @@ class TransformerLM(nn.Module):
     d_model: int = 256
     n_layers: int = 4
     n_heads: int = 4
+    n_kv_heads: int = 0      # 0 -> n_heads; < n_heads is GQA, 1 is MQA
     d_ff: int = 0            # 0 -> 4 * d_model
     attention: str = "dot"
     causal: bool = True
@@ -357,6 +392,7 @@ class TransformerLM(nn.Module):
                                self.attention, self.causal,
                                self.n_experts, self.moe_k,
                                self.dropout, self.mesh,
+                               self.n_kv_heads,
                                name=f"layer_{i}")(
                 x, train, decode_pos, cache_len)
             aux_total = aux_total + aux
@@ -616,11 +652,13 @@ class LanguageModel:
     """
 
     _CONFIG_KEYS = ("vocab_size", "d_model", "n_layers", "n_heads",
-                    "d_ff", "max_len", "attention", "n_experts", "moe_k",
+                    "n_kv_heads", "d_ff", "max_len", "attention",
+                    "n_experts", "moe_k",
                     "dropout", "aux_coef", "head_chunk", "remat")
 
     def __init__(self, vocab_size: int, d_model: int = 256,
-                 n_layers: int = 4, n_heads: int = 4, d_ff: int = 0,
+                 n_layers: int = 4, n_heads: int = 4,
+                 n_kv_heads: int = 0, d_ff: int = 0,
                  max_len: int = 512, attention: str = "auto",
                  n_experts: int = 0, moe_k: int = 2, dropout: float = 0.0,
                  aux_coef: float = 0.01, head_chunk: Optional[int] = None,
@@ -635,6 +673,12 @@ class LanguageModel:
         self.d_model = int(d_model)
         self.n_layers = int(n_layers)
         self.n_heads = int(n_heads)
+        self.n_kv_heads = int(n_kv_heads)
+        if self.n_kv_heads < 0 or (
+                self.n_kv_heads and self.n_heads % self.n_kv_heads):
+            raise ValueError(
+                f"n_kv_heads={self.n_kv_heads} must be a positive "
+                f"divisor of n_heads={self.n_heads} (or 0 for MHA)")
         self.d_ff = int(d_ff)
         self.max_len = int(max_len)
         self.attention = attention
@@ -694,6 +738,23 @@ class LanguageModel:
             return max(0, int(self.head_chunk))
         return 1024 if self.vocab_size >= 8192 else 0
 
+    def _param_rules(self, mesh):
+        """TP sharding rules, head-granular: a projection whose HEAD
+        count doesn't divide tp replicates, even when the raw column
+        count happens to divide — column-sharding across a head
+        boundary is numerically fine under GSPMD but defeats the
+        head-parallel attention plan (extra resharding at the
+        attention einsum). Checked separately for q/o (n_heads) and
+        k/v (n_kv_heads), which differ under GQA/MQA."""
+        rules = tuple(sharding_lib.TRANSFORMER_RULES)
+        kv = self.n_kv_heads or self.n_heads
+        tp_size = mesh.shape.get(mesh_lib.TP, 1)
+        if tp_size > 1 and kv % tp_size:
+            rules = ((r".*(k_proj|v_proj)/kernel$", P()),) + rules
+        if tp_size > 1 and self.n_heads % tp_size:
+            rules = ((r".*(q_proj|o_proj)/kernel$", P()),) + rules
+        return rules
+
     def _resolved_remat(self) -> str:
         value = os.environ.get("LO_TLM_REMAT") or self.remat or "none"
         if value not in ("none", "dots", "full"):
@@ -707,7 +768,8 @@ class LanguageModel:
     def _module_for(self, seq_len: Optional[int] = None) -> TransformerLM:
         return TransformerLM(
             vocab_size=self.vocab_size, d_model=self.d_model,
-            n_layers=self.n_layers, n_heads=self.n_heads, d_ff=self.d_ff,
+            n_layers=self.n_layers, n_heads=self.n_heads,
+            n_kv_heads=self.n_kv_heads, d_ff=self.d_ff,
             attention=self._resolved_attention(seq_len), causal=True,
             n_experts=self.n_experts, moe_k=self.moe_k,
             dropout=self.dropout, mesh=self._mesh_override,
@@ -780,7 +842,7 @@ class LanguageModel:
                 mesh=mesh,
                 metrics={"accuracy": token_accuracy},
                 compute_dtype=dtype,
-                param_rules=sharding_lib.TRANSFORMER_RULES,
+                param_rules=self._param_rules(mesh),
                 batch_sharding=jax.sharding.NamedSharding(
                     mesh, sharding_lib.batch_spec(mesh, seq_axis=seq_axis)),
                 predict_transform=lambda outputs: outputs[0],

@@ -81,13 +81,38 @@ def _fsdp_spec(shape: Tuple[int, ...], base: P, mesh: Mesh) -> P:
     return P(*entries)
 
 
+def _axes_size(entry, mesh: Mesh) -> int:
+    """Total device count of a PartitionSpec entry (axis name or
+    tuple of names)."""
+    axes = entry if isinstance(entry, (tuple, list)) else (entry,)
+    size = 1
+    for a in axes:
+        size *= mesh.shape[a]
+    return size
+
+
+def _drop_non_divisible(base: P, shape: Tuple[int, ...],
+                        mesh: Mesh) -> P:
+    """Replicate (instead of erroring) any rule-sharded dim the mesh
+    axis doesn't divide — e.g. an MQA k_proj whose single-head output
+    column is narrower than the tp axis."""
+    entries = []
+    for i, entry in enumerate(base):
+        if entry is not None and i < len(shape) and \
+                shape[i] % _axes_size(entry, mesh):
+            entry = None
+        entries.append(entry)
+    return P(*entries)
+
+
 def spec_for(path: str, shape: Tuple[int, ...], mesh: Mesh,
              rules: Sequence[Rule] = TRANSFORMER_RULES,
              fsdp: bool = True) -> P:
     base = P()
     for pattern, spec in rules:
         if re.match(pattern, path):
-            base = _axes_in_mesh(spec, mesh)
+            base = _drop_non_divisible(
+                _axes_in_mesh(spec, mesh), shape, mesh)
             break
     return _fsdp_spec(shape, base, mesh) if fsdp else base
 
@@ -130,14 +155,8 @@ def constrain(x, mesh: Mesh, *spec_entries) -> Any:
     the 1-sample trace during param init)."""
     spec = _axes_in_mesh(P(*spec_entries), mesh)
 
-    def fits(entry, dim):
-        axes = entry if isinstance(entry, (tuple, list)) else (entry,)
-        size = 1
-        for a in axes:
-            size *= mesh.shape[a]
-        return dim % size == 0
-
-    entries = [e if (e is not None and fits(e, d)) else None
+    entries = [e if (e is not None and d % _axes_size(e, mesh) == 0)
+               else None
                for e, d in zip(spec, x.shape)]
     spec = P(*entries)
     return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, spec))

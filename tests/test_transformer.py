@@ -433,3 +433,127 @@ def test_ring_fit_uses_sharded_fused_head(tmp_path):
     hist = lm.fit(toks, batch_size=8, epochs=1)
     assert np.isfinite(hist.history["loss"][0])
     assert "accuracy" in hist.history
+
+
+# ----------------------------------------------------------------------
+# grouped-query attention (GQA / MQA)
+# ----------------------------------------------------------------------
+def test_gqa_param_shapes_and_training(tmp_path):
+    """n_kv_heads < n_heads projects K/V to fewer heads: the KV cache
+    and k/v_proj shrink by n_heads/n_kv_heads while q/o keep full
+    width; training still learns (the repeat-to-full-heads path)."""
+    _mesh_config(tmp_path, "auto")
+    model = LanguageModel(vocab_size=32, d_model=32, n_layers=1,
+                          n_heads=4, n_kv_heads=2, max_len=16,
+                          attention="dot")
+    model.compile({"kind": "adam", "learning_rate": 5e-3})
+    x = _toy_tokens()
+    hist = model.fit(x, batch_size=32, epochs=12, shuffle=False)
+    assert hist.history["loss"][-1] < hist.history["loss"][0] * 0.5
+    attn = model.params["layer_0"]["attn"]
+    head_dim = 32 // 4
+    assert attn["q_proj"]["kernel"].shape == (32, 4 * head_dim)
+    assert attn["k_proj"]["kernel"].shape == (32, 2 * head_dim)
+    assert attn["v_proj"]["kernel"].shape == (32, 2 * head_dim)
+
+
+def test_gqa_n_kv_heads_must_divide():
+    with pytest.raises(ValueError, match="positive divisor"):
+        LanguageModel(vocab_size=8, n_heads=4, n_kv_heads=3)
+    with pytest.raises(ValueError, match="positive divisor"):
+        # 4 % -2 == 0 — the sign check must fire, not the divide check
+        LanguageModel(vocab_size=8, n_heads=4, n_kv_heads=-2)
+
+
+def test_gqa_cached_decode_matches_full_forward(tmp_path):
+    """The grouped single-token decode path (KV cache stored at
+    n_kv_heads, grouped einsum — no head repeat) must produce the
+    same greedy continuation as argmax over the full training-path
+    forward re-run per position."""
+    _mesh_config(tmp_path, "dp=1")
+    model = LanguageModel(vocab_size=16, d_model=16, n_layers=2,
+                          n_heads=4, n_kv_heads=2, max_len=12,
+                          attention="dot")
+    x = _toy_tokens(n=8, seq=8, vocab=16)
+    model.fit(x, batch_size=8, epochs=1)
+
+    prompt = x[:2, :4]
+    gen = model.generate(prompt, max_new_tokens=4, temperature=0.0)
+
+    # oracle: full forward per position, argmax with pad masked out
+    module = model._module_for(None)
+    buf = np.zeros((2, 8), np.int32)
+    buf[:, :4] = prompt
+    for pos in range(4, 8):
+        logits, _ = module.apply({"params": model.params},
+                                 jnp.asarray(buf))
+        last = np.asarray(logits[:, pos - 1]).astype(np.float64)
+        last[:, 0] = -np.inf
+        buf[:, pos] = last.argmax(-1)
+    np.testing.assert_array_equal(gen, buf)
+
+    # the cache really is kv-heads sized
+    _, mut = module.apply({"params": model.params},
+                          jnp.asarray(prompt), cache_len=8,
+                          mutable=["cache"])
+    k_cache = mut["cache"]["layer_0"]["attn"]["k"]
+    assert k_cache.shape == (2, 8, 2, 16 // 4)
+
+
+def test_mqa_tp_sharding_replicates_non_divisible_kv(tmp_path):
+    """MQA under TP: a k_proj column dim narrower than the tp axis
+    replicates (spec_for drops the non-divisible axis) instead of
+    erroring, while q_proj stays column-sharded."""
+    mesh = mesh_lib.build_mesh("tp=4")
+    module = TransformerLM(vocab_size=32, d_model=8, n_layers=1,
+                           n_heads=4, n_kv_heads=1, attention="dot")
+    params = module.init(jax.random.PRNGKey(0),
+                         jnp.zeros((1, 8), jnp.int32))["params"]
+    shardings = sharding_lib.param_shardings(params, mesh)
+    q = shardings["layer_0"]["attn"]["q_proj"]["kernel"].spec
+    k = shardings["layer_0"]["attn"]["k_proj"]["kernel"].spec
+    assert "tp" in tuple(q)
+    assert "tp" not in tuple(jax.tree_util.tree_leaves(tuple(k)) or ())
+
+
+def test_gqa_tp_rules_are_head_granular(tmp_path):
+    """kv_heads=2 under tp=4: raw k_proj columns (2*head_dim=64)
+    DIVIDE tp, but sharding would split mid-head — the model's rule
+    set must replicate k/v_proj while q/o stay TP-sharded."""
+    _mesh_config(tmp_path, "tp=4")
+    lm = LanguageModel(vocab_size=32, d_model=256, n_layers=1,
+                       n_heads=8, n_kv_heads=2, max_len=16,
+                       attention="dot")
+    mesh = mesh_lib.build_mesh("tp=4")
+    rules = lm._param_rules(mesh)
+    k_spec = sharding_lib.spec_for("layer_0/attn/k_proj/kernel",
+                                   (256, 64), mesh, rules, fsdp=False)
+    q_spec = sharding_lib.spec_for("layer_0/attn/q_proj/kernel",
+                                   (256, 256), mesh, rules, fsdp=False)
+    assert tuple(k_spec) == (None, None) or tuple(k_spec) == ()
+    assert "tp" in tuple(q_spec)
+    # kv_heads=4 divides tp=4 -> no override, k_proj TP-sharded
+    lm4 = LanguageModel(vocab_size=32, d_model=256, n_layers=1,
+                        n_heads=8, n_kv_heads=4, max_len=16,
+                        attention="dot")
+    k4 = sharding_lib.spec_for("layer_0/attn/k_proj/kernel",
+                               (256, 128), mesh, lm4._param_rules(mesh),
+                               fsdp=False)
+    assert "tp" in tuple(k4)
+
+
+def test_gqa_artifact_round_trip(tmp_path):
+    _mesh_config(tmp_path, "dp=1")
+    model = LanguageModel(vocab_size=16, d_model=16, n_layers=1,
+                          n_heads=4, n_kv_heads=1, max_len=12,
+                          attention="dot", name="gqa_rt")
+    x = _toy_tokens(n=8, seq=8, vocab=16)
+    model.fit(x, batch_size=8, epochs=1)
+    art = tmp_path / "artifact"
+    os.makedirs(art)
+    model.__lo_save__(str(art))
+    loaded = LanguageModel.__lo_load__(str(art))
+    assert loaded.n_kv_heads == 1
+    np.testing.assert_allclose(model.predict(x[:4], batch_size=4),
+                               loaded.predict(x[:4], batch_size=4),
+                               atol=1e-5)
