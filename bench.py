@@ -57,7 +57,11 @@ LSTM_VOCAB = 20000
 LSTM_SEQ = 200
 LSTM_N = int(os.environ.get("LO_BENCH_LSTM_N", "8192"))
 LSTM_BATCH = 128
-LSTM_EPOCHS = int(os.environ.get("LO_BENCH_LSTM_EPOCHS", "3"))
+# 5 epochs: train accuracy crosses 0.97 around epoch 4 on the synth
+# IMDb task (measured 0.962 at epoch 3), so the time-to-97% half of
+# the BASELINE metric lands; steady-state samples/s is per-epoch and
+# unaffected by the count
+LSTM_EPOCHS = int(os.environ.get("LO_BENCH_LSTM_EPOCHS", "5"))
 
 # TransformerLM (north-star MFU workload); dimensions are
 # env-overridable so the MFU sweep can scale the model to the chip
@@ -73,7 +77,7 @@ TLM_CFG = {"vocab_size": TLM_VOCAB,
            "d_ff": int(os.environ.get("LO_BENCH_TLM_FF", "2048")),
            "max_len": TLM_SEQ}
 # "auto" picks dot vs the Pallas flash kernel by the measured on-chip
-# crossover (seq >= 2048 -> flash); the parent still retries a
+# crossover (seq >= 1024 -> flash); the parent still retries a
 # timed-out tlm phase with "dot" so a pathological remote kernel
 # compile cannot cost the round its transformer number
 TLM_ATTENTION = os.environ.get("LO_BENCH_TLM_ATTENTION", "auto")
@@ -348,7 +352,9 @@ def phase_flash():
 
     b, h, d = 4, 8, 64
     results = {}
-    for seq in (1024, 2048, 4096, 8192):
+    seqs = tuple(int(s) for s in os.environ.get(
+        "LO_BENCH_FLASH_SEQS", "1024,2048,4096,8192").split(","))
+    for seq in seqs:
         for causal in (False, True):
             q, k, v = (
                 jnp.asarray(np.random.default_rng(i).normal(

@@ -711,15 +711,16 @@ class LanguageModel:
     def _resolved_attention(self, seq_len: Optional[int] = None) -> str:
         if self.attention != "auto":
             return self.attention
-        # On-chip micro-bench (BENCHMARKS.md "Flash kernel"): XLA's
-        # fused dot wins below ~2k tokens (10.4 vs 11.3 ms at 1k),
-        # the Pallas flash kernel wins from ~4k (21.2 vs 36.4 ms) and
-        # is the only path that compiles at 8k+ (dot materializes the
-        # (bh, s, s) scores). Cross over at 2048 on the ACTUAL
-        # sequence length when known (a max_len=4096 model fed
-        # 512-token windows should still take the dot path).
+        # On-chip micro-bench (BENCHMARKS.md "Flash kernel", re-run
+        # 2026-07-31 at the committed 512^2 auto tiles): the Pallas
+        # flash kernel now beats XLA's fused dot at EVERY measured
+        # length — 1024: 8.8 vs 9.7 ms causal (2.2x at full), 2048:
+        # 12.1 vs 15.5 ms, 4096: 19.6 vs 36.0 ms — and is the only
+        # path that compiles at 8k+ (dot materializes the (bh, s, s)
+        # scores). Cross over at 1024 on the ACTUAL sequence length
+        # when known; below 1024 is unmeasured, keep the dot oracle.
         if jax.default_backend() == "tpu":
-            return "flash" if (seq_len or self.max_len) >= 2048 else "dot"
+            return "flash" if (seq_len or self.max_len) >= 1024 else "dot"
         return "dot"
 
     def _head_chunk(self) -> int:
