@@ -85,7 +85,8 @@ TLM_ATTENTION = os.environ.get("LO_BENCH_TLM_ATTENTION", "auto")
 # per-phase wall-clock bounds (seconds); overridable for local smoke
 # runs via LO_BENCH_TIMEOUT_<PHASE>
 PHASE_TIMEOUTS = {"cnn": 600, "lstm": 600, "tlm": 900, "proxy": 120,
-                  "builder": 600, "flash": 600, "ingest": 600}
+                  "builder": 600, "flash": 600, "ingest": 600,
+                  "gen": 900}
 
 # out-of-core Builder (reference config 4: 10M-row GBT via Spark)
 BUILDER_ROWS = int(os.environ.get("LO_BENCH_BUILDER_ROWS", "10000000"))
@@ -295,6 +296,50 @@ def phase_tlm():
     out["attention"] = TLM_ATTENTION
     out["platform"] = jax.devices()[0].platform
     return out
+
+
+def phase_gen():
+    """KV-cache decode throughput: tokens/s for autoregressive
+    generation on a trained-shape LM. The whole continuation decodes
+    inside one jitted lax.fori_loop (transformer.py _gen_fns), so this
+    measures the device decode rate, not host round-trip latency.
+    Reference has no generation path at all — this is net-new
+    capability evidence; the interesting number is ms/token."""
+    import jax
+    import numpy as np
+
+    from learningorchestra_tpu.models.transformer import LanguageModel
+
+    cfg = dict(TLM_CFG)
+    new_tokens = int(os.environ.get("LO_BENCH_GEN_TOKENS", "256"))
+    prompt_len = int(os.environ.get("LO_BENCH_GEN_PROMPT", "64"))
+    gen_batch = int(os.environ.get("LO_BENCH_GEN_BATCH", "8"))
+    cfg["max_len"] = prompt_len + new_tokens
+    lm = LanguageModel(**cfg)
+    rng = np.random.default_rng(0)
+    seed_tokens = rng.integers(
+        1, cfg["vocab_size"], size=(gen_batch * 2, 128)).astype(np.int32)
+    lm.fit(seed_tokens, batch_size=gen_batch * 2, epochs=1)
+    prompt = rng.integers(1, cfg["vocab_size"],
+                          size=(gen_batch, prompt_len)).astype(np.int32)
+    # warmup pays the prefill+decode compile; then timed runs
+    lm.generate(prompt, max_new_tokens=new_tokens, temperature=0.8,
+                top_k=50, seed=0)
+    n_runs = 3
+    t0 = time.perf_counter()
+    for i in range(n_runs):
+        out = lm.generate(prompt, max_new_tokens=new_tokens,
+                          temperature=0.8, top_k=50, seed=i + 1)
+    dt = (time.perf_counter() - t0) / n_runs
+    assert out.shape == (gen_batch, prompt_len + new_tokens)
+    total_new = gen_batch * new_tokens
+    return {
+        "decode_tokens_per_sec": round(total_new / dt, 1),
+        "decode_ms_per_token_per_seq": round(dt * 1000.0 / new_tokens, 3),
+        "batch": gen_batch, "prompt_len": prompt_len,
+        "new_tokens": new_tokens,
+        "platform": jax.devices()[0].platform,
+    }
 
 
 def _scrub_exc(exc) -> str:
@@ -581,7 +626,8 @@ def phase_proxy(max_seconds=60.0):
 
 PHASES = {"cnn": phase_cnn, "lstm": phase_lstm, "tlm": phase_tlm,
           "proxy": phase_proxy, "builder": phase_builder,
-          "flash": phase_flash, "ingest": phase_ingest}
+          "flash": phase_flash, "ingest": phase_ingest,
+          "gen": phase_gen}
 
 _RESULT_MARK = "@@LO_BENCH_RESULT@@"
 
@@ -773,6 +819,10 @@ def main(argv=None):
             models["transformer_lm"] = retry
     models["builder_10m_streaming"] = _run_phase("builder", env)
     models["csv_ingest"] = _run_phase("ingest", env)
+    gen_cpu_env = dict(cpu_env, LO_BENCH_GEN_TOKENS="32",
+                       LO_BENCH_GEN_PROMPT="16", LO_BENCH_GEN_BATCH="2")
+    models["lm_decode"] = _run_phase("gen", None if tpu_ok
+                                     else gen_cpu_env)
     # interpret-mode kernel timing is meaningless — flash runs on TPU only
     flash = _run_phase("flash") if tpu_ok else {
         "skipped": "TPU unreachable; interpret-mode timing is not "

@@ -913,9 +913,11 @@ class LanguageModel:
                  top_p: Optional[float] = None) -> np.ndarray:
         """Greedy / temperature sampling with an incremental KV cache:
         the prompt runs ONCE (prefill fills every layer's K/V cache),
-        then each new token is a single-position forward attending
-        over the cache — O(L) per token instead of the O(L²) full
-        re-forward. prompt: (b, s) token ids.
+        then the whole continuation decodes inside ONE jitted
+        ``lax.fori_loop`` of single-position forwards attending over
+        the cache — O(L) per token instead of the O(L²) full
+        re-forward, and one host round trip for the entire
+        continuation. prompt: (b, s) token ids.
 
         ``top_k`` keeps only the k highest-logit tokens and ``top_p``
         keeps the smallest nucleus whose probability mass reaches p;
@@ -956,15 +958,15 @@ class LanguageModel:
         buf = np.zeros((b, total), np.int32)
         buf[:, :s] = prompt
         buf = jnp.asarray(buf)
-        prefill, step = self._gen_fns(
+        prefill, decode = self._gen_fns(
             b, s, total, float(temperature), top_k, top_p)
         params = self.params
         key = jax.random.PRNGKey(seed)
         key, sub = jax.random.split(key)
         buf, cache = prefill(params, buf, sub)
-        for pos in range(s + 1, total):
+        if total > s + 1:
             key, sub = jax.random.split(key)
-            buf, cache = step(params, cache, buf, jnp.asarray(pos), sub)
+            buf, cache = decode(params, cache, buf, sub)
         return np.asarray(buf)
 
     @staticmethod
@@ -994,11 +996,12 @@ class LanguageModel:
     def _gen_fns(self, b: int, s: int, total: int, temperature: float,
                  top_k: Optional[int] = None,
                  top_p: Optional[float] = None):
-        """Jitted (prefill, decode_step) per (batch, prompt_len, total,
+        """Jitted (prefill, decode) per (batch, prompt_len, total,
         temperature) — params/cache are arguments, not closures, so
         weights stay device-resident and repeated generate() calls
-        reuse the compile. The cache is donated through each decode
-        step (updated in place, no per-token copy)."""
+        reuse the compile. ``decode`` runs the WHOLE continuation in
+        one fori_loop program (buf and cache donated into it, updated
+        in place across iterations — no per-token host round trip)."""
         fns = getattr(self, "_gen_cache_fns", None)
         if fns is None:
             fns = self._gen_cache_fns = {}
@@ -1021,19 +1024,29 @@ class LanguageModel:
             buf = buf.at[:, s].set(nxt.astype(jnp.int32))
             return buf, mut["cache"]
 
-        @functools.partial(jax.jit, donate_argnums=(1,))
-        def step(params, cache, buf, pos, key):
-            tok = jax.lax.dynamic_slice(buf, (0, pos - 1), (b, 1))
-            (logits, _), mut = module.apply(
-                {"params": params, "cache": cache}, tok, train=False,
-                decode_pos=pos - 1, cache_len=total, mutable=["cache"])
-            nxt = self._sample(logits[:, 0], temperature, key,
-                               top_k, top_p)
-            buf = jax.lax.dynamic_update_slice(
-                buf, nxt[:, None].astype(jnp.int32), (0, pos))
-            return buf, mut["cache"]
+        @functools.partial(jax.jit, donate_argnums=(1, 2))
+        def decode(params, cache, buf, key):
+            # the WHOLE decode loop runs as one device program
+            # (lax.fori_loop carrying buf+cache) — one host round trip
+            # for the entire continuation instead of one per token,
+            # which dominates generate() latency on relayed backends
+            def body(pos, carry):
+                buf, cache = carry
+                tok = jax.lax.dynamic_slice(buf, (0, pos - 1), (b, 1))
+                (logits, _), mut = module.apply(
+                    {"params": params, "cache": cache}, tok, train=False,
+                    decode_pos=pos - 1, cache_len=total,
+                    mutable=["cache"])
+                nxt = self._sample(logits[:, 0], temperature,
+                                   jax.random.fold_in(key, pos),
+                                   top_k, top_p)
+                buf = jax.lax.dynamic_update_slice(
+                    buf, nxt[:, None].astype(jnp.int32), (0, pos))
+                return buf, mut["cache"]
 
-        fns[sig] = (prefill, step)
+            return jax.lax.fori_loop(s + 1, total, body, (buf, cache))
+
+        fns[sig] = (prefill, decode)
         return fns[sig]
 
     def _require_built(self) -> None:
