@@ -350,14 +350,17 @@ def test_tune_grid_search_pipeline(server):
     assert meta["finished"]
 
 
-def _resnet_transfer_tune(server, tmp_path, stage_sizes):
+def _resnet_transfer_tune(server, tmp_path, stage_sizes,
+                          learning_rates=(1e-3, 1e-4)):
     """BASELINE config 5 end-to-end: a pretrained ResNet-50 (weights
     loaded from a real npz export, not silent random init) created by
     module path through /model, then a learning-rate sweep through
     /tune — the reference's transfer-learn + GridSearchCV flow.
     ``stage_sizes`` shrinks the bottleneck stages for the fast run
     (same architecture family, ~10x cheaper compile on the CPU test
-    backend)."""
+    backend); the fast run also sweeps ONE learning rate (each trial
+    pays a full compile; multi-trial tune mechanics are covered by
+    test_tune_grid_search_pipeline on a cheap model)."""
     import os
 
     from learningorchestra_tpu.models.tf_compat.keras import applications
@@ -396,7 +399,8 @@ def _resnet_transfer_tune(server, tmp_path, stage_sizes):
         "modulePath": "learningorchestra_tpu.models",
         "class": "GridSearch",
         "classParameters": {"estimator": "$rn_model",
-                            "param_grid": {"learning_rate": [1e-3, 1e-4]},
+                            "param_grid": {
+                                "learning_rate": list(learning_rates)},
                             "validation_split": 0.25}})
     assert st == 201, body
     _poll_finished(server, f"{API}/model/tensorflow/rn_sweep")
@@ -411,13 +415,15 @@ def _resnet_transfer_tune(server, tmp_path, stage_sizes):
     assert meta["finished"]
     sweep = server.api.ctx.artifacts.load("rn_tune", "tune/tensorflow")
     assert sweep.best_params_ is not None
-    assert len(sweep.cv_results_["params"]) == 2
+    assert len(sweep.cv_results_["params"]) == len(learning_rates)
 
 
 def test_resnet_transfer_tune_pipeline_fast(server, tmp_path):
-    """Shrunken-stages variant ([1, 1, 1, 1] bottlenecks) — the whole
-    REST transfer+tune flow at a fraction of the compile cost."""
-    _resnet_transfer_tune(server, tmp_path, [1, 1, 1, 1])
+    """Shrunken-stages variant ([1, 1, 1, 1] bottlenecks, one sweep
+    trial) — the whole REST transfer+tune flow at a fraction of the
+    compile cost."""
+    _resnet_transfer_tune(server, tmp_path, [1, 1, 1, 1],
+                          learning_rates=(1e-3,))
 
 
 @pytest.mark.slow
