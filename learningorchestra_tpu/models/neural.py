@@ -86,6 +86,7 @@ class NeuralModel:
         self._engine: Optional[engine_lib.Engine] = None
         self._state: Optional[engine_lib.TrainState] = None
         self._mesh_override = None
+        self._accum = engine_lib.default_grad_accum()
 
     def set_mesh(self, mesh) -> None:
         """Pin this model to a mesh (e.g. a sweep trial's sub-slice of
@@ -176,8 +177,18 @@ class NeuralModel:
                 optimizer=build_optimizer(self.optimizer_spec),
                 mesh=self._mesh(),
                 metrics={n: _METRICS[n] for n in self.metric_names},
-                compute_dtype=dtype)
+                compute_dtype=dtype,
+                grad_accum=self._accum)
         return self._engine
+
+    def _set_grad_accum(self, grad_accum: Optional[int]) -> None:
+        """Fit-time microbatch override (keras has no equivalent; env
+        default LO_GRAD_ACCUM) — an effective change rebuilds the
+        engine."""
+        self._accum, changed = engine_lib.resolve_grad_accum(
+            grad_accum, self._accum)
+        if changed:
+            self._engine = None
 
     # ------------------------------------------------------------------
     def _coerce_x(self, x) -> np.ndarray:
@@ -217,7 +228,9 @@ class NeuralModel:
             validation_data: Optional[Tuple] = None,
             validation_split: float = 0.0,
             shuffle: bool = True, checkpointer=None,
-            log_fn=None, **_: Any) -> "History":
+            log_fn=None, grad_accum: Optional[int] = None,
+            **_: Any) -> "History":
+        self._set_grad_accum(grad_accum)
         if validation_split and validation_data is None:
             # keras-parity convenience: hold out the TAIL fraction
             # (keras also splits before shuffling)

@@ -694,6 +694,7 @@ class LanguageModel:
         self._engine: Optional[engine_lib.Engine] = None
         self._state = None
         self._mesh_override = None
+        self._accum = engine_lib.default_grad_accum()
 
     def set_mesh(self, mesh) -> None:
         """Pin this model to a mesh (e.g. a sweep trial's sub-slice of
@@ -847,8 +848,17 @@ class LanguageModel:
                 batch_sharding=jax.sharding.NamedSharding(
                     mesh, sharding_lib.batch_spec(mesh, seq_axis=seq_axis)),
                 predict_transform=lambda outputs: outputs[0],
-                flops_floor_fn=flops_floor)
+                flops_floor_fn=flops_floor,
+                grad_accum=self._accum)
         return self._engine
+
+    def _set_grad_accum(self, grad_accum: Optional[int]) -> None:
+        """Fit-time microbatch override (env default LO_GRAD_ACCUM) —
+        an effective change rebuilds the engine."""
+        self._accum, changed = engine_lib.resolve_grad_accum(
+            grad_accum, self._accum)
+        if changed:
+            self._engine = None
 
     # ------------------------------------------------------------------
     def _coerce_tokens(self, x) -> np.ndarray:
@@ -876,9 +886,10 @@ class LanguageModel:
 
     def fit(self, x=None, y=None, batch_size: Optional[int] = None,
             epochs: int = 1, shuffle: bool = True, checkpointer=None,
-            log_fn=None, **_: Any):
+            log_fn=None, grad_accum: Optional[int] = None, **_: Any):
         from learningorchestra_tpu.models.neural import History
 
+        self._set_grad_accum(grad_accum)
         batcher = self._batcher(x, batch_size, shuffle=shuffle)
         if self.params is None:
             self._build_params(batcher.array("x"))

@@ -16,6 +16,7 @@ This is what replaces the reference's hot loop — ``getattr(instance,
 
 from __future__ import annotations
 
+import os
 import time
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
@@ -40,6 +41,23 @@ class TrainState(struct.PyTreeNode):
 Metrics = Dict[str, Tuple[jax.Array, jax.Array]]  # name -> (sum, count)
 
 
+def default_grad_accum() -> int:
+    """Process-wide microbatch-count default (LO_GRAD_ACCUM env)."""
+    return max(1, int(os.environ.get("LO_GRAD_ACCUM", "1")))
+
+
+def resolve_grad_accum(requested: Optional[int],
+                       current: int) -> Tuple[int, bool]:
+    """Clamp a fit-time ``grad_accum`` override and report whether the
+    EFFECTIVE value changed (so callers only rebuild their engine —
+    discarding every cached jitted step — on a real change; a clamped
+    no-op like 0 -> 1 when already 1 must not recompile)."""
+    if requested is None:
+        return current, False
+    value = max(1, int(requested))
+    return value, value != current
+
+
 class Engine:
     """Generic sharded training engine over (apply_fn, loss_fn).
 
@@ -61,7 +79,8 @@ class Engine:
                  fsdp: bool = True,
                  batch_sharding=None,
                  predict_transform: Optional[Callable] = None,
-                 flops_floor_fn: Optional[Callable] = None):
+                 flops_floor_fn: Optional[Callable] = None,
+                 grad_accum: int = 1):
         self._apply_fn = apply_fn
         self._loss_fn = loss_fn
         self._optimizer = optimizer
@@ -88,6 +107,12 @@ class Engine:
         # (pallas_call), so a flash-attention model's MFU would be
         # deflated without it
         self._flops_floor_fn = flops_floor_fn
+        # microbatch count per optimizer step: the batch splits into
+        # grad_accum sequential microbatches whose gradients average
+        # before ONE update — peak activation memory scales with the
+        # microbatch, letting memory-bound shapes train at batch sizes
+        # HBM could not hold in one pass
+        self._grad_accum = max(1, int(grad_accum))
 
     # ------------------------------------------------------------------
     def init_state(self, params, model_state=None) -> TrainState:
@@ -123,13 +148,13 @@ class Engine:
         return jax.tree_util.tree_map(cast_leaf, tree)
 
     # ------------------------------------------------------------------
-    def _train_step_body(self, state: TrainState, batch, rng):
+    def _micro_grads(self, params, model_state, batch, rng):
+        """Gradients + metric sums for one (micro)batch."""
         weights = batch.get(data_lib.MASK_KEY)
 
-        def loss_of(params):
+        def loss_of(p):
             outputs, new_model_state = self._apply_fn(
-                self._cast(params), state.model_state,
-                self._cast(batch), True, rng)
+                self._cast(p), model_state, self._cast(batch), True, rng)
             res = self._loss_fn(outputs, batch, weights)
             # a loss_fn may return (loss, {metric: (sum, count)}) to
             # emit metrics it already computed — the fused-lm-head
@@ -141,20 +166,65 @@ class Engine:
                                               extra)
 
         (loss, (outputs, new_model_state, extra)), grads = \
-            jax.value_and_grad(loss_of, has_aux=True)(state.params)
-        updates, new_opt = self._optimizer.update(
-            grads, state.opt_state, state.params)
-        new_params = optax.apply_updates(state.params, updates)
+            jax.value_and_grad(loss_of, has_aux=True)(params)
         metrics = {"loss": (loss * _total(weights), _total(weights))}
         metrics.update(extra)
         for name, fn in self._metrics.items():
             if name in extra:
                 continue  # the loss already emitted this metric
             metrics[name] = fn(outputs, batch, weights)
+        return grads, new_model_state, metrics
+
+    def _train_step_body(self, state: TrainState, batch, rng):
+        if self._grad_accum > 1:
+            grads, new_model_state, metrics = self._accum_grads(
+                state, batch, rng)
+        else:
+            grads, new_model_state, metrics = self._micro_grads(
+                state.params, state.model_state, batch, rng)
+        updates, new_opt = self._optimizer.update(
+            grads, state.opt_state, state.params)
+        new_params = optax.apply_updates(state.params, updates)
         new_state = state.replace(step=state.step + 1, params=new_params,
                                   opt_state=new_opt,
                                   model_state=new_model_state)
         return new_state, metrics
+
+    def _accum_grads(self, state: TrainState, batch, rng):
+        """Sequential microbatch gradient accumulation: the batch
+        splits leaf-wise into ``grad_accum`` microbatches scanned with
+        a running gradient sum, so peak activation memory is one
+        microbatch's. Micro gradients average UNIFORMLY — exact when
+        every microbatch carries the same valid-token count (the
+        unmasked case), the standard approximation otherwise."""
+        accum = self._grad_accum
+        b = jax.tree_util.tree_leaves(batch)[0].shape[0]
+        if b % accum:
+            raise ValueError(
+                f"batch size {b} is not divisible by "
+                f"grad_accum={accum}")
+        micros = jax.tree_util.tree_map(
+            lambda a: a.reshape((accum, b // accum) + a.shape[1:]),
+            batch)
+        zero_g = jax.tree_util.tree_map(
+            lambda p: jnp.zeros(p.shape, jnp.float32), state.params)
+
+        def body(carry, mb):
+            g_acc, ms, i = carry
+            grads, ms, metrics = self._micro_grads(
+                state.params, ms, mb, jax.random.fold_in(rng, i))
+            g_acc = jax.tree_util.tree_map(
+                lambda a, g: a + g.astype(jnp.float32), g_acc, grads)
+            return (g_acc, ms, i + 1), metrics
+
+        (g_sum, new_model_state, _), metrics = jax.lax.scan(
+            body, (zero_g, state.model_state,
+                   jnp.zeros((), jnp.int32)), micros)
+        grads = jax.tree_util.tree_map(lambda g: g / accum, g_sum)
+        # each metric leaf is stacked (accum, ...) sums/counts
+        metrics = {k: (jnp.sum(s), jnp.sum(c))
+                   for k, (s, c) in metrics.items()}
+        return grads, new_model_state, metrics
 
     def _build_train_step(self):
         donate = (0,) if self._donate else ()

@@ -1,0 +1,75 @@
+#!/usr/bin/env bash
+# Round-4 measurement runner, hardened for a flappy chip: every
+# experiment is gated on a fresh bounded probe (a wedged chip hangs
+# backend init forever), so a mid-session wedge costs one probe
+# timeout, not 30 idle minutes per remaining phase. Results land in
+# $OUT as one JSON file per experiment; already-present results are
+# skipped, so the script is resumable.
+#
+#   bash scripts/measure_r4.sh [OUT_DIR]
+set -u
+cd "$(dirname "$0")/.."
+OUT="${1:-queued_results}"
+mkdir -p "$OUT"
+PROBE_INTERVAL="${LO_PROBE_INTERVAL:-120}"
+PHASE_TIMEOUT="${LO_PHASE_TIMEOUT:-1500}"
+
+probe() {
+  timeout 90 python - <<'EOF' >/dev/null 2>&1
+import faulthandler
+faulthandler.dump_traceback_later(80, exit=True)
+import jax
+assert any(d.platform != "cpu" for d in jax.devices())
+import jax.numpy as jnp
+assert float(jnp.ones((8, 8)).sum()) == 64.0
+EOF
+}
+
+wait_for_chip() {
+  until probe; do
+    echo "$(date -u +%FT%TZ) chip not answering; retry in ${PROBE_INTERVAL}s"
+    sleep "$PROBE_INTERVAL"
+  done
+}
+
+run() {  # run NAME ENV... -- ARGS...
+  local name="$1"; shift
+  if [ -s "$OUT/$name.out" ] && grep -q '"ok": true' "$OUT/$name.out"; then
+    echo "$(date -u +%FT%TZ) [$name] already done, skipping"
+    return
+  fi
+  local envs=()
+  while [ "$1" != "--" ]; do envs+=("$1"); shift; done
+  shift
+  wait_for_chip
+  echo "$(date -u +%FT%TZ) [$name] env ${envs[*]-} bench $*"
+  env "${envs[@]}" timeout "$PHASE_TIMEOUT" \
+      python bench.py "$@" > "$OUT/$name.out" 2> "$OUT/$name.err"
+  echo "exit=$? $(tail -c 400 "$OUT/$name.out")"
+}
+
+# the d=512 roofline pair (VERDICT next-round #2) first
+run tlm_fused LO_NOOP=1 -- --phase tlm
+run tlm_unfused LO_LM_HEAD_CHUNK=0 -- --phase tlm
+# long-context MFU on the flash path (VERDICT #1)
+run tlm_longctx LO_BENCH_TLM_SEQ=2048 LO_BENCH_TLM_D=1024 \
+    LO_BENCH_TLM_LAYERS=12 LO_BENCH_TLM_HEADS=16 LO_BENCH_TLM_FF=4096 \
+    LO_BENCH_TLM_BATCH=8 LO_BENCH_TLM_N=1024 -- --phase tlm
+# LSTM hoist decision (unroll=8 already measured: regression)
+run lstm_hoist LO_LSTM_HOIST=1 -- --phase lstm
+# remat batch scaling at the flagship shape
+run tlm_remat_dots_b32 LO_TLM_REMAT=dots LO_BENCH_TLM_BATCH=32 \
+    -- --phase tlm
+run tlm_remat_full_b64 LO_TLM_REMAT=full LO_BENCH_TLM_BATCH=64 \
+    -- --phase tlm
+# decode throughput (net-new lm_decode row)
+run gen LO_NOOP=1 -- --phase gen
+# flash crossover below 1024
+run flash512 LO_BENCH_FLASH_SEQS=512,1024 -- --phase flash
+# full run + BENCHMARKS.md regeneration (bench.py's own guard keeps
+# the committed table unless the chip answered)
+wait_for_chip
+echo "$(date -u +%FT%TZ) full bench + BENCHMARKS.md regeneration"
+timeout 5400 python bench.py --write-md BENCHMARKS.md \
+    > "$OUT/full_bench.out" 2> "$OUT/full_bench.err"
+echo "$(date -u +%FT%TZ) done (exit=$?) — results in $OUT/"

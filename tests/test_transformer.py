@@ -542,6 +542,20 @@ def test_gqa_tp_rules_are_head_granular(tmp_path):
     assert "tp" in tuple(k4)
 
 
+def test_gqa_trains_under_tp_and_sp(tmp_path):
+    """GQA fit on a real multi-axis mesh: kv_heads=2 under tp=2 (kv
+    divides tp -> k/v stay TP-sharded) composing with sequence-
+    parallel ring attention; loss must be finite through the GSPMD
+    engine."""
+    _mesh_config(tmp_path, "dp=2,sp=2,tp=2")
+    model = LanguageModel(vocab_size=32, d_model=16, n_layers=1,
+                          n_heads=4, n_kv_heads=2, max_len=16,
+                          attention="ring")
+    x = _toy_tokens(n=32)
+    hist = model.fit(x, batch_size=16, epochs=1, shuffle=False)
+    assert np.isfinite(hist.history["loss"][0])
+
+
 def test_gqa_artifact_round_trip(tmp_path):
     _mesh_config(tmp_path, "dp=1")
     model = LanguageModel(vocab_size=16, d_model=16, n_layers=1,
