@@ -114,6 +114,12 @@ class _Attention(nn.Module):
     causal: bool
     mesh: Any = None
     n_kv_heads: int = 0      # 0 -> n_heads (standard MHA)
+    # one (d, 3*proj) matmul instead of three (d, proj) ones: at small
+    # d_model the MXU is under-tiled in the output dim, so widening N
+    # 3x raises utilization (the BENCHMARKS.md d=512 roofline gap).
+    # MHA only — under GQA the q/k/v widths differ and column-sharding
+    # the concatenation would split across block boundaries.
+    fused_qkv: bool = False
 
     @property
     def kv_heads(self) -> int:
@@ -139,9 +145,15 @@ class _Attention(nn.Module):
         b, s, _ = x.shape
         shape4 = (b, s, self.n_heads, self.head_dim)
         kv_shape4 = (b, s, kv, self.head_dim)
-        q = dense("q_proj", proj)(x).reshape(shape4)
-        k = dense("k_proj", kv * self.head_dim)(x).reshape(kv_shape4)
-        v = dense("v_proj", kv * self.head_dim)(x).reshape(kv_shape4)
+        if self.fused_qkv and kv == self.n_heads:
+            qkv = dense("qkv_proj", 3 * proj)(x)
+            q, k, v = jnp.split(qkv, 3, axis=-1)
+            q, k, v = (q.reshape(shape4), k.reshape(shape4),
+                       v.reshape(shape4))
+        else:
+            q = dense("q_proj", proj)(x).reshape(shape4)
+            k = dense("k_proj", kv * self.head_dim)(x).reshape(kv_shape4)
+            v = dense("v_proj", kv * self.head_dim)(x).reshape(kv_shape4)
 
         if decode_pos is not None:
             # single-token step at absolute position decode_pos: rope
@@ -233,12 +245,18 @@ def _dispatch_attention(q, k, v, *, impl: str, causal: bool, mesh=None):
 
 class _MLP(nn.Module):
     d_ff: int
+    fused_gate_up: bool = False  # one (d, 2*d_ff) matmul (see fused_qkv)
 
     @nn.compact
     def __call__(self, x):
         d_model = x.shape[-1]
-        gate = nn.Dense(self.d_ff, use_bias=False, name="gate")(x)
-        up = nn.Dense(self.d_ff, use_bias=False, name="up_proj")(x)
+        if self.fused_gate_up:
+            gu = nn.Dense(2 * self.d_ff, use_bias=False,
+                          name="gate_up")(x)
+            gate, up = jnp.split(gu, 2, axis=-1)
+        else:
+            gate = nn.Dense(self.d_ff, use_bias=False, name="gate")(x)
+            up = nn.Dense(self.d_ff, use_bias=False, name="up_proj")(x)
         h = nn.silu(gate) * up
         return nn.Dense(d_model, use_bias=False, name="down_proj")(h)
 
@@ -275,13 +293,15 @@ class _Block(nn.Module):
     dropout: float
     mesh: Any = None
     n_kv_heads: int = 0
+    fused_proj: bool = False
 
     @nn.compact
     def __call__(self, x, train: bool, decode_pos=None, cache_len: int = 0):
         h = nn.RMSNorm(name="attn_norm")(x)
         h = _Attention(self.n_heads, self.head_dim, self.attention,
                        self.causal, self.mesh,
-                       n_kv_heads=self.n_kv_heads, name="attn")(
+                       n_kv_heads=self.n_kv_heads,
+                       fused_qkv=self.fused_proj, name="attn")(
             h, train, decode_pos=decode_pos, cache_len=cache_len)
         if self.dropout and train:
             h = nn.Dropout(self.dropout, deterministic=False)(h)
@@ -292,7 +312,8 @@ class _Block(nn.Module):
             h, aux = _MoE(self.n_experts, self.d_ff, self.moe_k,
                           self.mesh, name="moe")(h)
         else:
-            h = _MLP(self.d_ff, name="mlp")(h)
+            h = _MLP(self.d_ff, fused_gate_up=self.fused_proj,
+                     name="mlp")(h)
         if self.dropout and train:
             h = nn.Dropout(self.dropout, deterministic=False)(h)
         return x + h, aux
@@ -346,6 +367,16 @@ class TransformerLM(nn.Module):
     dropout: float = 0.0
     mesh: Any = None
     fused_head_chunk: int = 0
+    # fuse q/k/v into one (d, 3*proj) matmul and gate/up into one
+    # (d, 2*d_ff) matmul — wider MXU output tiles at small d_model
+    # (the measured d=512 roofline gap). The param-tree layout depends
+    # ONLY on this config (never on the ambient mesh, so artifacts
+    # stay portable across mesh shapes): under GQA the attention
+    # self-gates back to separate q/k/v (unequal widths) while the
+    # MLP still fuses, and under TP the sharding rules REPLICATE the
+    # fused kernels (a column shard would cross block boundaries)
+    # instead of changing the tree.
+    fused_proj: bool = False
     # per-layer rematerialization under training: "none" saves all
     # activations, "dots" saves matmul outputs only (the standard TPU
     # memory/FLOPs trade), "full" recomputes everything in backward
@@ -359,6 +390,7 @@ class TransformerLM(nn.Module):
         d_ff = self.d_ff or 4 * self.d_model
         head_dim = self.d_model // self.n_heads
         mesh = self.mesh or mesh_lib.get_default_mesh()
+        fuse = self.fused_proj
 
         x = nn.Embed(self.vocab_size, self.d_model, name="embed")(tokens)
         if decode_pos is None:
@@ -392,7 +424,7 @@ class TransformerLM(nn.Module):
                                self.attention, self.causal,
                                self.n_experts, self.moe_k,
                                self.dropout, self.mesh,
-                               self.n_kv_heads,
+                               self.n_kv_heads, fuse,
                                name=f"layer_{i}")(
                 x, train, decode_pos, cache_len)
             aux_total = aux_total + aux
@@ -654,7 +686,8 @@ class LanguageModel:
     _CONFIG_KEYS = ("vocab_size", "d_model", "n_layers", "n_heads",
                     "n_kv_heads", "d_ff", "max_len", "attention",
                     "n_experts", "moe_k",
-                    "dropout", "aux_coef", "head_chunk", "remat")
+                    "dropout", "aux_coef", "head_chunk", "remat",
+                    "fused_proj")
 
     def __init__(self, vocab_size: int, d_model: int = 256,
                  n_layers: int = 4, n_heads: int = 4,
@@ -662,10 +695,11 @@ class LanguageModel:
                  max_len: int = 512, attention: str = "auto",
                  n_experts: int = 0, moe_k: int = 2, dropout: float = 0.0,
                  aux_coef: float = 0.01, head_chunk: Optional[int] = None,
-                 remat: Optional[str] = None,
+                 remat: Optional[str] = None, fused_proj: bool = False,
                  name: str = "language_model"):
         self.name = name
         self.head_chunk = head_chunk
+        self.fused_proj = bool(fused_proj)
         # LO_TLM_REMAT env overrides; default "none" (measure before
         # paying recompute FLOPs — see BENCHMARKS.md queued table)
         self.remat = remat
@@ -755,6 +789,12 @@ class LanguageModel:
             rules = ((r".*(k_proj|v_proj)/kernel$", P()),) + rules
         if tp_size > 1 and self.n_heads % tp_size:
             rules = ((r".*(q_proj|o_proj)/kernel$", P()),) + rules
+        if tp_size > 1:
+            # fused projections: a column shard of the [q|k|v] (or
+            # [gate|up]) concatenation crosses block boundaries, so
+            # replicate — the param tree never changes with the mesh
+            # (artifact portability); FSDP may still storage-shard
+            rules = ((r".*(qkv_proj|gate_up)/kernel$", P()),) + rules
         return rules
 
     def _resolved_remat(self) -> str:
@@ -767,6 +807,21 @@ class LanguageModel:
                 f"unknown remat policy {value!r} (none|dots|full)")
         return value
 
+    def _resolved_fused_proj(self) -> bool:
+        env = os.environ.get("LO_TLM_FUSED_PROJ")
+        if not env:  # unset or empty -> constructor value
+            return self.fused_proj
+        value = env.strip().lower()
+        if value in ("1", "true", "yes"):
+            return True
+        if value in ("0", "false", "no"):
+            return False
+        # fail at resolution, not by silently measuring the wrong
+        # path (the _resolved_remat convention)
+        raise ValueError(
+            f"LO_TLM_FUSED_PROJ={env!r} (want 1/true/yes or "
+            f"0/false/no)")
+
     def _module_for(self, seq_len: Optional[int] = None) -> TransformerLM:
         return TransformerLM(
             vocab_size=self.vocab_size, d_model=self.d_model,
@@ -776,7 +831,8 @@ class LanguageModel:
             n_experts=self.n_experts, moe_k=self.moe_k,
             dropout=self.dropout, mesh=self._mesh_override,
             fused_head_chunk=self._head_chunk(),
-            remat=self._resolved_remat())
+            remat=self._resolved_remat(),
+            fused_proj=self._resolved_fused_proj())
 
     @property
     def module(self) -> TransformerLM:

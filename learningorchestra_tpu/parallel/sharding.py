@@ -28,7 +28,12 @@ Rule = Tuple[str, P]
 # collective per block is one reduce-scatter/all-gather pair inserted
 # by XLA.
 TRANSFORMER_RULES: Sequence[Rule] = (
-    (r".*(q_proj|k_proj|v_proj|wi|gate|up_proj)/kernel$",
+    # qkv_proj/gate_up are the fused-projection layouts; under tp > 1
+    # the model's _param_rules prepends a replicate override for them
+    # (a column shard would cross the concatenation's block
+    # boundaries), so their TP entry here serves meshes without tp
+    (r".*(q_proj|k_proj|v_proj|qkv_proj|wi|gate|gate_up|up_proj)"
+     r"/kernel$",
      P(None, mesh_lib.TP)),
     (r".*(o_proj|wo|down_proj)/kernel$", P(mesh_lib.TP, None)),
     (r".*embed/embedding$", P(None, mesh_lib.TP)),

@@ -571,3 +571,112 @@ def test_gqa_artifact_round_trip(tmp_path):
     np.testing.assert_allclose(model.predict(x[:4], batch_size=4),
                                loaded.predict(x[:4], batch_size=4),
                                atol=1e-5)
+
+
+# ----------------------------------------------------------------------
+# fused q/k/v + gate/up projections (the d=512 MXU-tiling experiment)
+# ----------------------------------------------------------------------
+def test_fused_proj_matches_unfused_math(tmp_path):
+    """fused_proj concatenates the SAME three projections into one
+    matmul: splitting an unfused init into the fused layout must give
+    bit-comparable logits."""
+    from learningorchestra_tpu.models import transformer as T
+
+    _mesh_config(tmp_path, "dp=1")
+    kw = dict(vocab_size=32, d_model=16, n_layers=1, n_heads=2,
+              attention="dot")
+    plain = T.TransformerLM(**kw)
+    fused = T.TransformerLM(fused_proj=True, **kw)
+    toks = (np.arange(2 * 8).reshape(2, 8) % 31 + 1).astype(np.int32)
+    params = plain.init(jax.random.PRNGKey(0), jnp.asarray(toks))["params"]
+
+    fp = jax.tree_util.tree_map(lambda x: x, params)  # shallow copy
+    attn = dict(fp["layer_0"]["attn"])
+    attn["qkv_proj"] = {"kernel": jnp.concatenate(
+        [attn.pop("q_proj")["kernel"], attn.pop("k_proj")["kernel"],
+         attn.pop("v_proj")["kernel"]], axis=1)}
+    mlp = dict(fp["layer_0"]["mlp"])
+    mlp["gate_up"] = {"kernel": jnp.concatenate(
+        [mlp.pop("gate")["kernel"], mlp.pop("up_proj")["kernel"]],
+        axis=1)}
+    fp["layer_0"] = dict(fp["layer_0"], attn=attn, mlp=mlp)
+
+    lg_plain, _ = plain.apply({"params": params}, jnp.asarray(toks))
+    lg_fused, _ = fused.apply({"params": fp}, jnp.asarray(toks))
+    np.testing.assert_allclose(np.asarray(lg_fused),
+                               np.asarray(lg_plain), atol=1e-5)
+
+
+def test_fused_proj_trains_and_generates(tmp_path):
+    _mesh_config(tmp_path, "dp=1")
+    lm = LanguageModel(vocab_size=32, d_model=16, n_layers=1,
+                       n_heads=2, max_len=12, attention="dot",
+                       fused_proj=True)
+    x = _toy_tokens(n=16, seq=8, vocab=32)
+    hist = lm.fit(x, batch_size=8, epochs=2)
+    assert np.isfinite(hist.history["loss"][0])
+    attn = lm.params["layer_0"]["attn"]
+    assert "qkv_proj" in attn and "q_proj" not in attn
+    assert "gate_up" in lm.params["layer_0"]["mlp"]
+    gen = lm.generate(x[:1, :4], max_new_tokens=4, temperature=0.0)
+    assert gen.shape == (1, 8)
+
+
+def test_fused_proj_tree_is_mesh_independent(tmp_path):
+    """The param tree depends only on the model config: a fused
+    artifact trained on a tp=1 mesh loads and predicts under tp=2 —
+    the sharding rules replicate the fused kernels there (a column
+    shard would cross q/k/v block boundaries) instead of changing
+    the tree."""
+    _mesh_config(tmp_path, "dp=1")
+    lm = LanguageModel(vocab_size=32, d_model=16, n_layers=1,
+                       n_heads=2, max_len=12, attention="dot",
+                       fused_proj=True, name="fp_rt")
+    x = _toy_tokens(n=8, seq=8, vocab=32)
+    lm.fit(x, batch_size=8, epochs=1)
+    art = tmp_path / "artifact"
+    os.makedirs(art)
+    lm.__lo_save__(str(art))
+    p_ref = lm.predict(x[:4], batch_size=4)
+
+    _mesh_config(tmp_path, "tp=2")
+    loaded = LanguageModel.__lo_load__(str(art))
+    assert "qkv_proj" in loaded.params["layer_0"]["attn"]
+    p_tp = loaded.predict(x[:4], batch_size=4)
+    np.testing.assert_allclose(p_tp, p_ref, atol=1e-5)
+    # and the tp rules replicate the fused kernels
+    mesh = mesh_lib.build_mesh("tp=2")
+    spec = sharding_lib.spec_for(
+        "layer_0/attn/qkv_proj/kernel", (16, 48), mesh,
+        loaded._param_rules(mesh), fsdp=False)
+    assert "tp" not in tuple(jax.tree_util.tree_leaves(tuple(spec))
+                             or ())
+
+
+def test_fused_proj_gqa_keeps_mlp_fusion(tmp_path):
+    """Under GQA only the q/k/v widths differ: attention self-gates
+    back to separate projections while the MLP still fuses."""
+    from learningorchestra_tpu.models import transformer as T
+
+    _mesh_config(tmp_path, "dp=1")
+    mod = T.TransformerLM(vocab_size=32, d_model=16, n_layers=1,
+                          n_heads=2, n_kv_heads=1, attention="dot",
+                          fused_proj=True)
+    params = mod.init(jax.random.PRNGKey(0),
+                      jnp.zeros((1, 8), jnp.int32))["params"]
+    assert "q_proj" in params["layer_0"]["attn"]
+    assert "qkv_proj" not in params["layer_0"]["attn"]
+    assert "gate_up" in params["layer_0"]["mlp"]
+
+
+def test_fused_proj_env_override_strict(tmp_path, monkeypatch):
+    _mesh_config(tmp_path, "dp=1")
+    lm = LanguageModel(vocab_size=8, d_model=8, n_heads=2,
+                       fused_proj=True)
+    monkeypatch.setenv("LO_TLM_FUSED_PROJ", "0")
+    assert lm._resolved_fused_proj() is False
+    monkeypatch.setenv("LO_TLM_FUSED_PROJ", "on")
+    with pytest.raises(ValueError, match="LO_TLM_FUSED_PROJ"):
+        lm._resolved_fused_proj()
+    monkeypatch.setenv("LO_TLM_FUSED_PROJ", "")
+    assert lm._resolved_fused_proj() is True
