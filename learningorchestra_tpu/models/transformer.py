@@ -94,6 +94,39 @@ class _Experts(nn.Module):
         return wi, wo
 
 
+class _LoRADense(nn.Module):
+    """Dense with an additive low-rank adapter: y = xW + (xA)B·(α/r).
+
+    A is init'd like a normal layer, B at zero, so step 0 reproduces
+    the base model exactly. The base ``kernel`` keeps the plain
+    nn.Dense param name/shape, so existing artifacts load into the
+    LoRA variant unchanged (the adapters init fresh) and sharding
+    rules keyed on the module path still match."""
+
+    features: int
+    rank: int
+    alpha: float
+
+    @nn.compact
+    def __call__(self, x):
+        kernel = self.param("kernel", nn.initializers.lecun_normal(),
+                            (x.shape[-1], self.features))
+        y = x @ kernel
+        a = self.param("lora_a", nn.initializers.lecun_normal(),
+                       (x.shape[-1], self.rank))
+        b = self.param("lora_b", nn.initializers.zeros,
+                       (self.rank, self.features))
+        scale = jnp.asarray(self.alpha / self.rank, x.dtype)
+        return y + (x @ a.astype(x.dtype)) @ b.astype(x.dtype) * scale
+
+
+def _make_dense(name: str, features: int, lora_rank: int,
+                lora_alpha: float):
+    if lora_rank > 0:
+        return _LoRADense(features, lora_rank, lora_alpha, name=name)
+    return nn.Dense(features, use_bias=False, name=name)
+
+
 class _Attention(nn.Module):
     """Multi-head attention with optional grouped-query KV heads.
 
@@ -120,6 +153,9 @@ class _Attention(nn.Module):
     # MHA only — under GQA the q/k/v widths differ and column-sharding
     # the concatenation would split across block boundaries.
     fused_qkv: bool = False
+    # LoRA adapters on the attention projections (rank 0 = off)
+    lora_rank: int = 0
+    lora_alpha: float = 16.0
 
     @property
     def kv_heads(self) -> int:
@@ -140,8 +176,8 @@ class _Attention(nn.Module):
                 f"n_kv_heads={kv} must divide n_heads={self.n_heads}")
         group = self.n_heads // kv
         proj = self.n_heads * self.head_dim
-        dense = lambda name, feats: nn.Dense(  # noqa: E731
-            feats, use_bias=False, name=name)
+        dense = lambda name, feats: _make_dense(  # noqa: E731
+            name, feats, self.lora_rank, self.lora_alpha)
         b, s, _ = x.shape
         shape4 = (b, s, self.n_heads, self.head_dim)
         kv_shape4 = (b, s, kv, self.head_dim)
@@ -294,6 +330,8 @@ class _Block(nn.Module):
     mesh: Any = None
     n_kv_heads: int = 0
     fused_proj: bool = False
+    lora_rank: int = 0
+    lora_alpha: float = 16.0
 
     @nn.compact
     def __call__(self, x, train: bool, decode_pos=None, cache_len: int = 0):
@@ -301,7 +339,9 @@ class _Block(nn.Module):
         h = _Attention(self.n_heads, self.head_dim, self.attention,
                        self.causal, self.mesh,
                        n_kv_heads=self.n_kv_heads,
-                       fused_qkv=self.fused_proj, name="attn")(
+                       fused_qkv=self.fused_proj,
+                       lora_rank=self.lora_rank,
+                       lora_alpha=self.lora_alpha, name="attn")(
             h, train, decode_pos=decode_pos, cache_len=cache_len)
         if self.dropout and train:
             h = nn.Dropout(self.dropout, deterministic=False)(h)
@@ -377,6 +417,11 @@ class TransformerLM(nn.Module):
     # fused kernels (a column shard would cross block boundaries)
     # instead of changing the tree.
     fused_proj: bool = False
+    # LoRA: rank-r adapters on the attention projections; the base
+    # kernels keep their plain names/shapes so a pre-trained artifact
+    # loads into the LoRA variant unchanged (adapters init fresh)
+    lora_rank: int = 0
+    lora_alpha: float = 16.0
     # per-layer rematerialization under training: "none" saves all
     # activations, "dots" saves matmul outputs only (the standard TPU
     # memory/FLOPs trade), "full" recomputes everything in backward
@@ -425,6 +470,7 @@ class TransformerLM(nn.Module):
                                self.n_experts, self.moe_k,
                                self.dropout, self.mesh,
                                self.n_kv_heads, fuse,
+                               self.lora_rank, self.lora_alpha,
                                name=f"layer_{i}")(
                 x, train, decode_pos, cache_len)
             aux_total = aux_total + aux
@@ -676,6 +722,24 @@ def token_accuracy(outputs, batch, weights):
 # ----------------------------------------------------------------------
 # keras-shaped wrapper (the stored lineage-root instance)
 # ----------------------------------------------------------------------
+def _lora_optimizer(base):
+    """Freeze everything except ``lora_*`` leaves: optax.multi_transform
+    routes adapter params through the real optimizer and pins the base
+    weights with set_to_zero — so optimizer state (adam mu/nu) exists
+    ONLY for the adapters, the actual memory win of LoRA."""
+    import optax
+
+    def labels(params):
+        def label(path, _):
+            leaf = getattr(path[-1], "key", str(path[-1]))
+            return "lora" if str(leaf).startswith("lora_") else "frozen"
+
+        return jax.tree_util.tree_map_with_path(label, params)
+
+    return optax.multi_transform(
+        {"lora": base, "frozen": optax.set_to_zero()}, labels)
+
+
 class LanguageModel:
     """Trainable LM artifact with the reference's method-call surface.
 
@@ -687,7 +751,7 @@ class LanguageModel:
                     "n_kv_heads", "d_ff", "max_len", "attention",
                     "n_experts", "moe_k",
                     "dropout", "aux_coef", "head_chunk", "remat",
-                    "fused_proj")
+                    "fused_proj", "lora_rank", "lora_alpha")
 
     def __init__(self, vocab_size: int, d_model: int = 256,
                  n_layers: int = 4, n_heads: int = 4,
@@ -696,10 +760,15 @@ class LanguageModel:
                  n_experts: int = 0, moe_k: int = 2, dropout: float = 0.0,
                  aux_coef: float = 0.01, head_chunk: Optional[int] = None,
                  remat: Optional[str] = None, fused_proj: bool = False,
+                 lora_rank: int = 0, lora_alpha: float = 16.0,
                  name: str = "language_model"):
         self.name = name
         self.head_chunk = head_chunk
         self.fused_proj = bool(fused_proj)
+        self.lora_rank = int(lora_rank)
+        self.lora_alpha = float(lora_alpha)
+        if self.lora_rank < 0:
+            raise ValueError(f"lora_rank must be >= 0, got {lora_rank}")
         # LO_TLM_REMAT env overrides; default "none" (measure before
         # paying recompute FLOPs — see BENCHMARKS.md queued table)
         self.remat = remat
@@ -832,7 +901,8 @@ class LanguageModel:
             dropout=self.dropout, mesh=self._mesh_override,
             fused_head_chunk=self._head_chunk(),
             remat=self._resolved_remat(),
-            fused_proj=self._resolved_fused_proj())
+            fused_proj=self._resolved_fused_proj(),
+            lora_rank=self.lora_rank, lora_alpha=self.lora_alpha)
 
     @property
     def module(self) -> TransformerLM:
@@ -890,13 +960,16 @@ class LanguageModel:
                 attn = 6.0 * self.n_layers * b * s * s * self.d_model
                 return 6.0 * max(matmul_params, 0) * b * s + attn
 
+            optimizer = build_optimizer(self.optimizer_spec)
+            if self.lora_rank > 0:
+                optimizer = _lora_optimizer(optimizer)
             self._engine = engine_lib.Engine(
                 apply_fn=self._apply_fn,
                 loss_fn=next_token_loss(
                     self.aux_coef,
                     head_chunk=self._head_chunk() or 1024,
                     mesh=mesh),
-                optimizer=build_optimizer(self.optimizer_spec),
+                optimizer=optimizer,
                 mesh=mesh,
                 metrics={"accuracy": token_accuracy},
                 compute_dtype=dtype,
@@ -1121,6 +1194,76 @@ class LanguageModel:
             raise RuntimeError(
                 "model has no parameters yet — call fit() first "
                 "(or load a trained artifact)")
+
+    def enable_lora(self, rank: int, alpha: float = 16.0) -> None:
+        """Attach fresh rank-``rank`` adapters to a trained model: the
+        base kernels keep their values (B inits at zero, so step-0
+        predictions are unchanged) and subsequent fit() updates ONLY
+        the adapters (frozen-base optimizer). Reachable through the
+        reference's call-method-on-stored-object train contract."""
+        if self.lora_rank > 0:
+            raise RuntimeError(
+                f"model already has LoRA adapters (rank "
+                f"{self.lora_rank}); merge_lora() first")
+        if int(rank) <= 0:
+            raise ValueError(f"rank must be >= 1, got {rank}")
+        self._require_built()
+        self.lora_rank = int(rank)
+        self.lora_alpha = float(alpha)
+        sample = jnp.zeros((1, min(8, self.max_len)), jnp.int32)
+        fresh = self._module_for(None).init(
+            jax.random.PRNGKey(self.seed), sample)["params"]
+
+        def graft(fresh_node, old_node, path=""):
+            if isinstance(fresh_node, dict):
+                old = old_node if isinstance(old_node, dict) else {}
+                return {k: graft(v, old.get(k), f"{path}/{k}")
+                        for k, v in fresh_node.items()}
+            if old_node is not None:
+                return old_node
+            # ONLY adapters may init fresh — any other missing leaf
+            # means the trained tree's layout doesn't match this
+            # config (e.g. a fused_proj env toggle) and silently
+            # re-initializing it would discard trained weights
+            if path.rsplit("/", 1)[-1].startswith("lora_"):
+                return fresh_node
+            raise ValueError(
+                f"enable_lora: trained params have no leaf at "
+                f"{path!r} — the model config resolves to a "
+                f"different param layout (fused_proj/attention "
+                f"mismatch?); refusing to re-initialize a base "
+                f"weight")
+
+        self.params = graft(fresh, engine_lib.to_host(self.params))
+        self._engine = None
+        self._state = None
+        self._gen_cache_fns = {}
+
+    def merge_lora(self) -> None:
+        """Fold the adapters into the base kernels (W += A·B·α/r) and
+        drop them: the model becomes a plain artifact, numerically
+        identical to the adapted one, loadable anywhere without LoRA
+        config."""
+        if self.lora_rank <= 0:
+            raise RuntimeError("model has no LoRA adapters to merge")
+        self._require_built()
+        scale = self.lora_alpha / self.lora_rank
+
+        def walk(node):
+            if isinstance(node, dict):
+                if "lora_a" in node and "kernel" in node:
+                    merged = node["kernel"] + np.asarray(
+                        node["lora_a"]) @ np.asarray(
+                        node["lora_b"]) * scale
+                    return {"kernel": merged}
+                return {k: walk(v) for k, v in node.items()}
+            return node
+
+        self.params = walk(engine_lib.to_host(self.params))
+        self.lora_rank = 0
+        self._engine = None
+        self._state = None
+        self._gen_cache_fns = {}
 
     def num_params(self) -> int:
         if self.params is None:

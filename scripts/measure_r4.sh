@@ -51,6 +51,8 @@ run() {  # run NAME ENV... -- ARGS...
 # the d=512 roofline pair (VERDICT next-round #2) first
 run tlm_fused LO_NOOP=1 -- --phase tlm
 run tlm_unfused LO_LM_HEAD_CHUNK=0 -- --phase tlm
+# fused q/k/v + gate/up projections (wider MXU output tiles at d=512)
+run tlm_fused_proj LO_TLM_FUSED_PROJ=1 -- --phase tlm
 # long-context MFU on the flash path (VERDICT #1)
 run tlm_longctx LO_BENCH_TLM_SEQ=2048 LO_BENCH_TLM_D=1024 \
     LO_BENCH_TLM_LAYERS=12 LO_BENCH_TLM_HEADS=16 LO_BENCH_TLM_FF=4096 \

@@ -680,3 +680,80 @@ def test_fused_proj_env_override_strict(tmp_path, monkeypatch):
         lm._resolved_fused_proj()
     monkeypatch.setenv("LO_TLM_FUSED_PROJ", "")
     assert lm._resolved_fused_proj() is True
+
+
+# ----------------------------------------------------------------------
+# LoRA fine-tuning
+# ----------------------------------------------------------------------
+def test_lora_fit_trains_only_adapters(tmp_path):
+    """With lora_rank set, fit() must leave every base kernel
+    bit-identical and move only lora_a/lora_b (the frozen-base
+    multi_transform optimizer)."""
+    _mesh_config(tmp_path, "dp=1")
+    lm = LanguageModel(vocab_size=32, d_model=16, n_layers=1,
+                       n_heads=2, max_len=12, attention="dot",
+                       lora_rank=4)
+    x = _toy_tokens(n=16, seq=8, vocab=32)
+    lm.fit(x, batch_size=8, epochs=1)  # builds params
+    import jax.tree_util as jtu
+    before = {jtu.keystr(p): np.asarray(v)
+              for p, v in jtu.tree_flatten_with_path(lm.params)[0]}
+    lm.fit(x, batch_size=8, epochs=3)
+    after = {jtu.keystr(p): np.asarray(v)
+             for p, v in jtu.tree_flatten_with_path(lm.params)[0]}
+    moved = {k for k in before
+             if not np.array_equal(before[k], after[k])}
+    assert moved, "nothing trained at all"
+    assert all("lora_" in k for k in moved), moved
+    frozen = {k for k in before if "lora_" not in k}
+    assert frozen and all(np.array_equal(before[k], after[k])
+                          for k in frozen)
+
+
+def test_lora_enable_merge_roundtrip(tmp_path):
+    """Plain pretrain -> enable_lora (step-0 predictions unchanged:
+    B=0) -> adapter fit -> merge_lora folds W += A·B·α/r with
+    identical predictions and a plain param tree."""
+    _mesh_config(tmp_path, "dp=1")
+    lm = LanguageModel(vocab_size=32, d_model=16, n_layers=1,
+                       n_heads=2, max_len=12, attention="dot")
+    x = _toy_tokens(n=16, seq=8, vocab=32)
+    lm.fit(x, batch_size=8, epochs=2)
+    base_pred = lm.predict(x[:4], batch_size=4)
+
+    lm.enable_lora(rank=4)
+    np.testing.assert_allclose(lm.predict(x[:4], batch_size=4),
+                               base_pred, atol=1e-5)
+    lm.fit(x, batch_size=8, epochs=3)
+    adapted_pred = lm.predict(x[:4], batch_size=4)
+
+    lm.merge_lora()
+    assert lm.lora_rank == 0
+    flat = jax.tree_util.tree_flatten_with_path(lm.params)[0]
+    assert not any("lora_" in jax.tree_util.keystr(p)
+                   for p, _ in flat)
+    np.testing.assert_allclose(lm.predict(x[:4], batch_size=4),
+                               adapted_pred, atol=1e-4)
+    # double-merge and re-enable guards
+    with pytest.raises(RuntimeError):
+        lm.merge_lora()
+    lm.enable_lora(rank=2)
+    with pytest.raises(RuntimeError):
+        lm.enable_lora(rank=2)
+
+
+def test_lora_artifact_round_trip(tmp_path):
+    _mesh_config(tmp_path, "dp=1")
+    lm = LanguageModel(vocab_size=32, d_model=16, n_layers=1,
+                       n_heads=2, max_len=12, attention="dot",
+                       lora_rank=2, name="lora_rt")
+    x = _toy_tokens(n=8, seq=8, vocab=32)
+    lm.fit(x, batch_size=8, epochs=1)
+    art = tmp_path / "artifact"
+    os.makedirs(art)
+    lm.__lo_save__(str(art))
+    loaded = LanguageModel.__lo_load__(str(art))
+    assert loaded.lora_rank == 2
+    np.testing.assert_allclose(loaded.predict(x[:4], batch_size=4),
+                               lm.predict(x[:4], batch_size=4),
+                               atol=1e-5)
