@@ -156,6 +156,8 @@ class _Attention(nn.Module):
     # LoRA adapters on the attention projections (rank 0 = off)
     lora_rank: int = 0
     lora_alpha: float = 16.0
+    # sliding-window (banded causal) attention; 0 = unlimited
+    window: int = 0
 
     @property
     def kv_heads(self) -> int:
@@ -216,6 +218,10 @@ class _Attention(nn.Module):
                 preferred_element_type=jnp.float32,
             ) / math.sqrt(self.head_dim)
             visible = jnp.arange(cache_len) <= decode_pos
+            if self.window > 0:
+                visible = jnp.logical_and(
+                    visible,
+                    jnp.arange(cache_len) > decode_pos - self.window)
             scores = jnp.where(visible[None, None, None, None, :], scores,
                                ring_lib.NEG_INF)
             p = jax.nn.softmax(scores, axis=-1)
@@ -235,12 +241,18 @@ class _Attention(nn.Module):
                 k = jnp.repeat(k, group, axis=2)
                 v = jnp.repeat(v, group, axis=2)
             o = _dispatch_attention(q, k, v, impl=self.impl,
-                                    causal=self.causal, mesh=self.mesh)
+                                    causal=self.causal, mesh=self.mesh,
+                                    window=self.window)
         o = o.reshape(b, s, proj)
         return dense("o_proj", d_model)(o)
 
 
-def _dispatch_attention(q, k, v, *, impl: str, causal: bool, mesh=None):
+def _dispatch_attention(q, k, v, *, impl: str, causal: bool, mesh=None,
+                        window: int = 0):
+    if window > 0 and impl in ("ring", "ulysses"):
+        raise ValueError(
+            f"sliding_window is not supported with {impl} sequence "
+            f"parallelism (use dot/flash, or window=0)")
     mesh = mesh or mesh_lib.get_default_mesh()
     b, s, h, _ = q.shape
     data_size = mesh_lib.data_parallel_size(mesh)
@@ -259,7 +271,8 @@ def _dispatch_attention(q, k, v, *, impl: str, causal: bool, mesh=None):
     if impl == "flash":
         sharded = tp > 1 or data_size > 1
         if not sharded:
-            return attn_ops.flash_attention(q, k, v, causal=causal)
+            return attn_ops.flash_attention(q, k, v, causal=causal,
+                                            window=window)
         if b % data_size == 0 and h % tp == 0:
             # pallas_call is opaque to GSPMD — shard_map it so each
             # device runs the kernel on its local (batch, heads) tile
@@ -270,13 +283,14 @@ def _dispatch_attention(q, k, v, *, impl: str, causal: bool, mesh=None):
             # check_vma=False: pallas_call emits ShapeDtypeStructs with
             # no varying-mesh-axes info, which the vma checker rejects
             fn = jax.shard_map(
-                lambda a, b_, c: attn_ops.flash_attention(a, b_, c,
-                                                          causal=causal),
+                lambda a, b_, c: attn_ops.flash_attention(
+                    a, b_, c, causal=causal, window=window),
                 mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
                 check_vma=False)
             return fn(q, k, v)
     # "dot" and all fallbacks (no sp axis, non-divisible shapes)
-    return ring_lib.full_attention_reference(q, k, v, causal=causal)
+    return ring_lib.full_attention_reference(q, k, v, causal=causal,
+                                             window=window)
 
 
 class _MLP(nn.Module):
@@ -332,6 +346,7 @@ class _Block(nn.Module):
     fused_proj: bool = False
     lora_rank: int = 0
     lora_alpha: float = 16.0
+    window: int = 0
 
     @nn.compact
     def __call__(self, x, train: bool, decode_pos=None, cache_len: int = 0):
@@ -341,7 +356,8 @@ class _Block(nn.Module):
                        n_kv_heads=self.n_kv_heads,
                        fused_qkv=self.fused_proj,
                        lora_rank=self.lora_rank,
-                       lora_alpha=self.lora_alpha, name="attn")(
+                       lora_alpha=self.lora_alpha,
+                       window=self.window, name="attn")(
             h, train, decode_pos=decode_pos, cache_len=cache_len)
         if self.dropout and train:
             h = nn.Dropout(self.dropout, deterministic=False)(h)
@@ -422,6 +438,10 @@ class TransformerLM(nn.Module):
     # loads into the LoRA variant unchanged (adapters init fresh)
     lora_rank: int = 0
     lora_alpha: float = 16.0
+    # sliding-window attention (banded causal, Mistral-style): query p
+    # attends [p-W+1, p]; flash predicates out-of-band tiles off so
+    # MXU work scales ~O(s*W). dot/flash only.
+    sliding_window: int = 0
     # per-layer rematerialization under training: "none" saves all
     # activations, "dots" saves matmul outputs only (the standard TPU
     # memory/FLOPs trade), "full" recomputes everything in backward
@@ -471,6 +491,7 @@ class TransformerLM(nn.Module):
                                self.dropout, self.mesh,
                                self.n_kv_heads, fuse,
                                self.lora_rank, self.lora_alpha,
+                               self.sliding_window,
                                name=f"layer_{i}")(
                 x, train, decode_pos, cache_len)
             aux_total = aux_total + aux
@@ -751,7 +772,8 @@ class LanguageModel:
                     "n_kv_heads", "d_ff", "max_len", "attention",
                     "n_experts", "moe_k",
                     "dropout", "aux_coef", "head_chunk", "remat",
-                    "fused_proj", "lora_rank", "lora_alpha")
+                    "fused_proj", "lora_rank", "lora_alpha",
+                    "sliding_window")
 
     def __init__(self, vocab_size: int, d_model: int = 256,
                  n_layers: int = 4, n_heads: int = 4,
@@ -761,6 +783,7 @@ class LanguageModel:
                  aux_coef: float = 0.01, head_chunk: Optional[int] = None,
                  remat: Optional[str] = None, fused_proj: bool = False,
                  lora_rank: int = 0, lora_alpha: float = 16.0,
+                 sliding_window: int = 0,
                  name: str = "language_model"):
         self.name = name
         self.head_chunk = head_chunk
@@ -769,6 +792,14 @@ class LanguageModel:
         self.lora_alpha = float(lora_alpha)
         if self.lora_rank < 0:
             raise ValueError(f"lora_rank must be >= 0, got {lora_rank}")
+        self.sliding_window = int(sliding_window)
+        if self.sliding_window < 0:
+            raise ValueError(
+                f"sliding_window must be >= 0, got {sliding_window}")
+        if self.sliding_window and attention in ("ring", "ulysses"):
+            raise ValueError(
+                "sliding_window is not supported with ring/ulysses "
+                "sequence parallelism")
         # LO_TLM_REMAT env overrides; default "none" (measure before
         # paying recompute FLOPs — see BENCHMARKS.md queued table)
         self.remat = remat
@@ -902,7 +933,8 @@ class LanguageModel:
             fused_head_chunk=self._head_chunk(),
             remat=self._resolved_remat(),
             fused_proj=self._resolved_fused_proj(),
-            lora_rank=self.lora_rank, lora_alpha=self.lora_alpha)
+            lora_rank=self.lora_rank, lora_alpha=self.lora_alpha,
+            sliding_window=self.sliding_window)
 
     @property
     def module(self) -> TransformerLM:

@@ -89,7 +89,7 @@ def _resolve_blocks(block_q: Optional[int], block_k: Optional[int],
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
                 acc_ref, m_ref, l_ref,
                 *, scale: float, causal: bool, kv_len: int,
-                block_q: int, block_k: int):
+                block_q: int, block_k: int, window: int = 0):
     i = pl.program_id(1)
     j = pl.program_id(2)
     nk = pl.num_programs(2)
@@ -100,10 +100,14 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
         l_ref[...] = jnp.zeros_like(l_ref)
         acc_ref[...] = jnp.zeros_like(acc_ref)
 
-    # causal: skip K/V tiles strictly above the diagonal band
+    # causal: skip K/V tiles strictly above the diagonal band;
+    # sliding window: also skip tiles wholly below it
     run = True
     if causal:
         run = j * block_k <= i * block_q + block_q - 1
+    if window > 0:
+        run = jnp.logical_and(
+            run, (j + 1) * block_k - 1 >= i * block_q - window + 1)
 
     @pl.when(run)
     def _tile():
@@ -117,10 +121,13 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
         col = j * block_k + lax.broadcasted_iota(
             jnp.int32, (block_q, block_k), 1)
         valid = col < kv_len
-        if causal:
+        if causal or window > 0:
             row = i * block_q + lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0)
+        if causal:
             valid = jnp.logical_and(valid, row >= col)
+        if window > 0:
+            valid = jnp.logical_and(valid, col > row - window)
         s = jnp.where(valid, s, NEG_INF)
 
         m_prev = m_ref[:, :1]                              # (bq, 1)
@@ -149,7 +156,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
 
 
 def _fwd_pallas(q, k, v, *, scale: float, causal: bool,
-                block_q: int, block_k: int, interpret: bool
+                block_q: int, block_k: int, interpret: bool,
+                window: int = 0
                 ) -> Tuple[jax.Array, jax.Array]:
     """q/k/v: (bh, s, d) — returns (o (bh, sq, d), lse (bh, sq))."""
     bh, sq, d = q.shape
@@ -165,7 +173,7 @@ def _fwd_pallas(q, k, v, *, scale: float, causal: bool,
     grid = (bh, sq_p // block_q, sk_p // block_k)
     kernel = functools.partial(
         _fwd_kernel, scale=scale, causal=causal, kv_len=sk,
-        block_q=block_q, block_k=block_k)
+        block_q=block_q, block_k=block_k, window=window)
     lanes = 128
     scratch = [
         pltpu.VMEM((block_q, d_p), jnp.float32),
@@ -205,7 +213,7 @@ def _fwd_pallas(q, k, v, *, scale: float, causal: bool,
 def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                    dq_ref, dq_acc_ref,
                    *, scale: float, causal: bool, kv_len: int,
-                   block_q: int, block_k: int):
+                   block_q: int, block_k: int, window: int = 0):
     """Grid (bh, q_blocks, kv_blocks): Q/dO resident, K/V stream."""
     i = pl.program_id(1)
     j = pl.program_id(2)
@@ -218,6 +226,9 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     run = True
     if causal:
         run = j * block_k <= i * block_q + block_q - 1
+    if window > 0:
+        run = jnp.logical_and(
+            run, (j + 1) * block_k - 1 >= i * block_q - window + 1)
 
     @pl.when(run)
     def _tile():
@@ -234,10 +245,13 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         col = j * block_k + lax.broadcasted_iota(
             jnp.int32, (block_q, block_k), 1)
         valid = col < kv_len
-        if causal:
+        if causal or window > 0:
             row = i * block_q + lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0)
+        if causal:
             valid = jnp.logical_and(valid, row >= col)
+        if window > 0:
+            valid = jnp.logical_and(valid, col > row - window)
         p = jnp.where(valid, jnp.exp(s - lse), 0.0)
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())),
@@ -255,7 +269,7 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                     dk_ref, dv_ref, dk_acc_ref, dv_acc_ref,
                     *, scale: float, causal: bool, kv_len: int,
-                    block_q: int, block_k: int):
+                    block_q: int, block_k: int, window: int = 0):
     """Grid (bh, kv_blocks, q_blocks): K/V resident, Q/dO stream."""
     j = pl.program_id(1)
     i = pl.program_id(2)
@@ -269,6 +283,9 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     run = True
     if causal:
         run = j * block_k <= i * block_q + block_q - 1
+    if window > 0:
+        run = jnp.logical_and(
+            run, (j + 1) * block_k - 1 >= i * block_q - window + 1)
 
     @pl.when(run)
     def _tile():
@@ -285,10 +302,13 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         col = j * block_k + lax.broadcasted_iota(
             jnp.int32, (block_q, block_k), 1)
         valid = col < kv_len
-        if causal:
+        if causal or window > 0:
             row = i * block_q + lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0)
+        if causal:
             valid = jnp.logical_and(valid, row >= col)
+        if window > 0:
+            valid = jnp.logical_and(valid, col > row - window)
         p = jnp.where(valid, jnp.exp(s - lse), 0.0)         # (bq, bk)
         dv_acc_ref[...] += jax.lax.dot_general(
             p, do, (((0,), (0,)), ((), ())),
@@ -309,7 +329,7 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 def _bwd_pallas(q, k, v, o, lse, do, *, scale: float, causal: bool,
                 block_q: int, block_k: int, interpret: bool,
-                dlse=None):
+                dlse=None, window: int = 0):
     """q/k/v/o/do: (bh, s, d), lse: (bh, sq). Returns (dq, dk, dv).
 
     ``dlse`` (bh, sq), when given, is the upstream gradient on the
@@ -350,7 +370,8 @@ def _bwd_pallas(q, k, v, o, lse, do, *, scale: float, causal: bool,
                               lambda b, i, j: (b, i, 0))
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
-                          kv_len=sk, block_q=block_q, block_k=block_k),
+                          kv_len=sk, block_q=block_q, block_k=block_k,
+                          window=window),
         grid=(bh, sq_p // block_q, sk_p // block_k),
         in_specs=[q_spec_i, kv_spec_j, kv_spec_j, q_spec_i, row_spec_i,
                   row_spec_i],
@@ -369,7 +390,8 @@ def _bwd_pallas(q, k, v, o, lse, do, *, scale: float, causal: bool,
                                lambda b, j, i: (b, i, 0))
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
-                          kv_len=sk, block_q=block_q, block_k=block_k),
+                          kv_len=sk, block_q=block_q, block_k=block_k,
+                          window=window),
         grid=(bh, sk_p // block_k, sq_p // block_q),
         in_specs=[q_spec_g2, kv_spec_g2, kv_spec_g2, q_spec_g2,
                   row_spec_g2, row_spec_g2],
@@ -388,26 +410,30 @@ def _bwd_pallas(q, k, v, o, lse, do, *, scale: float, causal: bool,
 # ----------------------------------------------------------------------
 # custom-vjp wrapper
 # ----------------------------------------------------------------------
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
-def _flash(q, k, v, causal, scale, block_q, block_k, interpret):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
+def _flash(q, k, v, causal, scale, block_q, block_k, interpret,
+           window=0):
     o, _ = _fwd_pallas(q, k, v, scale=scale, causal=causal,
                        block_q=block_q, block_k=block_k,
-                       interpret=interpret)
+                       interpret=interpret, window=window)
     return o
 
 
-def _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret):
+def _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret,
+               window=0):
     o, lse = _fwd_pallas(q, k, v, scale=scale, causal=causal,
                          block_q=block_q, block_k=block_k,
-                         interpret=interpret)
+                         interpret=interpret, window=window)
     return o, (q, k, v, o, lse)
 
 
-def _flash_bwd(causal, scale, block_q, block_k, interpret, res, g):
+def _flash_bwd(causal, scale, block_q, block_k, interpret, window,
+               res, g):
     q, k, v, o, lse = res
     dq, dk, dv = _bwd_pallas(q, k, v, o, lse, g, scale=scale,
                              causal=causal, block_q=block_q,
-                             block_k=block_k, interpret=interpret)
+                             block_k=block_k, interpret=interpret,
+                             window=window)
     return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
 
 
@@ -452,14 +478,29 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
                     causal: bool = False, scale: Optional[float] = None,
                     block_q: Optional[int] = None,
                     block_k: Optional[int] = None,
-                    interpret: Optional[bool] = None) -> jax.Array:
+                    interpret: Optional[bool] = None,
+                    window: int = 0) -> jax.Array:
     """Fused attention over (batch, seq, heads, head_dim) arrays.
 
     Layout matches :mod:`learningorchestra_tpu.parallel.ring` so the
     transformer can swap between single-chip flash and ring/Ulysses SP
     without reshuffling. Differentiable (custom VJP).
+
+    ``window=W`` (requires ``causal=True``) is sliding-window
+    attention: query p attends keys in ``[p-W+1, p]``. Tiles wholly
+    outside the band are predicated off (``pl.when``), so MXU work
+    scales ~O(s·W) instead of O(s²) — the long-context
+    local-attention pattern (Mistral-style SWA). The iteration grid
+    itself is still rectangular (like the causal skip), so K/V tile
+    DMA remains O(s²/block) — banding the grid is the known next
+    step.
     """
     b, sq, h, d = q.shape
+    if window < 0:
+        raise ValueError(f"window must be >= 0, got {window}")
+    if window and not causal:
+        raise ValueError("window requires causal=True (banded causal "
+                         "attention)")
     if scale is None:
         scale = 1.0 / (d ** 0.5)
     if interpret is None:
@@ -471,7 +512,7 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
         return x.transpose(0, 2, 1, 3).reshape(b * h, x.shape[1], d)
 
     o = _flash(merge(q), merge(k), merge(v), causal, float(scale),
-               block_q, block_k, bool(interpret))
+               block_q, block_k, bool(interpret), int(window))
     return o.reshape(b, h, sq, d).transpose(0, 2, 1, 3)
 
 

@@ -224,10 +224,17 @@ def ring_attention_sharded(q: jax.Array, k: jax.Array, v: jax.Array,
 
 
 def full_attention_reference(q, k, v, causal: bool = False,
-                             scale: Optional[float] = None) -> jax.Array:
+                             scale: Optional[float] = None,
+                             window: int = 0) -> jax.Array:
     """Plain full-softmax attention (the oracle ring_attention must
-    match; also the single-device fallback)."""
+    match; also the single-device fallback). ``window=W`` with
+    ``causal`` restricts query p to keys in [p-W+1, p] (sliding
+    window)."""
     d = q.shape[-1]
+    if window < 0:
+        raise ValueError(f"window must be >= 0, got {window}")
+    if window and not causal:
+        raise ValueError("window requires causal=True")
     if scale is None:
         scale = 1.0 / (d ** 0.5)
     scores = jnp.einsum("bqhd,bkhd->bqhk", q.astype(jnp.float32),
@@ -236,6 +243,9 @@ def full_attention_reference(q, k, v, causal: bool = False,
     if causal:
         sq, sk = scores.shape[1], scores.shape[3]
         mask = jnp.arange(sq)[:, None] >= jnp.arange(sk)[None, :]
+        if window > 0:
+            mask = mask & (jnp.arange(sk)[None, :] >
+                           jnp.arange(sq)[:, None] - window)
         scores = jnp.where(mask[None, :, None, :], scores, NEG_INF)
     p = jax.nn.softmax(scores, axis=-1)
     return jnp.einsum("bqhk,bkhd->bqhd", p,

@@ -125,3 +125,52 @@ def test_bfloat16_path():
     np.testing.assert_allclose(np.asarray(out, np.float32),
                                np.asarray(ref, np.float32),
                                atol=3e-2, rtol=3e-2)
+
+
+@pytest.mark.parametrize("window", [8, 17, 64])
+def test_sliding_window_forward_matches_reference(window):
+    """window=W bands the causal mask to [p-W+1, p]; W >= seq must
+    equal plain causal. Odd seq/blocks exercise the tile-skip edges."""
+    from learningorchestra_tpu.parallel.ring import (
+        full_attention_reference)
+
+    b, s, h, d = 2, 40, 2, 16
+    q, k, v = (_rand((b, s, h, d), 40 + i) for i in range(3))
+    out = flash_attention(q, k, v, causal=True, window=window,
+                          block_q=16, block_k=16)
+    ref = full_attention_reference(q, k, v, causal=True, window=window)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+    if window >= s:
+        plain = flash_attention(q, k, v, causal=True,
+                                block_q=16, block_k=16)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(plain),
+                                   atol=2e-5, rtol=2e-5)
+
+
+def test_sliding_window_gradients_match_reference():
+    from learningorchestra_tpu.parallel.ring import (
+        full_attention_reference)
+
+    b, s, h, d, w = 1, 24, 2, 8, 7
+
+    def loss_flash(q, k, v):
+        return jnp.sum(flash_attention(q, k, v, causal=True, window=w,
+                                       block_q=8, block_k=8) ** 2)
+
+    def loss_ref(q, k, v):
+        return jnp.sum(full_attention_reference(
+            q, k, v, causal=True, window=w) ** 2)
+
+    q, k, v = (_rand((b, s, h, d), 50 + i) for i in range(3))
+    gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, r in zip(gf, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(r),
+                                   atol=3e-5, rtol=3e-5)
+
+
+def test_sliding_window_requires_causal():
+    q = _rand((1, 16, 1, 8), 0)
+    with pytest.raises(ValueError, match="causal"):
+        flash_attention(q, q, q, causal=False, window=4)

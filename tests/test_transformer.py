@@ -757,3 +757,71 @@ def test_lora_artifact_round_trip(tmp_path):
     np.testing.assert_allclose(loaded.predict(x[:4], batch_size=4),
                                lm.predict(x[:4], batch_size=4),
                                atol=1e-5)
+
+
+# ----------------------------------------------------------------------
+# sliding-window attention
+# ----------------------------------------------------------------------
+def test_sliding_window_locality_and_decode_parity(tmp_path):
+    """A windowed LM's logits at position p must ignore tokens before
+    p-W+1 (locality), and the windowed cached decode must match the
+    windowed full-forward argmax rollout."""
+    from learningorchestra_tpu.models import transformer as T
+
+    _mesh_config(tmp_path, "dp=1")
+    W = 4
+    mod = T.TransformerLM(vocab_size=16, d_model=16, n_layers=2,
+                          n_heads=2, attention="dot", sliding_window=W)
+    toks = jnp.asarray((np.arange(1, 13) % 15 + 1)[None, :]
+                       .astype(np.int32))
+    params = mod.init(jax.random.PRNGKey(0), toks)["params"]
+    logits, _ = mod.apply({"params": params}, toks)
+    # perturb position 0: with 2 layers the receptive field at p is
+    # 2(W-1) back, so positions >= 2W-1 are out of reach of token 0
+    pert = toks.at[0, 0].set(9)
+    logits2, _ = mod.apply({"params": params}, pert)
+    reach = 2 * (W - 1)
+    np.testing.assert_allclose(np.asarray(logits[:, reach + 1:]),
+                               np.asarray(logits2[:, reach + 1:]),
+                               atol=1e-5)
+    assert not np.allclose(np.asarray(logits[:, 0]),
+                           np.asarray(logits2[:, 0]))
+
+    # decode parity through generate()
+    lm = LanguageModel(vocab_size=16, d_model=16, n_layers=2,
+                       n_heads=2, max_len=12, attention="dot",
+                       sliding_window=W)
+    x = _toy_tokens(n=8, seq=8, vocab=16)
+    lm.fit(x, batch_size=8, epochs=1)
+    prompt = x[:2, :4]
+    gen = lm.generate(prompt, max_new_tokens=4, temperature=0.0)
+    module = lm._module_for(None)
+    buf = np.zeros((2, 8), np.int32)
+    buf[:, :4] = prompt
+    for pos in range(4, 8):
+        lg, _ = module.apply({"params": lm.params}, jnp.asarray(buf))
+        last = np.asarray(lg[:, pos - 1]).astype(np.float64)
+        last[:, 0] = -np.inf
+        buf[:, pos] = last.argmax(-1)
+    np.testing.assert_array_equal(gen, buf)
+
+
+def test_sliding_window_flash_matches_dot_in_module(tmp_path):
+    _mesh_config(tmp_path, "dp=1")
+    from learningorchestra_tpu.models import transformer as T
+
+    tokens = jnp.asarray(_toy_tokens(n=2, seq=16)[:, :16])
+    mk = lambda impl: T.TransformerLM(  # noqa: E731
+        vocab_size=32, d_model=32, n_layers=1, n_heads=2,
+        attention=impl, sliding_window=5)
+    params = mk("dot").init(jax.random.PRNGKey(0), tokens)["params"]
+    out_dot, _ = mk("dot").apply({"params": params}, tokens)
+    out_flash, _ = mk("flash").apply({"params": params}, tokens)
+    np.testing.assert_allclose(np.asarray(out_dot),
+                               np.asarray(out_flash),
+                               atol=1e-4, rtol=1e-4)
+
+
+def test_sliding_window_rejects_sp_impls():
+    with pytest.raises(ValueError, match="ring/ulysses"):
+        LanguageModel(vocab_size=8, attention="ring", sliding_window=4)
