@@ -439,8 +439,8 @@ class TransformerLM(nn.Module):
     lora_rank: int = 0
     lora_alpha: float = 16.0
     # sliding-window attention (banded causal, Mistral-style): query p
-    # attends [p-W+1, p]; flash predicates out-of-band tiles off so
-    # MXU work scales ~O(s*W). dot/flash only.
+    # attends [p-W+1, p]; the flash kernels iterate a banded tile
+    # grid so compute AND K/V DMA scale ~O(s*W). dot/flash only.
     sliding_window: int = 0
     # per-layer rematerialization under training: "none" saves all
     # activations, "dots" saves matmul outputs only (the standard TPU

@@ -77,6 +77,74 @@ def _auto_block(seq: int) -> int:
     return block
 
 
+def _band_lo(i, block_q: int, block_k: int, window: int):
+    """First in-band kv tile for q tile ``i`` (0 when unwindowed)."""
+    if window <= 0:
+        return 0
+    return jnp.maximum(0, (i * block_q - window + 1) // block_k)
+
+
+def _band_width(nk: int, block_q: int, block_k: int,
+                window: int) -> int:
+    """Grid width (in kv tiles) that covers any q tile's band."""
+    if window <= 0:
+        return nk
+    span = block_q + window - 1
+    return min(nk, (span - 2) // block_k + 2)
+
+
+def _kv_index_map(block_q: int, block_k: int, window: int,
+                  causal: bool, nk: int):
+    """BlockSpec index map for the streamed K/V tiles: maps grid step
+    j to kv tile clip(lo+j, 0, hi). Out-of-band steps repeat the
+    boundary tile index — Mosaic's pipeline only issues a copy when
+    the block index CHANGES between steps, so the clamp turns the
+    causal upper triangle (and both sides of a sliding-window band)
+    into zero-copy revisits instead of dead DMA."""
+
+    def index(b, i, j):
+        j_eff = _band_lo(i, block_q, block_k, window) + j
+        hi = nk - 1
+        if causal:
+            hi = jnp.minimum(hi, (i * block_q + block_q - 1) // block_k)
+        return (b, jnp.clip(j_eff, 0, hi), 0)
+
+    return index
+
+
+def _qband_lo(j, block_q: int, block_k: int, causal: bool):
+    """First q tile whose rows can see kv tile ``j`` (causal)."""
+    if not causal:
+        return 0
+    return (j * block_k) // block_q
+
+
+def _qband_width(nq: int, block_q: int, block_k: int,
+                 window: int) -> int:
+    """Grid width (in q tiles) covering any kv tile's visible rows
+    when windowed (causal-only bands run to the end, width nq)."""
+    if window <= 0:
+        return nq
+    span = block_k + window - 1
+    return min(nq, (span - 2) // block_q + 2)
+
+
+def _q_index_map(block_q: int, block_k: int, window: int,
+                 causal: bool, nq: int):
+    """Streamed-Q BlockSpec index map for the dK/dV kernel: grid step
+    i -> q tile clip(lo+i, 0, hi); out-of-band steps revisit."""
+
+    def index(b, j, i):
+        i_eff = _qband_lo(j, block_q, block_k, causal) + i
+        hi = nq - 1
+        if window > 0:
+            hi = jnp.minimum(
+                hi, (j * block_k + block_k - 1 + window - 1) // block_q)
+        return (b, jnp.clip(i_eff, 0, hi), 0)
+
+    return index
+
+
 def _resolve_blocks(block_q: Optional[int], block_k: Optional[int],
                     sq: int, sk: int) -> Tuple[int, int]:
     return (int(block_q) if block_q else _auto_block(sq),
@@ -89,7 +157,8 @@ def _resolve_blocks(block_q: Optional[int], block_k: Optional[int],
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
                 acc_ref, m_ref, l_ref,
                 *, scale: float, causal: bool, kv_len: int,
-                block_q: int, block_k: int, window: int = 0):
+                block_q: int, block_k: int, window: int = 0,
+                nk_total: int = 0):
     i = pl.program_id(1)
     j = pl.program_id(2)
     nk = pl.num_programs(2)
@@ -100,14 +169,17 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
         l_ref[...] = jnp.zeros_like(l_ref)
         acc_ref[...] = jnp.zeros_like(acc_ref)
 
-    # causal: skip K/V tiles strictly above the diagonal band;
-    # sliding window: also skip tiles wholly below it
+    # banded iteration: grid step j covers the kv tile lo+j, where lo
+    # is the first in-band tile for this q tile (window) — the kv
+    # BlockSpec index map clamps with the same formula, so
+    # out-of-band steps revisit a fetched block (no DMA) and are
+    # predicated off here
+    j_eff = _band_lo(i, block_q, block_k, window) + j
     run = True
     if causal:
-        run = j * block_k <= i * block_q + block_q - 1
+        run = j_eff * block_k <= i * block_q + block_q - 1
     if window > 0:
-        run = jnp.logical_and(
-            run, (j + 1) * block_k - 1 >= i * block_q - window + 1)
+        run = jnp.logical_and(run, j_eff <= nk_total - 1)
 
     @pl.when(run)
     def _tile():
@@ -118,7 +190,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale   # (bq, bk)
 
-        col = j * block_k + lax.broadcasted_iota(
+        col = j_eff * block_k + lax.broadcasted_iota(
             jnp.int32, (block_q, block_k), 1)
         valid = col < kv_len
         if causal or window > 0:
@@ -170,10 +242,13 @@ def _fwd_pallas(q, k, v, *, scale: float, causal: bool,
     k = jnp.pad(k, ((0, 0), (0, sk_p - sk), (0, d_p - d)))
     v = jnp.pad(v, ((0, 0), (0, sk_p - sk), (0, d_p - d)))
 
-    grid = (bh, sq_p // block_q, sk_p // block_k)
+    nk = sk_p // block_k
+    nj = _band_width(nk, block_q, block_k, window)
+    grid = (bh, sq_p // block_q, nj)
     kernel = functools.partial(
         _fwd_kernel, scale=scale, causal=causal, kv_len=sk,
-        block_q=block_q, block_k=block_k, window=window)
+        block_q=block_q, block_k=block_k, window=window, nk_total=nk)
+    kv_map = _kv_index_map(block_q, block_k, window, causal, nk)
     lanes = 128
     scratch = [
         pltpu.VMEM((block_q, d_p), jnp.float32),
@@ -185,8 +260,8 @@ def _fwd_pallas(q, k, v, *, scale: float, causal: bool,
         grid=grid,
         in_specs=[
             pl.BlockSpec((1, block_q, d_p), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, block_k, d_p), lambda b, i, j: (b, j, 0)),
-            pl.BlockSpec((1, block_k, d_p), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d_p), kv_map),
+            pl.BlockSpec((1, block_k, d_p), kv_map),
         ],
         out_specs=[
             pl.BlockSpec((1, block_q, d_p), lambda b, i, j: (b, i, 0)),
@@ -213,8 +288,10 @@ def _fwd_pallas(q, k, v, *, scale: float, causal: bool,
 def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                    dq_ref, dq_acc_ref,
                    *, scale: float, causal: bool, kv_len: int,
-                   block_q: int, block_k: int, window: int = 0):
-    """Grid (bh, q_blocks, kv_blocks): Q/dO resident, K/V stream."""
+                   block_q: int, block_k: int, window: int = 0,
+                   nk_total: int = 0):
+    """Grid (bh, q_blocks, kv_band): Q/dO resident, K/V stream the
+    band (same clamped-index revisit scheme as the forward)."""
     i = pl.program_id(1)
     j = pl.program_id(2)
     nk = pl.num_programs(2)
@@ -223,12 +300,12 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     def _init():
         dq_acc_ref[...] = jnp.zeros_like(dq_acc_ref)
 
+    j_eff = _band_lo(i, block_q, block_k, window) + j
     run = True
     if causal:
-        run = j * block_k <= i * block_q + block_q - 1
+        run = j_eff * block_k <= i * block_q + block_q - 1
     if window > 0:
-        run = jnp.logical_and(
-            run, (j + 1) * block_k - 1 >= i * block_q - window + 1)
+        run = jnp.logical_and(run, j_eff <= nk_total - 1)
 
     @pl.when(run)
     def _tile():
@@ -242,7 +319,7 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale     # (bq, bk)
-        col = j * block_k + lax.broadcasted_iota(
+        col = j_eff * block_k + lax.broadcasted_iota(
             jnp.int32, (block_q, block_k), 1)
         valid = col < kv_len
         if causal or window > 0:
@@ -269,8 +346,12 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                     dk_ref, dv_ref, dk_acc_ref, dv_acc_ref,
                     *, scale: float, causal: bool, kv_len: int,
-                    block_q: int, block_k: int, window: int = 0):
-    """Grid (bh, kv_blocks, q_blocks): K/V resident, Q/dO stream."""
+                    block_q: int, block_k: int, window: int = 0,
+                    nq_total: int = 0):
+    """Grid (bh, kv_blocks, q_band): K/V resident, Q/dO stream the
+    band of q tiles whose rows can see this kv tile (causal: from the
+    diagonal down; window: at most W-1 rows past it) — same
+    clamped-index revisit scheme as the forward."""
     j = pl.program_id(1)
     i = pl.program_id(2)
     nq = pl.num_programs(2)
@@ -280,12 +361,15 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dk_acc_ref[...] = jnp.zeros_like(dk_acc_ref)
         dv_acc_ref[...] = jnp.zeros_like(dv_acc_ref)
 
-    run = True
+    i_eff = _qband_lo(j, block_q, block_k, causal) + i
+    run = i_eff <= nq_total - 1
     if causal:
-        run = j * block_k <= i * block_q + block_q - 1
+        run = jnp.logical_and(
+            run, j * block_k <= i_eff * block_q + block_q - 1)
     if window > 0:
         run = jnp.logical_and(
-            run, (j + 1) * block_k - 1 >= i * block_q - window + 1)
+            run,
+            i_eff * block_q <= j * block_k + block_k - 1 + window - 1)
 
     @pl.when(run)
     def _tile():
@@ -303,7 +387,7 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             jnp.int32, (block_q, block_k), 1)
         valid = col < kv_len
         if causal or window > 0:
-            row = i * block_q + lax.broadcasted_iota(
+            row = i_eff * block_q + lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0)
         if causal:
             valid = jnp.logical_and(valid, row >= col)
@@ -364,15 +448,19 @@ def _bwd_pallas(q, k, v, o, lse, do, *, scale: float, causal: bool,
     delta_l = jnp.pad(delta, ((0, 0), (0, sq_p - sq)))[..., None] * \
         jnp.ones((1, 1, lanes), jnp.float32)
 
+    nk = sk_p // block_k
+    nj = _band_width(nk, block_q, block_k, window)
     q_spec_i = pl.BlockSpec((1, block_q, d_p), lambda b, i, j: (b, i, 0))
-    kv_spec_j = pl.BlockSpec((1, block_k, d_p), lambda b, i, j: (b, j, 0))
+    kv_spec_j = pl.BlockSpec((1, block_k, d_p),
+                             _kv_index_map(block_q, block_k, window,
+                                           causal, nk))
     row_spec_i = pl.BlockSpec((1, block_q, lanes),
                               lambda b, i, j: (b, i, 0))
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
                           kv_len=sk, block_q=block_q, block_k=block_k,
-                          window=window),
-        grid=(bh, sq_p // block_q, sk_p // block_k),
+                          window=window, nk_total=nk),
+        grid=(bh, sq_p // block_q, nj),
         in_specs=[q_spec_i, kv_spec_j, kv_spec_j, q_spec_i, row_spec_i,
                   row_spec_i],
         out_specs=q_spec_i,
@@ -384,15 +472,17 @@ def _bwd_pallas(q, k, v, o, lse, do, *, scale: float, causal: bool,
     )(q, k, v, do, lse_l, delta_l)
 
     # second kernel: K/V resident, Q streams — grid dims (b, j, i)
-    q_spec_g2 = pl.BlockSpec((1, block_q, d_p), lambda b, j, i: (b, i, 0))
+    nq = sq_p // block_q
+    ni = _qband_width(nq, block_q, block_k, window)
+    q_map = _q_index_map(block_q, block_k, window, causal, nq)
+    q_spec_g2 = pl.BlockSpec((1, block_q, d_p), q_map)
     kv_spec_g2 = pl.BlockSpec((1, block_k, d_p), lambda b, j, i: (b, j, 0))
-    row_spec_g2 = pl.BlockSpec((1, block_q, lanes),
-                               lambda b, j, i: (b, i, 0))
+    row_spec_g2 = pl.BlockSpec((1, block_q, lanes), q_map)
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
                           kv_len=sk, block_q=block_q, block_k=block_k,
-                          window=window),
-        grid=(bh, sk_p // block_k, sq_p // block_q),
+                          window=window, nq_total=nq),
+        grid=(bh, sk_p // block_k, ni),
         in_specs=[q_spec_g2, kv_spec_g2, kv_spec_g2, q_spec_g2,
                   row_spec_g2, row_spec_g2],
         out_specs=[kv_spec_g2, kv_spec_g2],
@@ -487,13 +577,12 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
     without reshuffling. Differentiable (custom VJP).
 
     ``window=W`` (requires ``causal=True``) is sliding-window
-    attention: query p attends keys in ``[p-W+1, p]``. Tiles wholly
-    outside the band are predicated off (``pl.when``), so MXU work
-    scales ~O(s·W) instead of O(s²) — the long-context
-    local-attention pattern (Mistral-style SWA). The iteration grid
-    itself is still rectangular (like the causal skip), so K/V tile
-    DMA remains O(s²/block) — banding the grid is the known next
-    step.
+    attention: query p attends keys in ``[p-W+1, p]``. The kv grid
+    axis is BANDED: it spans only ~(block+W)/block tiles per q tile,
+    with clamped index maps so boundary revisits issue no DMA — both
+    compute AND copy traffic scale ~O(s·W) instead of O(s²)
+    (Mistral-style SWA). Plain causal runs get the same clamp on the
+    upper triangle, halving their K/V copy traffic.
     """
     b, sq, h, d = q.shape
     if window < 0:

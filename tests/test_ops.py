@@ -174,3 +174,36 @@ def test_sliding_window_requires_causal():
     q = _rand((1, 16, 1, 8), 0)
     with pytest.raises(ValueError, match="causal"):
         flash_attention(q, q, q, causal=False, window=4)
+
+
+@pytest.mark.parametrize("window", [0, 20])
+def test_banded_iteration_many_blocks(window):
+    """Banded/clamped kv iteration across many tiles (seq 96, 16-wide
+    blocks -> 6x6 tile grid) must stay exact for causal and windowed
+    runs, forward AND backward — this is the shape class where the
+    revisit-clamp index maps actually reorder the stream."""
+    from learningorchestra_tpu.parallel.ring import (
+        full_attention_reference)
+
+    b, s, h, d = 1, 96, 2, 16
+    q, k, v = (_rand((b, s, h, d), 60 + i) for i in range(3))
+
+    def loss_flash(q, k, v):
+        return jnp.sum(flash_attention(q, k, v, causal=True,
+                                       window=window,
+                                       block_q=16, block_k=16) ** 2)
+
+    def loss_ref(q, k, v):
+        return jnp.sum(full_attention_reference(
+            q, k, v, causal=True, window=window) ** 2)
+
+    out = flash_attention(q, k, v, causal=True, window=window,
+                          block_q=16, block_k=16)
+    ref = full_attention_reference(q, k, v, causal=True, window=window)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=3e-5, rtol=3e-5)
+    gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, r in zip(gf, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(r),
+                                   atol=5e-5, rtol=5e-5)
