@@ -211,7 +211,8 @@ class NeuralModel:
         return np.squeeze(y) if y.ndim > 1 and y.shape[-1] == 1 else y
 
     def _batcher(self, x, y=None, batch_size: Optional[int] = None,
-                 shuffle: bool = False) -> data_lib.ArrayBatcher:
+                 shuffle: bool = False,
+                 sample_weight=None) -> data_lib.ArrayBatcher:
         from learningorchestra_tpu.config import get_config
         mesh = self._mesh()
         arrays = {"x": self._coerce_x(x)}
@@ -220,7 +221,8 @@ class NeuralModel:
         return data_lib.ArrayBatcher(
             arrays, batch_size or get_config().default_batch_size,
             shuffle=shuffle, seed=self.seed,
-            dp_multiple=mesh_lib.data_parallel_size(mesh))
+            dp_multiple=mesh_lib.data_parallel_size(mesh),
+            sample_weight=sample_weight)
 
     # ------------------------------------------------------------------
     def fit(self, x=None, y=None, batch_size: Optional[int] = None,
@@ -229,8 +231,10 @@ class NeuralModel:
             validation_split: float = 0.0,
             shuffle: bool = True, checkpointer=None,
             log_fn=None, grad_accum: Optional[int] = None,
+            sample_weight=None,
             **_: Any) -> "History":
         self._set_grad_accum(grad_accum)
+        val_weight = None
         if validation_split and validation_data is None:
             # keras-parity convenience: hold out the TAIL fraction
             # (keras also splits before shuffling)
@@ -246,7 +250,15 @@ class NeuralModel:
             x = x[:-n_val]
             if y is not None:
                 y = y[:-n_val]
-        batcher = self._batcher(x, y, batch_size, shuffle=shuffle)
+            if sample_weight is not None:
+                # keras splits the weights with the data: the tail
+                # slice weights the validation metrics
+                sample_weight = np.asarray(sample_weight,
+                                           np.float32).reshape(-1)
+                val_weight = sample_weight[-n_val:]
+                sample_weight = sample_weight[:-n_val]
+        batcher = self._batcher(x, y, batch_size, shuffle=shuffle,
+                                sample_weight=sample_weight)
         if self.params is None:
             self._build_params(batcher.array("x"))
         eng = self._get_engine()
@@ -258,7 +270,8 @@ class NeuralModel:
         # already consumed) — still evaluate, record as its own entry
         if validation_data is not None:
             vx, vy = validation_data[0], validation_data[1]
-            val = eng.evaluate(state, self._batcher(vx, vy, batch_size))
+            val = eng.evaluate(state, self._batcher(
+                vx, vy, batch_size, sample_weight=val_weight))
             if not history:
                 history.append({})
             for k, v in val.items():
@@ -270,11 +283,12 @@ class NeuralModel:
         return History(history)
 
     def evaluate(self, x=None, y=None, batch_size: Optional[int] = None,
-                 **_: Any) -> Dict[str, float]:
+                 sample_weight=None, **_: Any) -> Dict[str, float]:
         self._require_built()
         eng = self._get_engine()
         state = self._state or eng.init_state(self.params, self.model_state)
-        return eng.evaluate(state, self._batcher(x, y, batch_size))
+        return eng.evaluate(state, self._batcher(
+            x, y, batch_size, sample_weight=sample_weight))
 
     def predict(self, x=None, batch_size: Optional[int] = None,
                 **_: Any) -> np.ndarray:

@@ -39,7 +39,8 @@ class ArrayBatcher:
 
     def __init__(self, arrays: Dict[str, np.ndarray], batch_size: int,
                  *, shuffle: bool = False, seed: int = 0,
-                 dp_multiple: int = 1):
+                 dp_multiple: int = 1,
+                 sample_weight: Optional[np.ndarray] = None):
         if not arrays:
             raise ValueError("empty feed")
         sizes = {k: len(v) for k, v in arrays.items()}
@@ -47,6 +48,23 @@ class ArrayBatcher:
             raise ValueError(f"mismatched array lengths: {sizes}")
         self._arrays = {k: np.asarray(v) for k, v in arrays.items()}
         self.num_samples = next(iter(sizes.values()))
+        # per-sample loss/metric weights (keras fit(sample_weight=...));
+        # padding rows still weigh 0 — the pad mask and user weights
+        # ride the same MASK_KEY column. Losses normalize by the
+        # weight TOTAL (weighted mean): per-sample relative influence
+        # matches keras exactly, and 0/1 weights are identical; the
+        # global loss scale differs from keras's sum-over-batch-size
+        # reduction by sum(w)/batch_size (a learning-rate scale,
+        # absorbed by adaptive optimizers)
+        self._sample_weight = None
+        if sample_weight is not None:
+            sample_weight = np.asarray(sample_weight,
+                                       np.float32).reshape(-1)
+            if len(sample_weight) != self.num_samples:
+                raise ValueError(
+                    f"sample_weight has {len(sample_weight)} entries "
+                    f"for {self.num_samples} samples")
+            self._sample_weight = sample_weight
         if batch_size % dp_multiple:
             batch_size = mesh_lib.pad_to_multiple(batch_size, dp_multiple)
         self.batch_size = batch_size
@@ -88,6 +106,8 @@ class ArrayBatcher:
                     [arr, np.zeros((pad,) + arr.shape[1:], arr.dtype)])
             out[key] = arr
         mask = np.ones((n_total,), np.float32)
+        if self._sample_weight is not None:
+            mask[:self.num_samples] = self._sample_weight
         if pad:
             mask[self.num_samples:] = 0.0
         out[MASK_KEY] = mask
@@ -112,9 +132,15 @@ class ArrayBatcher:
                     take = np.concatenate(
                         [take, np.zeros((pad,) + arr.shape[1:], arr.dtype)])
                 batch[key] = take
-            mask = np.ones((bs,), np.float32)
-            if pad:
-                mask[-pad:] = 0.0
+            if self._sample_weight is not None:
+                mask = self._sample_weight[idx].astype(np.float32)
+                if pad:
+                    mask = np.concatenate(
+                        [mask, np.zeros((pad,), np.float32)])
+            else:
+                mask = np.ones((bs,), np.float32)
+                if pad:
+                    mask[-pad:] = 0.0
             batch[MASK_KEY] = mask
             yield batch
 

@@ -194,9 +194,13 @@ class Engine:
         """Sequential microbatch gradient accumulation: the batch
         splits leaf-wise into ``grad_accum`` microbatches scanned with
         a running gradient sum, so peak activation memory is one
-        microbatch's. Micro gradients average UNIFORMLY — exact when
-        every microbatch carries the same valid-token count (the
-        unmasked case), the standard approximation otherwise."""
+        microbatch's. Each micro gradient is the gradient of that
+        micro's WEIGHTED-MEAN loss, so the accumulator weights it by
+        the micro's weight total and normalizes by the grand total —
+        algebraically identical to the single-batch weighted-mean
+        step for ANY mask/sample_weight distribution (a micro holding
+        only padding contributes zero weight, not a diluting zero
+        gradient)."""
         accum = self._grad_accum
         b = jax.tree_util.tree_leaves(batch)[0].shape[0]
         if b % accum:
@@ -213,14 +217,20 @@ class Engine:
             g_acc, ms, i = carry
             grads, ms, metrics = self._micro_grads(
                 state.params, ms, mb, jax.random.fold_in(rng, i))
+            # the "loss" metric's count IS this micro's weight total
+            # (sum of mask*sample_weight, or 1.0 when unweighted)
+            w = metrics["loss"][1].astype(jnp.float32)
             g_acc = jax.tree_util.tree_map(
-                lambda a, g: a + g.astype(jnp.float32), g_acc, grads)
+                lambda a, g: a + g.astype(jnp.float32) * w,
+                g_acc, grads)
             return (g_acc, ms, i + 1), metrics
 
         (g_sum, new_model_state, _), metrics = jax.lax.scan(
             body, (zero_g, state.model_state,
                    jnp.zeros((), jnp.int32)), micros)
-        grads = jax.tree_util.tree_map(lambda g: g / accum, g_sum)
+        w_total = jnp.maximum(
+            jnp.sum(metrics["loss"][1].astype(jnp.float32)), 1e-9)
+        grads = jax.tree_util.tree_map(lambda g: g / w_total, g_sum)
         # each metric leaf is stacked (accum, ...) sums/counts
         metrics = {k: (jnp.sum(s), jnp.sum(c))
                    for k, (s, c) in metrics.items()}

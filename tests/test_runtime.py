@@ -361,3 +361,39 @@ def test_grad_accum_noop_override_keeps_engine(tmp_config):
     eng = lm._engine
     lm.fit(toks, batch_size=8, epochs=1, grad_accum=0)
     assert lm._engine is eng
+
+
+def test_grad_accum_exact_under_skewed_weights(tmp_config):
+    """Micro gradients are weighted by their weight totals, so
+    accumulation equals the single-batch weighted step even when the
+    sample weights land wildly unevenly across microbatches."""
+    from learningorchestra_tpu.runtime import engine as E
+    from learningorchestra_tpu.runtime import mesh as M
+    from learningorchestra_tpu.runtime.data import ArrayBatcher
+
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(64, 3)).astype(np.float32)
+    y = (x @ np.array([[2.0], [-1.0], [0.5]], np.float32))[:, 0]
+    w = np.ones(64, np.float32)
+    w[:16] = 30.0        # first microbatch dominates
+    w[48:] = 0.001       # last microbatch nearly weightless
+
+    def apply_fn(params, model_state, batch, train, rng_):
+        return batch["x"] @ params["w"] + params["b"], model_state
+
+    def run(accum):
+        eng = E.Engine(apply_fn, E.mse_loss, optax.sgd(0.1),
+                       mesh=M.build_mesh("auto"),
+                       compute_dtype=jnp.float32, grad_accum=accum)
+        params = {"w": jnp.zeros((3, 1)), "b": jnp.zeros(())}
+        state = eng.init_state(params)
+        batcher = ArrayBatcher({"x": x, "y": y}, 64, dp_multiple=8,
+                               sample_weight=w)
+        state, history = eng.fit(state, batcher, epochs=2)
+        return E.to_host(state.params), history
+
+    p1, h1 = run(1)
+    p4, h4 = run(4)
+    np.testing.assert_allclose(np.asarray(p4["w"]), np.asarray(p1["w"]),
+                               atol=1e-5)
+    assert abs(h4[-1]["loss"] - h1[-1]["loss"]) < 1e-4
