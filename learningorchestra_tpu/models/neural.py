@@ -231,9 +231,11 @@ class NeuralModel:
             validation_split: float = 0.0,
             shuffle: bool = True, checkpointer=None,
             log_fn=None, grad_accum: Optional[int] = None,
-            sample_weight=None,
+            sample_weight=None, class_weight=None,
             **_: Any) -> "History":
         self._set_grad_accum(grad_accum)
+        if class_weight is not None and y is None:
+            raise ValueError("class_weight requires labels y")
         val_weight = None
         if validation_split and validation_data is None:
             # keras-parity convenience: hold out the TAIL fraction
@@ -257,6 +259,22 @@ class NeuralModel:
                                            np.float32).reshape(-1)
                 val_weight = sample_weight[-n_val:]
                 sample_weight = sample_weight[:-n_val]
+        if class_weight is not None:
+            # keras semantics: per-class TRAINING loss weights (applied
+            # after the validation split — val metrics stay unweighted
+            # by class), composed multiplicatively onto sample_weight
+            y = self._coerce_y(y)
+            cw = np.ones(len(y), np.float32)
+            for cls, wt in dict(class_weight).items():
+                cw[y == int(cls)] = float(wt)
+            if sample_weight is not None:
+                sw = np.asarray(sample_weight, np.float32).reshape(-1)
+                if len(sw) != len(cw):
+                    raise ValueError(
+                        f"sample_weight has {len(sw)} entries for "
+                        f"{len(cw)} samples")
+                cw = cw * sw
+            sample_weight = cw
         batcher = self._batcher(x, y, batch_size, shuffle=shuffle,
                                 sample_weight=sample_weight)
         if self.params is None:
