@@ -237,9 +237,6 @@ class _Attention(nn.Module):
                 ck, cv = self._cache_vars(b, cache_len, x.dtype)
                 ck.value = ck.value.at[:, :s].set(k.astype(x.dtype))
                 cv.value = cv.value.at[:, :s].set(v.astype(x.dtype))
-            if group > 1:
-                k = jnp.repeat(k, group, axis=2)
-                v = jnp.repeat(v, group, axis=2)
             o = _dispatch_attention(q, k, v, impl=self.impl,
                                     causal=self.causal, mesh=self.mesh,
                                     window=self.window)
@@ -249,12 +246,25 @@ class _Attention(nn.Module):
 
 def _dispatch_attention(q, k, v, *, impl: str, causal: bool, mesh=None,
                         window: int = 0):
+    """q: (b, s, h, d); k/v may carry FEWER (kv) heads under GQA.
+    The single-chip flash path consumes them natively (the kernel
+    folds the query group — K/V never materialize at h heads); every
+    other impl repeats K/V up to h first, which XLA fuses into the
+    consuming matmul on the dot path."""
     if window > 0 and impl in ("ring", "ulysses"):
         raise ValueError(
             f"sliding_window is not supported with {impl} sequence "
             f"parallelism (use dot/flash, or window=0)")
     mesh = mesh or mesh_lib.get_default_mesh()
     b, s, h, _ = q.shape
+    kvh = k.shape[2]
+    group = h // kvh
+
+    def repeated():
+        if group == 1:
+            return k, v
+        return (jnp.repeat(k, group, axis=2),
+                jnp.repeat(v, group, axis=2))
     data_size = mesh_lib.data_parallel_size(mesh)
     sp = mesh.shape.get(mesh_lib.SP, 1)
     tp = mesh.shape.get(mesh_lib.TP, 1)
@@ -264,16 +274,28 @@ def _dispatch_attention(q, k, v, *, impl: str, causal: bool, mesh=None,
     divisible = b % data_size == 0 and s % sp == 0
 
     if impl == "ring" and sp > 1 and divisible:
-        return ring_lib.ring_attention_sharded(q, k, v, mesh, causal=causal)
+        kr, vr = repeated()
+        return ring_lib.ring_attention_sharded(q, kr, vr, mesh,
+                                               causal=causal)
     if impl == "ulysses" and sp > 1 and divisible and h % sp == 0:
-        return ulysses_lib.ulysses_attention_sharded(q, k, v, mesh,
+        kr, vr = repeated()
+        return ulysses_lib.ulysses_attention_sharded(q, kr, vr, mesh,
                                                      causal=causal)
     if impl == "flash":
         sharded = tp > 1 or data_size > 1
         if not sharded:
+            # GQA-native: unrepeated K/V straight into the kernel
             return attn_ops.flash_attention(q, k, v, causal=causal,
                                             window=window)
         if b % data_size == 0 and h % tp == 0:
+            if kvh % tp:
+                # kv heads don't divide tp: repeat up to full heads so
+                # the contiguous head shards stay well-formed
+                k, v = repeated()
+            # else: shard the kv-width K/V directly — contiguous head
+            # sharding aligns each device's q-head chunk with its
+            # kv-head chunk (h/tp == group * kvh/tp), so the per-shard
+            # kernel stays GQA-native and K/V HBM still scales with kv
             # pallas_call is opaque to GSPMD — shard_map it so each
             # device runs the kernel on its local (batch, heads) tile
             # and TP never gathers heads
@@ -289,7 +311,8 @@ def _dispatch_attention(q, k, v, *, impl: str, causal: bool, mesh=None,
                 check_vma=False)
             return fn(q, k, v)
     # "dot" and all fallbacks (no sp axis, non-divisible shapes)
-    return ring_lib.full_attention_reference(q, k, v, causal=causal,
+    kr, vr = repeated()
+    return ring_lib.full_attention_reference(q, kr, vr, causal=causal,
                                              window=window)
 
 

@@ -94,19 +94,22 @@ def _band_width(nk: int, block_q: int, block_k: int,
 
 
 def _kv_index_map(block_q: int, block_k: int, window: int,
-                  causal: bool, nk: int):
+                  causal: bool, nk: int, nq_head: int):
     """BlockSpec index map for the streamed K/V tiles: maps grid step
     j to kv tile clip(lo+j, 0, hi). Out-of-band steps repeat the
     boundary tile index — Mosaic's pipeline only issues a copy when
     the block index CHANGES between steps, so the clamp turns the
     causal upper triangle (and both sides of a sliding-window band)
-    into zero-copy revisits instead of dead DMA."""
+    into zero-copy revisits instead of dead DMA. Under grouped-query
+    folding the q-tile position within its head is i % nq_head."""
 
     def index(b, i, j):
-        j_eff = _band_lo(i, block_q, block_k, window) + j
+        ih = i % nq_head
+        j_eff = _band_lo(ih, block_q, block_k, window) + j
         hi = nk - 1
         if causal:
-            hi = jnp.minimum(hi, (i * block_q + block_q - 1) // block_k)
+            hi = jnp.minimum(hi,
+                             (ih * block_q + block_q - 1) // block_k)
         return (b, jnp.clip(j_eff, 0, hi), 0)
 
     return index
@@ -130,17 +133,20 @@ def _qband_width(nq: int, block_q: int, block_k: int,
 
 
 def _q_index_map(block_q: int, block_k: int, window: int,
-                 causal: bool, nq: int):
+                 causal: bool, nq: int, band_ni: int):
     """Streamed-Q BlockSpec index map for the dK/dV kernel: grid step
-    i -> q tile clip(lo+i, 0, hi); out-of-band steps revisit."""
+    i = (head, within-band) -> folded q tile
+    head·nq + clip(lo+within, 0, hi); out-of-band steps revisit."""
 
     def index(b, j, i):
-        i_eff = _qband_lo(j, block_q, block_k, causal) + i
+        head = i // band_ni
+        within = i % band_ni
+        i_eff = _qband_lo(j, block_q, block_k, causal) + within
         hi = nq - 1
         if window > 0:
             hi = jnp.minimum(
                 hi, (j * block_k + block_k - 1 + window - 1) // block_q)
-        return (b, jnp.clip(i_eff, 0, hi), 0)
+        return (b, head * nq + jnp.clip(i_eff, 0, hi), 0)
 
     return index
 
@@ -158,8 +164,13 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
                 acc_ref, m_ref, l_ref,
                 *, scale: float, causal: bool, kv_len: int,
                 block_q: int, block_k: int, window: int = 0,
-                nk_total: int = 0):
+                nk_total: int = 0, nq_head: int = 0):
+    # grouped-query folding: the q-row axis stacks `group` query heads
+    # per kv head, so the tile's POSITION within its head is
+    # i % nq_head (== i when ungrouped) — all causal/window math uses
+    # that, while the storage index stays i
     i = pl.program_id(1)
+    ih = i % nq_head
     j = pl.program_id(2)
     nk = pl.num_programs(2)
 
@@ -174,10 +185,10 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
     # BlockSpec index map clamps with the same formula, so
     # out-of-band steps revisit a fetched block (no DMA) and are
     # predicated off here
-    j_eff = _band_lo(i, block_q, block_k, window) + j
+    j_eff = _band_lo(ih, block_q, block_k, window) + j
     run = True
     if causal:
-        run = j_eff * block_k <= i * block_q + block_q - 1
+        run = j_eff * block_k <= ih * block_q + block_q - 1
     if window > 0:
         run = jnp.logical_and(run, j_eff <= nk_total - 1)
 
@@ -194,7 +205,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
             jnp.int32, (block_q, block_k), 1)
         valid = col < kv_len
         if causal or window > 0:
-            row = i * block_q + lax.broadcasted_iota(
+            row = ih * block_q + lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0)
         if causal:
             valid = jnp.logical_and(valid, row >= col)
@@ -229,26 +240,31 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
 
 def _fwd_pallas(q, k, v, *, scale: float, causal: bool,
                 block_q: int, block_k: int, interpret: bool,
-                window: int = 0
+                window: int = 0, group: int = 1, seq_q: int = 0
                 ) -> Tuple[jax.Array, jax.Array]:
-    """q/k/v: (bh, s, d) — returns (o (bh, sq, d), lse (bh, sq))."""
-    bh, sq, d = q.shape
+    """q: (b·kv, group·sq_p, d) pre-padded/folded (``_fold_q``);
+    k/v: (b·kv, sk, d). Returns (o, lse) in the folded layout.
+    ``seq_q`` is the per-head padded q length (sq_p)."""
+    bh, sq_fold, d = q.shape
+    sq_p = seq_q or sq_fold
     sk = k.shape[1]
-    block_q = min(block_q, _round_up(sq, 8))
     block_k = min(block_k, _round_up(sk, 8))
-    sq_p, sk_p = _round_up(sq, block_q), _round_up(sk, block_k)
+    sk_p = _round_up(sk, block_k)
     d_p = _round_up(d, 128)
-    q = jnp.pad(q, ((0, 0), (0, sq_p - sq), (0, d_p - d)))
+    q = jnp.pad(q, ((0, 0), (0, 0), (0, d_p - d)))
     k = jnp.pad(k, ((0, 0), (0, sk_p - sk), (0, d_p - d)))
     v = jnp.pad(v, ((0, 0), (0, sk_p - sk), (0, d_p - d)))
 
     nk = sk_p // block_k
     nj = _band_width(nk, block_q, block_k, window)
-    grid = (bh, sq_p // block_q, nj)
+    nq_head = sq_p // block_q
+    grid = (bh, group * nq_head, nj)
     kernel = functools.partial(
         _fwd_kernel, scale=scale, causal=causal, kv_len=sk,
-        block_q=block_q, block_k=block_k, window=window, nk_total=nk)
-    kv_map = _kv_index_map(block_q, block_k, window, causal, nk)
+        block_q=block_q, block_k=block_k, window=window, nk_total=nk,
+        nq_head=nq_head)
+    kv_map = _kv_index_map(block_q, block_k, window, causal, nk,
+                           nq_head)
     lanes = 128
     scratch = [
         pltpu.VMEM((block_q, d_p), jnp.float32),
@@ -268,8 +284,9 @@ def _fwd_pallas(q, k, v, *, scale: float, causal: bool,
             pl.BlockSpec((1, block_q, lanes), lambda b, i, j: (b, i, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((bh, sq_p, d_p), q.dtype),
-            jax.ShapeDtypeStruct((bh, sq_p, lanes), jnp.float32),
+            jax.ShapeDtypeStruct((bh, group * sq_p, d_p), q.dtype),
+            jax.ShapeDtypeStruct((bh, group * sq_p, lanes),
+                                 jnp.float32),
         ],
         scratch_shapes=scratch,
         # bh and the Q-tile axis own disjoint outputs/accumulator
@@ -279,7 +296,7 @@ def _fwd_pallas(q, k, v, *, scale: float, causal: bool,
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
     )(q, k, v)
-    return o[:, :sq, :d], lse[:, :sq, 0]
+    return o[..., :d], lse[..., 0]
 
 
 # ----------------------------------------------------------------------
@@ -289,10 +306,13 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                    dq_ref, dq_acc_ref,
                    *, scale: float, causal: bool, kv_len: int,
                    block_q: int, block_k: int, window: int = 0,
-                   nk_total: int = 0):
+                   nk_total: int = 0, nq_head: int = 0):
     """Grid (bh, q_blocks, kv_band): Q/dO resident, K/V stream the
-    band (same clamped-index revisit scheme as the forward)."""
+    band (same clamped-index revisit scheme as the forward; grouped
+    folding puts `group` query heads on the q axis — see
+    _fwd_kernel)."""
     i = pl.program_id(1)
+    ih = i % nq_head
     j = pl.program_id(2)
     nk = pl.num_programs(2)
 
@@ -300,10 +320,10 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     def _init():
         dq_acc_ref[...] = jnp.zeros_like(dq_acc_ref)
 
-    j_eff = _band_lo(i, block_q, block_k, window) + j
+    j_eff = _band_lo(ih, block_q, block_k, window) + j
     run = True
     if causal:
-        run = j_eff * block_k <= i * block_q + block_q - 1
+        run = j_eff * block_k <= ih * block_q + block_q - 1
     if window > 0:
         run = jnp.logical_and(run, j_eff <= nk_total - 1)
 
@@ -323,7 +343,7 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             jnp.int32, (block_q, block_k), 1)
         valid = col < kv_len
         if causal or window > 0:
-            row = i * block_q + lax.broadcasted_iota(
+            row = ih * block_q + lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0)
         if causal:
             valid = jnp.logical_and(valid, row >= col)
@@ -347,11 +367,12 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                     dk_ref, dv_ref, dk_acc_ref, dv_acc_ref,
                     *, scale: float, causal: bool, kv_len: int,
                     block_q: int, block_k: int, window: int = 0,
-                    nq_total: int = 0):
-    """Grid (bh, kv_blocks, q_band): K/V resident, Q/dO stream the
-    band of q tiles whose rows can see this kv tile (causal: from the
-    diagonal down; window: at most W-1 rows past it) — same
-    clamped-index revisit scheme as the forward."""
+                    nq_total: int = 0, band_ni: int = 0):
+    """Grid (bh·kv, kv_blocks, group·q_band): K/V resident, Q/dO
+    stream the band of q tiles whose rows can see this kv tile
+    (causal: from the diagonal down; window: at most W-1 rows past
+    it), once per grouped query head — dK/dV accumulate over the
+    whole group. Same clamped-index revisit scheme as the forward."""
     j = pl.program_id(1)
     i = pl.program_id(2)
     nq = pl.num_programs(2)
@@ -361,7 +382,8 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dk_acc_ref[...] = jnp.zeros_like(dk_acc_ref)
         dv_acc_ref[...] = jnp.zeros_like(dv_acc_ref)
 
-    i_eff = _qband_lo(j, block_q, block_k, causal) + i
+    within = i % band_ni
+    i_eff = _qband_lo(j, block_q, block_k, causal) + within
     run = i_eff <= nq_total - 1
     if causal:
         run = jnp.logical_and(
@@ -413,58 +435,61 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 def _bwd_pallas(q, k, v, o, lse, do, *, scale: float, causal: bool,
                 block_q: int, block_k: int, interpret: bool,
-                dlse=None, window: int = 0):
-    """q/k/v/o/do: (bh, s, d), lse: (bh, sq). Returns (dq, dk, dv).
+                dlse=None, window: int = 0, group: int = 1,
+                seq_q: int = 0):
+    """Folded layout (see ``_fwd_pallas``): q/o/do (b·kv, g·sq_p, d),
+    lse (b·kv, g·sq_p), k/v (b·kv, sk, d). Returns (dq, dk, dv) in
+    the same folded layout. ``seq_q`` is the per-head padded q length.
 
-    ``dlse`` (bh, sq), when given, is the upstream gradient on the
+    ``dlse``, when given, is the upstream gradient on the
     log-sum-exp output (ring-flash merges consume lse, so it carries
     real gradient there). Math: dL/ds_ij gains the term
     ``dlse_i · ∂lse_i/∂s_ij = dlse_i · p_ij``, so
     ``ds = p·(dp - delta + dlse)`` — exactly the existing kernels with
     ``delta - dlse`` fed in place of ``delta``. No kernel change.
     """
-    bh, sq, d = q.shape
+    bh, sq_fold, d = q.shape
+    sq_p = seq_q or sq_fold
     sk = k.shape[1]
-    block_q = min(block_q, _round_up(sq, 8))
     block_k = min(block_k, _round_up(sk, 8))
-    sq_p, sk_p = _round_up(sq, block_q), _round_up(sk, block_k)
+    sk_p = _round_up(sk, block_k)
     d_p = _round_up(d, 128)
     lanes = 128
+    nq_head = sq_p // block_q
 
     # delta = rowsum(do * o): one XLA fusion, no kernel needed. Padded
     # rows carry q = do = 0, so their p·(dp - delta) contributions to
     # dk/dv vanish without an explicit row mask.
     delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
-                    axis=-1)                                 # (bh, sq)
+                    axis=-1)                             # (bh, g·sq_p)
     if dlse is not None:
         delta = delta - dlse.astype(jnp.float32)
 
-    q = jnp.pad(q, ((0, 0), (0, sq_p - sq), (0, d_p - d)))
+    q = jnp.pad(q, ((0, 0), (0, 0), (0, d_p - d)))
     k = jnp.pad(k, ((0, 0), (0, sk_p - sk), (0, d_p - d)))
     v = jnp.pad(v, ((0, 0), (0, sk_p - sk), (0, d_p - d)))
-    do = jnp.pad(do, ((0, 0), (0, sq_p - sq), (0, d_p - d)))
-    lse_l = jnp.pad(lse, ((0, 0), (0, sq_p - sq)))[..., None] * \
-        jnp.ones((1, 1, lanes), jnp.float32)
-    delta_l = jnp.pad(delta, ((0, 0), (0, sq_p - sq)))[..., None] * \
-        jnp.ones((1, 1, lanes), jnp.float32)
+    do = jnp.pad(do, ((0, 0), (0, 0), (0, d_p - d)))
+    lse_l = lse[..., None] * jnp.ones((1, 1, lanes), jnp.float32)
+    delta_l = delta[..., None] * jnp.ones((1, 1, lanes), jnp.float32)
 
     nk = sk_p // block_k
     nj = _band_width(nk, block_q, block_k, window)
     q_spec_i = pl.BlockSpec((1, block_q, d_p), lambda b, i, j: (b, i, 0))
     kv_spec_j = pl.BlockSpec((1, block_k, d_p),
                              _kv_index_map(block_q, block_k, window,
-                                           causal, nk))
+                                           causal, nk, nq_head))
     row_spec_i = pl.BlockSpec((1, block_q, lanes),
                               lambda b, i, j: (b, i, 0))
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
                           kv_len=sk, block_q=block_q, block_k=block_k,
-                          window=window, nk_total=nk),
-        grid=(bh, sq_p // block_q, nj),
+                          window=window, nk_total=nk, nq_head=nq_head),
+        grid=(bh, group * nq_head, nj),
         in_specs=[q_spec_i, kv_spec_j, kv_spec_j, q_spec_i, row_spec_i,
                   row_spec_i],
         out_specs=q_spec_i,
-        out_shape=jax.ShapeDtypeStruct((bh, sq_p, d_p), jnp.float32),
+        out_shape=jax.ShapeDtypeStruct((bh, group * sq_p, d_p),
+                                       jnp.float32),
         scratch_shapes=[pltpu.VMEM((block_q, d_p), jnp.float32)],
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
@@ -472,17 +497,18 @@ def _bwd_pallas(q, k, v, o, lse, do, *, scale: float, causal: bool,
     )(q, k, v, do, lse_l, delta_l)
 
     # second kernel: K/V resident, Q streams — grid dims (b, j, i)
-    nq = sq_p // block_q
-    ni = _qband_width(nq, block_q, block_k, window)
-    q_map = _q_index_map(block_q, block_k, window, causal, nq)
+    band_ni = _qband_width(nq_head, block_q, block_k, window)
+    q_map = _q_index_map(block_q, block_k, window, causal, nq_head,
+                         band_ni)
     q_spec_g2 = pl.BlockSpec((1, block_q, d_p), q_map)
     kv_spec_g2 = pl.BlockSpec((1, block_k, d_p), lambda b, j, i: (b, j, 0))
     row_spec_g2 = pl.BlockSpec((1, block_q, lanes), q_map)
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
                           kv_len=sk, block_q=block_q, block_k=block_k,
-                          window=window, nq_total=nq),
-        grid=(bh, sk_p // block_k, ni),
+                          window=window, nq_total=nq_head,
+                          band_ni=band_ni),
+        grid=(bh, sk_p // block_k, group * band_ni),
         in_specs=[q_spec_g2, kv_spec_g2, kv_spec_g2, q_spec_g2,
                   row_spec_g2, row_spec_g2],
         out_specs=[kv_spec_g2, kv_spec_g2],
@@ -494,68 +520,128 @@ def _bwd_pallas(q, k, v, o, lse, do, *, scale: float, causal: bool,
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
     )(q, k, v, do, lse_l, delta_l)
-    return (dq[:, :sq, :d], dk[:, :sk, :d], dv[:, :sk, :d])
+    return (dq[..., :d], dk[:, :sk, :d], dv[:, :sk, :d])
 
 
 # ----------------------------------------------------------------------
-# custom-vjp wrapper
+# grouped fold helpers + custom-vjp wrapper
 # ----------------------------------------------------------------------
+def _fold_q(x, kvh: int, group: int, sq_p: int):
+    """(b, sq, h, d) -> (b*kv, group*sq_p, d): head-major fold with
+    per-head row padding, so each query head's rows are a contiguous
+    run of whole q tiles and K/V stream ONCE per kv head."""
+    b, sq, h, d = x.shape
+    x = jnp.pad(x, ((0, 0), (0, sq_p - sq), (0, 0), (0, 0)))
+    x = x.transpose(0, 2, 1, 3).reshape(b, kvh, group, sq_p, d)
+    return x.reshape(b * kvh, group * sq_p, d)
+
+
+def _unfold_q(x, b: int, kvh: int, group: int, sq_p: int, sq: int):
+    d = x.shape[-1]
+    x = x.reshape(b, kvh * group, sq_p, d)
+    return x.transpose(0, 2, 1, 3)[:, :sq]
+
+
+def _merge_kv(x):
+    """(b, sk, kv, d) -> (b*kv, sk, d)."""
+    b, sk, kvh, d = x.shape
+    return x.transpose(0, 2, 1, 3).reshape(b * kvh, sk, d)
+
+
+def _split_kv(x, b: int, kvh: int):
+    bkv, sk, d = x.shape
+    return x.reshape(b, kvh, sk, d).transpose(0, 2, 1, 3)
+
+
+def _flash_plan(q, k, block_q: int):
+    b, sq, h, d = q.shape
+    kvh = k.shape[2]
+    group = h // kvh
+    bq = min(block_q, _round_up(sq, 8))
+    sq_p = _round_up(sq, bq)
+    return b, sq, h, d, kvh, group, bq, sq_p
+
+
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
 def _flash(q, k, v, causal, scale, block_q, block_k, interpret,
            window=0):
-    o, _ = _fwd_pallas(q, k, v, scale=scale, causal=causal,
-                       block_q=block_q, block_k=block_k,
-                       interpret=interpret, window=window)
-    return o
+    """q: (b, sq, h, d); k/v: (b, sk, kv, d) with kv | h. Grouped
+    query heads fold into the q-row axis, so K/V never materialize at
+    h heads (the GQA point: HBM traffic scales with kv, not h)."""
+    out, _ = _flash_fwd(q, k, v, causal, scale, block_q, block_k,
+                        interpret, window)
+    return out
 
 
 def _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret,
                window=0):
-    o, lse = _fwd_pallas(q, k, v, scale=scale, causal=causal,
-                         block_q=block_q, block_k=block_k,
-                         interpret=interpret, window=window)
-    return o, (q, k, v, o, lse)
+    b, sq, h, d, kvh, group, bq, sq_p = _flash_plan(q, k, block_q)
+    qf = _fold_q(q, kvh, group, sq_p)
+    kf, vf = _merge_kv(k), _merge_kv(v)
+    o, lse = _fwd_pallas(qf, kf, vf, scale=scale, causal=causal,
+                         block_q=bq, block_k=block_k,
+                         interpret=interpret, window=window,
+                         group=group, seq_q=sq_p)
+    out = _unfold_q(o, b, kvh, group, sq_p, sq)
+    return out, (qf, kf, vf, o, lse, (b, sq, kvh, group, bq, sq_p))
 
 
 def _flash_bwd(causal, scale, block_q, block_k, interpret, window,
                res, g):
-    q, k, v, o, lse = res
-    dq, dk, dv = _bwd_pallas(q, k, v, o, lse, g, scale=scale,
-                             causal=causal, block_q=block_q,
+    qf, kf, vf, o, lse, meta = res
+    b, sq, kvh, group, bq, sq_p = meta
+    gf = _fold_q(g, kvh, group, sq_p)
+    dq, dk, dv = _bwd_pallas(qf, kf, vf, o, lse, gf, scale=scale,
+                             causal=causal, block_q=bq,
                              block_k=block_k, interpret=interpret,
-                             window=window)
-    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+                             window=window, group=group, seq_q=sq_p)
+    dq4 = _unfold_q(dq, b, kvh, group, sq_p, sq).astype(qf.dtype)
+    dk4 = _split_kv(dk, b, kvh).astype(kf.dtype)
+    dv4 = _split_kv(dv, b, kvh).astype(vf.dtype)
+    return dq4, dk4, dv4
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)
 
 
+def _pad_rows(x, sq_p: int):
+    return jnp.pad(x, ((0, 0), (0, sq_p - x.shape[1])) +
+                   ((0, 0),) * (x.ndim - 2))
+
+
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
 def _flash_lse(q, k, v, causal, scale, block_q, block_k, interpret):
-    """Like ``_flash`` but also returns the log-sum-exp rows — the
-    merge quantity sequence-parallel (ring) composition needs. lse
-    carries real gradient through the merge weights, handled in the
-    vjp via the ``delta - dlse`` identity (see _bwd_pallas)."""
-    return _fwd_pallas(q, k, v, scale=scale, causal=causal,
-                       block_q=block_q, block_k=block_k,
-                       interpret=interpret)
+    """Like ``_flash`` but merged-head 3D (bh, s, d) and also returns
+    the log-sum-exp rows — the merge quantity sequence-parallel (ring)
+    composition needs. lse carries real gradient through the merge
+    weights, handled in the vjp via the ``delta - dlse`` identity
+    (see _bwd_pallas). Ungrouped (ring repeats KV to full heads
+    before sharding)."""
+    out, _ = _flash_lse_fwd(q, k, v, causal, scale, block_q, block_k,
+                            interpret)
+    return out
 
 
 def _flash_lse_fwd(q, k, v, causal, scale, block_q, block_k, interpret):
-    o, lse = _fwd_pallas(q, k, v, scale=scale, causal=causal,
-                         block_q=block_q, block_k=block_k,
-                         interpret=interpret)
-    return (o, lse), (q, k, v, o, lse)
+    bh, sq, d = q.shape
+    bq = min(block_q, _round_up(sq, 8))
+    sq_p = _round_up(sq, bq)
+    qp = _pad_rows(q, sq_p)
+    o, lse = _fwd_pallas(qp, k, v, scale=scale, causal=causal,
+                         block_q=bq, block_k=block_k,
+                         interpret=interpret, seq_q=sq_p)
+    return (o[:, :sq], lse[:, :sq]), (qp, k, v, o, lse, sq, sq_p, bq)
 
 
 def _flash_lse_bwd(causal, scale, block_q, block_k, interpret, res, g):
-    q, k, v, o, lse = res
+    qp, k, v, o, lse, sq, sq_p, bq = res
     do, dlse = g
-    dq, dk, dv = _bwd_pallas(q, k, v, o, lse, do, scale=scale,
-                             causal=causal, block_q=block_q,
+    dq, dk, dv = _bwd_pallas(qp, k, v, o, lse, _pad_rows(do, sq_p),
+                             scale=scale, causal=causal, block_q=bq,
                              block_k=block_k, interpret=interpret,
-                             dlse=dlse)
-    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+                             dlse=_pad_rows(dlse, sq_p), seq_q=sq_p)
+    return (dq[:, :sq].astype(qp.dtype), dk.astype(k.dtype),
+            dv.astype(v.dtype))
 
 
 _flash_lse.defvjp(_flash_lse_fwd, _flash_lse_bwd)
@@ -576,6 +662,12 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
     transformer can swap between single-chip flash and ring/Ulysses SP
     without reshuffling. Differentiable (custom VJP).
 
+    GQA-native: ``k``/``v`` may carry FEWER heads than ``q``
+    (``kv | h``) — the query-head group folds into the kernel's q-row
+    axis, so K/V stream once per KV head and never materialize at
+    ``h`` heads in HBM (the grouped-attention memory win survives the
+    kernel boundary).
+
     ``window=W`` (requires ``causal=True``) is sliding-window
     attention: query p attends keys in ``[p-W+1, p]``. The kv grid
     axis is BANDED: it spans only ~(block+W)/block tiles per q tile,
@@ -585,6 +677,11 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
     upper triangle, halving their K/V copy traffic.
     """
     b, sq, h, d = q.shape
+    kvh = k.shape[2]
+    if h % kvh:
+        raise ValueError(
+            f"q has {h} heads but k/v have {kvh} — kv heads must "
+            f"divide query heads (GQA)")
     if window < 0:
         raise ValueError(f"window must be >= 0, got {window}")
     if window and not causal:
@@ -596,13 +693,8 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
         interpret = _auto_interpret()
     block_q, block_k = _resolve_blocks(block_q, block_k,
                                        sq, k.shape[1])
-
-    def merge(x):
-        return x.transpose(0, 2, 1, 3).reshape(b * h, x.shape[1], d)
-
-    o = _flash(merge(q), merge(k), merge(v), causal, float(scale),
-               block_q, block_k, bool(interpret), int(window))
-    return o.reshape(b, h, sq, d).transpose(0, 2, 1, 3)
+    return _flash(q, k, v, causal, float(scale), block_q, block_k,
+                  bool(interpret), int(window))
 
 
 def flash_attention_with_lse(q: jax.Array, k: jax.Array, v: jax.Array,

@@ -207,3 +207,41 @@ def test_banded_iteration_many_blocks(window):
     for a, r in zip(gf, gr):
         np.testing.assert_allclose(np.asarray(a), np.asarray(r),
                                    atol=5e-5, rtol=5e-5)
+
+
+@pytest.mark.parametrize("kvh,window", [(1, 0), (2, 0), (2, 9)])
+def test_gqa_grouped_kernel_matches_repeat(kvh, window):
+    """GQA-native path: k/v carry kv heads < q heads and the group
+    folds into the kernel's q-row axis. Values AND gradients must
+    match repeating K/V to full heads (the mathematical definition of
+    GQA), including under a sliding window and odd seq."""
+    from learningorchestra_tpu.parallel.ring import (
+        full_attention_reference)
+
+    b, s, h, d = 2, 40, 4, 16
+    g = h // kvh
+    q = _rand((b, s, h, d), 70)
+    k = _rand((b, s, kvh, d), 71)
+    v = _rand((b, s, kvh, d), 72)
+
+    def grouped(q, k, v):
+        return flash_attention(q, k, v, causal=True, window=window,
+                               block_q=16, block_k=16)
+
+    def oracle(q, k, v):
+        return full_attention_reference(
+            q, jnp.repeat(k, g, axis=2), jnp.repeat(v, g, axis=2),
+            causal=True, window=window)
+
+    out = grouped(q, k, v)
+    ref = oracle(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+
+    gf = jax.grad(lambda *a: jnp.sum(grouped(*a) ** 2),
+                  argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(lambda *a: jnp.sum(oracle(*a) ** 2),
+                  argnums=(0, 1, 2))(q, k, v)
+    for a, r in zip(gf, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(r),
+                                   atol=5e-5, rtol=5e-5)
