@@ -38,6 +38,7 @@ always prints the final JSON line.
 """
 
 import argparse
+import functools
 import json
 import os
 import signal
@@ -417,6 +418,18 @@ def phase_flash():
             if "flash_fwd_bwd_ms" in entry and "dot_fwd_bwd_ms" in entry:
                 entry["speedup"] = round(
                     entry["dot_fwd_bwd_ms"] / entry["flash_fwd_bwd_ms"], 3)
+            # sliding-window row (causal only): the banded grid should
+            # make this ~O(s*W) — the evidence for the clamp-indexed
+            # tile iteration
+            win = int(os.environ.get("LO_BENCH_FLASH_WINDOW", "0"))
+            if causal and win:
+                try:
+                    wfn = functools.partial(attn.flash_attention,
+                                            window=win)
+                    entry[f"flash_window{win}_fwd_bwd_ms"] = round(
+                        timed_ms_per_iter(wfn, q, k, v, True), 3)
+                except Exception as exc:  # noqa: BLE001
+                    entry[f"flash_window{win}_error"] = _scrub_exc(exc)
             results[key] = entry
     results["platform"] = jax.devices()[0].platform
     return results

@@ -709,6 +709,11 @@ def flash_attention_with_lse(q: jax.Array, k: jax.Array, v: jax.Array,
     merge exactly via log-sum-exp weights. Differentiable in both
     outputs (lse gradient flows through the merge)."""
     b, sq, h, d = q.shape
+    if k.shape[2] != h:
+        raise ValueError(
+            f"flash_attention_with_lse needs equal head counts "
+            f"(q has {h}, k/v have {k.shape[2]}) — repeat K/V to "
+            f"full heads first; grouped GQA is flash_attention only")
     if scale is None:
         scale = 1.0 / (d ** 0.5)
     if interpret is None:

@@ -68,6 +68,9 @@ run tlm_remat_full_b64 LO_TLM_REMAT=full LO_BENCH_TLM_BATCH=64 \
 run gen LO_NOOP=1 -- --phase gen
 # flash crossover below 1024
 run flash512 LO_BENCH_FLASH_SEQS=512,1024 -- --phase flash
+# sliding-window banded-grid evidence (W=1024 at long seq)
+run flash_window LO_BENCH_FLASH_WINDOW=1024 \
+    LO_BENCH_FLASH_SEQS=4096,8192 -- --phase flash
 # full run + BENCHMARKS.md regeneration (bench.py's own guard keeps
 # the committed table unless the chip answered)
 wait_for_chip
