@@ -1105,7 +1105,8 @@ class LanguageModel:
     def generate(self, prompt, max_new_tokens: int = 32,
                  temperature: float = 0.0, seed: int = 0,
                  top_k: Optional[int] = None,
-                 top_p: Optional[float] = None) -> np.ndarray:
+                 top_p: Optional[float] = None,
+                 num_beams: int = 1) -> np.ndarray:
         """Greedy / temperature sampling with an incremental KV cache:
         the prompt runs ONCE (prefill fills every layer's K/V cache),
         then the whole continuation decodes inside ONE jitted
@@ -1124,6 +1125,19 @@ class LanguageModel:
         padding by ``next_token_loss`` and is masked out of sampling.
         """
         self._require_built()
+        if num_beams > 1:
+            if temperature > 0:
+                raise ValueError(
+                    "beam search is deterministic — use temperature=0 "
+                    "(sampling and beams don't compose)")
+            if num_beams >= self.vocab_size:
+                # token 0 is pad-masked, so vocab-1 real candidates
+                raise ValueError(
+                    f"num_beams={num_beams} exceeds the "
+                    f"{self.vocab_size - 1} non-pad vocabulary "
+                    f"candidates")
+            return self._beam_search(prompt, max_new_tokens,
+                                     int(num_beams))
         if temperature <= 0:
             # greedy argmax never reads the filters — normalize so
             # generate(.., top_k=50) shares the greedy compile
@@ -1140,12 +1154,7 @@ class LanguageModel:
                 raise ValueError(f"top_p must be in (0, 1], got {top_p}")
             if top_p == 1.0:
                 top_p = None  # keeps everything — same compile as None
-        prompt = np.atleast_2d(np.asarray(prompt)).astype(np.int32)
-        b, s = prompt.shape
-        if s >= self.max_len:
-            prompt = prompt[:, -(self.max_len - 1):]
-            s = prompt.shape[1]
-        total = min(self.max_len, s + max_new_tokens)
+        prompt, b, s, total = self._prep_prompt(prompt, max_new_tokens)
         if total <= s:
             # nothing to generate — prefill would clamp buf[:, s] onto
             # the last prompt column and corrupt it
@@ -1163,6 +1172,97 @@ class LanguageModel:
             key, sub = jax.random.split(key)
             buf, cache = decode(params, cache, buf, sub)
         return np.asarray(buf)
+
+    def _prep_prompt(self, prompt, max_new_tokens: int):
+        """Shared generate/beam preprocessing: 2-D int32 prompt,
+        sliding-window truncation of prompts at/over max_len, and the
+        clamped total length."""
+        prompt = np.atleast_2d(np.asarray(prompt)).astype(np.int32)
+        b, s = prompt.shape
+        if s >= self.max_len:
+            prompt = prompt[:, -(self.max_len - 1):]
+            s = prompt.shape[1]
+        total = min(self.max_len, s + max_new_tokens)
+        return prompt, b, s, total
+
+    # ------------------------------------------------------------------
+    # beam search
+    # ------------------------------------------------------------------
+    def _beam_search(self, prompt, max_new_tokens: int,
+                     num_beams: int) -> np.ndarray:
+        """Deterministic beam search over the KV cache: prefill runs
+        once per sample, the cache tiles to ``b·beams`` rows, and each
+        jitted ``fori_loop`` step scores every (beam, token) candidate
+        (summed log-probs), keeps the top ``num_beams``, and REORDERS
+        buf+cache by each survivor's parent beam (a batch-axis gather
+        inside the loop). All beams share one fixed length, so raw
+        summed log-prob is the ranking (no length penalty needed);
+        returns the best beam per sample, shape (b, s+new)."""
+        prompt, b, s, total = self._prep_prompt(prompt, max_new_tokens)
+        if total <= s:
+            return prompt
+        fns = getattr(self, "_beam_cache_fns", None)
+        if fns is None:
+            fns = self._beam_cache_fns = {}
+        sig = (b, s, total, num_beams, self._resolved_attention(s))
+        if sig not in fns:
+            fns[sig] = self._build_beam_fns(b, s, total, num_beams)
+        run = fns[sig]
+        return np.asarray(run(self.params, jnp.asarray(prompt)))
+
+    def _build_beam_fns(self, b: int, s: int, total: int, n: int):
+        module = self._module_for(s)
+        V = self.vocab_size
+
+        def logp_of(logits):
+            lg = logits.astype(jnp.float32)
+            lg = lg.at[..., 0].set(ring_lib.NEG_INF)  # pad token
+            return jax.nn.log_softmax(lg, axis=-1)
+
+        @jax.jit
+        def run(params, prompt):
+            buf0 = jnp.zeros((b, total), jnp.int32).at[:, :s].set(prompt)
+            (logits, _), mut = module.apply(
+                {"params": params}, prompt, train=False,
+                cache_len=total, mutable=["cache"])
+            first = logp_of(logits[:, -1])                  # (b, V)
+            scores, toks = jax.lax.top_k(first, n)          # (b, n)
+            buf = jnp.repeat(buf0[:, None, :], n, axis=1)   # (b, n, T)
+            buf = buf.at[:, :, s].set(toks)
+            cache = jax.tree_util.tree_map(
+                lambda c: jnp.repeat(c, n, axis=0), mut["cache"])
+
+            def body(pos, carry):
+                buf, cache, scores = carry
+                tok = jax.lax.dynamic_slice(
+                    buf, (0, 0, pos - 1), (b, n, 1)).reshape(b * n, 1)
+                (lg, _), mut = module.apply(
+                    {"params": params, "cache": cache}, tok,
+                    train=False, decode_pos=pos - 1, cache_len=total,
+                    mutable=["cache"])
+                logp = logp_of(lg[:, 0]).reshape(b, n, V)
+                cand = scores[..., None] + logp             # (b, n, V)
+                scores, flat = jax.lax.top_k(
+                    cand.reshape(b, n * V), n)              # (b, n)
+                parent = flat // V
+                token = (flat % V).astype(jnp.int32)
+                buf = jnp.take_along_axis(
+                    buf, parent[..., None], axis=1)
+                buf = jax.lax.dynamic_update_slice(
+                    buf, token[..., None], (0, 0, pos))
+                rows = (jnp.arange(b)[:, None] * n
+                        + parent).reshape(-1)               # (b*n,)
+                cache = jax.tree_util.tree_map(
+                    lambda c: jnp.take(c, rows, axis=0), mut["cache"])
+                return buf, cache, scores
+
+            buf, cache, scores = jax.lax.fori_loop(
+                s + 1, total, body, (buf, cache, scores))
+            best = jnp.argmax(scores, axis=1)
+            return jnp.take_along_axis(
+                buf, best[:, None, None], axis=1)[:, 0]
+
+        return run
 
     @staticmethod
     def _sample(last, temperature: float, key,

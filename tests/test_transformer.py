@@ -856,3 +856,65 @@ def test_gqa_flash_sharded_fit_stays_native(tmp_path):
     x = _toy_tokens(n=16)
     hist = model.fit(x, batch_size=8, epochs=1, shuffle=False)
     assert np.isfinite(hist.history["loss"][0])
+
+
+# ----------------------------------------------------------------------
+# beam search
+# ----------------------------------------------------------------------
+def _seq_logprob(lm, seq, prompt_len):
+    """Model's own summed log-prob of seq's continuation (pad-masked)."""
+    logits = lm.predict(seq[None], batch_size=1)[0]
+    lp = jax.nn.log_softmax(
+        jnp.asarray(logits).astype(jnp.float32).at[..., 0]
+        .set(-1e30), axis=-1)
+    tot = 0.0
+    for pos in range(prompt_len, len(seq)):
+        tot += float(lp[pos - 1, seq[pos]])
+    return tot
+
+
+def test_beam_search_matches_greedy_and_finds_optimum(tmp_path):
+    """num_beams=1 must equal greedy decode exactly. For a 2-token
+    horizon a FULL-WIDTH beam (num_beams = vocab-1, every non-pad
+    first token kept) is exhaustive search, so its result must be the
+    global argmax continuation — a guaranteed property, unlike
+    beam-vs-greedy comparisons (narrow beams may prune the greedy
+    path)."""
+    _mesh_config(tmp_path, "dp=1")
+    V = 12
+    lm = LanguageModel(vocab_size=V, d_model=16, n_layers=1,
+                       n_heads=2, max_len=16, attention="dot")
+    x = _toy_tokens(n=16, seq=12, vocab=V)
+    lm.fit(x, batch_size=8, epochs=2)
+    prompt = x[:2, :4]
+
+    greedy = lm.generate(prompt, max_new_tokens=6, temperature=0.0)
+    beam1 = lm.generate(prompt, max_new_tokens=6, num_beams=1)
+    np.testing.assert_array_equal(beam1, greedy)
+
+    full = lm.generate(prompt, max_new_tokens=2, num_beams=V - 1)
+    assert (full[:, :4] == prompt).all() and (full > 0).all()
+    # brute-force oracle over all (V-1)^2 continuations
+    for i in range(2):
+        best_lp, best_seq = -np.inf, None
+        for t1 in range(1, V):
+            for t2 in range(1, V):
+                seq = np.concatenate([prompt[i], [t1, t2]])
+                lp = _seq_logprob(lm, seq, 4)
+                if lp > best_lp:
+                    best_lp, best_seq = lp, seq
+        np.testing.assert_array_equal(full[i], best_seq)
+
+    with pytest.raises(ValueError, match="num_beams"):
+        lm.generate(prompt, max_new_tokens=2, num_beams=V)
+
+
+def test_beam_search_rejects_sampling(tmp_path):
+    _mesh_config(tmp_path, "dp=1")
+    lm = LanguageModel(vocab_size=16, d_model=16, n_layers=1,
+                       n_heads=2, max_len=12, attention="dot")
+    x = _toy_tokens(n=8, seq=8, vocab=16)
+    lm.fit(x, batch_size=8, epochs=1)
+    with pytest.raises(ValueError, match="beam"):
+        lm.generate(x[:1, :4], max_new_tokens=2, temperature=0.8,
+                    num_beams=2)
