@@ -71,6 +71,9 @@ run flash512 LO_BENCH_FLASH_SEQS=512,1024 -- --phase flash
 # sliding-window banded-grid evidence (W=1024 at long seq)
 run flash_window LO_BENCH_FLASH_WINDOW=1024 \
     LO_BENCH_FLASH_SEQS=4096,8192 -- --phase flash
+# full flash table on the BANDED kernels (flash_auto measured the
+# pre-banding kernel; the causal rows should improve)
+run flash_banded LO_NOOP=1 -- --phase flash
 # full run + BENCHMARKS.md regeneration (bench.py's own guard keeps
 # the committed table unless the chip answered)
 wait_for_chip
