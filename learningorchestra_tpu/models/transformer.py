@@ -852,6 +852,7 @@ class LanguageModel:
         self._state = None
         self._mesh_override = None
         self._accum = engine_lib.default_grad_accum()
+        self._drop_decode_caches()
 
     def set_mesh(self, mesh) -> None:
         """Pin this model to a mesh (e.g. a sweep trial's sub-slice of
@@ -861,6 +862,14 @@ class LanguageModel:
         # device state from a previous fit is laid out on the old mesh;
         # host params survive, state must rebuild on the new mesh
         self._state = None
+        self._drop_decode_caches()
+
+    def _drop_decode_caches(self) -> None:
+        """Generation/beam compiles close over the mesh-resolved
+        module — anything that changes the mesh or the param layout
+        must drop them or a stale compile serves the old config."""
+        self._gen_cache_fns = {}
+        self._beam_cache_fns = {}
 
     def _mesh(self):
         return self._mesh_override or mesh_lib.get_default_mesh()
@@ -1201,9 +1210,7 @@ class LanguageModel:
         prompt, b, s, total = self._prep_prompt(prompt, max_new_tokens)
         if total <= s:
             return prompt
-        fns = getattr(self, "_beam_cache_fns", None)
-        if fns is None:
-            fns = self._beam_cache_fns = {}
+        fns = self._beam_cache_fns
         sig = (b, s, total, num_beams, self._resolved_attention(s))
         if sig not in fns:
             fns[sig] = self._build_beam_fns(b, s, total, num_beams)
@@ -1297,9 +1304,7 @@ class LanguageModel:
         reuse the compile. ``decode`` runs the WHOLE continuation in
         one fori_loop program (buf and cache donated into it, updated
         in place across iterations — no per-token host round trip)."""
-        fns = getattr(self, "_gen_cache_fns", None)
-        if fns is None:
-            fns = self._gen_cache_fns = {}
+        fns = self._gen_cache_fns
         # resolve flash-vs-dot from the PREFILL length, not max_len: a
         # max_len>=2048 model generating from a short prompt attends
         # over only s tokens, below the measured flash crossover
@@ -1392,7 +1397,7 @@ class LanguageModel:
         self.params = graft(fresh, engine_lib.to_host(self.params))
         self._engine = None
         self._state = None
-        self._gen_cache_fns = {}
+        self._drop_decode_caches()
 
     def merge_lora(self) -> None:
         """Fold the adapters into the base kernels (W += A·B·α/r) and
@@ -1418,7 +1423,7 @@ class LanguageModel:
         self.lora_rank = 0
         self._engine = None
         self._state = None
-        self._gen_cache_fns = {}
+        self._drop_decode_caches()
 
     def num_params(self) -> int:
         if self.params is None:

@@ -918,3 +918,19 @@ def test_beam_search_rejects_sampling(tmp_path):
     with pytest.raises(ValueError, match="beam"):
         lm.generate(x[:1, :4], max_new_tokens=2, temperature=0.8,
                     num_beams=2)
+
+
+def test_set_mesh_drops_decode_caches(tmp_path):
+    """Generation/beam compiles close over the mesh-resolved module;
+    re-pinning the mesh (sweep sub-slices) must drop them so a stale
+    compile can't serve the old mesh."""
+    _mesh_config(tmp_path, "dp=1")
+    lm = LanguageModel(vocab_size=16, d_model=16, n_layers=1,
+                       n_heads=2, max_len=12, attention="dot")
+    x = _toy_tokens(n=8, seq=8, vocab=16)
+    lm.fit(x, batch_size=8, epochs=1)
+    lm.generate(x[:1, :4], max_new_tokens=2)
+    lm.generate(x[:1, :4], max_new_tokens=2, num_beams=2)
+    assert lm._gen_cache_fns and lm._beam_cache_fns
+    lm.set_mesh(mesh_lib.build_mesh("dp=2"))
+    assert not lm._gen_cache_fns and not lm._beam_cache_fns
