@@ -250,11 +250,10 @@ def _dispatch_attention(q, k, v, *, impl: str, causal: bool, mesh=None,
     The single-chip flash path consumes them natively (the kernel
     folds the query group — K/V never materialize at h heads); every
     other impl repeats K/V up to h first, which XLA fuses into the
-    consuming matmul on the dot path."""
-    if window > 0 and impl in ("ring", "ulysses"):
-        raise ValueError(
-            f"sliding_window is not supported with {impl} sequence "
-            f"parallelism (use dot/flash, or window=0)")
+    consuming matmul on the dot path. ``window`` composes with every
+    impl: ring hops apply the exact banded mask at static cross-shard
+    offsets (hops wholly below the band skip), Ulysses windows its
+    local full-sequence attention."""
     mesh = mesh or mesh_lib.get_default_mesh()
     b, s, h, _ = q.shape
     kvh = k.shape[2]
@@ -276,11 +275,13 @@ def _dispatch_attention(q, k, v, *, impl: str, causal: bool, mesh=None,
     if impl == "ring" and sp > 1 and divisible:
         kr, vr = repeated()
         return ring_lib.ring_attention_sharded(q, kr, vr, mesh,
-                                               causal=causal)
+                                               causal=causal,
+                                               window=window)
     if impl == "ulysses" and sp > 1 and divisible and h % sp == 0:
         kr, vr = repeated()
         return ulysses_lib.ulysses_attention_sharded(q, kr, vr, mesh,
-                                                     causal=causal)
+                                                     causal=causal,
+                                                     window=window)
     if impl == "flash":
         sharded = tp > 1 or data_size > 1
         if not sharded:
@@ -463,7 +464,8 @@ class TransformerLM(nn.Module):
     lora_alpha: float = 16.0
     # sliding-window attention (banded causal, Mistral-style): query p
     # attends [p-W+1, p]; the flash kernels iterate a banded tile
-    # grid so compute AND K/V DMA scale ~O(s*W). dot/flash only.
+    # grid so compute AND K/V DMA scale ~O(s*W). Composes with every
+    # impl incl. ring/Ulysses sequence parallelism.
     sliding_window: int = 0
     # per-layer rematerialization under training: "none" saves all
     # activations, "dots" saves matmul outputs only (the standard TPU
@@ -819,10 +821,6 @@ class LanguageModel:
         if self.sliding_window < 0:
             raise ValueError(
                 f"sliding_window must be >= 0, got {sliding_window}")
-        if self.sliding_window and attention in ("ring", "ulysses"):
-            raise ValueError(
-                "sliding_window is not supported with ring/ulysses "
-                "sequence parallelism")
         # LO_TLM_REMAT env overrides; default "none" (measure before
         # paying recompute FLOPs — see BENCHMARKS.md queued table)
         self.remat = remat

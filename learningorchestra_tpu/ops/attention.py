@@ -77,11 +77,15 @@ def _auto_block(seq: int) -> int:
     return block
 
 
-def _band_lo(i, block_q: int, block_k: int, window: int):
-    """First in-band kv tile for q tile ``i`` (0 when unwindowed)."""
+def _band_lo(i, block_q: int, block_k: int, window: int,
+             offset: int = 0):
+    """First in-band kv tile for q tile ``i`` (0 when unwindowed).
+    ``offset`` is the static global-position shift of the k axis
+    relative to q (cross-shard ring hops): col_global = c + offset."""
     if window <= 0:
         return 0
-    return jnp.maximum(0, (i * block_q - window + 1) // block_k)
+    return jnp.maximum(0, (i * block_q - window + 1 - offset)
+                       // block_k)
 
 
 def _band_width(nk: int, block_q: int, block_k: int,
@@ -94,7 +98,8 @@ def _band_width(nk: int, block_q: int, block_k: int,
 
 
 def _kv_index_map(block_q: int, block_k: int, window: int,
-                  causal: bool, nk: int, nq_head: int):
+                  causal: bool, nk: int, nq_head: int,
+                  offset: int = 0):
     """BlockSpec index map for the streamed K/V tiles: maps grid step
     j to kv tile clip(lo+j, 0, hi). Out-of-band steps repeat the
     boundary tile index — Mosaic's pipeline only issues a copy when
@@ -105,21 +110,28 @@ def _kv_index_map(block_q: int, block_k: int, window: int,
 
     def index(b, i, j):
         ih = i % nq_head
-        j_eff = _band_lo(ih, block_q, block_k, window) + j
+        j_eff = _band_lo(ih, block_q, block_k, window, offset) + j
         hi = nk - 1
         if causal:
-            hi = jnp.minimum(hi,
-                             (ih * block_q + block_q - 1) // block_k)
+            # floored: a positive offset (future-shifted keys) could
+            # push the causal bound below 0 — the DMA index must stay
+            # in bounds even for tiles the run predicate discards
+            hi = jnp.maximum(
+                jnp.minimum(
+                    hi,
+                    (ih * block_q + block_q - 1 - offset) // block_k),
+                0)
         return (b, jnp.clip(j_eff, 0, hi), 0)
 
     return index
 
 
-def _qband_lo(j, block_q: int, block_k: int, causal: bool):
+def _qband_lo(j, block_q: int, block_k: int, causal: bool,
+              offset: int = 0):
     """First q tile whose rows can see kv tile ``j`` (causal)."""
     if not causal:
         return 0
-    return (j * block_k) // block_q
+    return jnp.maximum(0, (j * block_k + offset) // block_q)
 
 
 def _qband_width(nq: int, block_q: int, block_k: int,
@@ -133,7 +145,8 @@ def _qband_width(nq: int, block_q: int, block_k: int,
 
 
 def _q_index_map(block_q: int, block_k: int, window: int,
-                 causal: bool, nq: int, band_ni: int):
+                 causal: bool, nq: int, band_ni: int,
+                 offset: int = 0):
     """Streamed-Q BlockSpec index map for the dK/dV kernel: grid step
     i = (head, within-band) -> folded q tile
     head·nq + clip(lo+within, 0, hi); out-of-band steps revisit."""
@@ -141,11 +154,19 @@ def _q_index_map(block_q: int, block_k: int, window: int,
     def index(b, j, i):
         head = i // band_ni
         within = i % band_ni
-        i_eff = _qband_lo(j, block_q, block_k, causal) + within
+        i_eff = _qband_lo(j, block_q, block_k, causal, offset) + within
         hi = nq - 1
         if window > 0:
-            hi = jnp.minimum(
-                hi, (j * block_k + block_k - 1 + window - 1) // block_q)
+            # a negative ring offset can push the whole band before
+            # row 0 (hi < 0) — floor it so the clip never emits a
+            # negative block index (the run predicate discards the
+            # tile's data, but the DMA itself must stay in bounds)
+            hi = jnp.maximum(
+                jnp.minimum(
+                    hi,
+                    (j * block_k + block_k - 1 + offset + window - 1)
+                    // block_q),
+                0)
         return (b, head * nq + jnp.clip(i_eff, 0, hi), 0)
 
     return index
@@ -164,11 +185,13 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
                 acc_ref, m_ref, l_ref,
                 *, scale: float, causal: bool, kv_len: int,
                 block_q: int, block_k: int, window: int = 0,
-                nk_total: int = 0, nq_head: int = 0):
+                nk_total: int = 0, nq_head: int = 0,
+                offset: int = 0):
     # grouped-query folding: the q-row axis stacks `group` query heads
     # per kv head, so the tile's POSITION within its head is
     # i % nq_head (== i when ungrouped) — all causal/window math uses
-    # that, while the storage index stays i
+    # that, while the storage index stays i. `offset` statically
+    # shifts k positions (cross-shard ring hops): col = c + offset.
     i = pl.program_id(1)
     ih = i % nq_head
     j = pl.program_id(2)
@@ -185,10 +208,11 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
     # BlockSpec index map clamps with the same formula, so
     # out-of-band steps revisit a fetched block (no DMA) and are
     # predicated off here
-    j_eff = _band_lo(ih, block_q, block_k, window) + j
+    j_eff = _band_lo(ih, block_q, block_k, window, offset) + j
     run = True
     if causal:
-        run = j_eff * block_k <= ih * block_q + block_q - 1
+        run = (j_eff * block_k + offset
+               <= ih * block_q + block_q - 1)
     if window > 0:
         run = jnp.logical_and(run, j_eff <= nk_total - 1)
 
@@ -208,9 +232,10 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
             row = ih * block_q + lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0)
         if causal:
-            valid = jnp.logical_and(valid, row >= col)
+            valid = jnp.logical_and(valid, row >= col + offset)
         if window > 0:
-            valid = jnp.logical_and(valid, col > row - window)
+            valid = jnp.logical_and(valid,
+                                    col + offset > row - window)
         s = jnp.where(valid, s, NEG_INF)
 
         m_prev = m_ref[:, :1]                              # (bq, 1)
@@ -231,7 +256,10 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
         safe_l = jnp.where(l > 0, l, 1.0)
         o_ref[0] = (acc_ref[...] / safe_l).astype(o_ref.dtype)
         m = m_ref[:, :1]
-        lse = jnp.where(l > 0, m + jnp.log(safe_l), 0.0)  # (bq, 1)
+        # a row with NO visible keys (possible on windowed/offset
+        # hops) must carry lse = -inf so ring log-sum-exp merges give
+        # it ZERO weight — 0.0 would weigh it exp(0) = 1
+        lse = jnp.where(l > 0, m + jnp.log(safe_l), NEG_INF)  # (bq, 1)
         # lse output carries a 128-lane trailing dim (Mosaic requires
         # the last two block dims tile to (8, 128)); value broadcast
         # across lanes, wrapper reads lane 0
@@ -240,7 +268,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
 
 def _fwd_pallas(q, k, v, *, scale: float, causal: bool,
                 block_q: int, block_k: int, interpret: bool,
-                window: int = 0, group: int = 1, seq_q: int = 0
+                window: int = 0, group: int = 1, seq_q: int = 0,
+                offset: int = 0
                 ) -> Tuple[jax.Array, jax.Array]:
     """q: (b·kv, group·sq_p, d) pre-padded/folded (``_fold_q``);
     k/v: (b·kv, sk, d). Returns (o, lse) in the folded layout.
@@ -262,9 +291,9 @@ def _fwd_pallas(q, k, v, *, scale: float, causal: bool,
     kernel = functools.partial(
         _fwd_kernel, scale=scale, causal=causal, kv_len=sk,
         block_q=block_q, block_k=block_k, window=window, nk_total=nk,
-        nq_head=nq_head)
+        nq_head=nq_head, offset=offset)
     kv_map = _kv_index_map(block_q, block_k, window, causal, nk,
-                           nq_head)
+                           nq_head, offset)
     lanes = 128
     scratch = [
         pltpu.VMEM((block_q, d_p), jnp.float32),
@@ -306,7 +335,8 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                    dq_ref, dq_acc_ref,
                    *, scale: float, causal: bool, kv_len: int,
                    block_q: int, block_k: int, window: int = 0,
-                   nk_total: int = 0, nq_head: int = 0):
+                   nk_total: int = 0, nq_head: int = 0,
+                   offset: int = 0):
     """Grid (bh, q_blocks, kv_band): Q/dO resident, K/V stream the
     band (same clamped-index revisit scheme as the forward; grouped
     folding puts `group` query heads on the q axis — see
@@ -320,10 +350,11 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     def _init():
         dq_acc_ref[...] = jnp.zeros_like(dq_acc_ref)
 
-    j_eff = _band_lo(ih, block_q, block_k, window) + j
+    j_eff = _band_lo(ih, block_q, block_k, window, offset) + j
     run = True
     if causal:
-        run = j_eff * block_k <= ih * block_q + block_q - 1
+        run = (j_eff * block_k + offset
+               <= ih * block_q + block_q - 1)
     if window > 0:
         run = jnp.logical_and(run, j_eff <= nk_total - 1)
 
@@ -346,9 +377,10 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             row = ih * block_q + lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0)
         if causal:
-            valid = jnp.logical_and(valid, row >= col)
+            valid = jnp.logical_and(valid, row >= col + offset)
         if window > 0:
-            valid = jnp.logical_and(valid, col > row - window)
+            valid = jnp.logical_and(valid,
+                                    col + offset > row - window)
         p = jnp.where(valid, jnp.exp(s - lse), 0.0)
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())),
@@ -367,7 +399,8 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                     dk_ref, dv_ref, dk_acc_ref, dv_acc_ref,
                     *, scale: float, causal: bool, kv_len: int,
                     block_q: int, block_k: int, window: int = 0,
-                    nq_total: int = 0, band_ni: int = 0):
+                    nq_total: int = 0, band_ni: int = 0,
+                    offset: int = 0):
     """Grid (bh·kv, kv_blocks, group·q_band): K/V resident, Q/dO
     stream the band of q tiles whose rows can see this kv tile
     (causal: from the diagonal down; window: at most W-1 rows past
@@ -383,15 +416,17 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dv_acc_ref[...] = jnp.zeros_like(dv_acc_ref)
 
     within = i % band_ni
-    i_eff = _qband_lo(j, block_q, block_k, causal) + within
+    i_eff = _qband_lo(j, block_q, block_k, causal, offset) + within
     run = i_eff <= nq_total - 1
     if causal:
         run = jnp.logical_and(
-            run, j * block_k <= i_eff * block_q + block_q - 1)
+            run,
+            j * block_k + offset <= i_eff * block_q + block_q - 1)
     if window > 0:
         run = jnp.logical_and(
             run,
-            i_eff * block_q <= j * block_k + block_k - 1 + window - 1)
+            i_eff * block_q
+            <= j * block_k + block_k - 1 + offset + window - 1)
 
     @pl.when(run)
     def _tile():
@@ -412,9 +447,10 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             row = i_eff * block_q + lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0)
         if causal:
-            valid = jnp.logical_and(valid, row >= col)
+            valid = jnp.logical_and(valid, row >= col + offset)
         if window > 0:
-            valid = jnp.logical_and(valid, col > row - window)
+            valid = jnp.logical_and(valid,
+                                    col + offset > row - window)
         p = jnp.where(valid, jnp.exp(s - lse), 0.0)         # (bq, bk)
         dv_acc_ref[...] += jax.lax.dot_general(
             p, do, (((0,), (0,)), ((), ())),
@@ -436,7 +472,7 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 def _bwd_pallas(q, k, v, o, lse, do, *, scale: float, causal: bool,
                 block_q: int, block_k: int, interpret: bool,
                 dlse=None, window: int = 0, group: int = 1,
-                seq_q: int = 0):
+                seq_q: int = 0, offset: int = 0):
     """Folded layout (see ``_fwd_pallas``): q/o/do (b·kv, g·sq_p, d),
     lse (b·kv, g·sq_p), k/v (b·kv, sk, d). Returns (dq, dk, dv) in
     the same folded layout. ``seq_q`` is the per-head padded q length.
@@ -477,13 +513,15 @@ def _bwd_pallas(q, k, v, o, lse, do, *, scale: float, causal: bool,
     q_spec_i = pl.BlockSpec((1, block_q, d_p), lambda b, i, j: (b, i, 0))
     kv_spec_j = pl.BlockSpec((1, block_k, d_p),
                              _kv_index_map(block_q, block_k, window,
-                                           causal, nk, nq_head))
+                                           causal, nk, nq_head,
+                                           offset))
     row_spec_i = pl.BlockSpec((1, block_q, lanes),
                               lambda b, i, j: (b, i, 0))
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
                           kv_len=sk, block_q=block_q, block_k=block_k,
-                          window=window, nk_total=nk, nq_head=nq_head),
+                          window=window, nk_total=nk, nq_head=nq_head,
+                          offset=offset),
         grid=(bh, group * nq_head, nj),
         in_specs=[q_spec_i, kv_spec_j, kv_spec_j, q_spec_i, row_spec_i,
                   row_spec_i],
@@ -499,7 +537,7 @@ def _bwd_pallas(q, k, v, o, lse, do, *, scale: float, causal: bool,
     # second kernel: K/V resident, Q streams — grid dims (b, j, i)
     band_ni = _qband_width(nq_head, block_q, block_k, window)
     q_map = _q_index_map(block_q, block_k, window, causal, nq_head,
-                         band_ni)
+                         band_ni, offset)
     q_spec_g2 = pl.BlockSpec((1, block_q, d_p), q_map)
     kv_spec_g2 = pl.BlockSpec((1, block_k, d_p), lambda b, j, i: (b, j, 0))
     row_spec_g2 = pl.BlockSpec((1, block_q, lanes), q_map)
@@ -507,7 +545,7 @@ def _bwd_pallas(q, k, v, o, lse, do, *, scale: float, causal: bool,
         functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
                           kv_len=sk, block_q=block_q, block_k=block_k,
                           window=window, nq_total=nq_head,
-                          band_ni=band_ni),
+                          band_ni=band_ni, offset=offset),
         grid=(bh, sk_p // block_k, group * band_ni),
         in_specs=[q_spec_g2, kv_spec_g2, kv_spec_g2, q_spec_g2,
                   row_spec_g2, row_spec_g2],
@@ -609,8 +647,10 @@ def _pad_rows(x, sq_p: int):
                    ((0, 0),) * (x.ndim - 2))
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
-def _flash_lse(q, k, v, causal, scale, block_q, block_k, interpret):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8,
+                                                    9))
+def _flash_lse(q, k, v, causal, scale, block_q, block_k, interpret,
+               window=0, offset=0):
     """Like ``_flash`` but merged-head 3D (bh, s, d) and also returns
     the log-sum-exp rows — the merge quantity sequence-parallel (ring)
     composition needs. lse carries real gradient through the merge
@@ -618,28 +658,32 @@ def _flash_lse(q, k, v, causal, scale, block_q, block_k, interpret):
     (see _bwd_pallas). Ungrouped (ring repeats KV to full heads
     before sharding)."""
     out, _ = _flash_lse_fwd(q, k, v, causal, scale, block_q, block_k,
-                            interpret)
+                            interpret, window, offset)
     return out
 
 
-def _flash_lse_fwd(q, k, v, causal, scale, block_q, block_k, interpret):
+def _flash_lse_fwd(q, k, v, causal, scale, block_q, block_k, interpret,
+                   window=0, offset=0):
     bh, sq, d = q.shape
     bq = min(block_q, _round_up(sq, 8))
     sq_p = _round_up(sq, bq)
     qp = _pad_rows(q, sq_p)
     o, lse = _fwd_pallas(qp, k, v, scale=scale, causal=causal,
                          block_q=bq, block_k=block_k,
-                         interpret=interpret, seq_q=sq_p)
+                         interpret=interpret, seq_q=sq_p,
+                         window=window, offset=offset)
     return (o[:, :sq], lse[:, :sq]), (qp, k, v, o, lse, sq, sq_p, bq)
 
 
-def _flash_lse_bwd(causal, scale, block_q, block_k, interpret, res, g):
+def _flash_lse_bwd(causal, scale, block_q, block_k, interpret, window,
+                   offset, res, g):
     qp, k, v, o, lse, sq, sq_p, bq = res
     do, dlse = g
     dq, dk, dv = _bwd_pallas(qp, k, v, o, lse, _pad_rows(do, sq_p),
                              scale=scale, causal=causal, block_q=bq,
                              block_k=block_k, interpret=interpret,
-                             dlse=_pad_rows(dlse, sq_p), seq_q=sq_p)
+                             dlse=_pad_rows(dlse, sq_p), seq_q=sq_p,
+                             window=window, offset=offset)
     return (dq[:, :sq].astype(qp.dtype), dk.astype(k.dtype),
             dv.astype(v.dtype))
 
@@ -703,6 +747,7 @@ def flash_attention_with_lse(q: jax.Array, k: jax.Array, v: jax.Array,
                              block_q: Optional[int] = None,
                              block_k: Optional[int] = None,
                              interpret: Optional[bool] = None,
+                             window: int = 0, kv_offset: int = 0,
                              ) -> Tuple[jax.Array, jax.Array]:
     """(out (b, sq, h, d), lse (b, sq, h)) — the blockwise form ring
     attention composes across devices (parallel/ring.py): hop outputs
@@ -726,7 +771,8 @@ def flash_attention_with_lse(q: jax.Array, k: jax.Array, v: jax.Array,
 
     o, lse = _flash_lse(merge_heads(q), merge_heads(k), merge_heads(v),
                         causal, float(scale), block_q,
-                        block_k, bool(interpret))
+                        block_k, bool(interpret), int(window),
+                        int(kv_offset))
     o = o.reshape(b, h, sq, d).transpose(0, 2, 1, 3)
     lse = lse.reshape(b, h, sq).transpose(0, 2, 1)
     return o, lse

@@ -57,7 +57,8 @@ def _block_attn(q, k, v, scale, mask):
 def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                    axis_name: str = mesh_lib.SP,
                    causal: bool = False,
-                   scale: Optional[float] = None) -> jax.Array:
+                   scale: Optional[float] = None,
+                   window: int = 0) -> jax.Array:
     """Exact attention over a sequence sharded on ``axis_name``.
 
     Call INSIDE ``shard_map``; q/k/v are the local sequence shards
@@ -68,6 +69,8 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     my_idx = lax.axis_index(axis_name)
     b, sq, h, d = q.shape
     sk = k.shape[1]
+    if window and not causal:
+        raise ValueError("window requires causal=True")
     if scale is None:
         scale = 1.0 / (d ** 0.5)
     qf = q.astype(jnp.float32)
@@ -86,6 +89,9 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
             if causal:
                 k_pos = kv_idx * sk + jnp.arange(sk)
                 mask = q_pos[:, None] >= k_pos[None, :]
+                if window > 0:
+                    mask = mask & (k_pos[None, :]
+                                   > q_pos[:, None] - window)
             num, bm, bl = _block_attn(qf, k_blk.astype(jnp.float32),
                                       v_blk, scale, mask)
             new_m = jnp.maximum(m, bm)
@@ -100,8 +106,14 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
             # key position > every local query position): the block is
             # fully masked, so attending would compute then discard it.
             # Each device branches on its own index — halves total
-            # causal FLOPs around the ring.
+            # causal FLOPs around the ring. A sliding window also
+            # skips blocks wholly BELOW the band (too far in the
+            # past), so only ~(W/sk + 1) hops attend at all.
             fully_masked = kv_idx * sk > my_idx * sq + sq - 1
+            if window > 0:
+                below = (kv_idx * sk + sk - 1
+                         < my_idx * sq - window + 1)
+                fully_masked = jnp.logical_or(fully_masked, below)
             o, m, l = lax.cond(fully_masked,
                                lambda o, m, l: (o, m, l), attend, o, m, l)
         else:
@@ -125,7 +137,8 @@ def ring_flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                          axis_name: str = mesh_lib.SP,
                          causal: bool = False,
                          scale: Optional[float] = None,
-                         interpret: Optional[bool] = None) -> jax.Array:
+                         interpret: Optional[bool] = None,
+                         window: int = 0) -> jax.Array:
     """Ring attention with the PALLAS FLASH KERNEL as the per-hop
     block (call inside ``shard_map``; same contract as
     :func:`ring_attention`).
@@ -149,20 +162,46 @@ def ring_flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     if sk != sq:
         raise ValueError("ring_flash_attention needs equal shards "
                          f"(sq={sq}, sk={sk})")
+    if window and not causal:
+        raise ValueError("window requires causal=True")
     if scale is None:
         scale = 1.0 / (d ** 0.5)
 
-    def flash_hop(k_blk, v_blk, hop_causal: bool):
+    def flash_hop(k_blk, v_blk, hop_causal: bool, offset: int = 0,
+                  win: int = 0):
         o, lse = attn_ops.flash_attention_with_lse(
             q, k_blk, v_blk, causal=hop_causal, scale=scale,
-            interpret=interpret)
+            interpret=interpret, window=win, kv_offset=offset)
         return o.astype(jnp.float32), lse
+
+    def skip_hop(kb, vb):
+        # lse = -inf: zero weight in the log-sum-exp merge
+        return (jnp.zeros((b, sq, h, d), jnp.float32),
+                jnp.full((b, sq, h), NEG_INF))
 
     def step(carry, hop):
         o_acc, lse_acc, k_blk, v_blk = carry
         kv_idx = (my_idx - hop) % n
 
-        if causal:
+        if causal and window > 0:
+            # one branch per past-hop distance: the kernel applies the
+            # exact banded mask at static offset -dist*sk, and hops
+            # wholly below the band (dist*sk >= W + sq - 1) are
+            # statically skipped — a W << total_seq ring attends only
+            # ~(W/sk + 1) hops
+            dist = my_idx - kv_idx
+            case = jnp.where(dist >= 0, dist, n)
+            branches = []
+            for d_ in range(n):
+                if d_ * sk >= window + sq - 1:
+                    branches.append(skip_hop)
+                else:
+                    branches.append(functools.partial(
+                        flash_hop, hop_causal=True, offset=-d_ * sk,
+                        win=window))
+            branches.append(skip_hop)  # future
+            o_hop, lse_hop = lax.switch(case, branches, k_blk, v_blk)
+        elif causal:
             # 0 = fully past (unmasked), 1 = diagonal (aligned
             # causal), 2 = fully future (skip — zero weight)
             case = jnp.where(kv_idx < my_idx, 0,
@@ -171,8 +210,7 @@ def ring_flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                 case,
                 [lambda kb, vb: flash_hop(kb, vb, False),
                  lambda kb, vb: flash_hop(kb, vb, True),
-                 lambda kb, vb: (jnp.zeros((b, sq, h, d), jnp.float32),
-                                 jnp.full((b, sq, h), NEG_INF))],
+                 skip_hop],
                 k_blk, v_blk)
         else:
             o_hop, lse_hop = flash_hop(k_blk, v_blk, False)
@@ -198,7 +236,8 @@ def _ring_perm(n) -> list:
 def ring_attention_sharded(q: jax.Array, k: jax.Array, v: jax.Array,
                            mesh: Mesh, causal: bool = False,
                            scale: Optional[float] = None,
-                           block_impl: str = "auto") -> jax.Array:
+                           block_impl: str = "auto",
+                           window: int = 0) -> jax.Array:
     """pjit-level entry: global (b, seq, h, d) arrays, sequence sharded
     over ``sp``, batch over the data axes.
 
@@ -218,7 +257,7 @@ def ring_attention_sharded(q: jax.Array, k: jax.Array, v: jax.Array,
     extra = {"check_vma": False} if block_impl == "flash" else {}
     fn = jax.shard_map(
         functools.partial(inner, axis_name=mesh_lib.SP,
-                          causal=causal, scale=scale),
+                          causal=causal, scale=scale, window=window),
         mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec, **extra)
     return fn(q, k, v)
 

@@ -27,7 +27,7 @@ from learningorchestra_tpu.runtime import mesh as mesh_lib
 
 def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                       axis_name: str = mesh_lib.SP,
-                      causal: bool = False,
+                      causal: bool = False, window: int = 0,
                       scale: Optional[float] = None,
                       attn_fn: Optional[Callable] = None) -> jax.Array:
     """Inside shard_map: q/k/v local shards (b, seq_local, heads, d)
@@ -45,10 +45,12 @@ def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array,
             from learningorchestra_tpu.ops import attention as attn_ops
 
             attn_fn = functools.partial(attn_ops.flash_attention,
-                                        causal=causal, scale=scale)
+                                        causal=causal, scale=scale,
+                                        window=window)
         else:
             attn_fn = functools.partial(
                 ring_lib.full_attention_reference, causal=causal,
+                window=window,
                 scale=scale)
 
     def scatter_heads(x):  # (b, s/n, h, d) -> (b, s, h/n, d)
@@ -65,6 +67,7 @@ def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array,
 
 def ulysses_attention_sharded(q: jax.Array, k: jax.Array, v: jax.Array,
                               mesh: Mesh, causal: bool = False,
+                              window: int = 0,
                               scale: Optional[float] = None) -> jax.Array:
     if mesh_lib.SP not in mesh.axis_names:
         raise ValueError("mesh has no 'sp' axis")
@@ -72,6 +75,6 @@ def ulysses_attention_sharded(q: jax.Array, k: jax.Array, v: jax.Array,
     spec = P(data if data else None, mesh_lib.SP, None, None)
     fn = jax.shard_map(
         functools.partial(ulysses_attention, axis_name=mesh_lib.SP,
-                          causal=causal, scale=scale),
+                          causal=causal, scale=scale, window=window),
         mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
     return fn(q, k, v)
