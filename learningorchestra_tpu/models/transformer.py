@@ -158,6 +158,9 @@ class _Attention(nn.Module):
     lora_alpha: float = 16.0
     # sliding-window (banded causal) attention; 0 = unlimited
     window: int = 0
+    # RoPE frequency base; raise (e.g. 500000) to stretch usable
+    # context (NTK-style scaling)
+    rope_base: float = 10000.0
 
     @property
     def kv_heads(self) -> int:
@@ -197,7 +200,7 @@ class _Attention(nn.Module):
             # single-token step at absolute position decode_pos: rope
             # from the scalar position, attend over the KV cache
             half = self.head_dim // 2
-            freqs = 1.0 / (10000.0 ** (
+            freqs = 1.0 / (self.rope_base ** (
                 jnp.arange(half, dtype=jnp.float32) / half))
             ang = decode_pos.astype(jnp.float32) * freqs       # (half,)
             cos, sin = jnp.cos(ang)[None, :], jnp.sin(ang)[None, :]
@@ -229,7 +232,8 @@ class _Attention(nn.Module):
                            cv.value.astype(jnp.float32)
                            ).reshape(shape4).astype(x.dtype)
         else:
-            cos, sin = rope_tables(s, self.head_dim)
+            cos, sin = rope_tables(s, self.head_dim,
+                                   base=self.rope_base)
             q, k = apply_rope(q, cos, sin), apply_rope(k, cos, sin)
             if cache_len:
                 # prefill: stash the prompt's K/V so decode steps can
@@ -278,7 +282,10 @@ def _dispatch_attention(q, k, v, *, impl: str, causal: bool, mesh=None,
                                                causal=causal,
                                                window=window)
     if impl == "ulysses" and sp > 1 and divisible and h % sp == 0:
-        kr, vr = repeated()
+        # GQA-native when kv heads divide sp: the head scatter moves
+        # kv-width K/V (group-fold less all_to_all traffic) and the
+        # local flash kernel consumes the group directly
+        kr, vr = (k, v) if kvh % sp == 0 else repeated()
         return ulysses_lib.ulysses_attention_sharded(q, kr, vr, mesh,
                                                      causal=causal,
                                                      window=window)
@@ -371,6 +378,7 @@ class _Block(nn.Module):
     lora_rank: int = 0
     lora_alpha: float = 16.0
     window: int = 0
+    rope_base: float = 10000.0
 
     @nn.compact
     def __call__(self, x, train: bool, decode_pos=None, cache_len: int = 0):
@@ -381,7 +389,8 @@ class _Block(nn.Module):
                        fused_qkv=self.fused_proj,
                        lora_rank=self.lora_rank,
                        lora_alpha=self.lora_alpha,
-                       window=self.window, name="attn")(
+                       window=self.window,
+                       rope_base=self.rope_base, name="attn")(
             h, train, decode_pos=decode_pos, cache_len=cache_len)
         if self.dropout and train:
             h = nn.Dropout(self.dropout, deterministic=False)(h)
@@ -467,6 +476,8 @@ class TransformerLM(nn.Module):
     # grid so compute AND K/V DMA scale ~O(s*W). Composes with every
     # impl incl. ring/Ulysses sequence parallelism.
     sliding_window: int = 0
+    # RoPE frequency base (NTK-style context stretching)
+    rope_base: float = 10000.0
     # per-layer rematerialization under training: "none" saves all
     # activations, "dots" saves matmul outputs only (the standard TPU
     # memory/FLOPs trade), "full" recomputes everything in backward
@@ -516,7 +527,7 @@ class TransformerLM(nn.Module):
                                self.dropout, self.mesh,
                                self.n_kv_heads, fuse,
                                self.lora_rank, self.lora_alpha,
-                               self.sliding_window,
+                               self.sliding_window, self.rope_base,
                                name=f"layer_{i}")(
                 x, train, decode_pos, cache_len)
             aux_total = aux_total + aux
@@ -798,7 +809,7 @@ class LanguageModel:
                     "n_experts", "moe_k",
                     "dropout", "aux_coef", "head_chunk", "remat",
                     "fused_proj", "lora_rank", "lora_alpha",
-                    "sliding_window")
+                    "sliding_window", "rope_base")
 
     def __init__(self, vocab_size: int, d_model: int = 256,
                  n_layers: int = 4, n_heads: int = 4,
@@ -808,7 +819,7 @@ class LanguageModel:
                  aux_coef: float = 0.01, head_chunk: Optional[int] = None,
                  remat: Optional[str] = None, fused_proj: bool = False,
                  lora_rank: int = 0, lora_alpha: float = 16.0,
-                 sliding_window: int = 0,
+                 sliding_window: int = 0, rope_base: float = 10000.0,
                  name: str = "language_model"):
         self.name = name
         self.head_chunk = head_chunk
@@ -817,6 +828,10 @@ class LanguageModel:
         self.lora_alpha = float(lora_alpha)
         if self.lora_rank < 0:
             raise ValueError(f"lora_rank must be >= 0, got {lora_rank}")
+        self.rope_base = float(rope_base)
+        if self.rope_base <= 1.0:
+            raise ValueError(
+                f"rope_base must be > 1, got {rope_base}")
         self.sliding_window = int(sliding_window)
         if self.sliding_window < 0:
             raise ValueError(
@@ -964,7 +979,8 @@ class LanguageModel:
             remat=self._resolved_remat(),
             fused_proj=self._resolved_fused_proj(),
             lora_rank=self.lora_rank, lora_alpha=self.lora_alpha,
-            sliding_window=self.sliding_window)
+            sliding_window=self.sliding_window,
+            rope_base=self.rope_base)
 
     @property
     def module(self) -> TransformerLM:

@@ -18,6 +18,7 @@ import functools
 from typing import Callable, Optional
 
 import jax
+import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
@@ -30,28 +31,40 @@ def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                       causal: bool = False, window: int = 0,
                       scale: Optional[float] = None,
                       attn_fn: Optional[Callable] = None) -> jax.Array:
-    """Inside shard_map: q/k/v local shards (b, seq_local, heads, d)
-    with heads divisible by the axis size. Returns the local output
-    shard (b, seq_local, heads, d)."""
+    """Inside shard_map: q local shard (b, seq_local, heads, d); k/v
+    may carry FEWER (kv) heads (GQA) — both head counts must divide
+    the axis size, and the head scatter then moves kv-width K/V
+    (n-fold less all_to_all traffic than repeating first). Returns
+    the local output shard (b, seq_local, heads, d)."""
     n = lax.psum(1, axis_name)
-    if q.shape[2] % n:
+    h, kvh = q.shape[2], k.shape[2]
+    if h % n:
+        raise ValueError(f"heads {h} not divisible by sp={n}")
+    if kvh != h and (h % kvh or kvh % n):
         raise ValueError(
-            f"heads {q.shape[2]} not divisible by sp={n}")
+            f"GQA kv heads {kvh} must divide query heads {h} and be "
+            f"divisible by sp={n} (repeat K/V to full heads "
+            f"otherwise)")
     if attn_fn is None:
         if jax.default_backend() == "tpu":
             # local attention over the gathered sequence runs the
             # fused flash kernel — O(block) memory for the full-seq
-            # score rows instead of a dense (s, s) tile per head
+            # score rows instead of a dense (s, s) tile per head;
+            # grouped K/V consumed natively
             from learningorchestra_tpu.ops import attention as attn_ops
 
             attn_fn = functools.partial(attn_ops.flash_attention,
                                         causal=causal, scale=scale,
                                         window=window)
         else:
-            attn_fn = functools.partial(
-                ring_lib.full_attention_reference, causal=causal,
-                window=window,
-                scale=scale)
+            def attn_fn(ql, kl, vl):
+                if kl.shape[2] != ql.shape[2]:
+                    g = ql.shape[2] // kl.shape[2]
+                    kl = jnp.repeat(kl, g, axis=2)
+                    vl = jnp.repeat(vl, g, axis=2)
+                return ring_lib.full_attention_reference(
+                    ql, kl, vl, causal=causal, window=window,
+                    scale=scale)
 
     def scatter_heads(x):  # (b, s/n, h, d) -> (b, s, h/n, d)
         return lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1,

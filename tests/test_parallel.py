@@ -377,3 +377,34 @@ def test_ring_windowed_multi_tile_shards():
     for a, r in zip(g_ring, g_full):
         np.testing.assert_allclose(np.asarray(a), np.asarray(r),
                                    rtol=3e-4, atol=3e-4)
+
+
+def test_ulysses_gqa_native_matches_oracle():
+    """Ulysses with kv-width K/V (kvh=2 over sp=2): the head scatter
+    moves grouped K/V and local attention consumes the group — must
+    equal the repeat-based banded oracle (fwd + grads, windowed)."""
+    from learningorchestra_tpu.parallel import ulysses
+
+    mesh = _mesh("sp=2")
+    q, _, _ = _qkv(b=1, s=32, h=4, d=8)
+    k, v = (jax.random.normal(jax.random.PRNGKey(i), (1, 32, 2, 8),
+                              jnp.float32) * 0.2 for i in (7, 8))
+
+    def oracle(q, k, v):
+        return ring.full_attention_reference(
+            q, jnp.repeat(k, 2, axis=2), jnp.repeat(v, 2, axis=2),
+            causal=True, window=9)
+
+    got = ulysses.ulysses_attention_sharded(q, k, v, mesh, causal=True,
+                                            window=9)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(oracle(q, k, v)),
+                               rtol=3e-5, atol=3e-5)
+    g_u = jax.grad(lambda a, b_, c: jnp.sum(
+        ulysses.ulysses_attention_sharded(a, b_, c, mesh, causal=True,
+                                          window=9) ** 2),
+        argnums=(0, 1, 2))(q, k, v)
+    g_o = jax.grad(lambda a, b_, c: jnp.sum(oracle(a, b_, c) ** 2),
+                   argnums=(0, 1, 2))(q, k, v)
+    for a, r in zip(g_u, g_o):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(r),
+                                   rtol=2e-4, atol=2e-4)
