@@ -315,6 +315,11 @@ def phase_gen():
     new_tokens = int(os.environ.get("LO_BENCH_GEN_TOKENS", "256"))
     prompt_len = int(os.environ.get("LO_BENCH_GEN_PROMPT", "64"))
     gen_batch = int(os.environ.get("LO_BENCH_GEN_BATCH", "8"))
+    # n_kv_heads override: LO_BENCH_GEN_KV=2 measures the GQA decode
+    # win (kv-width cache -> less HBM per token)
+    kv = int(os.environ.get("LO_BENCH_GEN_KV", "0"))
+    if kv:
+        cfg["n_kv_heads"] = kv
     cfg["max_len"] = prompt_len + new_tokens
     lm = LanguageModel(**cfg)
     rng = np.random.default_rng(0)
@@ -339,6 +344,7 @@ def phase_gen():
         "decode_ms_per_token_per_seq": round(dt * 1000.0 / new_tokens, 3),
         "batch": gen_batch, "prompt_len": prompt_len,
         "new_tokens": new_tokens,
+        "n_kv_heads": kv or cfg["n_heads"],
         "platform": jax.devices()[0].platform,
     }
 

@@ -64,8 +64,9 @@ run tlm_remat_dots_b32 LO_TLM_REMAT=dots LO_BENCH_TLM_BATCH=32 \
     -- --phase tlm
 run tlm_remat_full_b64 LO_TLM_REMAT=full LO_BENCH_TLM_BATCH=64 \
     -- --phase tlm
-# decode throughput (net-new lm_decode row)
+# decode throughput (net-new lm_decode row) + the GQA cache win
 run gen LO_NOOP=1 -- --phase gen
+run gen_gqa LO_BENCH_GEN_KV=2 -- --phase gen
 # flash crossover below 1024
 run flash512 LO_BENCH_FLASH_SEQS=512,1024 -- --phase flash
 # sliding-window banded-grid evidence (W=1024 at long seq)
