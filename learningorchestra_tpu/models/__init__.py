@@ -23,5 +23,7 @@ from learningorchestra_tpu.models.sweep import (  # noqa: F401
 )
 from learningorchestra_tpu.models.transformer import (  # noqa: F401
     LanguageModel,
+    TextClassifier,
+    TransformerEncoder,
     TransformerLM,
 )

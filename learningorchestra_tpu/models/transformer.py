@@ -779,6 +779,272 @@ def token_accuracy(outputs, batch, weights):
 # ----------------------------------------------------------------------
 # keras-shaped wrapper (the stored lineage-root instance)
 # ----------------------------------------------------------------------
+class TransformerEncoder(nn.Module):
+    """Non-causal (bidirectional) transformer encoder for sequence
+    classification: embed → blocks(causal=False) → final RMSNorm →
+    pad-masked mean pool → class head. Shares every block/param
+    convention with :class:`TransformerLM`, so the TP/FSDP sharding
+    rules and the attention impl table (dot/flash/ring/ulysses) apply
+    unchanged; token id 0 is the pad and is excluded from the pool."""
+
+    vocab_size: int
+    n_classes: int
+    d_model: int = 256
+    n_layers: int = 4
+    n_heads: int = 4
+    n_kv_heads: int = 0
+    d_ff: int = 0
+    attention: str = "dot"
+    dropout: float = 0.0
+    mesh: Any = None
+
+    @nn.compact
+    def __call__(self, tokens, train: bool = False):
+        if self.attention not in ATTENTION_IMPLS:
+            raise ValueError(
+                f"unknown attention impl: {self.attention!r}")
+        d_ff = self.d_ff or 4 * self.d_model
+        head_dim = self.d_model // self.n_heads
+        x = nn.Embed(self.vocab_size, self.d_model, name="embed")(tokens)
+        mesh = self.mesh or mesh_lib.get_default_mesh()
+        x = sharding_lib.constrain(
+            x, mesh, mesh_lib.data_axes(mesh) or None,
+            mesh_lib.SP if self.attention in ("ring", "ulysses")
+            else None,
+            None)
+        for i in range(self.n_layers):
+            x, _ = _Block(self.n_heads, head_dim, d_ff,
+                          self.attention, False, 0, 2,
+                          self.dropout, self.mesh, self.n_kv_heads,
+                          name=f"layer_{i}")(x, train)
+        x = nn.RMSNorm(name="final_norm")(x)
+        mask = (tokens != 0).astype(jnp.float32)[..., None]
+        pooled = jnp.sum(x * mask, axis=1) / jnp.maximum(
+            jnp.sum(mask, axis=1), 1e-9)
+        return nn.Dense(self.n_classes, use_bias=True,
+                        name="cls_head")(pooled)
+
+
+class TextClassifier:
+    """Keras-shaped sequence classifier over the transformer encoder
+    (the modern counterpart to the reference's IMDb-LSTM config):
+    ``fit/evaluate/predict`` through the same GSPMD engine as every
+    other model, reachable by module path through ``POST /model``."""
+
+    _CONFIG_KEYS = ("vocab_size", "n_classes", "d_model", "n_layers",
+                    "n_heads", "n_kv_heads", "d_ff", "max_len",
+                    "attention", "dropout")
+
+    def __init__(self, vocab_size: int, n_classes: int,
+                 d_model: int = 256, n_layers: int = 4,
+                 n_heads: int = 4, n_kv_heads: int = 0, d_ff: int = 0,
+                 max_len: int = 512, attention: str = "dot",
+                 dropout: float = 0.0, name: str = "text_classifier"):
+        self.name = name
+        self.vocab_size = int(vocab_size)
+        self.n_classes = int(n_classes)
+        self.d_model = int(d_model)
+        self.n_layers = int(n_layers)
+        self.n_heads = int(n_heads)
+        self.n_kv_heads = int(n_kv_heads)
+        self.d_ff = int(d_ff)
+        self.max_len = int(max_len)
+        if attention not in ATTENTION_IMPLS + ("auto",):
+            raise ValueError(f"unknown attention impl: {attention!r}")
+        self.attention = attention
+        if self.n_kv_heads < 0 or (
+                self.n_kv_heads and self.n_heads % self.n_kv_heads):
+            raise ValueError(
+                f"n_kv_heads={self.n_kv_heads} must be a positive "
+                f"divisor of n_heads={self.n_heads} (or 0 for MHA)")
+        self.dropout = float(dropout)
+        self.optimizer_spec: Dict[str, Any] = {"kind": "adamw",
+                                               "learning_rate": 3e-4}
+        self.params: Any = None
+        self.history: List[Dict[str, Any]] = []
+        self.seed = 0
+        self._engine: Optional[engine_lib.Engine] = None
+        self._state = None
+        self._mesh_override = None
+        self._accum = engine_lib.default_grad_accum()
+
+    def _require_built(self) -> None:
+        if self.params is None:
+            raise RuntimeError(
+                "model has no parameters yet — call fit() first "
+                "(or load a trained artifact)")
+
+    def _resolved_attention(self) -> str:
+        if self.attention != "auto":
+            return self.attention
+        # same measured crossover as the LM (BENCHMARKS.md flash table)
+        if jax.default_backend() == "tpu":
+            return "flash" if self.max_len >= 1024 else "dot"
+        return "dot"
+
+    def _mesh(self):
+        return self._mesh_override or mesh_lib.get_default_mesh()
+
+    def set_mesh(self, mesh) -> None:
+        self._mesh_override = mesh
+        self._engine = None
+        self._state = None
+
+    def compile(self, optimizer: Any = "adamw", **_: Any) -> None:
+        if isinstance(optimizer, str):
+            self.optimizer_spec = {"kind": optimizer}
+        elif isinstance(optimizer, dict):
+            self.optimizer_spec = dict(optimizer)
+        else:
+            raise TypeError(f"unsupported optimizer: {optimizer!r}")
+        self._engine = None
+
+    @property
+    def module(self) -> TransformerEncoder:
+        return TransformerEncoder(
+            vocab_size=self.vocab_size, n_classes=self.n_classes,
+            d_model=self.d_model, n_layers=self.n_layers,
+            n_heads=self.n_heads, n_kv_heads=self.n_kv_heads,
+            d_ff=self.d_ff, attention=self._resolved_attention(),
+            dropout=self.dropout, mesh=self._mesh_override)
+
+    def _apply_fn(self, params, model_state, batch, train, rng):
+        rngs = {"dropout": rng} if (train and rng is not None and
+                                    self.dropout) else None
+        out = self.module.apply({"params": params}, batch["x"],
+                                train=train, rngs=rngs)
+        return out, model_state
+
+    def _get_engine(self) -> engine_lib.Engine:
+        if self._engine is None:
+            from learningorchestra_tpu.config import get_config
+            from learningorchestra_tpu.models.neural import (
+                build_optimizer)
+            dtype = jnp.bfloat16 \
+                if get_config().compute_dtype == "bfloat16" \
+                else jnp.float32
+            mesh = self._mesh()
+            self._engine = engine_lib.Engine(
+                apply_fn=self._apply_fn,
+                loss_fn=engine_lib.sparse_softmax_loss,
+                optimizer=build_optimizer(self.optimizer_spec),
+                mesh=mesh,
+                metrics={"accuracy": engine_lib.accuracy_metric},
+                compute_dtype=dtype,
+                param_rules=sharding_lib.TRANSFORMER_RULES,
+                batch_sharding=jax.sharding.NamedSharding(
+                    mesh, sharding_lib.batch_spec(
+                        mesh, seq_axis=self.attention in
+                        ("ring", "ulysses"))),
+                grad_accum=self._accum)
+        return self._engine
+
+    def _coerce(self, x) -> np.ndarray:
+        if hasattr(x, "to_numpy"):
+            x = data_lib.dataframe_to_arrays(x)["x"]
+        x = np.atleast_2d(np.asarray(x)).astype(np.int32)
+        if x.shape[1] > self.max_len:
+            x = x[:, :self.max_len]
+        return x
+
+    def _batcher(self, x, y=None, batch_size=None, shuffle=False):
+        from learningorchestra_tpu.config import get_config
+        arrays = {"x": self._coerce(x)}
+        if y is not None:
+            arrays["y"] = np.asarray(y).astype(np.int32).reshape(-1)
+        return data_lib.ArrayBatcher(
+            arrays, batch_size or get_config().default_batch_size,
+            shuffle=shuffle, seed=self.seed,
+            dp_multiple=mesh_lib.data_parallel_size(self._mesh()))
+
+    def _build_params(self, sample_x) -> None:
+        variables = self.module.init(
+            jax.random.PRNGKey(self.seed),
+            jnp.asarray(sample_x[:1]), train=False)
+        self.params = variables["params"]
+
+    def fit(self, x=None, y=None, batch_size: Optional[int] = None,
+            epochs: int = 1, shuffle: bool = True, checkpointer=None,
+            log_fn=None, grad_accum: Optional[int] = None, **_: Any):
+        from learningorchestra_tpu.models.neural import History
+
+        self._accum, changed = engine_lib.resolve_grad_accum(
+            grad_accum, self._accum)
+        if changed:
+            self._engine = None
+        batcher = self._batcher(x, y, batch_size, shuffle=shuffle)
+        if self.params is None:
+            self._build_params(batcher.array("x"))
+        eng = self._get_engine()
+        state = eng.init_state(self.params)
+        state, history = eng.fit(state, batcher, epochs=epochs,
+                                 seed=self.seed,
+                                 checkpointer=checkpointer,
+                                 log_fn=log_fn)
+        self._state = state
+        self.params = engine_lib.to_host(state.params)
+        self.history.extend(history)
+        return History(history)
+
+    def evaluate(self, x=None, y=None,
+                 batch_size: Optional[int] = None,
+                 **_: Any) -> Dict[str, float]:
+        self._require_built()
+        eng = self._get_engine()
+        state = self._state or eng.init_state(self.params)
+        return eng.evaluate(state, self._batcher(x, y, batch_size))
+
+    def predict(self, x=None, batch_size: Optional[int] = None,
+                **_: Any) -> np.ndarray:
+        """Class probabilities (n, n_classes)."""
+        self._require_built()
+        eng = self._get_engine()
+        state = self._state or eng.init_state(self.params)
+        logits = eng.predict(state, self._batcher(x, None, batch_size))
+        return np.asarray(jax.nn.softmax(jnp.asarray(logits), axis=-1))
+
+    def num_params(self) -> int:
+        if self.params is None:
+            return 0
+        return sum(int(np.prod(p.shape))
+                   for p in jax.tree_util.tree_leaves(self.params))
+
+    # artifact-store native protocol --------------------------------
+    def __lo_save__(self, path: str) -> None:
+        from learningorchestra_tpu.runtime import checkpoint as ckpt
+
+        config = {k: getattr(self, k) for k in self._CONFIG_KEYS}
+        config.update(name=self.name, optimizer_spec=self.optimizer_spec,
+                      seed=self.seed, history=self.history,
+                      built=self.params is not None)
+        with open(os.path.join(path, "config.json"), "w") as f:
+            json.dump(config, f)
+        if self.params is not None:
+            ckpt.save_pytree({"params": self.params},
+                             os.path.join(path, "weights.msgpack"))
+
+    @classmethod
+    def __lo_load__(cls, path: str) -> "TextClassifier":
+        from learningorchestra_tpu.runtime import checkpoint as ckpt
+
+        with open(os.path.join(path, "config.json")) as f:
+            config = json.load(f)
+        model = cls(**{k: config[k] for k in cls._CONFIG_KEYS
+                       if k in config},
+                    name=config["name"])
+        model.optimizer_spec = config["optimizer_spec"]
+        model.seed = config["seed"]
+        model.history = config["history"]
+        if config["built"]:
+            sample = np.zeros((1, 8), np.int32)
+            model._build_params(sample)
+            restored = ckpt.load_pytree(
+                os.path.join(path, "weights.msgpack"),
+                {"params": model.params})
+            model.params = restored["params"]
+        return model
+
+
 def _lora_optimizer(base):
     """Freeze everything except ``lora_*`` leaves: optax.multi_transform
     routes adapter params through the real optimizer and pins the base
