@@ -93,3 +93,23 @@ def test_save_load_roundtrip(tmp_path):
     p1 = sweep.predict(x[:8])
     p2 = loaded.predict(x[:8])
     np.testing.assert_allclose(p1, p2, atol=1e-5)
+
+
+def test_grid_search_over_text_classifier(tmp_config):
+    """The sweep's clone protocol (__lo_save__/__lo_load__/set_mesh)
+    works for the encoder family too: a 2-point learning-rate grid
+    over TextClassifier runs trial-parallel and reports a best."""
+    import numpy as np
+
+    from learningorchestra_tpu.models import GridSearch, TextClassifier
+
+    rng = np.random.default_rng(0)
+    x = rng.integers(1, 16, size=(32, 8)).astype(np.int32)
+    y = (x[:, 0] > 8).astype(np.int32)
+    base = TextClassifier(vocab_size=16, n_classes=2, d_model=16,
+                          n_layers=1, n_heads=2, max_len=8)
+    sweep = GridSearch(base, {"learning_rate": [1e-2, 1e-3]},
+                       validation_split=0.25, refit=False)
+    sweep.fit(x, y, batch_size=8, epochs=2)
+    assert sweep.best_params_ is not None
+    assert len(sweep.cv_results_["params"]) == 2
