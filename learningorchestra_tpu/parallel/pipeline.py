@@ -313,10 +313,15 @@ def pipeline_value_and_grad_1f1b(
             dx = dx / lax.psum(1, data)
         return loss, dsp, dhp, dx
 
+    # check_vma=False: stage bodies may run pallas_call (the PP
+    # block's flash attention), whose ShapeDtypeStructs carry no
+    # varying-mesh-axes info — the vma checker rejects them (same as
+    # the tp flash path and ring_flash)
     fn = jax.shard_map(
         body, mesh=mesh,
         in_specs=(pspec, hspec, xspec, xspec),
-        out_specs=(P(), pspec, hspec, xspec))
+        out_specs=(P(), pspec, hspec, xspec),
+        check_vma=False)
     return fn(stage_params, head_params, x, y)
 
 
@@ -333,9 +338,12 @@ def pipeline_apply(stage_fn: Callable[[Any, jax.Array], jax.Array],
     pspec = jax.tree_util.tree_map(
         lambda p: P(*((mesh_lib.PP,) + (None,) * (p.ndim - 1))),
         stage_params)
+    # check_vma=False: see value_and_grad_1f1b — stage bodies may
+    # contain pallas_call
     fn = jax.shard_map(
         functools.partial(pipeline_apply_local, stage_fn,
                           num_microbatches=num_microbatches,
                           axis_name=mesh_lib.PP),
-        mesh=mesh, in_specs=(pspec, xspec), out_specs=xspec)
+        mesh=mesh, in_specs=(pspec, xspec), out_specs=xspec,
+        check_vma=False)
     return fn(stage_params, x)
