@@ -67,7 +67,8 @@ def _rms_norm(x, scale):
 
 
 def _block(p: Dict[str, jnp.ndarray], x: jnp.ndarray,
-           n_heads: int, attention: str = "auto") -> jnp.ndarray:
+           n_heads: int, attention: str = "auto",
+           window: int = 0) -> jnp.ndarray:
     """One decoder block, (b, s, d) -> (b, s, d). Pure jnp so it can be
     the uniform GPipe stage body; on TPU the attention runs the Pallas
     flash kernel (no (s, s) score tensor per microbatch — the same
@@ -77,6 +78,8 @@ def _block(p: Dict[str, jnp.ndarray], x: jnp.ndarray,
         raise ValueError(
             f"unknown attention impl: {attention!r} "
             f"(auto|flash|dense)")
+    if window < 0:
+        raise ValueError(f"window must be >= 0, got {window}")
     b, s, d = x.shape
     h = _rms_norm(x, p["ln1"])
     q, k, v = jnp.split(h @ p["qkv"], 3, axis=-1)
@@ -95,30 +98,33 @@ def _block(p: Dict[str, jnp.ndarray], x: jnp.ndarray,
         from learningorchestra_tpu.ops import attention as attn_ops
 
         attn = attn_ops.flash_attention(
-            q, k, v, causal=True, scale=scale).reshape(b, s, d)
+            q, k, v, causal=True, scale=scale,
+            window=window).reshape(b, s, d)
     else:
-        scores = jnp.einsum("bqhd,bkhd->bhqk", q, k,
-                            preferred_element_type=jnp.float32) * scale
-        mask = jnp.arange(s)[:, None] >= jnp.arange(s)[None, :]
-        scores = jnp.where(mask[None, None], scores, NEG_INF)
-        probs = jax.nn.softmax(scores, axis=-1).astype(x.dtype)
-        attn = jnp.einsum("bhqk,bkhd->bqhd", probs, v).reshape(b, s, d)
+        from learningorchestra_tpu.parallel import ring as ring_lib
+
+        # the dense oracle (and its banded-window mask) lives in ONE
+        # place — the same fallback _dispatch_attention uses
+        attn = ring_lib.full_attention_reference(
+            q, k, v, causal=True, scale=scale,
+            window=window).reshape(b, s, d)
     x = x + attn @ p["o"]
     h = _rms_norm(x, p["ln2"])
     return x + (jax.nn.silu(h @ p["wi"]) @ p["wo"])
 
 
 def _stage_fn_for(n_heads: int, layers_per_stage: int,
-                  attention: str = "auto"):
+                  attention: str = "auto", window: int = 0):
     """Uniform stage body: run this stage's ``layers_per_stage`` blocks
     in order. ``pipeline_apply_local`` already stripped the leading
     local-shard dim, so leaves arrive as (layers_per_stage, ...)."""
     def stage_fn(stage_params, x):
         if layers_per_stage == 1:
             lp = jax.tree_util.tree_map(lambda a: a[0], stage_params)
-            return _block(lp, x, n_heads, attention)
+            return _block(lp, x, n_heads, attention, window)
         x, _ = jax.lax.scan(
-            lambda carry, lp: (_block(lp, carry, n_heads, attention),
+            lambda carry, lp: (_block(lp, carry, n_heads, attention,
+                                      window),
                                None),
             x, stage_params)
         return x
@@ -159,7 +165,7 @@ def _stage_setup(params: Dict[str, Any], mesh):
 
 def forward(params: Dict[str, Any], tokens: jnp.ndarray, mesh,
             n_heads: int, num_microbatches: int = 4,
-            attention: str = "auto") -> jnp.ndarray:
+            attention: str = "auto", window: int = 0) -> jnp.ndarray:
     """tokens (b, s) int32 -> logits (b, s, V); blocks pipelined over
     ``pp``, embedding and tied head outside the pipeline."""
     pp, layers_per_stage, stage_params = _stage_setup(params, mesh)
@@ -169,22 +175,23 @@ def forward(params: Dict[str, Any], tokens: jnp.ndarray, mesh,
 
     if pp > 1:
         x = pp_lib.pipeline_apply(
-            _stage_fn_for(n_heads, layers_per_stage, attention),
+            _stage_fn_for(n_heads, layers_per_stage, attention,
+                          window),
             stage_params, x,
             mesh, num_microbatches=num_microbatches)
     else:
         for i in range(blocks["qkv"].shape[0]):
             x = _block(jax.tree_util.tree_map(lambda a, i=i: a[i], blocks),
-                       x, n_heads, attention)
+                       x, n_heads, attention, window)
     return x @ embed.T  # tied head
 
 
 def next_token_loss(params, tokens, mesh, n_heads: int,
                     num_microbatches: int = 4,
-                    attention: str = "auto"):
+                    attention: str = "auto", window: int = 0):
     logits = forward(params, tokens, mesh, n_heads,
                      num_microbatches=num_microbatches,
-                     attention=attention)
+                     attention=attention, window=window)
     tgt = tokens[:, 1:]
     lg = logits[:, :-1].astype(jnp.float32)
     per_tok = optax.softmax_cross_entropy_with_integer_labels(lg, tgt)
@@ -208,7 +215,7 @@ def _head_loss(embed: jnp.ndarray, out: jnp.ndarray,
 
 def value_and_grad_1f1b(params, tokens: jnp.ndarray, mesh, n_heads: int,
                         num_microbatches: int = 4,
-                        attention: str = "auto"):
+                        attention: str = "auto", window: int = 0):
     """Hand-assembled train pass on the 1F1B schedule
     (parallel/pipeline.py): the pipelined middle returns its stage
     grads plus dx; the embedding's gradient combines the tied head's
@@ -221,7 +228,8 @@ def value_and_grad_1f1b(params, tokens: jnp.ndarray, mesh, n_heads: int,
     n_layers = params["blocks"]["qkv"].shape[0]
     x = _embed_in(embed, tokens)
     loss, dstage, dembed_head, dx = pp_lib.pipeline_value_and_grad_1f1b(
-        _stage_fn_for(n_heads, layers_per_stage, attention), _head_loss,
+        _stage_fn_for(n_heads, layers_per_stage, attention, window),
+        _head_loss,
         stage_params, embed, x, tokens, mesh,
         num_microbatches=num_microbatches)
     dblocks = jax.tree_util.tree_map(
@@ -235,7 +243,7 @@ def value_and_grad_1f1b(params, tokens: jnp.ndarray, mesh, n_heads: int,
 def fit(params, tokens: np.ndarray, mesh, n_heads: int, steps: int = 4,
         batch_size: Optional[int] = None, learning_rate: float = 1e-3,
         num_microbatches: int = 4, schedule: str = "gpipe",
-        attention: str = "auto",
+        attention: str = "auto", window: int = 0,
         ) -> Tuple[Dict[str, Any], List[float]]:
     """Minimal jitted training loop (dryrun / test harness — the full
     REST-facing engine path uses LanguageModel; this validates the PP
@@ -253,12 +261,14 @@ def fit(params, tokens: np.ndarray, mesh, n_heads: int, steps: int = 4,
         if schedule == "1f1b":
             loss, grads = value_and_grad_1f1b(p, batch, mesh, n_heads,
                                               num_microbatches,
-                                              attention=attention)
+                                              attention=attention,
+                                              window=window)
         else:
             def loss_of(t):
                 return next_token_loss(t, batch, mesh, n_heads,
                                        num_microbatches,
-                                       attention=attention)
+                                       attention=attention,
+                                       window=window)
 
             loss, grads = jax.value_and_grad(loss_of)(p)
         updates, o = optimizer.update(grads, o, p)

@@ -113,8 +113,8 @@ def test_pp_block_flash_matches_dense():
                           jnp.float32),
     }
     x = jnp.asarray(rng.normal(size=(2, 24, d)), jnp.float32)
-    dense = pp._block(p, x, heads, attention="dense")
-    flash = pp._block(p, x, heads, attention="flash")
+    dense = pp_lm._block(p, x, heads, attention="dense")
+    flash = pp_lm._block(p, x, heads, attention="flash")
     np.testing.assert_allclose(np.asarray(flash), np.asarray(dense),
                                atol=2e-5, rtol=2e-5)
 
@@ -124,27 +124,38 @@ def test_pp_pipelined_flash_both_schedules():
     combination): both schedules must run the Pallas kernel per stage
     (check_vma=False on the pipeline shard_maps) and match the dense
     pipelined forward."""
-    import jax
-    import jax.numpy as jnp
-    import numpy as np
-
-    from learningorchestra_tpu.models import pp_transformer as pp
-    from learningorchestra_tpu.runtime import mesh as mesh_lib
-
     mesh = mesh_lib.build_mesh("pp=2")
-    params = pp.init_params(jax.random.PRNGKey(0), vocab_size=32,
-                            d_model=16, n_layers=2)
+    params = pp_lm.init_params(jax.random.PRNGKey(0), vocab_size=32,
+                               d_model=16, n_layers=2)
     tokens = (np.arange(4 * 12).reshape(4, 12) % 31 + 1).astype(np.int32)
-    dense = pp.forward(params, jnp.asarray(tokens), mesh, n_heads=2,
-                       num_microbatches=2, attention="dense")
-    flash = pp.forward(params, jnp.asarray(tokens), mesh, n_heads=2,
-                       num_microbatches=2, attention="flash")
+    dense = pp_lm.forward(params, jnp.asarray(tokens), mesh, n_heads=2,
+                          num_microbatches=2, attention="dense")
+    flash = pp_lm.forward(params, jnp.asarray(tokens), mesh, n_heads=2,
+                          num_microbatches=2, attention="flash")
     np.testing.assert_allclose(np.asarray(flash), np.asarray(dense),
                                atol=2e-4, rtol=2e-4)
 
-    loss, grads = pp.value_and_grad_1f1b(
+    loss, grads = pp_lm.value_and_grad_1f1b(
         params, jnp.asarray(tokens), mesh, n_heads=2,
         num_microbatches=2, attention="flash")
     assert np.isfinite(float(loss))
     assert all(np.all(np.isfinite(np.asarray(g)))
                for g in jax.tree_util.tree_leaves(grads))
+
+
+def test_pp_windowed_matches_banded_oracle():
+    """Sliding window through the pipelined stages (flash AND dense
+    paths): pp=2 forward equals the single-stage dense banded math."""
+    mesh = mesh_lib.build_mesh("pp=2")
+    params = pp_lm.init_params(jax.random.PRNGKey(0), vocab_size=32,
+                               d_model=16, n_layers=2)
+    tokens = (np.arange(2 * 16).reshape(2, 16) % 31 + 1).astype(np.int32)
+    W = 5
+    ref = pp_lm.forward(params, jnp.asarray(tokens), None, n_heads=2,
+                        attention="dense", window=W)
+    for attn in ("dense", "flash"):
+        got = pp_lm.forward(params, jnp.asarray(tokens), mesh,
+                            n_heads=2, num_microbatches=2,
+                            attention=attn, window=W)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                                   atol=2e-4, rtol=2e-4)
