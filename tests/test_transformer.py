@@ -1050,3 +1050,35 @@ def test_text_classifier_sharded_and_gqa(tmp_path):
              n_heads=4, n_kv_heads=2, max_len=16, attention="flash")
     hist = clf.fit(x, y, batch_size=16, epochs=1, shuffle=False)
     assert np.isfinite(hist.history["loss"][0])
+
+
+def test_feature_stack_interactions(tmp_path):
+    """All the round-4 features composed in ONE model — GQA +
+    sliding window + fused projections off (GQA gates qkv) + LoRA +
+    grad accumulation + beam search — train, decode parity, merge."""
+    _mesh_config(tmp_path, "dp=1")
+    lm = LanguageModel(vocab_size=24, d_model=16, n_layers=2,
+                       n_heads=4, n_kv_heads=2, max_len=16,
+                       attention="dot", sliding_window=6,
+                       rope_base=50000.0)
+    x = _toy_tokens(n=16, seq=12, vocab=24)
+    lm.fit(x, batch_size=8, epochs=2, grad_accum=2)
+    lm.enable_lora(rank=2)
+    lm.fit(x, batch_size=8, epochs=1, grad_accum=2)
+    lm.merge_lora()
+
+    prompt = x[:2, :4]
+    greedy = lm.generate(prompt, max_new_tokens=4, temperature=0.0)
+    # greedy == full-forward rollout under the whole feature stack
+    mod = lm._module_for(None)
+    buf = np.zeros((2, 8), np.int32)
+    buf[:, :4] = prompt
+    for pos in range(4, 8):
+        lg, _ = mod.apply({"params": lm.params}, jnp.asarray(buf))
+        last = np.asarray(lg[:, pos - 1]).astype(np.float64)
+        last[:, 0] = -np.inf
+        buf[:, pos] = last.argmax(-1)
+    np.testing.assert_array_equal(greedy, buf)
+
+    beams = lm.generate(prompt, max_new_tokens=4, num_beams=3)
+    assert beams.shape == greedy.shape and (beams > 0).all()
