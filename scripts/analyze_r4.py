@@ -1,0 +1,101 @@
+#!/usr/bin/env python
+"""Summarize queued_results/*.out into the round-4 default decisions.
+
+Reads the one-line JSON results the measurement runner writes and
+prints, per experiment pair, the comparison that decides a committed
+default — so when the chip answers (possibly minutes before a round
+ends) the flip-or-keep call is a glance, not an analysis session.
+
+  python scripts/analyze_r4.py [RESULTS_DIR]
+"""
+import json
+import os
+import sys
+
+MARK = "@@LO_BENCH_RESULT@@"
+
+
+def load(d, name):
+    path = os.path.join(d, f"{name}.out")
+    try:
+        text = open(path).read()
+    except OSError:
+        return None
+    idx = text.rfind(MARK)
+    if idx < 0:
+        return None
+    try:
+        payload = json.loads(text[idx + len(MARK):].strip())
+    except json.JSONDecodeError:
+        return None
+    return payload.get("result") if payload.get("ok") else {
+        "error": payload.get("error")}
+
+
+def tlm_row(r):
+    if not r:
+        return "MISSING"
+    if "error" in r:
+        return f"ERROR {r['error'][:90]}"
+    return (f"{r.get('tflops_per_sec_per_chip', '?')} TFLOP/s/chip, "
+            f"MFU {r.get('mfu', '?')}, "
+            f"{r.get('samples_per_sec_per_chip', '?')} samples/s")
+
+
+def main():
+    d = sys.argv[1] if len(sys.argv) > 1 else "queued_results"
+    print(f"== results in {d}\n")
+
+    print("-- d=512 roofline (decides: fused head default, fused_proj, "
+          "remat batch)")
+    for name in ("tlm_fused", "tlm_unfused", "tlm_fused_proj",
+                 "tlm_remat_dots_b32", "tlm_remat_full_b64"):
+        print(f"  {name:22s} {tlm_row(load(d, name))}")
+    print("  decision: highest MFU row wins; flip LO_LM_HEAD_CHUNK/"
+          "fused_proj/remat defaults in transformer.py accordingly\n")
+
+    print("-- long-context flash MFU (seq 2048 d1024)")
+    print(f"  tlm_longctx          {tlm_row(load(d, 'tlm_longctx'))}\n")
+
+    print("-- LSTM hoist (decides LO_LSTM_HOIST default; "
+          "unroll already decided: keep 1)")
+    for name in ("lstm_default", "lstm_hoist"):
+        r = load(d, name)
+        row = ("MISSING" if not r else
+               f"ERROR {r['error'][:90]}" if "error" in r else
+               f"{r.get('samples_per_sec_per_chip', '?')} samples/s, "
+               f"time_to_97 {r.get('time_to_97pct_train_acc_s', '—')}s")
+        print(f"  {name:22s} {row}")
+    print("  decision: hoist default flips only if clearly faster\n")
+
+    print("-- decode throughput (lm_decode row; GQA win)")
+    for name in ("gen", "gen_gqa"):
+        r = load(d, name)
+        row = ("MISSING" if not r else
+               f"ERROR {r['error'][:90]}" if "error" in r else
+               f"{r.get('decode_tokens_per_sec', '?')} tok/s "
+               f"({r.get('decode_ms_per_token_per_seq', '?')} ms/tok, "
+               f"kv={r.get('n_kv_heads', '?')})")
+        print(f"  {name:22s} {row}")
+    print()
+
+    print("-- flash kernels (banded vs pre-banding table in "
+          "BENCHMARKS.md; window rows)")
+    for name in ("flash_banded", "flash512", "flash_window"):
+        r = load(d, name)
+        if not r:
+            print(f"  {name:22s} MISSING")
+            continue
+        if "error" in r:
+            print(f"  {name:22s} ERROR {r['error'][:90]}")
+            continue
+        cells = {k: v for k, v in r.items() if k != "platform"}
+        print(f"  {name}:")
+        for k, v in cells.items():
+            print(f"    {k}: {v}")
+    print("\n  decision: crossover stays 1024 unless flash512 shows a "
+          "sub-1024 win; window rows substantiate the ~O(s*W) claim")
+
+
+if __name__ == "__main__":
+    main()
