@@ -242,11 +242,7 @@ class NeuralModel:
             # (keras also splits before shuffling)
             x = self._coerce_x(x)
             y = self._coerce_y(y) if y is not None else None
-            n_val = max(1, int(len(x) * float(validation_split)))
-            if n_val >= len(x):
-                raise ValueError(
-                    f"validation_split={validation_split} leaves no "
-                    "training data")
+            n_val = validation_tail_count(len(x), validation_split)
             validation_data = (x[-n_val:],
                                y[-n_val:] if y is not None else None)
             x = x[:-n_val]
@@ -547,6 +543,20 @@ class NeuralModel:
             model.params = restored["params"]
             model.model_state = restored["model_state"]
         return model
+
+
+def validation_tail_count(n: int, split: float) -> int:
+    """Validated keras-style tail-split size: 0 < split < 1 and at
+    least one training row must remain."""
+    split = float(split)
+    if not 0.0 < split < 1.0:
+        raise ValueError(
+            f"validation_split must be in (0, 1), got {split}")
+    n_val = max(1, int(n * split))
+    if n_val >= n:
+        raise ValueError(
+            f"validation_split={split} leaves no training data")
+    return n_val
 
 
 class History:

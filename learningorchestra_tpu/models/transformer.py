@@ -1359,10 +1359,22 @@ class LanguageModel:
 
     def fit(self, x=None, y=None, batch_size: Optional[int] = None,
             epochs: int = 1, shuffle: bool = True, checkpointer=None,
-            log_fn=None, grad_accum: Optional[int] = None, **_: Any):
+            log_fn=None, grad_accum: Optional[int] = None,
+            validation_split: float = 0.0, **_: Any):
         from learningorchestra_tpu.models.neural import History
 
         self._set_grad_accum(grad_accum)
+        val_x = None
+        if validation_split:
+            # keras-parity tail split (sequences, no labels: held-out
+            # windows scored on next-token loss/accuracy); range
+            # validation shared with NeuralModel
+            from learningorchestra_tpu.models.neural import (
+                validation_tail_count)
+            x = self._coerce_tokens(x)
+            n_val = validation_tail_count(len(x), validation_split)
+            val_x = x[-n_val:]
+            x = x[:-n_val]
         batcher = self._batcher(x, batch_size, shuffle=shuffle)
         if self.params is None:
             self._build_params(batcher.array("x"))
@@ -1371,6 +1383,12 @@ class LanguageModel:
         state, history = eng.fit(state, batcher, epochs=epochs,
                                  seed=self.seed, checkpointer=checkpointer,
                                  log_fn=log_fn)
+        if val_x is not None:
+            val = eng.evaluate(state, self._batcher(val_x, batch_size))
+            if not history:
+                history.append({})
+            for k, v in val.items():
+                history[-1][f"val_{k}"] = v
         self._state = state
         self.params = engine_lib.to_host(state.params)
         self.history.extend(history)

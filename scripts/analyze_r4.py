@@ -32,14 +32,20 @@ def load(d, name):
         "error": payload.get("error")}
 
 
-def tlm_row(r):
+def row(r, fmt):
+    """MISSING / ERROR / formatted-success, in one place."""
     if not r:
         return "MISSING"
     if "error" in r:
         return f"ERROR {r['error'][:90]}"
-    return (f"{r.get('tflops_per_sec_per_chip', '?')} TFLOP/s/chip, "
-            f"MFU {r.get('mfu', '?')}, "
-            f"{r.get('samples_per_sec_per_chip', '?')} samples/s")
+    return fmt(r)
+
+
+def tlm_row(r):
+    return row(r, lambda r: (
+        f"{r.get('tflops_per_sec_per_chip', '?')} TFLOP/s/chip, "
+        f"MFU {r.get('mfu', '?')}, "
+        f"{r.get('samples_per_sec_per_chip', '?')} samples/s"))
 
 
 def main():
@@ -60,39 +66,32 @@ def main():
     print("-- LSTM hoist (decides LO_LSTM_HOIST default; "
           "unroll already decided: keep 1)")
     for name in ("lstm_default", "lstm_hoist"):
-        r = load(d, name)
-        row = ("MISSING" if not r else
-               f"ERROR {r['error'][:90]}" if "error" in r else
-               f"{r.get('samples_per_sec_per_chip', '?')} samples/s, "
-               f"time_to_97 {r.get('time_to_97pct_train_acc_s', '—')}s")
-        print(f"  {name:22s} {row}")
+        text = row(load(d, name), lambda r: (
+            f"{r.get('samples_per_sec_per_chip', '?')} samples/s, "
+            f"time_to_97 {r.get('time_to_97pct_train_acc_s', '—')}s"))
+        print(f"  {name:22s} {text}")
     print("  decision: hoist default flips only if clearly faster\n")
 
     print("-- decode throughput (lm_decode row; GQA win)")
     for name in ("gen", "gen_gqa"):
-        r = load(d, name)
-        row = ("MISSING" if not r else
-               f"ERROR {r['error'][:90]}" if "error" in r else
-               f"{r.get('decode_tokens_per_sec', '?')} tok/s "
-               f"({r.get('decode_ms_per_token_per_seq', '?')} ms/tok, "
-               f"kv={r.get('n_kv_heads', '?')})")
-        print(f"  {name:22s} {row}")
+        text = row(load(d, name), lambda r: (
+            f"{r.get('decode_tokens_per_sec', '?')} tok/s "
+            f"({r.get('decode_ms_per_token_per_seq', '?')} ms/tok, "
+            f"kv={r.get('n_kv_heads', '?')})"))
+        print(f"  {name:22s} {text}")
     print()
 
     print("-- flash kernels (banded vs pre-banding table in "
           "BENCHMARKS.md; window rows)")
     for name in ("flash_banded", "flash512", "flash_window"):
         r = load(d, name)
-        if not r:
-            print(f"  {name:22s} MISSING")
+        if not r or "error" in r:
+            print(f"  {name:22s} {row(r, lambda r: '')}")
             continue
-        if "error" in r:
-            print(f"  {name:22s} ERROR {r['error'][:90]}")
-            continue
-        cells = {k: v for k, v in r.items() if k != "platform"}
         print(f"  {name}:")
-        for k, v in cells.items():
-            print(f"    {k}: {v}")
+        for k, v in r.items():
+            if k != "platform":
+                print(f"    {k}: {v}")
     print("\n  decision: crossover stays 1024 unless flash512 shows a "
           "sub-1024 win; window rows substantiate the ~O(s*W) claim")
 

@@ -90,8 +90,14 @@ def test_fit_validation_split(tmp_config):
     assert np.isfinite(hist.history["val_loss"][-1])
     import pytest as _pytest
 
-    with _pytest.raises(ValueError, match="no training data"):
+    # out-of-range splits (incl. negative) are rejected up front
+    with _pytest.raises(ValueError, match="must be in"):
         model.fit(x[:4], y[:4], epochs=1, validation_split=1.0)
+    with _pytest.raises(ValueError, match="must be in"):
+        model.fit(x[:4], y[:4], epochs=1, validation_split=-0.25)
+    # a split that rounds to the whole set still leaves no data
+    with _pytest.raises(ValueError, match="no training data"):
+        model.fit(x[:1], y[:1], epochs=1, validation_split=0.5)
 
 
 def test_binary_crossentropy_head(tmp_config):

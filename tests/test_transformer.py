@@ -1082,3 +1082,17 @@ def test_feature_stack_interactions(tmp_path):
 
     beams = lm.generate(prompt, max_new_tokens=4, num_beams=3)
     assert beams.shape == greedy.shape and (beams > 0).all()
+
+
+def test_lm_fit_validation_split(tmp_path):
+    """validation_split on the LM: the tail windows score next-token
+    val_loss/val_accuracy after training (keras-parity surface)."""
+    _mesh_config(tmp_path, "dp=1")
+    lm = LanguageModel(vocab_size=32, d_model=16, n_layers=1,
+                       n_heads=2, max_len=16, attention="dot")
+    x = _toy_tokens(n=32)
+    hist = lm.fit(x, batch_size=8, epochs=2, validation_split=0.25)
+    assert "val_loss" in hist.history and "val_accuracy" in hist.history
+    assert np.isfinite(hist.history["val_loss"][-1])
+    with pytest.raises(ValueError, match="validation_split"):
+        lm.fit(x[:1], batch_size=1, epochs=1, validation_split=0.5)
