@@ -77,6 +77,13 @@ TLM_CFG = {"vocab_size": TLM_VOCAB,
            "n_heads": int(os.environ.get("LO_BENCH_TLM_HEADS", "8")),
            "d_ff": int(os.environ.get("LO_BENCH_TLM_FF", "2048")),
            "max_len": TLM_SEQ}
+# optional attention-config sweeps (0 = off/default MHA/full context)
+_TLM_KV = int(os.environ.get("LO_BENCH_TLM_KV", "0"))
+if _TLM_KV:
+    TLM_CFG["n_kv_heads"] = _TLM_KV
+_TLM_WINDOW = int(os.environ.get("LO_BENCH_TLM_WINDOW", "0"))
+if _TLM_WINDOW:
+    TLM_CFG["sliding_window"] = _TLM_WINDOW
 # "auto" picks dot vs the Pallas flash kernel by the measured on-chip
 # crossover (seq >= 1024 -> flash); the parent still retries a
 # timed-out tlm phase with "dot" so a pathological remote kernel
