@@ -37,7 +37,14 @@ def build_optimizer(spec: Dict[str, Any]) -> optax.GradientTransformation:
         return optax.adam(lr, b1=spec.get("beta_1", 0.9),
                           b2=spec.get("beta_2", 0.999))
     if kind == "adamw":
-        return optax.adamw(lr, weight_decay=spec.get("weight_decay", 1e-4))
+        # standard decay mask: only matrices decay — biases, norm
+        # scales, and other vectors/scalars are excluded (decaying an
+        # RMSNorm scale toward zero is a regularization bug, not a
+        # regularizer)
+        return optax.adamw(
+            lr, weight_decay=spec.get("weight_decay", 1e-4),
+            mask=lambda params: jax.tree_util.tree_map(
+                lambda p: getattr(p, "ndim", 0) >= 2, params))
     if kind == "sgd":
         return optax.sgd(lr, momentum=spec.get("momentum", 0.0),
                          nesterov=spec.get("nesterov", False))

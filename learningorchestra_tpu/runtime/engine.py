@@ -402,7 +402,20 @@ class Engine:
         epochs, Keras-style)."""
         if checkpointer is None or checkpointer.latest_step() is None:
             return state, False
-        restored = checkpointer.restore(state)
+        try:
+            restored = checkpointer.restore(state)
+        except Exception as exc:  # noqa: BLE001 — structure mismatch
+            # a checkpoint whose pytree no longer matches the current
+            # state (e.g. an optimizer gained a decay mask between
+            # versions) must not strand the job — warn loudly and
+            # train from scratch instead of crashing the resume
+            import warnings
+
+            warnings.warn(
+                f"checkpoint restore failed ({type(exc).__name__}: "
+                f"{exc}); state layout changed — training from "
+                f"scratch instead of resuming", stacklevel=2)
+            return state, False
         if restored is None:
             return state, False
         return restored, True

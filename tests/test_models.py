@@ -459,3 +459,25 @@ def test_class_weight_val_split_and_length_check(tmp_config):
         model.fit(x, y, batch_size=16, epochs=1,
                   class_weight={0: 1.0},
                   sample_weight=np.ones(5))
+
+
+def test_adamw_decay_skips_vectors(tmp_config):
+    """adamw's weight decay applies to matrices only: with zero
+    gradients, a kernel shrinks toward zero while a norm scale /
+    bias stays bit-identical."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from learningorchestra_tpu.models.neural import build_optimizer
+
+    opt = build_optimizer({"kind": "adamw", "learning_rate": 0.1,
+                           "weight_decay": 0.5})
+    params = {"w": jnp.ones((2, 2)), "scale": jnp.ones((2,))}
+    state = opt.init(params)
+    grads = jax.tree_util.tree_map(jnp.zeros_like, params)
+    updates, _ = opt.update(grads, state, params)
+    new = jax.tree_util.tree_map(lambda p, u: p + u, params, updates)
+    assert np.all(np.asarray(new["w"]) < 1.0)          # decayed
+    np.testing.assert_array_equal(np.asarray(new["scale"]),
+                                  np.ones(2))          # untouched

@@ -397,3 +397,38 @@ def test_grad_accum_exact_under_skewed_weights(tmp_config):
     np.testing.assert_allclose(np.asarray(p4["w"]), np.asarray(p1["w"]),
                                atol=1e-5)
     assert abs(h4[-1]["loss"] - h1[-1]["loss"]) < 1e-4
+
+
+def test_restore_structure_mismatch_trains_from_scratch(
+        tmp_config, tmp_path):
+    """A checkpoint whose pytree no longer matches the current state
+    (optimizer structure evolved between versions) warns and trains
+    from scratch instead of crashing the resume."""
+    from learningorchestra_tpu.runtime import engine as E
+    from learningorchestra_tpu.runtime import mesh as M
+    from learningorchestra_tpu.runtime.checkpoint import Checkpointer
+    from learningorchestra_tpu.runtime.data import ArrayBatcher
+
+    def apply_fn(params, model_state, batch, train, rng_):
+        return batch["x"] @ params["w"], model_state
+
+    x = np.random.default_rng(0).normal(size=(16, 3)).astype(np.float32)
+    y = (x[:, 0] > 0).astype(np.float32)
+    batcher = ArrayBatcher({"x": x, "y": y}, 8, dp_multiple=8)
+
+    # write a checkpoint under one optimizer structure...
+    eng1 = E.Engine(apply_fn, E.mse_loss, optax.sgd(0.1),
+                    mesh=M.build_mesh("auto"),
+                    compute_dtype=jnp.float32)
+    st1 = eng1.init_state({"w": jnp.zeros((3, 1))})
+    ck = Checkpointer(str(tmp_path / "ck"))
+    eng1.fit(st1, batcher, epochs=2, checkpointer=ck)
+
+    # ...then resume with a DIFFERENT optimizer state tree
+    eng2 = E.Engine(apply_fn, E.mse_loss, optax.adam(0.1),
+                    mesh=M.build_mesh("auto"),
+                    compute_dtype=jnp.float32)
+    st2 = eng2.init_state({"w": jnp.zeros((3, 1))})
+    with pytest.warns(UserWarning, match="training from scratch"):
+        _, history = eng2.fit(st2, batcher, epochs=2, checkpointer=ck)
+    assert len(history) == 2  # full budget ran fresh
