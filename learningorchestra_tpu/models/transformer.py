@@ -874,12 +874,15 @@ class TextClassifier:
                 "model has no parameters yet — call fit() first "
                 "(or load a trained artifact)")
 
-    def _resolved_attention(self) -> str:
+    def _resolved_attention(self, seq_len: Optional[int] = None) -> str:
         if self.attention != "auto":
             return self.attention
-        # same measured crossover as the LM (BENCHMARKS.md flash table)
+        # same measured crossover as the LM (BENCHMARKS.md flash
+        # table), resolved from the ACTUAL batch width when known — a
+        # max_len=2048 classifier fed 128-token batches should take
+        # the dot path, not flash below the measured crossover
         if jax.default_backend() == "tpu":
-            return "flash" if self.max_len >= 1024 else "dot"
+            return "flash" if (seq_len or self.max_len) >= 1024 else "dot"
         return "dot"
 
     def _mesh(self):
@@ -901,18 +904,25 @@ class TextClassifier:
 
     @property
     def module(self) -> TransformerEncoder:
+        return self._module()
+
+    def _module(self, seq_len: Optional[int] = None) -> TransformerEncoder:
         return TransformerEncoder(
             vocab_size=self.vocab_size, n_classes=self.n_classes,
             d_model=self.d_model, n_layers=self.n_layers,
             n_heads=self.n_heads, n_kv_heads=self.n_kv_heads,
-            d_ff=self.d_ff, attention=self._resolved_attention(),
+            d_ff=self.d_ff, attention=self._resolved_attention(seq_len),
             dropout=self.dropout, mesh=self._mesh_override)
 
     def _apply_fn(self, params, model_state, batch, train, rng):
         rngs = {"dropout": rng} if (train and rng is not None and
                                     self.dropout) else None
-        out = self.module.apply({"params": params}, batch["x"],
-                                train=train, rngs=rngs)
+        # the attention impl resolves from the traced batch width, so
+        # an "auto" classifier takes flash only at-or-above the
+        # measured crossover regardless of its configured max_len
+        module = self._module(int(batch["x"].shape[1]))
+        out = module.apply({"params": params}, batch["x"],
+                           train=train, rngs=rngs)
         return out, model_state
 
     def _get_engine(self) -> engine_lib.Engine:
@@ -958,9 +968,10 @@ class TextClassifier:
             dp_multiple=mesh_lib.data_parallel_size(self._mesh()))
 
     def _build_params(self, sample_x) -> None:
-        variables = self.module.init(
+        sample = np.asarray(sample_x)
+        variables = self._module(int(sample.shape[1])).init(
             jax.random.PRNGKey(self.seed),
-            jnp.asarray(sample_x[:1]), train=False)
+            jnp.asarray(sample[:1]), train=False)
         self.params = variables["params"]
 
     def fit(self, x=None, y=None, batch_size: Optional[int] = None,
@@ -1437,6 +1448,10 @@ class LanguageModel:
                 raise ValueError(
                     "beam search is deterministic — use temperature=0 "
                     "(sampling and beams don't compose)")
+            if top_k is not None or top_p is not None:
+                raise ValueError(
+                    "beam search is deterministic — top_k/top_p "
+                    "sampling filters don't compose with num_beams>1")
             if num_beams >= self.vocab_size:
                 # token 0 is pad-masked, so vocab-1 real candidates
                 raise ValueError(

@@ -49,6 +49,40 @@ class Checkpointer:
             return None
         return self._mgr.restore(step, args=ocp.args.StandardRestore(target))
 
+    def saved_metadata(self, step: Optional[int] = None) -> Any:
+        """The SAVED tree's structure as a pytree of ArrayMetadata
+        leaves (shape/dtype) — reads checkpoint metadata only, no
+        array data. This is the layout-drift discriminator: comparing
+        it structurally against the live state beats sniffing orbax's
+        mismatch message, which rewords across releases."""
+        if step is None:
+            step = self._mgr.latest_step()
+        if step is None:
+            return None
+        meta = self._mgr.item_metadata(step)
+        return getattr(meta, "tree", meta)
+
+    def restore_partial(self, target_subtree: Any,
+                        step: Optional[int] = None) -> Any:
+        """Restore only the subtrees named in ``target_subtree`` (e.g.
+        params + step, skipping a drifted opt_state entirely, so the
+        stale optimizer arrays are never read into host memory). Uses
+        a fresh read-only manager: the instance manager's handler
+        registry is pinned to StandardRestore by the failed full
+        restore that precedes a migration."""
+        import orbax.checkpoint as ocp
+
+        if step is None:
+            step = self._mgr.latest_step()
+        if step is None:
+            return None
+        mgr = ocp.CheckpointManager(self._dir)
+        try:
+            return mgr.restore(step, args=ocp.args.PyTreeRestore(
+                item=target_subtree, partial_restore=True))
+        finally:
+            mgr.close()
+
     # -- sidecar progress metadata ------------------------------------
     # Epoch progress can't be reconstructed from the restored step when
     # a re-run reshapes the feed (different batch_size / data size), so

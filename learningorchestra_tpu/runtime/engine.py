@@ -404,21 +404,111 @@ class Engine:
             return state, False
         try:
             restored = checkpointer.restore(state)
-        except Exception as exc:  # noqa: BLE001 — structure mismatch
-            # a checkpoint whose pytree no longer matches the current
-            # state (e.g. an optimizer gained a decay mask between
-            # versions) must not strand the job — warn loudly and
-            # train from scratch instead of crashing the resume
+        except (ValueError, KeyError, TypeError) as exc:
+            # The targeted restore failed. Decide what that MEANS from
+            # the checkpoint's own metadata (structure only, no array
+            # reads) rather than the exception text — orbax raises
+            # ValueError both for layout drift and for I/O corruption
+            # (tensorstore NOT_FOUND), and silently training from
+            # scratch on a corrupted read could overwrite the last
+            # good checkpoint at the next save.
             import warnings
 
+            migrated, reason = self._restore_params_only(state,
+                                                         checkpointer)
+            if migrated is not None:
+                warnings.warn(
+                    f"checkpoint state layout changed "
+                    f"({type(exc).__name__}: {exc}); resumed params at "
+                    f"step {int(migrated.step)} and rebuilt optimizer "
+                    f"state fresh", stacklevel=2)
+                return migrated, True
+            if reason == "unreadable":
+                # the checkpoint itself failed to read: corruption/IO,
+                # not drift — propagate rather than risk overwriting
+                # the last good save with a from-scratch run
+                raise
             warnings.warn(
                 f"checkpoint restore failed ({type(exc).__name__}: "
-                f"{exc}); state layout changed — training from "
-                f"scratch instead of resuming", stacklevel=2)
+                f"{exc}); state layout changed and params could not "
+                f"be migrated — training from scratch instead of "
+                f"resuming", stacklevel=2)
             return state, False
         if restored is None:
             return state, False
         return restored, True
+
+    def _restore_params_only(self, state: TrainState, checkpointer
+                             ) -> Tuple[Optional[TrainState], str]:
+        """Layout-drift migration: graft the checkpoint's params (and
+        step / model_state where their structure still matches) onto
+        the live state and rebuild opt_state from the optimizer — a
+        run whose optimizer pytree drifted resumes with a cold
+        optimizer instead of restarting at step 0.
+
+        Returns ``(state, "ok")`` on success, ``(None, reason)``
+        otherwise; reason "mismatch" means the params themselves
+        drifted (scratch is legitimate), anything else means the
+        checkpoint could not be read (the caller should re-raise).
+        Only the matching subtrees are restored, so a drifted
+        opt_state's stale arrays (2x params for adam) never touch
+        host memory."""
+        if not (hasattr(checkpointer, "saved_metadata") and
+                hasattr(checkpointer, "restore_partial")):
+            return None, "unsupported"
+        meta = checkpointer.saved_metadata()
+        if not isinstance(meta, dict) or "params" not in meta:
+            return None, "mismatch"
+
+        def _same_structure(live, saved) -> bool:
+            if jax.tree_util.tree_structure(live) != \
+                    jax.tree_util.tree_structure(saved):
+                return False
+            return all(
+                tuple(getattr(x, "shape", ())) ==
+                tuple(getattr(y, "shape", ()))
+                for x, y in zip(jax.tree_util.tree_leaves(live),
+                                jax.tree_util.tree_leaves(saved)))
+
+        if not _same_structure(state.params, meta["params"]):
+            return None, "mismatch"
+        target = {"params": jax.tree_util.tree_map(
+            lambda x: np.zeros(x.shape, x.dtype), state.params)}
+        if "step" in meta:
+            target["step"] = np.zeros(state.step.shape, state.step.dtype)
+        graft_model_state = (
+            "model_state" in meta and
+            jax.tree_util.tree_leaves(state.model_state) and
+            _same_structure(state.model_state, meta["model_state"]))
+        if graft_model_state:
+            target["model_state"] = jax.tree_util.tree_map(
+                lambda x: np.zeros(x.shape, x.dtype), state.model_state)
+        raw = checkpointer.restore_partial(target)
+        if raw is None:
+            return None, "unreadable"
+        # land each leaf on its live sharding so a TP/FSDP layout
+        # survives the migration
+        params = jax.tree_util.tree_map(
+            lambda cur, new: jax.device_put(
+                jnp.asarray(new, cur.dtype), cur.sharding),
+            state.params, raw["params"])
+        if self._mesh is not None and self._param_rules is not None:
+            opt_state = jax.jit(self._optimizer.init)(params)
+        else:
+            opt_state = self._optimizer.init(params)
+        step = state.step
+        if "step" in raw:
+            step = jax.device_put(
+                jnp.asarray(raw["step"], state.step.dtype),
+                state.step.sharding)
+        model_state = state.model_state
+        if graft_model_state:
+            model_state = jax.tree_util.tree_map(
+                lambda cur, new: jax.device_put(
+                    jnp.asarray(new, cur.dtype), cur.sharding),
+                state.model_state, raw["model_state"])
+        return TrainState(step=step, params=params, opt_state=opt_state,
+                          model_state=model_state), "ok"
 
     def _fit_scanned(self, state: TrainState,
                      batcher: data_lib.ArrayBatcher, epochs: int,

@@ -399,11 +399,11 @@ def test_grad_accum_exact_under_skewed_weights(tmp_config):
     assert abs(h4[-1]["loss"] - h1[-1]["loss"]) < 1e-4
 
 
-def test_restore_structure_mismatch_trains_from_scratch(
-        tmp_config, tmp_path):
-    """A checkpoint whose pytree no longer matches the current state
-    (optimizer structure evolved between versions) warns and trains
-    from scratch instead of crashing the resume."""
+def test_restore_optimizer_drift_migrates_params(tmp_config, tmp_path):
+    """A checkpoint whose OPTIMIZER pytree no longer matches the live
+    state (optimizer structure evolved between versions, e.g. adamw
+    gaining a decay mask) resumes params-only with a freshly built
+    opt_state instead of silently restarting at step 0."""
     from learningorchestra_tpu.runtime import engine as E
     from learningorchestra_tpu.runtime import mesh as M
     from learningorchestra_tpu.runtime.checkpoint import Checkpointer
@@ -422,13 +422,60 @@ def test_restore_structure_mismatch_trains_from_scratch(
                     compute_dtype=jnp.float32)
     st1 = eng1.init_state({"w": jnp.zeros((3, 1))})
     ck = Checkpointer(str(tmp_path / "ck"))
-    eng1.fit(st1, batcher, epochs=2, checkpointer=ck)
+    st1, _ = eng1.fit(st1, batcher, epochs=2, checkpointer=ck)
+    trained_w = np.asarray(st1.params["w"])
+    trained_step = int(st1.step)
 
-    # ...then resume with a DIFFERENT optimizer state tree
+    # ...then resume with a DIFFERENT optimizer state tree: the params
+    # graft over, the step continues, and only the remaining budget runs
     eng2 = E.Engine(apply_fn, E.mse_loss, optax.adam(0.1),
                     mesh=M.build_mesh("auto"),
                     compute_dtype=jnp.float32)
+    # the migration grafts EXACTLY the trained params (not the live
+    # zero-init) before any further training
+    probe = eng2.init_state({"w": jnp.zeros((3, 1))})
+    with pytest.warns(UserWarning, match="rebuilt optimizer"):
+        migrated, was_restored = eng2._maybe_restore(probe, ck)
+    assert was_restored and int(migrated.step) == trained_step
+    assert not np.allclose(trained_w, 0.0)
+    np.testing.assert_allclose(np.asarray(migrated.params["w"]),
+                               trained_w)
     st2 = eng2.init_state({"w": jnp.zeros((3, 1))})
+    with pytest.warns(UserWarning, match="rebuilt optimizer"):
+        st2, history = eng2.fit(st2, batcher, epochs=3, checkpointer=ck)
+    assert len(history) == 1  # 2 of 3 epochs already done
+    assert int(st2.step) > trained_step
+
+
+def test_restore_params_drift_trains_from_scratch(tmp_config, tmp_path):
+    """When the PARAMS tree itself drifted (different shapes), no
+    migration is possible: warn and train from scratch."""
+    from learningorchestra_tpu.runtime import engine as E
+    from learningorchestra_tpu.runtime import mesh as M
+    from learningorchestra_tpu.runtime.checkpoint import Checkpointer
+    from learningorchestra_tpu.runtime.data import ArrayBatcher
+
+    x = np.random.default_rng(0).normal(size=(16, 3)).astype(np.float32)
+    y = (x[:, 0] > 0).astype(np.float32)
+    batcher = ArrayBatcher({"x": x, "y": y}, 8, dp_multiple=8)
+
+    def apply1(params, model_state, batch, train, rng_):
+        return batch["x"] @ params["w"], model_state
+
+    eng1 = E.Engine(apply1, E.mse_loss, optax.sgd(0.1),
+                    mesh=M.build_mesh("auto"),
+                    compute_dtype=jnp.float32)
+    st1 = eng1.init_state({"w": jnp.zeros((3, 1))})
+    ck = Checkpointer(str(tmp_path / "ck"))
+    eng1.fit(st1, batcher, epochs=2, checkpointer=ck)
+
+    def apply2(params, model_state, batch, train, rng_):
+        return batch["x"] @ params["w"] + params["b"], model_state
+
+    eng2 = E.Engine(apply2, E.mse_loss, optax.adam(0.1),
+                    mesh=M.build_mesh("auto"),
+                    compute_dtype=jnp.float32)
+    st2 = eng2.init_state({"w": jnp.zeros((3, 1)), "b": jnp.zeros((1,))})
     with pytest.warns(UserWarning, match="training from scratch"):
         _, history = eng2.fit(st2, batcher, epochs=2, checkpointer=ck)
     assert len(history) == 2  # full budget ran fresh

@@ -11,6 +11,7 @@ import pytest
 from learningorchestra_tpu import config as config_mod
 from learningorchestra_tpu.models.transformer import (
     LanguageModel,
+    TextClassifier,
     TransformerLM,
 )
 from learningorchestra_tpu.parallel import sharding as sharding_lib
@@ -944,6 +945,34 @@ def test_beam_search_rejects_sampling(tmp_path):
     with pytest.raises(ValueError, match="beam"):
         lm.generate(x[:1, :4], max_new_tokens=2, temperature=0.8,
                     num_beams=2)
+    # top_k/top_p are sampling filters: silently dropping them under
+    # beams would return deterministic beams the caller didn't ask for
+    with pytest.raises(ValueError, match="top_k/top_p"):
+        lm.generate(x[:1, :4], max_new_tokens=2, num_beams=2, top_k=5)
+    with pytest.raises(ValueError, match="top_k/top_p"):
+        lm.generate(x[:1, :4], max_new_tokens=2, num_beams=2, top_p=0.9)
+
+
+def test_auto_attention_resolves_from_actual_seq_len(tmp_path,
+                                                     monkeypatch):
+    """attention="auto" picks flash vs dot from the ACTUAL sequence
+    width, not the configured max_len — a long-capable classifier fed
+    short batches must stay on dot below the measured 1024 crossover
+    (and the LM already did; pin both)."""
+    import jax as jax_mod
+
+    _mesh_config(tmp_path, "dp=1")
+    monkeypatch.setattr(jax_mod, "default_backend", lambda: "tpu")
+    clf = TextClassifier(vocab_size=64, n_classes=2, d_model=16,
+                         n_layers=1, n_heads=2, max_len=2048,
+                         attention="auto")
+    assert clf._resolved_attention(128) == "dot"
+    assert clf._resolved_attention(1024) == "flash"
+    assert clf._resolved_attention() == "flash"  # falls back to max_len
+    lm = LanguageModel(vocab_size=64, d_model=16, n_layers=1,
+                       n_heads=2, max_len=2048, attention="auto")
+    assert lm._resolved_attention(128) == "dot"
+    assert lm._resolved_attention(1024) == "flash"
 
 
 def test_set_mesh_drops_decode_caches(tmp_path):
