@@ -48,6 +48,10 @@ class Config:
     # an exclusive resource, unlike the reference's forgiving threads).
     mesh_leases: int = dataclasses.field(
         default_factory=lambda: int(os.environ.get("LO_MESH_LEASES", "1")))
+    # Fair-scheduling pool weights, "train=2,tune=1" (unlisted pools
+    # weigh 1) — reference fairscheduler.xml ``weight`` parity.
+    pool_weights: str = dataclasses.field(
+        default_factory=lambda: os.environ.get("LO_POOL_WEIGHTS", ""))
 
     # Device mesh defaults: axis names follow the scaling-book
     # convention. Shape 'auto' = 1D data-parallel over all devices.

@@ -28,6 +28,7 @@ from flax import struct
 
 from learningorchestra_tpu.runtime import data as data_lib
 from learningorchestra_tpu.runtime import mesh as mesh_lib
+from learningorchestra_tpu.runtime import preempt
 
 
 class TrainState(struct.PyTreeNode):
@@ -556,6 +557,13 @@ class Engine:
                 self._save_checkpoint(checkpointer, state, epoch)
             if log_fn is not None:
                 log_fn(record)
+            # fair scheduling: offer the mesh lease to waiting jobs of
+            # other pools (no-op outside the service layer); the epoch
+            # is checkpointed, so the hand-off is durable. Never after
+            # the last epoch — a finishing job must not block on
+            # re-acquiring a lease it has no more work for.
+            if epoch + 1 < epochs:
+                preempt.maybe_yield()
         return state, history
 
     def fit(self, state: TrainState, batcher: data_lib.ArrayBatcher,
@@ -638,6 +646,8 @@ class Engine:
                 self._save_checkpoint(checkpointer, state, epoch)
             if log_fn is not None:
                 log_fn(record)
+            if epoch + 1 < epochs:  # fair scheduling (see _fit_scanned)
+                preempt.maybe_yield()
         return state, history
 
     def evaluate(self, state: TrainState, batcher: data_lib.ArrayBatcher,

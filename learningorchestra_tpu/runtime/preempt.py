@@ -1,0 +1,45 @@
+"""Cooperative preemption hook for long device jobs.
+
+The reference gives each Spark service its own FAIR scheduler pool so
+a long job cannot monopolize the cluster
+(reference spark_image/fairscheduler.xml:1-8, builder_image
+server.py:57-63). The TPU analogue: the mesh is an exclusive lease
+(services/scheduler.FairLease), and long engine fits offer to YIELD
+the lease at epoch boundaries — per-epoch orbax checkpoints make the
+hand-off durable, and since all jobs share one process the model
+state stays live in memory across the yield.
+
+The engine can't import the services layer (layering), so the lease
+installs a thread-local callback here and the engine's epoch loops
+call :func:`maybe_yield` between epochs. No lease installed (direct
+library use, tests, workers) → no-op.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Optional
+
+_tls = threading.local()
+
+
+def install(fn: Callable[[], None]) -> None:
+    """Register ``fn`` as this thread's between-epochs yield point
+    (called by the mesh lease when a job thread acquires it)."""
+    _tls.fn = fn
+
+
+def clear() -> None:
+    _tls.fn = None
+
+
+def current() -> Optional[Callable[[], None]]:
+    return getattr(_tls, "fn", None)
+
+
+def maybe_yield() -> None:
+    """Engine epoch boundary: hand the mesh lease to a waiting job of
+    another pool (if any) and re-acquire it through the fair queue."""
+    fn = current()
+    if fn is not None:
+        fn()

@@ -23,6 +23,8 @@ class ServiceContext:
         from learningorchestra_tpu.runtime import distributed as dist
         from learningorchestra_tpu.services.jobs import JobManager
         from learningorchestra_tpu.services.params import ParameterResolver
+        from learningorchestra_tpu.services.scheduler import \
+            parse_pool_weights
 
         self.config = config or get_config()
         self.config.ensure_dirs()
@@ -32,7 +34,9 @@ class ServiceContext:
         self.jobs = JobManager(self.catalog,
                                max_workers=self.config.max_workers,
                                mesh_leases=self.config.mesh_leases,
-                               pod_failure_fn=dist.pod_failure)
+                               pod_failure_fn=dist.pod_failure,
+                               pool_weights=parse_pool_weights(
+                                   self.config.pool_weights))
         self.params = ParameterResolver(self)
         self._pod_guard = _start_pod_guard(self.jobs)
 

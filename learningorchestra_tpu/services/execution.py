@@ -183,6 +183,10 @@ class ExecutionService:
         self._ctx.jobs.submit(
             name, run, description=description,
             parameters=method_parameters, needs_mesh=True,
+            # the executor verb (train/tune/evaluate/predict) is the
+            # fair-scheduling pool — per-service FAIR pool parity
+            # (reference spark_image/fairscheduler.xml:1-8)
+            pool=type_string.split("/", 1)[0],
             max_retries=self._ctx.config.job_max_retries)
 
 

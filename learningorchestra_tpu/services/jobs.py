@@ -13,8 +13,12 @@ Beyond the reference (its in-flight jobs are simply lost on failure,
 README.md:194-198):
 
 - **Device leasing.** A TPU mesh is an exclusive resource; jobs that
-  need it acquire a bounded lease so concurrent REST jobs queue
-  instead of fighting over HBM (SURVEY §7 hard part #1).
+  need it acquire a lease so concurrent REST jobs queue instead of
+  fighting over HBM (SURVEY §7 hard part #1). The lease is FAIR
+  across job classes (services/scheduler.py — fairscheduler.xml
+  parity) and long fits yield it at epoch boundaries; a preempted
+  job's device state stays in HBM, so LO_MESH_YIELD=0 restores
+  strict serialization when concurrent footprints would not fit.
 - **Retry.** ``max_retries`` re-runs a failed pipeline; each attempt
   appends its own execution document.
 - **Timing.** Every execution document records ``elapsedSeconds``
@@ -39,11 +43,14 @@ class JobManager:
     def __init__(self, catalog: Catalog, max_workers: int = 8,
                  mesh_leases: int = 1,
                  pod_failure_fn: Optional[Callable[[], Optional[str]]]
-                 = None):
+                 = None,
+                 pool_weights: Optional[Dict[str, float]] = None):
+        from learningorchestra_tpu.services.scheduler import FairLease
+
         self._catalog = catalog
         self._pool = ThreadPoolExecutor(max_workers=max_workers,
                                         thread_name_prefix="lo-job")
-        self._mesh_sem = threading.BoundedSemaphore(mesh_leases)
+        self._mesh = FairLease(mesh_leases, pool_weights)
         self._futures: Dict[str, Future] = {}
         self._mesh_jobs: Dict[str, Dict[str, Any]] = {}
         self._lock = threading.Lock()
@@ -53,16 +60,21 @@ class JobManager:
         self._pod_failure_fn = pod_failure_fn or (lambda: None)
 
     # ------------------------------------------------------------------
-    def mesh_lease(self):
-        """Context manager granting exclusive accelerator access (the
-        semaphore itself — ``with jobs.mesh_lease(): ...``)."""
-        return self._mesh_sem
+    def mesh_lease(self, pool: str = "default"):
+        """Context manager granting accelerator access through the
+        fair queue (``with jobs.mesh_lease(): ...``)."""
+        return self._mesh.lease(pool)
+
+    def mesh_served(self) -> Dict[str, float]:
+        """Cumulative mesh seconds per pool (observability)."""
+        return self._mesh.served()
 
     # ------------------------------------------------------------------
     def submit(self, name: str, fn: Callable[[], Any], *,
                description: str = "",
                parameters: Optional[Dict[str, Any]] = None,
                needs_mesh: bool = False,
+               pool: str = "default",
                max_retries: int = 0,
                on_success: Optional[Callable[[Any], None]] = None,
                mark_finished: bool = True,
@@ -88,14 +100,32 @@ class JobManager:
                                 extra={"workerLost": True,
                                        "attempt": attempt + 1}))
                         return None
-                lease = (self._mesh_sem if needs_mesh
+                lease = (self._mesh.lease(pool) if needs_mesh
                          else contextlib.nullcontext())
-                with lease:
+                with lease as token:
                     queue_wait = time.monotonic() - submitted
                     start = time.monotonic()
+
+                    def timing(extra_base):
+                        # elapsedSeconds is the job's OWN runtime:
+                        # epochs spent preempted (lease handed to
+                        # another pool) are reported separately so
+                        # throughput comparisons stay meaningful
+                        # under contention
+                        elapsed = time.monotonic() - start
+                        preempted = getattr(token, "preempted_seconds",
+                                            0.0)
+                        extra = dict(extra_base)
+                        extra["elapsedSeconds"] = round(
+                            elapsed - preempted, 6)
+                        if preempted > 0:
+                            extra["preemptedSeconds"] = round(
+                                preempted, 6)
+                            extra["leaseYields"] = token.yields
+                        return extra
+
                     try:
                         result = fn()
-                        elapsed = time.monotonic() - start
                         if on_success is not None:
                             on_success(result)
                         if mark_finished:
@@ -103,20 +133,18 @@ class JobManager:
                         self._catalog.append_document(
                             name, D.execution_document(
                                 description, parameters,
-                                extra={"elapsedSeconds": round(elapsed, 6),
-                                       "queueWaitSeconds": round(
-                                           queue_wait, 6),
-                                       "attempt": attempt + 1}))
+                                extra=timing(
+                                    {"queueWaitSeconds": round(
+                                        queue_wait, 6),
+                                     "attempt": attempt + 1})))
                         return result
                     except Exception as exception:  # noqa: BLE001
                         traceback.print_exc()
-                        elapsed = time.monotonic() - start
                         self._catalog.append_document(
                             name, D.execution_document(
                                 description, parameters,
                                 exception=repr(exception),
-                                extra={"elapsedSeconds": round(elapsed, 6),
-                                       "attempt": attempt + 1}))
+                                extra=timing({"attempt": attempt + 1})))
                         if attempt + 1 >= attempts:
                             # finished stays False (reference parity)
                             return None

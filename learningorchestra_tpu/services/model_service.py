@@ -94,4 +94,5 @@ class ModelService:
         self._ctx.jobs.submit(
             name, run, description=description,
             parameters=class_parameters,
-            needs_mesh=type_string.endswith(("/tensorflow", "/jax")))
+            needs_mesh=type_string.endswith(("/tensorflow", "/jax")),
+            pool=type_string.split("/", 1)[0])

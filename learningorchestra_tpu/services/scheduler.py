@@ -1,0 +1,201 @@
+"""Fair mesh scheduling.
+
+The reference runs every Spark service under a FAIR scheduler pool
+(one ``<pool weight=1 minShare=2>`` per service, reference
+spark_image/fairscheduler.xml:1-8, wired in builder_image
+server.py:57-63) so concurrent Builder/Tune/Train requests share the
+cluster instead of queuing behind each other. The round-4 rebuild had
+a single FIFO ``BoundedSemaphore`` — one long train starved every
+tune/evaluate behind it.
+
+:class:`FairLease` is the TPU-native replacement:
+
+- **Pools** — each job class (``train``, ``tune``, ``evaluate``,
+  ``predict``, …) is a pool. Capacity ``n`` leases are granted to the
+  pool with the LOWEST served-time/weight among pools with waiters
+  (weighted fair queuing), FIFO within a pool. A pool that has used
+  the mesh least goes first, so a burst of tunes cannot starve a
+  train and vice versa.
+- **Epoch-boundary preemption** — a granted lease installs a
+  thread-local yield point (:mod:`runtime.preempt`); the engine's
+  epoch loops call it between epochs. If ANOTHER pool is waiting, the
+  holder releases, the waiter runs, and the holder re-queues through
+  the same fair policy (same-pool waiters stay FIFO — no per-epoch
+  ping-pong between two trains). Per-epoch orbax checkpoints plus
+  in-process state make the hand-off safe and nearly free.
+- **Weights** — ``LO_POOL_WEIGHTS="train=2,tune=1"`` biases the
+  fair-share ratio (fairscheduler.xml ``weight`` parity); unlisted
+  pools weigh 1.
+
+Caveats (when preemption does NOT apply):
+
+- **Multi-host pods** never yield: every host must replay the same
+  collectives in the same order, and only the coordinator sees the
+  lease — a coordinator-side yield would diverge the SPMD program
+  and hang the pod. Single-host only.
+- A preempted job's device state stays resident in HBM while the
+  preemptor runs, so two jobs whose combined footprint exceeds HBM
+  can OOM where strict serialization would not. Set
+  ``LO_MESH_YIELD=0`` to disable epoch yielding (the lease then
+  degrades to the strict FIFO-fair queue with no mid-job hand-off).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+import time
+from typing import Dict, Iterator, Optional
+
+from learningorchestra_tpu.runtime import preempt
+
+
+def parse_pool_weights(spec: str) -> Dict[str, float]:
+    """``"train=2,tune=1"`` -> ``{"train": 2.0, "tune": 1.0}``."""
+    weights: Dict[str, float] = {}
+    for part in (spec or "").split(","):
+        part = part.strip()
+        if not part:
+            continue
+        name, _, value = part.partition("=")
+        try:
+            weights[name.strip()] = float(value)
+        except ValueError as exc:
+            raise ValueError(
+                f"bad pool weight {part!r} (want name=number)") from exc
+    return weights
+
+
+class FairLease:
+    """Weighted-fair device lease (capacity ``leases`` holders)."""
+
+    def __init__(self, leases: int = 1,
+                 weights: Optional[Dict[str, float]] = None):
+        self._capacity = max(1, int(leases))
+        self._weights = dict(weights or {})
+        self._cv = threading.Condition()
+        self._holders = 0
+        self._served: Dict[str, float] = {}   # pool -> total held seconds
+        self._waiters: list = []              # [(seq, pool)] arrival order
+        self._granted: set = set()            # seqs granted, not yet claimed
+        self._seq = 0
+
+    # -- policy --------------------------------------------------------
+    def _weight(self, pool: str) -> float:
+        w = float(self._weights.get(pool, 1.0))
+        return w if w > 0 else 1.0
+
+    def _grant_next(self) -> None:
+        """With the lock held: hand out free capacity to the waiter of
+        the most-deserving pool (min served/weight; FIFO inside)."""
+        while self._holders + len(self._granted) < self._capacity \
+                and self._waiters:
+            heads: Dict[str, int] = {}
+            for seq, pool in self._waiters:
+                if pool not in heads:
+                    heads[pool] = seq
+            best = min(heads, key=lambda p: (
+                self._served.get(p, 0.0) / self._weight(p), heads[p]))
+            self._waiters.remove((heads[best], best))
+            self._granted.add(heads[best])
+            self._cv.notify_all()
+
+    # -- mechanics -----------------------------------------------------
+    def acquire(self, pool: str = "default") -> None:
+        with self._cv:
+            seq = self._seq
+            self._seq += 1
+            self._waiters.append((seq, pool))
+            self._grant_next()
+            while seq not in self._granted:
+                self._cv.wait()
+            self._granted.discard(seq)
+            self._holders += 1
+
+    def release(self, pool: str, held_seconds: float) -> None:
+        with self._cv:
+            self._holders -= 1
+            self._served[pool] = self._served.get(pool, 0.0) \
+                + max(0.0, held_seconds)
+            self._grant_next()
+
+    def contended(self) -> bool:
+        with self._cv:
+            return bool(self._waiters)
+
+    def contended_by_other(self, pool: str) -> bool:
+        """A waiter from a DIFFERENT pool exists — the only condition
+        under which a holder should yield (same-pool waiters are
+        served FIFO when the holder finishes)."""
+        with self._cv:
+            return any(p != pool for _, p in self._waiters)
+
+    def served(self) -> Dict[str, float]:
+        """Per-pool cumulative mesh seconds (observability)."""
+        with self._cv:
+            return dict(self._served)
+
+    # -- job-facing surface --------------------------------------------
+    @contextlib.contextmanager
+    def lease(self, pool: str = "default") -> Iterator["LeaseToken"]:
+        """Hold the mesh fairly; installs the epoch-boundary yield
+        point for the duration (so engine fits running on this thread
+        hand the device to waiting pools between epochs). Yields a
+        :class:`LeaseToken` whose ``preempted_seconds`` lets callers
+        subtract hand-off idle time from a job's own runtime."""
+        self.acquire(pool)
+        token = LeaseToken()
+        start = [time.monotonic()]
+        can_yield = _yield_enabled()
+
+        def yield_point() -> None:
+            if not can_yield or not self.contended_by_other(pool):
+                return
+            self.release(pool, time.monotonic() - start[0])
+            t_wait = time.monotonic()
+            self.acquire(pool)
+            start[0] = time.monotonic()
+            token.preempted_seconds += start[0] - t_wait
+            token.yields += 1
+
+        previous = preempt.current()
+        preempt.install(yield_point)
+        try:
+            yield token
+        finally:
+            if previous is None:
+                preempt.clear()
+            else:
+                preempt.install(previous)
+            self.release(pool, time.monotonic() - start[0])
+
+
+class LeaseToken:
+    """Per-hold accounting: how long the holder sat preempted (lease
+    handed to another pool) and how many hand-offs happened."""
+
+    def __init__(self) -> None:
+        self.preempted_seconds = 0.0
+        self.yields = 0
+
+
+def _yield_enabled() -> bool:
+    """Epoch-boundary yielding is single-host only (a multi-host pod
+    must replay identical collectives in identical order on every
+    host; a coordinator-side yield would diverge the SPMD program and
+    hang the pod) and can be disabled outright with LO_MESH_YIELD=0
+    for HBM-tight deployments."""
+    import os
+
+    if os.environ.get("LO_MESH_YIELD", "1") in ("0", "false", "no"):
+        return False
+    try:
+        from learningorchestra_tpu.runtime import distributed as dist
+
+        if not dist.is_initialized():
+            return True
+        import jax
+
+        return jax.process_count() <= 1
+    except Exception:  # noqa: BLE001 — no runtime formed yet
+        return True

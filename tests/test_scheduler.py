@@ -1,0 +1,328 @@
+"""Fair mesh scheduling (services/scheduler.py).
+
+Parity target: the reference's per-service FAIR pools
+(spark_image/fairscheduler.xml:1-8) — concurrent job classes share
+the cluster instead of queuing behind one long job. Here the shared
+resource is the mesh lease, and long fits yield it between epochs.
+"""
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from learningorchestra_tpu.runtime import preempt
+from learningorchestra_tpu.services.scheduler import (
+    FairLease,
+    parse_pool_weights,
+)
+
+
+def test_parse_pool_weights():
+    assert parse_pool_weights("") == {}
+    assert parse_pool_weights("train=2,tune=1") == \
+        {"train": 2.0, "tune": 1.0}
+    assert parse_pool_weights(" train = 2 ") == {"train": 2.0}
+    with pytest.raises(ValueError, match="pool weight"):
+        parse_pool_weights("train=fast")
+
+
+def test_uncontended_lease_is_immediate():
+    lease = FairLease(1)
+    with lease.lease("train"):
+        pass
+    assert lease.served()["train"] >= 0.0
+
+
+def test_fifo_within_pool():
+    """Same-pool waiters are served in arrival order."""
+    lease = FairLease(1)
+    order = []
+    hold = threading.Event()
+    started = threading.Event()
+
+    def holder():
+        with lease.lease("train"):
+            started.set()
+            hold.wait(5)
+
+    def waiter(tag, ready):
+        ready.set()
+        with lease.lease("train"):
+            order.append(tag)
+
+    t0 = threading.Thread(target=holder)
+    t0.start()
+    started.wait(5)
+    threads = []
+    for tag in ("a", "b", "c"):
+        ready = threading.Event()
+        t = threading.Thread(target=waiter, args=(tag, ready))
+        t.start()
+        ready.wait(5)
+        time.sleep(0.02)  # ensure stable arrival order in the queue
+        threads.append(t)
+    hold.set()
+    for t in [t0] + threads:
+        t.join(5)
+    assert order == ["a", "b", "c"]
+
+
+def test_least_served_pool_wins():
+    """When the lease frees, the pool with the lowest served/weight
+    ratio goes first — a burst of one class cannot starve another."""
+    lease = FairLease(1)
+    # seed history: train has consumed 10 mesh-seconds, tune none
+    lease.acquire("train")
+    lease.release("train", 10.0)
+    order = []
+    hold = threading.Event()
+    started = threading.Event()
+
+    def holder():
+        with lease.lease("evaluate"):
+            started.set()
+            hold.wait(5)
+
+    def waiter(pool):
+        def run():
+            with lease.lease(pool):
+                order.append(pool)
+        return run
+
+    t0 = threading.Thread(target=holder)
+    t0.start()
+    started.wait(5)
+    # train arrives FIRST but tune (zero served time) must win the grant
+    threads = []
+    for pool in ("train", "tune"):
+        t = threading.Thread(target=waiter(pool))
+        t.start()
+        time.sleep(0.05)
+        threads.append(t)
+    hold.set()
+    for t in [t0] + threads:
+        t.join(5)
+    assert order == ["tune", "train"]
+
+
+def test_weights_bias_the_share():
+    """weight=3 makes 3 consumed seconds cost like 1 — the weighted
+    pool wins against an equal-served unweighted pool."""
+    lease = FairLease(1, weights={"train": 3.0})
+    lease.acquire("train")
+    lease.release("train", 9.0)   # effective 3.0
+    lease.acquire("tune")
+    lease.release("tune", 4.0)    # effective 4.0
+    order = []
+    hold = threading.Event()
+    started = threading.Event()
+
+    def holder():
+        with lease.lease("predict"):
+            started.set()
+            hold.wait(5)
+
+    t0 = threading.Thread(target=holder)
+    t0.start()
+    started.wait(5)
+    threads = []
+    for pool in ("tune", "train"):
+        def run(p=pool):
+            with lease.lease(p):
+                order.append(p)
+        t = threading.Thread(target=run)
+        t.start()
+        time.sleep(0.05)
+        threads.append(t)
+    hold.set()
+    for t in [t0] + threads:
+        t.join(5)
+    assert order == ["train", "tune"]
+
+
+def test_yield_point_hands_over_and_requeues():
+    """A holder calling preempt.maybe_yield() between 'epochs' lets a
+    waiting other-pool job run, then continues — the interleaving the
+    single FIFO semaphore could never produce."""
+    lease = FairLease(1)
+    events = []
+    tune_done = threading.Event()
+
+    def train():
+        with lease.lease("train"):
+            for epoch in range(6):
+                events.append(("train", epoch))
+                time.sleep(0.01)
+                preempt.maybe_yield()
+
+    def tune():
+        with lease.lease("tune"):
+            events.append(("tune", 0))
+            tune_done.set()
+
+    t1 = threading.Thread(target=train)
+    t1.start()
+    while not any(e[0] == "train" for e in events):
+        time.sleep(0.005)
+    t2 = threading.Thread(target=tune)
+    t2.start()
+    t1.join(10)
+    t2.join(10)
+    assert tune_done.is_set()
+    tune_at = events.index(("tune", 0))
+    # tune ran BETWEEN train epochs, not after all of them
+    assert 0 < tune_at < len(events) - 1
+    train_events = [e for e in events if e[0] == "train"]
+    assert train_events == [("train", i) for i in range(6)]
+
+
+def test_yield_without_contention_keeps_lease():
+    lease = FairLease(1)
+    with lease.lease("train") as token:
+        fn = preempt.current()
+        assert fn is not None
+        fn()  # nobody waiting — must not deadlock or release
+        assert lease.contended() is False
+        assert token.yields == 0
+    assert preempt.current() is None
+
+
+def test_same_pool_waiter_does_not_preempt():
+    """Within one pool the queue is strictly FIFO: a second train must
+    NOT make the first train hand off every epoch (ping-pong doubles
+    resident HBM for zero fairness gain)."""
+    lease = FairLease(1)
+    events = []
+    first_in = threading.Event()
+
+    def first():
+        with lease.lease("train") as token:
+            first_in.set()
+            for epoch in range(4):
+                events.append(("first", epoch))
+                time.sleep(0.01)
+                preempt.maybe_yield()
+            assert token.yields == 0  # same-pool waiter: no hand-off
+
+    def second():
+        with lease.lease("train"):
+            events.append(("second", 0))
+
+    t1 = threading.Thread(target=first)
+    t1.start()
+    first_in.wait(5)
+    t2 = threading.Thread(target=second)
+    t2.start()
+    t1.join(10)
+    t2.join(10)
+    assert events == [("first", i) for i in range(4)] + [("second", 0)]
+
+
+def test_mesh_yield_env_disables_preemption(monkeypatch):
+    monkeypatch.setenv("LO_MESH_YIELD", "0")
+    lease = FairLease(1)
+    events = []
+    first_in = threading.Event()
+
+    def train():
+        with lease.lease("train") as token:
+            first_in.set()
+            for epoch in range(4):
+                events.append(("train", epoch))
+                time.sleep(0.01)
+                preempt.maybe_yield()
+            assert token.yields == 0
+
+    def tune():
+        with lease.lease("tune"):
+            events.append(("tune", 0))
+
+    t1 = threading.Thread(target=train)
+    t1.start()
+    first_in.wait(5)
+    t2 = threading.Thread(target=tune)
+    t2.start()
+    t1.join(10)
+    t2.join(10)
+    # strict serialization: tune ran only after the whole train
+    assert events == [("train", i) for i in range(4)] + [("tune", 0)]
+
+
+def test_job_manager_fair_pools(tmp_config):
+    """End-to-end through JobManager: a long train job yields between
+    epochs and a tune job submitted later finishes FIRST instead of
+    waiting for the whole train (VERDICT round-4 item 3)."""
+    from learningorchestra_tpu.catalog import Catalog
+    from learningorchestra_tpu.services.jobs import JobManager
+
+    cat = Catalog(tmp_config.catalog_path, tmp_config.datasets_dir)
+    jobs = JobManager(cat, max_workers=4)
+    events = []
+    train_started = threading.Event()
+    try:
+        def train_fn():
+            for epoch in range(8):
+                train_started.set()
+                events.append(("train", epoch))
+                time.sleep(0.02)
+                preempt.maybe_yield()
+            return "trained"
+
+        def tune_fn():
+            events.append(("tune", 0))
+            return "tuned"
+
+        cat.create_collection("t-train", "train/tensorflow", {})
+        cat.create_collection("t-tune", "tune/tensorflow", {})
+        jobs.submit("t-train", train_fn, needs_mesh=True, pool="train")
+        train_started.wait(10)
+        jobs.submit("t-tune", tune_fn, needs_mesh=True, pool="tune")
+        assert jobs.wait("t-train", timeout=30) == "trained"
+        assert jobs.wait("t-tune", timeout=30) == "tuned"
+        tune_at = events.index(("tune", 0))
+        assert tune_at < len(events) - 1  # interleaved, not starved
+        served = jobs.mesh_served()
+        assert served["train"] > 0 and "tune" in served
+        # the preempted train's execution doc separates its own
+        # runtime from the time it sat yielded to the tune pool
+        train_docs = [d for d in cat.get_documents("t-train")
+                      if "elapsedSeconds" in d]
+        assert train_docs and train_docs[-1]["preemptedSeconds"] > 0
+        assert train_docs[-1]["leaseYields"] >= 1
+    finally:
+        jobs.shutdown()
+        cat.close()
+
+
+def test_engine_fit_offers_yield_each_epoch(tmp_config):
+    """The engine's epoch loops call the preempt hook — that's what
+    makes REST train jobs preemptible at epoch granularity."""
+    import jax.numpy as jnp
+    import optax
+
+    from learningorchestra_tpu.runtime import engine as E
+    from learningorchestra_tpu.runtime import mesh as M
+    from learningorchestra_tpu.runtime.data import ArrayBatcher
+
+    def apply_fn(params, model_state, batch, train, rng_):
+        return batch["x"] @ params["w"], model_state
+
+    x = np.random.default_rng(0).normal(size=(16, 3)).astype(np.float32)
+    y = (x[:, 0] > 0).astype(np.float32)
+    calls = []
+    preempt.install(lambda: calls.append(1))
+    try:
+        eng = E.Engine(apply_fn, E.mse_loss, optax.sgd(0.1),
+                       mesh=M.build_mesh("auto"),
+                       compute_dtype=jnp.float32)
+        for scan in (True, False):
+            st = eng.init_state({"w": jnp.zeros((3, 1))})
+            batcher = ArrayBatcher({"x": x, "y": y}, 8, dp_multiple=8)
+            calls.clear()
+            eng.fit(st, batcher, epochs=3, scan_batches=scan)
+            # between epochs only — a finishing fit must not offer
+            # the lease after its last epoch
+            assert len(calls) == 2, f"scan={scan}"
+    finally:
+        preempt.clear()
