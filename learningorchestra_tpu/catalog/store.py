@@ -508,6 +508,15 @@ class Catalog:
         cur = self._conn().execute("SELECT COALESCE(MAX(seq), 0) FROM changes")
         return cur.fetchone()[0]
 
+    def collection_seq(self, name: str) -> int:
+        """Newest change-feed seq touching ``name`` — with
+        :meth:`dataset_version` (parquet writes bypass the feed), the
+        content version that keys the GET response cache."""
+        cur = self._conn().execute(
+            "SELECT COALESCE(MAX(seq), 0) FROM changes "
+            "WHERE collection = ?", (name,))
+        return cur.fetchone()[0]
+
     def changes_since(self, seq: int,
                       collection: Optional[str] = None,
                       ) -> List[Dict[str, Any]]:

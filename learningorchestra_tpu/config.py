@@ -52,6 +52,11 @@ class Config:
     # weigh 1) — reference fairscheduler.xml ``weight`` parity.
     pool_weights: str = dataclasses.field(
         default_factory=lambda: os.environ.get("LO_POOL_WEIGHTS", ""))
+    # Epoch-boundary lease yielding (single-host only). Off = strict
+    # FIFO-fair serialization, for HBM-tight concurrent footprints.
+    mesh_yield: bool = dataclasses.field(
+        default_factory=lambda: os.environ.get(
+            "LO_MESH_YIELD", "1") not in ("0", "false", "no"))
 
     # Device mesh defaults: axis names follow the scaling-book
     # convention. Shape 'auto' = 1D data-parallel over all devices.
@@ -114,6 +119,18 @@ class Config:
             "LO_PARAM_CACHE", str(256 << 20))))
     fault_inject: str = dataclasses.field(
         default_factory=lambda: os.environ.get("LO_FAULT_INJECT", ""))
+
+    # Gateway behaviors (KrakenD parity, krakend.json:1769-1770):
+    # version-revalidated response cache for universal GETs (TTL is a
+    # lifetime bound, never a staleness window; 0 disables) and an
+    # optional per-request timeout -> 504 (0 = off; the reference
+    # proxies with "timeout": "10s").
+    get_cache_ttl_seconds: float = dataclasses.field(
+        default_factory=lambda: float(os.environ.get(
+            "LO_GET_CACHE_TTL", "300")))
+    request_timeout_seconds: float = dataclasses.field(
+        default_factory=lambda: float(os.environ.get(
+            "LO_REQUEST_TIMEOUT", "0")))
 
     # Observability.
     log_level: str = dataclasses.field(

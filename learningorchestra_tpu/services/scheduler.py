@@ -184,10 +184,10 @@ def _yield_enabled() -> bool:
     must replay identical collectives in identical order on every
     host; a coordinator-side yield would diverge the SPMD program and
     hang the pod) and can be disabled outright with LO_MESH_YIELD=0
-    for HBM-tight deployments."""
-    import os
+    (config ``mesh_yield``) for HBM-tight deployments."""
+    from learningorchestra_tpu.config import get_config
 
-    if os.environ.get("LO_MESH_YIELD", "1") in ("0", "false", "no"):
+    if not get_config().mesh_yield:
         return False
     try:
         from learningorchestra_tpu.runtime import distributed as dist

@@ -85,6 +85,10 @@ class Api:
         self.builder = BuilderService(self.ctx)
         self._profile_dir: Optional[str] = None  # active jax trace
         self._profile_lock = threading.Lock()
+        from learningorchestra_tpu.services.cache import ReadCache
+
+        self.read_cache = ReadCache(
+            ttl_seconds=self.ctx.config.get_cache_ttl_seconds)
         # gateway metrics (KrakenD exposes a metrics collector on
         # :8090, krakend.json:1752-1760; here it's first-party)
         self._metrics_lock = threading.Lock()
@@ -170,9 +174,12 @@ class Api:
     # ------------------------------------------------------------------
     def dispatch(self, method: str, path: str, params: Dict[str, Any],
                  body: Optional[Dict[str, Any]],
-                 ) -> Tuple[int, Any, str]:
+                 record: bool = True) -> Tuple[int, Any, str]:
         """Returns (status, payload, content_type). payload is a dict
-        (JSON) or raw bytes when content_type is not JSON."""
+        (JSON) or raw bytes when content_type is not JSON.
+        ``record=False`` lets a deadline-bound caller own the metrics
+        record (otherwise a timed-out request would be counted twice:
+        the 504 the client saw AND the late real completion)."""
         t0 = time.monotonic()
         try:
             out = self._route(method, path, params, body)
@@ -181,8 +188,9 @@ class Api:
         except Exception as e:  # noqa: BLE001
             out = 500, {"result": f"internal error: {e!r}"}, \
                 "application/json"
-        self._record_metrics(method, path, out[0],
-                             time.monotonic() - t0)
+        if record:
+            self._record_metrics(method, path, out[0],
+                                 time.monotonic() - t0)
         return out
 
     def _record_metrics(self, method: str, path: str, status: int,
@@ -215,6 +223,7 @@ class Api:
             }
         out["jobsRunning"] = self.ctx.jobs.running()
         out["collections"] = len(self.ctx.catalog.list_collections())
+        out["getCache"] = self.read_cache.stats()
         return out
 
     def metrics_prometheus(self) -> bytes:
@@ -421,13 +430,24 @@ class Api:
 
     def _get(self, service: str, tool: str, name: Optional[str],
              params: Dict[str, Any]) -> Tuple[int, Any, str]:
+        now = time.monotonic()
         if name is None:
             # listing: every collection of this type (reference routes
             # list GETs to the dataset reader with ?type=,
-            # krakend.json:722-757)
+            # krakend.json:722-757). Cached against the global change
+            # seq — any create/update/delete invalidates.
+            if self.read_cache.enabled:
+                key = ("list", service, tool)
+                version = self.ctx.catalog.latest_seq()
+                hit = self.read_cache.get(key, version, now)
+                if hit is not None:
+                    return hit[0], hit[1], "application/json"
             type_string = D.normalize_type(f"{service}/{tool}")
-            return 200, {"result": self.ctx.catalog.list_collections(
-                type_string)}, "application/json"
+            payload = {"result": self.ctx.catalog.list_collections(
+                type_string)}
+            if self.read_cache.enabled:
+                self.read_cache.put(key, version, now, 200, payload)
+            return 200, payload, "application/json"
         # explore plots are PNGs (reference send_file image/png,
         # database_executor server.py:151-166); paged/queried GETs
         # still read the JSON documents so status polling works
@@ -445,8 +465,24 @@ class Api:
         limit = params.get("limit")
         limit = int(limit) if limit not in (None, "") else None
         query = parse_query_param(params.get("query"))
+        # universal read, cached per (name, page) against the
+        # collection's content version: change-feed seq for docs +
+        # parquet file stats for rows (appends bypass the feed). A
+        # poller spinning on ?limit=1 stops re-reading sqlite/parquet;
+        # the doc append that flips ``finished`` bumps the seq and
+        # invalidates (krakend.json:1769 "cache_ttl" parity, made
+        # staleness-proof).
+        key = ("read", name, skip, limit, params.get("query"))
+        if self.read_cache.enabled:
+            version = (self.ctx.catalog.collection_seq(name),
+                       self.ctx.catalog.dataset_version(name))
+            hit = self.read_cache.get(key, version, now)
+            if hit is not None:
+                return hit[0], hit[1], "application/json"
         status, payload = self.dataset.read_file(
             name, skip=skip, limit=limit, query=query)
+        if self.read_cache.enabled:
+            self.read_cache.put(key, version, now, status, payload)
         return status, payload, "application/json"
 
     # ------------------------------------------------------------------
@@ -461,6 +497,13 @@ class Api:
         name = parts[1]
         seq = int(params.get("seq", 0) or 0)
         timeout = min(float(params.get("timeout", 25) or 25), 120.0)
+        # under a gateway deadline the long-poll window clamps to just
+        # inside it: the client gets an empty 200 (and re-polls, the
+        # normal long-poll idiom) instead of a 504 whose abandoned
+        # dispatch would sit in the condition wait for the full window
+        gateway = self.ctx.config.request_timeout_seconds
+        if gateway > 0:
+            timeout = min(timeout, max(0.05, gateway - 0.1))
         changes = self.ctx.catalog.watch(seq, collection=name,
                                          timeout=timeout)
         return 200, {"result": {
@@ -494,8 +537,40 @@ class _Handler(BaseHTTPRequestHandler):
         parsed = urlparse(self.path)
         params = {k: v[0] for k, v in parse_qs(parsed.query).items()}
         body = self._read_body() if method in ("POST", "PATCH") else None
-        status, payload, content_type = self.api.dispatch(
-            method, parsed.path, params, body)
+        timeout = self.api.ctx.config.request_timeout_seconds
+        if timeout > 0:
+            # KrakenD proxies with a per-endpoint "timeout": "10s"
+            # (krakend.json:1770): the client gets 504 while the
+            # backend call keeps running — same semantics here (the
+            # dispatch daemon thread finishes its work; only the
+            # response is abandoned). A thread per timed request, not
+            # a shared pool: N abandoned slow dispatches must never
+            # starve unrelated requests, and daemon threads don't
+            # block interpreter exit. Metrics are recorded HERE with
+            # the status the client actually saw (record=False below).
+            t0 = time.monotonic()
+            result: list = []
+            done = threading.Event()
+
+            def run_dispatch() -> None:
+                result.append(self.api.dispatch(
+                    method, parsed.path, params, body, record=False))
+                done.set()
+
+            threading.Thread(target=run_dispatch, daemon=True,
+                             name="lo-gateway").start()
+            if done.wait(timeout):
+                status, payload, content_type = result[0]
+            else:
+                status, payload, content_type = (
+                    504,
+                    {"result": f"request timed out after {timeout:g}s"},
+                    "application/json")
+            self.api._record_metrics(method, parsed.path, status,
+                                     time.monotonic() - t0)
+        else:
+            status, payload, content_type = self.api.dispatch(
+                method, parsed.path, params, body)
         if isinstance(payload, (bytes, bytearray)):
             data = bytes(payload)
         else:

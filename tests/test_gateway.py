@@ -1,0 +1,198 @@
+"""Gateway behaviors: universal-GET response cache + request timeout.
+
+Parity target: KrakenD fronts every endpoint with ``"cache_ttl":
+"300s"`` and ``"timeout": "10s"`` (reference krakend.json:1769-1770).
+The rebuild's cache is version-revalidated (change-feed seq + parquet
+stats), so unlike the reference it can NEVER serve a stale
+``finished`` flag to a poller.
+"""
+import json
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+API = "/api/learningOrchestra/v1"
+
+
+@pytest.fixture()
+def api(tmp_config):
+    from learningorchestra_tpu.services.server import Api
+
+    a = Api()
+    yield a
+    a.ctx.close()
+
+
+def _get(api, path, **params):
+    return api.dispatch("GET", path, params, None)
+
+
+def test_read_cache_hits_on_repeat_poll(api):
+    api.ctx.catalog.create_collection("c1", "function/python", {})
+    api.ctx.catalog.append_document("c1", {"note": "v1"})
+
+    s1, b1, _ = _get(api, f"{API}/function/python/c1", limit="1")
+    assert s1 == 200
+    before = api.read_cache.stats()
+    s2, b2, _ = _get(api, f"{API}/function/python/c1", limit="1")
+    after = api.read_cache.stats()
+    assert s2 == 200 and b2 == b1
+    assert after["hits"] == before["hits"] + 1
+
+
+def test_read_cache_never_serves_stale_finished_flag(api):
+    """The poller contract: the very GET after mark_finished must see
+    finished=True — the doc/metadata change bumps the collection seq
+    and invalidates, version-keying beats the reference's blind TTL."""
+    api.ctx.catalog.create_collection("c2", "train/tensorflow", {})
+    path = f"{API}/train/tensorflow/c2"
+    _, body, _ = _get(api, path, limit="1")
+    assert body["metadata"]["finished"] is False
+    _, body, _ = _get(api, path, limit="1")  # now cached
+    assert body["metadata"]["finished"] is False
+    api.ctx.catalog.mark_finished("c2")
+    _, body, _ = _get(api, path, limit="1")
+    assert body["metadata"]["finished"] is True
+
+
+def test_read_cache_invalidates_on_new_documents(api):
+    api.ctx.catalog.create_collection("c3", "function/python", {})
+    path = f"{API}/function/python/c3"
+    _, b1, _ = _get(api, path)
+    _, b1b, _ = _get(api, path)  # cache hit
+    assert b1b == b1
+    api.ctx.catalog.append_document("c3", {"epochRecord": {"loss": 1.0}})
+    _, b2, _ = _get(api, path)
+    assert len(b2["result"]) == len(b1["result"]) + 1
+
+
+def test_read_cache_invalidates_on_dataset_rows(api, tmp_path):
+    """Parquet appends bypass the change feed; the file-stat version
+    component must still invalidate the cached page."""
+    import pyarrow as pa
+
+    api.ctx.catalog.create_collection("d1", "dataset/csv", {})
+    w = api.ctx.catalog.dataset_writer("d1")
+    w.write_batch(pa.Table.from_pylist([{"a": 1}, {"a": 2}]))
+    w.close()
+    path = f"{API}/dataset/csv/d1"
+    _, b1, _ = _get(api, path)
+    _, _, _ = _get(api, path)  # prime the cache
+    n1 = len(b1["result"])
+    time.sleep(0.01)  # distinct mtime_ns for the new part file
+    w = api.ctx.catalog.dataset_writer("d1")
+    w.write_batch(pa.Table.from_pylist([{"a": 3}]))
+    w.close()
+    _, b2, _ = _get(api, path)
+    assert len(b2["result"]) == n1 + 1
+
+
+def test_listing_cache_sees_new_collections(api):
+    path = f"{API}/function/python"
+    _, b1, _ = _get(api, path)
+    before = api.read_cache.stats()
+    _, b1b, _ = _get(api, path)
+    assert api.read_cache.stats()["hits"] == before["hits"] + 1
+    assert b1b == b1
+    api.ctx.catalog.create_collection("newfn", "function/python", {})
+    _, b2, _ = _get(api, path)
+    names = [m["name"] for m in b2["result"]]
+    assert "newfn" in names
+
+
+def test_cache_disabled_by_zero_ttl(tmp_config, monkeypatch):
+    from learningorchestra_tpu import config as config_mod
+    from learningorchestra_tpu.services.server import Api
+
+    config_mod.set_config(tmp_config.replace(get_cache_ttl_seconds=0.0))
+    a = Api()
+    try:
+        a.ctx.catalog.create_collection("z1", "function/python", {})
+        _get(a, f"{API}/function/python/z1")
+        _get(a, f"{API}/function/python/z1")
+        assert a.read_cache.stats() == {"entries": 0, "hits": 0,
+                                        "misses": 0}
+    finally:
+        a.ctx.close()
+
+
+def test_cache_stats_in_metrics(api):
+    api.ctx.catalog.create_collection("m1", "function/python", {})
+    _get(api, f"{API}/function/python/m1")
+    _get(api, f"{API}/function/python/m1")
+    m = api.metrics()
+    assert m["getCache"]["hits"] >= 1
+
+
+def test_request_timeout_returns_504(tmp_config):
+    """An over-deadline dispatch gets 504 while the backend call keeps
+    running on its (daemon) thread — KrakenD "timeout" proxy
+    semantics — and the gateway metrics record the 504 the client
+    saw, exactly once."""
+    from learningorchestra_tpu import config as config_mod
+    from learningorchestra_tpu.services.server import RestServer
+
+    config_mod.set_config(tmp_config.replace(
+        request_timeout_seconds=0.3))
+    srv = RestServer(host="127.0.0.1", port=0).start()
+    try:
+        # a normal fast request is unaffected
+        with urllib.request.urlopen(f"{srv.base_url}/health",
+                                    timeout=30) as r:
+            assert r.status == 200
+        srv.api.ctx.catalog.create_collection(
+            "slow1", "function/python", {})
+        real = srv.api.dataset.read_file
+
+        def slow_read(*args, **kwargs):
+            time.sleep(1.5)
+            return real(*args, **kwargs)
+
+        srv.api.dataset.read_file = slow_read
+        t0 = time.monotonic()
+        try:
+            urllib.request.urlopen(
+                f"{srv.base_url}{API}/function/python/slow1", timeout=30)
+            raise AssertionError("expected 504")
+        except urllib.error.HTTPError as e:
+            assert e.code == 504
+            assert "timed out" in json.loads(e.read())["result"]
+        assert time.monotonic() - t0 < 1.4  # deadline, not the sleep
+        srv.api.dataset.read_file = real
+        time.sleep(1.5)  # let the abandoned dispatch finish
+        m = srv.api.metrics()
+        # exactly one 504 recorded; the late real completion did NOT
+        # double-count the request
+        assert m["responsesByStatus"].get("504") == 1
+        n_gets = m["requestsByRoute"].get("GET function", 0)
+        assert n_gets == 1
+    finally:
+        srv.stop()
+
+
+def test_observe_clamps_to_gateway_deadline(tmp_config):
+    """Under a gateway deadline a long-poll observe returns an empty
+    200 just inside it (the client re-polls — long-poll idiom) rather
+    than 504ing and stranding its dispatch in the poll window."""
+    from learningorchestra_tpu import config as config_mod
+    from learningorchestra_tpu.services.server import RestServer
+
+    config_mod.set_config(tmp_config.replace(
+        request_timeout_seconds=0.5))
+    srv = RestServer(host="127.0.0.1", port=0).start()
+    try:
+        srv.api.ctx.catalog.create_collection(
+            "obs1", "function/python", {})
+        seq = srv.api.ctx.catalog.latest_seq()
+        t0 = time.monotonic()
+        with urllib.request.urlopen(
+                f"{srv.base_url}{API}/observe/obs1?seq={seq}&timeout=20",
+                timeout=30) as r:
+            assert r.status == 200
+            body = json.loads(r.read())
+        assert body["result"]["changes"] == []
+        assert time.monotonic() - t0 < 2.0
+    finally:
+        srv.stop()

@@ -219,8 +219,10 @@ def test_same_pool_waiter_does_not_preempt():
     assert events == [("first", i) for i in range(4)] + [("second", 0)]
 
 
-def test_mesh_yield_env_disables_preemption(monkeypatch):
-    monkeypatch.setenv("LO_MESH_YIELD", "0")
+def test_mesh_yield_config_disables_preemption(tmp_config):
+    from learningorchestra_tpu import config as config_mod
+
+    config_mod.set_config(tmp_config.replace(mesh_yield=False))
     lease = FairLease(1)
     events = []
     first_in = threading.Event()
