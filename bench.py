@@ -93,8 +93,8 @@ TLM_ATTENTION = os.environ.get("LO_BENCH_TLM_ATTENTION", "auto")
 # per-phase wall-clock bounds (seconds); overridable for local smoke
 # runs via LO_BENCH_TIMEOUT_<PHASE>
 PHASE_TIMEOUTS = {"cnn": 600, "lstm": 600, "tlm": 900, "proxy": 120,
-                  "builder": 600, "flash": 600, "ingest": 600,
-                  "gen": 900}
+                  "builder": 600, "builder_mesh": 600, "flash": 600,
+                  "ingest": 600, "gen": 900}
 
 # out-of-core Builder (reference config 4: 10M-row GBT via Spark)
 BUILDER_ROWS = int(os.environ.get("LO_BENCH_BUILDER_ROWS", "10000000"))
@@ -448,6 +448,28 @@ def phase_flash():
     return results
 
 
+def _write_builder_synth(cat, name, rows, seed):
+    """Linearly separable 5-feature synthetic dataset, written in
+    bounded batches (shared by the streaming and mesh builder
+    phases so their data distributions can never diverge)."""
+    import numpy as np
+    import pyarrow as pa
+
+    w_true = np.array([1.0, -2.0, 0.5, 1.5, -1.0])
+    r = np.random.default_rng(seed)
+    cat.create_collection(name, "dataset/csv", {})
+    with cat.dataset_writer(name) as w:
+        left = rows
+        while left:
+            n = min(left, 262_144)
+            x = r.normal(size=(n, 5))
+            y = (x @ w_true > 0).astype(np.int64)
+            w.write_batch(pa.table({
+                **{f"f{i}": x[:, i] for i in range(5)}, "label": y}))
+            left -= n
+    cat.mark_finished(name)
+
+
 def phase_builder():
     """BASELINE config 4 (the reference's Spark path): 10M-row
     synthetic binary classification through POST /builder with
@@ -457,33 +479,14 @@ def phase_builder():
     this measures the out-of-core host data plane."""
     import resource
 
-    import numpy as np
-    import pyarrow as pa
-
     api, prefix = _make_api()
     cat = api.ctx.catalog
-    rng = np.random.default_rng(0)
-    w_true = np.array([1.0, -2.0, 0.5, 1.5, -1.0])
-
-    def write(name, rows, seed):
-        r = np.random.default_rng(seed)
-        cat.create_collection(name, "dataset/csv", {})
-        with cat.dataset_writer(name) as w:
-            left = rows
-            while left:
-                n = min(left, 262_144)
-                x = r.normal(size=(n, 5))
-                y = (x @ w_true > 0).astype(np.int64)
-                w.write_batch(pa.table({
-                    **{f"f{i}": x[:, i] for i in range(5)}, "label": y}))
-                left -= n
-        cat.mark_finished(name)
 
     test_rows = max(BUILDER_ROWS // 20, 1)
     t_gen = time.perf_counter()
-    write("b_train", BUILDER_ROWS, 1)
-    write("b_test", test_rows, 2)
-    write("b_eval", test_rows, 3)
+    _write_builder_synth(cat, "b_train", BUILDER_ROWS, 1)
+    _write_builder_synth(cat, "b_test", test_rows, 2)
+    _write_builder_synth(cat, "b_eval", test_rows, 3)
     gen_seconds = time.perf_counter() - t_gen
 
     t0 = time.perf_counter()
@@ -510,6 +513,59 @@ def phase_builder():
                           "f1": meta.get("f1"),
                           "fitTime": meta.get("fitTime"),
                           "trainedOnSample": meta.get("trainedOnSample")}
+    return out
+
+
+def phase_builder_mesh():
+    """Mesh-parallel Builder (SURVEY §7: N models as parallel jobs
+    over mesh slices; VERDICT r4 item 4): the SAME in-memory pipeline
+    run twice — meshParallel=true (LR+NB as JAX fits on disjoint
+    device sub-slices) vs host sklearn threads — so the table carries
+    a measured jax-vs-sklearn fit-time row per family."""
+    import jax
+
+    rows = int(os.environ.get("LO_BENCH_BUILDER_MESH_ROWS", "2000000"))
+    api, prefix = _make_api()
+    cat = api.ctx.catalog
+    _write_builder_synth(cat, "bm_train", rows, 1)
+    _write_builder_synth(cat, "bm_test", rows // 20, 2)
+    modeling = (
+        "import numpy as np\n"
+        "feats = [c for c in training_df.columns"
+        " if c not in ('label', '_id')]\n"
+        "features_training = (training_df[feats].to_numpy(np.float32),"
+        " training_df['label'].to_numpy())\n"
+        "features_testing = testing_df[feats].to_numpy(np.float32)\n"
+        "features_evaluation = (testing_df[feats].to_numpy(np.float32),"
+        " testing_df['label'].to_numpy())\n")
+
+    out = {"rows": rows}
+    for label, mesh_parallel in (("mesh", True), ("host", False)):
+        t0 = time.perf_counter()
+        status, body, _ = api.dispatch(
+            "POST", f"{prefix}/builder/sparkml", {}, {
+                "trainDatasetName": "bm_train",
+                "testDatasetName": "bm_test",
+                "evaluationDatasetName": "bm_test",
+                "modelingCode": modeling,
+                "classifiersList": ["LR", "NB"],
+                "meshParallel": mesh_parallel})
+        _expect_created(status, body)
+        for uri in body["result"]:
+            _wait(api, uri, timeout=540)
+        elapsed = time.perf_counter() - t0
+        entry = {"pipeline_seconds": round(elapsed, 2),
+                 "train_rows_per_sec": round(rows / elapsed, 2)}
+        for c in ("LR", "NB"):
+            meta = cat.get_metadata(f"bm_test{c}")
+            entry[c.lower()] = {
+                "accuracy": meta.get("accuracy"),
+                "fitTime": meta.get("fitTime"),
+                "engine": meta.get("engine"),
+                "meshDevices": meta.get("meshDevices")}
+        out[label] = entry
+    api.ctx.jobs.shutdown()
+    out["platform"] = jax.devices()[0].platform
     return out
 
 
@@ -652,6 +708,7 @@ def phase_proxy(max_seconds=60.0):
 
 PHASES = {"cnn": phase_cnn, "lstm": phase_lstm, "tlm": phase_tlm,
           "proxy": phase_proxy, "builder": phase_builder,
+          "builder_mesh": phase_builder_mesh,
           "flash": phase_flash, "ingest": phase_ingest,
           "gen": phase_gen}
 
@@ -844,6 +901,7 @@ def main(argv=None):
             retry["flash_error"] = models["transformer_lm"]["error"]
             models["transformer_lm"] = retry
     models["builder_10m_streaming"] = _run_phase("builder", env)
+    models["builder_mesh_2m"] = _run_phase("builder_mesh", env)
     models["csv_ingest"] = _run_phase("ingest", env)
     gen_cpu_env = dict(cpu_env, LO_BENCH_GEN_TOKENS="32",
                        LO_BENCH_GEN_PROMPT="16", LO_BENCH_GEN_BATCH="2")
@@ -956,6 +1014,20 @@ def _write_md(path, report):
                 f"| rows={stats.get('rows')}, peak_rss_mb="
                 f"{stats.get('peak_rss_mb')}, gb_full_data="
                 f"{not gb.get('trainedOnSample', False)} |")
+            continue
+        if name == "builder_mesh_2m":
+            mesh = stats.get("mesh", {})
+            host = stats.get("host", {})
+            lines.append(
+                f"| {name} (LR+NB, mesh vs host) "
+                f"| {stats.get('platform', '?')} "
+                f"| {mesh.get('train_rows_per_sec', '—')} rows/s "
+                f"(host {host.get('train_rows_per_sec', '—')}) | — | — "
+                f"| LR {mesh.get('lr', {}).get('accuracy')} "
+                f"| — | rows={stats.get('rows')}, jax LR fit="
+                f"{mesh.get('lr', {}).get('fitTime')}s vs sklearn "
+                f"{host.get('lr', {}).get('fitTime')}s, slices="
+                f"{mesh.get('lr', {}).get('meshDevices')}dev |")
             continue
         if name == "csv_ingest":
             lines.append(

@@ -1,0 +1,198 @@
+"""JAX-native classical estimators.
+
+The reference Builder trains five MLlib classifier families on a Spark
+cluster capped at 3 one-core executors
+(reference builder_image/builder.py:62-78, docker-compose.yml:157-163).
+Here the linear-algebra families run ON the device mesh through the
+same sharded engine the neural models use — an MXU matmul per step for
+logistic regression, one-hot matmul reductions for Gaussian NB — so a
+mesh-parallel Builder (``meshParallel: true``) actually puts the TPU
+to work per classifier slice. Tree families stay on host sklearn
+(data-dependent branching has no MXU mapping worth forcing).
+
+Both classes speak the sklearn surface the Builder consumes
+(``fit(X, y)`` / ``predict`` / ``predict_proba``) plus ``set_mesh``
+for sub-slice placement (models/sweep.py ``sub_meshes``).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from learningorchestra_tpu.runtime import data as data_lib
+from learningorchestra_tpu.runtime import engine as engine_lib
+from learningorchestra_tpu.runtime import mesh as mesh_lib
+
+
+class LogisticRegressionJAX:
+    """Multinomial logistic regression trained by the sharded engine:
+    minibatch softmax cross-entropy on the mesh (DP over the batch,
+    bf16 matmuls on the MXU), adam updates. The engine gives it
+    scan-fit epochs, grad-accum and sharding for free — the same
+    machinery as the deep models, at d x C scale."""
+
+    def __init__(self, epochs: int = 12, batch_size: int = 4096,
+                 learning_rate: float = 0.05, seed: int = 0):
+        self.epochs = int(epochs)
+        self.batch_size = int(batch_size)
+        self.learning_rate = float(learning_rate)
+        self.seed = int(seed)
+        self.classes_: Optional[np.ndarray] = None
+        self.params: Any = None
+        self.history: list = []
+        self._mesh_override = None
+
+    def set_mesh(self, mesh) -> None:
+        self._mesh_override = mesh
+
+    def _mesh(self):
+        return self._mesh_override or mesh_lib.get_default_mesh()
+
+    @staticmethod
+    def _apply(params, model_state, batch, train, rng):
+        logits = batch["x"] @ params["w"] + params["b"]
+        return logits, model_state
+
+    def fit(self, x, y) -> "LogisticRegressionJAX":
+        import optax
+
+        x = np.asarray(x, np.float32)
+        y = np.asarray(y)
+        self.classes_, y_idx = np.unique(y, return_inverse=True)
+        n_classes = len(self.classes_)
+        mesh = self._mesh()
+        eng = engine_lib.Engine(
+            apply_fn=self._apply,
+            loss_fn=engine_lib.sparse_softmax_loss,
+            optimizer=optax.adam(self.learning_rate),
+            mesh=mesh,
+            metrics={"accuracy": engine_lib.accuracy_metric})
+        d = x.shape[1]
+        params = {"w": jnp.zeros((d, n_classes), jnp.float32),
+                  "b": jnp.zeros((n_classes,), jnp.float32)}
+        state = eng.init_state(params)
+        batcher = data_lib.ArrayBatcher(
+            {"x": x, "y": y_idx.astype(np.int32)},
+            min(self.batch_size, len(x)), shuffle=True, seed=self.seed,
+            dp_multiple=mesh_lib.data_parallel_size(mesh))
+        state, history = eng.fit(state, batcher, epochs=self.epochs,
+                                 seed=self.seed)
+        self.params = engine_lib.to_host(state.params)
+        self.history = history
+        return self
+
+    def _check_fitted(self) -> None:
+        if self.params is None:
+            raise RuntimeError("not fitted — call fit(X, y) first")
+
+    def decision_function(self, x) -> np.ndarray:
+        self._check_fitted()
+        x = np.asarray(x, np.float32)
+        return x @ self.params["w"] + self.params["b"]
+
+    def predict_proba(self, x) -> np.ndarray:
+        z = self.decision_function(x)
+        z = z - z.max(axis=1, keepdims=True)
+        e = np.exp(z)
+        return e / e.sum(axis=1, keepdims=True)
+
+    def predict(self, x) -> np.ndarray:
+        return self.classes_[np.argmax(self.decision_function(x), axis=1)]
+
+    def score(self, x, y) -> float:
+        return float(np.mean(self.predict(x) == np.asarray(y)))
+
+
+class GaussianNBJAX:
+    """Gaussian naive Bayes as three one-hot matmuls: per-class counts,
+    sums and squared sums come from ``onehot.T @ [1, x, x^2]`` — large
+    batched contractions the MXU eats, one pass over the data, no
+    per-class Python loop."""
+
+    def __init__(self, var_smoothing: float = 1e-9):
+        self.var_smoothing = float(var_smoothing)
+        self.classes_: Optional[np.ndarray] = None
+        self.theta_: Optional[np.ndarray] = None  # (C, d) means
+        self.var_: Optional[np.ndarray] = None    # (C, d) variances
+        self.class_prior_: Optional[np.ndarray] = None
+        self._mesh_override = None
+
+    def set_mesh(self, mesh) -> None:
+        self._mesh_override = mesh
+
+    @staticmethod
+    @jax.jit
+    def _sufficient_stats(x, onehot):
+        counts = onehot.sum(axis=0)
+        sums = onehot.T @ x
+        sq_sums = onehot.T @ (x * x)
+        return counts, sums, sq_sums
+
+    def fit(self, x, y) -> "GaussianNBJAX":
+        x = np.asarray(x, np.float32)
+        y = np.asarray(y)
+        self.classes_, y_idx = np.unique(y, return_inverse=True)
+        # center on the global per-feature mean (f64, host) before the
+        # f32 device reductions: E[x^2]-mean^2 on RAW data cancels
+        # catastrophically when |mean| >> std (timestamps, unscaled
+        # sensors); on centered data both terms are O(std^2)
+        shift = np.mean(x, axis=0, dtype=np.float64).astype(np.float32)
+        x_c = x - shift[None, :]
+        onehot_np = np.zeros((len(x), len(self.classes_)), np.float32)
+        onehot_np[np.arange(len(x)), y_idx] = 1.0
+        xj, onehot = jnp.asarray(x_c), jnp.asarray(onehot_np)
+        if self._mesh_override is not None:
+            # place the pass on THIS estimator's sub-slice, rows
+            # sharded over dp; zero-padded rows have all-zero one-hot
+            # so they contribute nothing to any statistic
+            mesh = self._mesh_override
+            dp = mesh_lib.data_parallel_size(mesh)
+            pad = (-len(x)) % dp
+            if pad:
+                xj = jnp.concatenate(
+                    [xj, jnp.zeros((pad,) + xj.shape[1:], xj.dtype)])
+                onehot = jnp.concatenate(
+                    [onehot, jnp.zeros((pad, onehot.shape[1]),
+                                       onehot.dtype)])
+            sharding = mesh_lib.batch_sharding(mesh)
+            xj = jax.device_put(xj, sharding)
+            onehot = jax.device_put(onehot, sharding)
+        counts, sums, sq_sums = self._sufficient_stats(xj, onehot)
+        counts = np.asarray(counts, np.float64)
+        sums = np.asarray(sums, np.float64)
+        sq_sums = np.asarray(sq_sums, np.float64)
+        n = np.maximum(counts, 1.0)[:, None]
+        theta_c = sums / n          # class means of CENTERED data
+        self.theta_ = theta_c + shift[None, :].astype(np.float64)
+        var = sq_sums / n - theta_c ** 2
+        eps = self.var_smoothing * float(np.var(x, axis=0).max())
+        self.var_ = np.maximum(var, 0.0) + max(eps, 1e-12)
+        self.class_prior_ = counts / counts.sum()
+        return self
+
+    def _joint_log_likelihood(self, x) -> np.ndarray:
+        x = np.asarray(x, np.float32)
+        # (n, C): sum_d log N(x_d | theta_cd, var_cd) + log prior_c
+        ll = -0.5 * (np.log(2.0 * np.pi * self.var_)[None, :, :]
+                     + (x[:, None, :] - self.theta_[None, :, :]) ** 2
+                     / self.var_[None, :, :]).sum(axis=2)
+        return ll + np.log(self.class_prior_)[None, :]
+
+    def predict(self, x) -> np.ndarray:
+        if self.classes_ is None:
+            raise RuntimeError("not fitted — call fit(X, y) first")
+        return self.classes_[
+            np.argmax(self._joint_log_likelihood(x), axis=1)]
+
+    def predict_proba(self, x) -> np.ndarray:
+        ll = self._joint_log_likelihood(x)
+        ll = ll - ll.max(axis=1, keepdims=True)
+        e = np.exp(ll)
+        return e / e.sum(axis=1, keepdims=True)
+
+    def score(self, x, y) -> float:
+        return float(np.mean(self.predict(x) == np.asarray(y)))

@@ -38,6 +38,7 @@ TEST_FIELD = "testDatasetName"
 MODELING_CODE_FIELD = "modelingCode"
 CLASSIFIERS_FIELD = "classifiersList"
 STREAMING_FIELD = "streaming"
+MESH_PARALLEL_FIELD = "meshParallel"
 LABEL_FIELD = "labelColumn"
 FEATURES_FIELD = "featureColumns"
 EVAL_DATASET_FIELD = "evaluationDatasetName"
@@ -45,6 +46,11 @@ BATCH_SIZE_FIELD = "batchSize"
 LABEL_COLUMN = "label"
 
 CLASSIFIER_NAMES = ("LR", "DT", "RF", "GB", "NB")
+
+# families with a JAX-native estimator under meshParallel=true (the
+# linear-algebra ones; trees keep host sklearn — data-dependent
+# branching has no MXU mapping worth forcing)
+_JAX_FAMILIES = ("LR", "NB")
 
 # non-incremental families train on a bounded reservoir sample in
 # streaming mode; incremental families see every row via partial_fit
@@ -65,6 +71,15 @@ def _make_classifier(name: str):
         "GB": GradientBoostingClassifier,
         "NB": GaussianNB,
     }[name]()
+
+
+def _make_jax_classifier(name: str, mesh):
+    from learningorchestra_tpu.models import estimators
+
+    clf = {"LR": estimators.LogisticRegressionJAX,
+           "NB": estimators.GaussianNBJAX}[name]()
+    clf.set_mesh(mesh)
+    return clf
 
 
 def _make_streaming_classifier(name: str):
@@ -160,6 +175,13 @@ class BuilderService:
     def create(self, body: Dict[str, Any], tool: str = "sparkml",
                ) -> Tuple[int, Dict[str, Any]]:
         streaming = bool(body.get(STREAMING_FIELD))
+        mesh_parallel = bool(body.get(MESH_PARALLEL_FIELD))
+        if streaming and mesh_parallel:
+            raise V.HttpError(
+                V.HTTP_NOT_ACCEPTABLE,
+                "streaming and meshParallel are exclusive: the "
+                "out-of-core path is host-native (C++/sklearn), the "
+                "mesh path trains in-memory per sub-slice")
         required = [TRAIN_FIELD, TEST_FIELD, CLASSIFIERS_FIELD]
         if not streaming:
             required.append(MODELING_CODE_FIELD)
@@ -202,12 +224,24 @@ class BuilderService:
                 feat_cols, batch_size)
         else:
             run = lambda: self._run(  # noqa: E731
-                train_name, test_name, code, outputs)
+                train_name, test_name, code, outputs,
+                mesh_parallel=mesh_parallel)
         self._ctx.jobs.submit(
             first, run,
             description="builder pipeline",
             parameters={CLASSIFIERS_FIELD: classifiers,
-                        STREAMING_FIELD: streaming},
+                        STREAMING_FIELD: streaming,
+                        MESH_PARALLEL_FIELD: mesh_parallel},
+            # the mesh path trains on device sub-slices, so the job
+            # holds the (fair, "builder"-pool) accelerator lease —
+            # but only when a JAX family is actually requested; pure
+            # tree lists must not block real mesh jobs on host fits
+            needs_mesh=mesh_parallel and any(
+                c in _JAX_FAMILIES for c in classifiers),
+            pool="builder",
+            # a terminal job failure must document EVERY output
+            # collection, or pollers of the non-first classifiers hang
+            failure_names=list(outputs.values()),
             mark_finished=False)  # each classifier marks its own output
         return V.HTTP_CREATED, {"result": [
             f"/api/learningOrchestra/v1/builder/{tool}/{out}"
@@ -215,7 +249,8 @@ class BuilderService:
 
     # ------------------------------------------------------------------
     def _run(self, train_name: str, test_name: str, code: str,
-             outputs: Dict[str, str]) -> None:
+             outputs: Dict[str, str], mesh_parallel: bool = False,
+             ) -> None:
         training_df = self._ctx.catalog.read_dataframe(train_name)
         testing_df = self._ctx.catalog.read_dataframe(test_name)
         ctx_vars, _ = sandbox.run_user_code(
@@ -234,13 +269,34 @@ class BuilderService:
         x_eval, y_eval = _split_xy(features_evaluation, needs_label=True) \
             if features_evaluation is not None else (None, None)
 
-        with ThreadPoolExecutor(max_workers=len(outputs)) as pool:
+        slice_pool = None
+        sequential_jax: List[str] = []
+        errors: Dict[str, Exception] = {}
+        if mesh_parallel:
+            slice_pool, sequential_jax = self._mesh_slices(outputs)
+        # multi-host: every host must replay identical device programs
+        # in identical order — JAX fits run sequentially on the full
+        # mesh, in sorted order, before the host pool. A failure here
+        # documents its own output and the remaining classifiers still
+        # run (same contract as pooled failures).
+        for c in sequential_jax:
+            try:
+                self._fit_one(c, x_train, y_train, x_test, x_eval,
+                              y_eval, testing_df, outputs[c],
+                              slice_pool=slice_pool)
+            except Exception as e:  # noqa: BLE001
+                errors[c] = e
+                self._ctx.catalog.append_document(
+                    outputs[c], D.execution_document(
+                        "builder classifier", None,
+                        exception=repr(e)))
+        pooled = [c for c in outputs if c not in sequential_jax]
+        with ThreadPoolExecutor(max_workers=max(1, len(pooled))) as pool:
             futures = {
                 c: pool.submit(self._fit_one, c, x_train, y_train,
                                x_test, x_eval, y_eval, testing_df,
-                               outputs[c])
-                for c in outputs}
-            errors = {}
+                               outputs[c], slice_pool=slice_pool)
+                for c in pooled}
             for c, fut in futures.items():
                 try:
                     fut.result()
@@ -252,6 +308,31 @@ class BuilderService:
                             exception=repr(e)))
         if errors:
             raise RuntimeError(f"classifier failures: {errors}")
+
+    def _mesh_slices(self, outputs: Dict[str, str]):
+        """(free-queue of disjoint sub-meshes, classifiers to run
+        sequentially). Single-host: one slice per JAX family, trained
+        concurrently (SURVEY §7's 'N models as parallel jobs over mesh
+        slices'). Multi-host: sub-slice thread timing would diverge
+        the SPMD replay, so JAX fits serialize over the full mesh."""
+        import queue as queue_mod
+
+        import jax
+
+        from learningorchestra_tpu.models.sweep import sub_meshes
+        from learningorchestra_tpu.runtime import mesh as mesh_lib
+
+        jax_families = sorted(c for c in outputs if c in _JAX_FAMILIES)
+        if not jax_families:
+            return None, []
+        mesh = mesh_lib.get_default_mesh()
+        free = queue_mod.Queue()
+        if jax.process_count() > 1:
+            free.put(mesh)
+            return free, jax_families
+        for s in sub_meshes(mesh, len(jax_families)):
+            free.put(s)
+        return free, []
 
     # ------------------------------------------------------------------
     # out-of-core path (reference config 4: GBTClassifier on 10M rows
@@ -452,15 +533,30 @@ class BuilderService:
             "builder GB (streaming, full data)")
 
     def _fit_one(self, classifier_name: str, x_train, y_train, x_test,
-                 x_eval, y_eval, testing_df, out_name: str) -> None:
+                 x_eval, y_eval, testing_df, out_name: str,
+                 slice_pool=None) -> None:
         from sklearn.metrics import accuracy_score, f1_score
 
-        clf = _make_classifier(classifier_name)
+        metrics: Dict[str, Any] = {"classifier": classifier_name}
+        sub = None
+        use_jax = (slice_pool is not None
+                   and classifier_name in _JAX_FAMILIES)
+        if use_jax:
+            sub = slice_pool.get()
+            clf = _make_jax_classifier(classifier_name, sub)
+            metrics["engine"] = "jax"
+            metrics["meshDevices"] = int(sub.size)
+        else:
+            clf = _make_classifier(classifier_name)
+            metrics["engine"] = "sklearn"
         t0 = time.perf_counter()
-        clf.fit(x_train, y_train)
+        try:
+            clf.fit(x_train, y_train)
+        finally:
+            if sub is not None:
+                slice_pool.put(sub)
         fit_time = time.perf_counter() - t0
-        metrics: Dict[str, Any] = {"classifier": classifier_name,
-                                   "fitTime": round(fit_time, 6)}
+        metrics["fitTime"] = round(fit_time, 6)
         if x_eval is not None and y_eval is not None:
             pred_eval = clf.predict(x_eval)
             metrics["accuracy"] = float(accuracy_score(y_eval, pred_eval))

@@ -78,10 +78,27 @@ class JobManager:
                max_retries: int = 0,
                on_success: Optional[Callable[[Any], None]] = None,
                mark_finished: bool = True,
+               failure_names: Optional[list] = None,
                ) -> Future:
         """Run ``fn`` asynchronously under the reference's
         finished-flag contract for collection ``name`` (which must
-        already exist with ``finished: False``)."""
+        already exist with ``finished: False``). Multi-output jobs
+        (Builder: one collection per classifier) pass
+        ``failure_names`` so a TERMINAL job failure documents EVERY
+        output — a client polling any of them must see the error, not
+        hang on a forever-False finished flag."""
+        doc_names = list(failure_names) if failure_names else [name]
+
+        def fail_all(document: Dict[str, Any]) -> None:
+            for n in doc_names:
+                if n != name:
+                    # outputs that already finished (e.g. classifiers
+                    # that completed before a sibling's failure sank
+                    # the job) keep their clean record
+                    meta = self._catalog.get_metadata(n)
+                    if meta is None or meta.get(D.FINISHED_FIELD):
+                        continue
+                self._catalog.append_document(n, dict(document))
 
         def run() -> Any:
             submitted = time.monotonic()
@@ -93,12 +110,11 @@ class JobManager:
                         # a degraded pod cannot run mesh collectives:
                         # record a TERMINAL typed failure instead of
                         # entering a jit that would hang forever
-                        self._catalog.append_document(
-                            name, D.execution_document(
-                                description, parameters,
-                                exception=f"WorkerLost({failure!r})",
-                                extra={"workerLost": True,
-                                       "attempt": attempt + 1}))
+                        fail_all(D.execution_document(
+                            description, parameters,
+                            exception=f"WorkerLost({failure!r})",
+                            extra={"workerLost": True,
+                                   "attempt": attempt + 1}))
                         return None
                 lease = (self._mesh.lease(pool) if needs_mesh
                          else contextlib.nullcontext())
@@ -140,14 +156,16 @@ class JobManager:
                         return result
                     except Exception as exception:  # noqa: BLE001
                         traceback.print_exc()
-                        self._catalog.append_document(
-                            name, D.execution_document(
-                                description, parameters,
-                                exception=repr(exception),
-                                extra=timing({"attempt": attempt + 1})))
-                        if attempt + 1 >= attempts:
+                        terminal = attempt + 1 >= attempts
+                        doc = D.execution_document(
+                            description, parameters,
+                            exception=repr(exception),
+                            extra=timing({"attempt": attempt + 1}))
+                        if terminal:
+                            fail_all(doc)
                             # finished stays False (reference parity)
                             return None
+                        self._catalog.append_document(name, doc)
 
         future = self._pool.submit(run)
         with self._lock:

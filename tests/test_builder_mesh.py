@@ -1,0 +1,165 @@
+"""Mesh-parallel Builder (SURVEY §7: 'N models trained as parallel
+jobs over mesh slices'; reference trains 5 classifiers concurrently on
+a 3-executor Spark cluster, builder_image/builder.py:62-78).
+
+``meshParallel: true`` hands each JAX-native family (LR, NB) a
+disjoint device sub-slice (models/sweep.sub_meshes) while the tree
+families keep host sklearn threads.
+"""
+import numpy as np
+import pytest
+
+from learningorchestra_tpu.models.estimators import (
+    GaussianNBJAX,
+    LogisticRegressionJAX,
+)
+from learningorchestra_tpu.runtime import mesh as mesh_lib
+from learningorchestra_tpu.services.builder_service import BuilderService
+from learningorchestra_tpu.services.context import ServiceContext
+from learningorchestra_tpu.services.validators import HttpError
+
+
+def _synth(n, seed, d=4):
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(n, d)).astype(np.float32)
+    y = (x @ np.array([1.0, -2.0, 0.5, 1.5])[:d] > 0).astype(np.int64)
+    return x, y
+
+
+# ---------------------------------------------------------------- unit
+def test_logreg_jax_learns_separable():
+    x, y = _synth(4096, seed=0)
+    clf = LogisticRegressionJAX(epochs=8, batch_size=512)
+    clf.fit(x, y)
+    xt, yt = _synth(1024, seed=1)
+    assert clf.score(xt, yt) > 0.95
+    probs = clf.predict_proba(xt)
+    assert probs.shape == (1024, 2)
+    np.testing.assert_allclose(probs.sum(axis=1), 1.0, atol=1e-5)
+
+
+def test_logreg_jax_on_sub_mesh():
+    from learningorchestra_tpu.models.sweep import sub_meshes
+
+    slices = sub_meshes(mesh_lib.get_default_mesh(), 2)
+    assert len(slices) == 2 and slices[0].size >= 2
+    x, y = _synth(2048, seed=2)
+    clf = LogisticRegressionJAX(epochs=6, batch_size=256)
+    clf.set_mesh(slices[1])  # a non-default disjoint slice
+    clf.fit(x, y)
+    assert clf.score(*_synth(512, seed=3)) > 0.9
+
+
+def test_gaussian_nb_jax_matches_sklearn():
+    from sklearn.naive_bayes import GaussianNB
+
+    x, y = _synth(2048, seed=4)
+    ours = GaussianNBJAX().fit(x, y)
+    ref = GaussianNB().fit(x, y)
+    xt, _ = _synth(512, seed=5)
+    agree = np.mean(ours.predict(xt) == ref.predict(xt))
+    assert agree > 0.99
+    np.testing.assert_allclose(ours.theta_, ref.theta_, atol=1e-4)
+
+
+def test_gaussian_nb_jax_large_mean_features():
+    """E[x^2]-mean^2 on raw f32 data cancels catastrophically when
+    |mean| >> std (timestamps, unscaled sensors); the global-mean
+    centering must keep variances and predictions sklearn-accurate."""
+    from sklearn.naive_bayes import GaussianNB
+
+    rng = np.random.default_rng(11)
+    x = rng.normal(size=(4096, 3)).astype(np.float64)
+    y = (x[:, 0] + 0.5 * x[:, 1] > 0).astype(np.int64)
+    x = x + np.array([1e4, 5e4, 1e5])  # huge means, unit stds
+    ours = GaussianNBJAX().fit(x, y)
+    ref = GaussianNB().fit(x, y)
+    np.testing.assert_allclose(ours.var_, ref.var_, rtol=5e-2)
+    xt = rng.normal(size=(512, 3)) + np.array([1e4, 5e4, 1e5])
+    assert np.mean(ours.predict(xt) == ref.predict(xt)) > 0.99
+
+
+def test_gaussian_nb_jax_sharded_matches_unsharded():
+    """The dp-sharded sufficient-stats pass (with zero-padded rows)
+    must give the same model as the unsharded one — rows don't divide
+    the slice evenly on purpose."""
+    from learningorchestra_tpu.models.sweep import sub_meshes
+
+    x, y = _synth(1000, seed=6)  # 1000 % 4 != 0
+    plain = GaussianNBJAX().fit(x, y)
+    sharded = GaussianNBJAX()
+    sharded.set_mesh(sub_meshes(mesh_lib.get_default_mesh(), 2)[0])
+    sharded.fit(x, y)
+    np.testing.assert_allclose(sharded.theta_, plain.theta_, atol=1e-5)
+    np.testing.assert_allclose(sharded.var_, plain.var_, atol=1e-5)
+    np.testing.assert_allclose(sharded.class_prior_, plain.class_prior_,
+                               atol=1e-7)
+
+
+# ------------------------------------------------------------- service
+@pytest.fixture()
+def ctx(tmp_config):
+    c = ServiceContext(tmp_config)
+    yield c
+    c.close()
+
+
+def _write_df(catalog, name, n, seed):
+    import pyarrow as pa
+
+    x, y = _synth(n, seed)
+    catalog.create_collection(name, "dataset/csv", {})
+    with catalog.dataset_writer(name) as w:
+        w.write_batch(pa.table({
+            "f0": x[:, 0], "f1": x[:, 1], "f2": x[:, 2], "f3": x[:, 3],
+            "label": y}))
+    catalog.mark_finished(name)
+
+
+MODELING = """
+import numpy as np
+feats = ["f0", "f1", "f2", "f3"]
+features_training = (training_df[feats].to_numpy(np.float32),
+                     training_df["label"].to_numpy())
+features_testing = testing_df[feats].to_numpy(np.float32)
+features_evaluation = (testing_df[feats].to_numpy(np.float32),
+                       testing_df["label"].to_numpy())
+"""
+
+
+def test_mesh_parallel_builder_pipeline(ctx):
+    _write_df(ctx.catalog, "mp_train", 4096, seed=7)
+    _write_df(ctx.catalog, "mp_test", 1024, seed=8)
+    svc = BuilderService(ctx)
+    status, body = svc.create({
+        "trainDatasetName": "mp_train", "testDatasetName": "mp_test",
+        "evaluationDatasetName": "mp_test",
+        "modelingCode": MODELING,
+        "classifiersList": ["LR", "NB", "DT"],
+        "meshParallel": True})
+    assert status == 201
+    ctx.jobs.wait("mp_testLR", timeout=600)
+    for c, engine in (("LR", "jax"), ("NB", "jax"), ("DT", "sklearn")):
+        meta = ctx.catalog.get_metadata(f"mp_test{c}")
+        assert meta["finished"] is True, meta
+        assert meta["engine"] == engine, (c, meta)
+        assert meta["accuracy"] > 0.9, (c, meta)
+        assert ctx.catalog.count_rows(f"mp_test{c}") == 1024
+    # the two JAX families each got a DISJOINT sub-slice of the
+    # 8-device test mesh (4 devices each)
+    for c in ("LR", "NB"):
+        meta = ctx.catalog.get_metadata(f"mp_test{c}")
+        assert meta["meshDevices"] == 4, meta
+    # the mesh job went through the builder fair-scheduling pool
+    assert "builder" in ctx.jobs.mesh_served()
+
+
+def test_mesh_parallel_rejects_streaming(ctx):
+    _write_df(ctx.catalog, "x_train", 64, seed=9)
+    _write_df(ctx.catalog, "x_test", 64, seed=10)
+    svc = BuilderService(ctx)
+    with pytest.raises(HttpError, match="exclusive"):
+        svc.create({
+            "trainDatasetName": "x_train", "testDatasetName": "x_test",
+            "classifiersList": ["LR"],
+            "streaming": True, "meshParallel": True})
