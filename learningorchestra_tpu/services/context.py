@@ -19,7 +19,8 @@ from learningorchestra_tpu.catalog.artifacts import ArtifactStore
 
 
 class ServiceContext:
-    def __init__(self, config: Optional[Config] = None):
+    def __init__(self, config: Optional[Config] = None,
+                 pod_failure_fn=None, force_pod_guard: bool = False):
         from learningorchestra_tpu.runtime import distributed as dist
         from learningorchestra_tpu.services.jobs import JobManager
         from learningorchestra_tpu.services.params import ParameterResolver
@@ -31,14 +32,18 @@ class ServiceContext:
         self.catalog = Catalog(self.config.catalog_path,
                                self.config.datasets_dir)
         self.artifacts = ArtifactStore(self.config.artifacts_dir)
+        self.pod_failure_fn = pod_failure_fn or dist.pod_failure
         self.jobs = JobManager(self.catalog,
                                max_workers=self.config.max_workers,
                                mesh_leases=self.config.mesh_leases,
-                               pod_failure_fn=dist.pod_failure,
+                               pod_failure_fn=self.pod_failure_fn,
                                pool_weights=parse_pool_weights(
                                    self.config.pool_weights))
         self.params = ParameterResolver(self)
-        self._pod_guard = _start_pod_guard(self.jobs)
+        # callbacks fired by the pod guard when a degraded pod's
+        # heartbeats resume (the Api registers worker-lost requeue)
+        self.on_pod_healthy: list = []
+        self._pod_guard = _start_pod_guard(self, force=force_pod_guard)
 
     @property
     def mesh(self):
@@ -56,50 +61,62 @@ class ServiceContext:
         self.catalog.close()
 
 
-def _start_pod_guard(jobs):
+def _start_pod_guard(ctx: "ServiceContext", force: bool = False):
     """Coordinator-side watchdog (multi-host only): the moment a
     worker stops heartbeating, every in-flight mesh job gets a typed
     ``WorkerLost`` execution document — clients polling see a terminal
     failure within seconds instead of a silent hang on a collective
     (the reference loses in-flight work on node failure and relies on
     Swarm re-placement, README.md:194-202; surfacing the failure is
-    the single-controller equivalent)."""
+    the single-controller equivalent). When heartbeats RESUME, the
+    ``ctx.on_pod_healthy`` callbacks fire — that's the elastic
+    recovery hook that requeues checkpointed worker-lost jobs with no
+    server restart. ``force=True`` starts the guard regardless of
+    topology (tests with an injected ``pod_failure_fn``)."""
     import threading
+    import traceback
 
     from learningorchestra_tpu.runtime import distributed as dist
 
-    # only consult jax when the multi-host runtime already formed:
-    # touching jax.process_count() here would otherwise initialize the
-    # single-host backend and break a later dist.initialize() (the
-    # documented order is initialize-then-ServiceContext, as
-    # services/server.py main does)
-    if not dist.is_initialized():
-        return None
-    try:
-        import jax
-
-        if jax.process_count() <= 1 or jax.process_index() != 0:
+    if not force:
+        # only consult jax when the multi-host runtime already formed:
+        # touching jax.process_count() here would otherwise initialize
+        # the single-host backend and break a later dist.initialize()
+        # (the documented order is initialize-then-ServiceContext, as
+        # services/server.py main does)
+        if not dist.is_initialized():
             return None
-    except Exception:  # noqa: BLE001 — no runtime formed yet
-        return None
+        try:
+            import jax
+
+            if jax.process_count() <= 1 or jax.process_index() != 0:
+                return None
+        except Exception:  # noqa: BLE001 — no runtime formed yet
+            return None
 
     stop = threading.Event()
 
     def guard() -> None:
         reported = False
         while not stop.wait(dist.HEARTBEAT_INTERVAL):
-            failure = dist.pod_failure()
+            failure = ctx.pod_failure_fn()
             if failure and not reported:
                 reported = True
-                n = jobs.fail_running_mesh_jobs(failure)
+                n = ctx.jobs.fail_running_mesh_jobs(failure)
                 print(f"pod guard: {failure} — marked {n} in-flight "
                       f"mesh job(s) failed", flush=True)
             elif not failure and reported:
-                # heartbeats resumed (transient pause, not a death):
-                # re-arm so a later real loss is reported again
+                # heartbeats resumed (transient pause or a restarted
+                # worker): re-arm, then let the recovery callbacks
+                # requeue whatever the loss stranded
                 reported = False
                 print("pod guard: heartbeats resumed, pod healthy "
                       "again", flush=True)
+                for callback in list(ctx.on_pod_healthy):
+                    try:
+                        callback()
+                    except Exception:  # noqa: BLE001 — the guard
+                        traceback.print_exc()  # must keep watching
 
     threading.Thread(target=guard, daemon=True,
                      name="lo-pod-guard").start()

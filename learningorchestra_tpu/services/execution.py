@@ -157,7 +157,7 @@ class ExecutionService:
     # ------------------------------------------------------------------
     def _submit(self, name: str, type_string: str, parent_name: str,
                 method: str, method_parameters: Dict[str, Any],
-                description: str) -> None:
+                description: str, only_if_idle: bool = False) -> None:
         def run():
             _broadcast_to_workers(name, type_string, parent_name, method,
                                   method_parameters)
@@ -187,6 +187,7 @@ class ExecutionService:
             # fair-scheduling pool — per-service FAIR pool parity
             # (reference spark_image/fairscheduler.xml:1-8)
             pool=type_string.split("/", 1)[0],
+            only_if_idle=only_if_idle,
             max_retries=self._ctx.config.job_max_retries)
 
 

@@ -79,6 +79,7 @@ class JobManager:
                on_success: Optional[Callable[[Any], None]] = None,
                mark_finished: bool = True,
                failure_names: Optional[list] = None,
+               only_if_idle: bool = False,
                ) -> Future:
         """Run ``fn`` asynchronously under the reference's
         finished-flag contract for collection ``name`` (which must
@@ -157,18 +158,32 @@ class JobManager:
                     except Exception as exception:  # noqa: BLE001
                         traceback.print_exc()
                         terminal = attempt + 1 >= attempts
+                        extra = timing({"attempt": attempt + 1})
+                        if needs_mesh and self._pod_failure_fn():
+                            # a mesh job failing WHILE the pod is
+                            # degraded is a worker-loss casualty (a
+                            # collective erroring out under it), not a
+                            # code failure — flag it so elastic
+                            # recovery requeues it on heal
+                            extra["workerLost"] = True
                         doc = D.execution_document(
                             description, parameters,
-                            exception=repr(exception),
-                            extra=timing({"attempt": attempt + 1}))
+                            exception=repr(exception), extra=extra)
                         if terminal:
                             fail_all(doc)
                             # finished stays False (reference parity)
                             return None
                         self._catalog.append_document(name, doc)
 
-        future = self._pool.submit(run)
         with self._lock:
+            existing = self._futures.get(name)
+            if only_if_idle and existing is not None \
+                    and not existing.done():
+                # elastic-recovery guard vs a concurrent client PATCH:
+                # the check and the registration share one lock, so
+                # the same job can never be double-submitted
+                return existing
+            future = self._pool.submit(run)
             # prune finished entries so a long-lived server doesn't
             # leak a Future per job (results live in the catalog; wait()
             # on a pruned job returns immediately)
@@ -220,6 +235,15 @@ class JobManager:
     def running(self) -> int:
         with self._lock:
             return sum(1 for f in self._futures.values() if not f.done())
+
+    def is_active(self, name: str) -> bool:
+        """True while job ``name`` has a live (unfinished) future —
+        re-form recovery must not requeue a job whose original thread
+        is still running (a transient heartbeat pause leaves the
+        in-flight job healthy; requeueing it would double-run)."""
+        with self._lock:
+            future = self._futures.get(name)
+        return future is not None and not future.done()
 
     def shutdown(self) -> None:
         self._pool.shutdown(wait=False, cancel_futures=True)

@@ -98,6 +98,9 @@ class Api:
         self._latency_sum = 0.0
         self._latency_count = 0
         self.recover_unfinished()
+        # elastic pod recovery: when the guard sees heartbeats resume,
+        # requeue checkpointed worker-lost executions automatically
+        self.ctx.on_pod_healthy.append(self.recover_worker_lost)
 
     # ------------------------------------------------------------------
     def recover_unfinished(self) -> Dict[str, list]:
@@ -125,18 +128,18 @@ class Api:
             # parity keeps finished=False) — only jobs interrupted
             # mid-flight (no terminal record) are recovered, or every
             # restart would re-run failed fits / stack duplicate
-            # InterruptedError docs
+            # InterruptedError docs. EXCEPTION: a WorkerLost failure
+            # is the pod's fault, not the job's — elastic-recovery
+            # policy requeues those here too, or a restart would
+            # strand jobs the running server auto-recovers.
             docs = self.ctx.catalog.get_documents(name)
-            if docs and docs[-1].get(D.EXCEPTION_FIELD):
+            if docs and docs[-1].get(D.EXCEPTION_FIELD) and \
+                    not docs[-1].get("workerLost"):
                 continue
             try:
                 if verb in EXECUTION_VERBS and \
                         meta.get(D.METHOD_FIELD) is not None:
-                    self.execution._submit(
-                        name, type_string, meta[D.PARENT_NAME_FIELD],
-                        meta[D.METHOD_FIELD],
-                        meta.get(D.METHOD_PARAMETERS_FIELD) or {},
-                        meta.get(D.DESCRIPTION_FIELD, ""))
+                    self._requeue_execution(name, type_string, meta)
                     requeued.append(name)
                 elif verb == "function" and \
                         meta.get(D.FUNCTION_FIELD) is not None:
@@ -170,6 +173,62 @@ class Api:
                         exception=f"requeue-on-boot failed: {exc!r}"))
                 failed.append(name)
         return {"requeued": requeued, "failed": failed}
+
+    def _requeue_execution(self, name: str, type_string: str,
+                           meta: Dict[str, Any],
+                           only_if_idle: bool = False) -> None:
+        """Shared requeue-from-stored-request used by boot recovery
+        and elastic re-form recovery (one place owns the _submit
+        signature)."""
+        self.execution._submit(
+            name, type_string, meta[D.PARENT_NAME_FIELD],
+            meta[D.METHOD_FIELD],
+            meta.get(D.METHOD_PARAMETERS_FIELD) or {},
+            meta.get(D.DESCRIPTION_FIELD, ""),
+            only_if_idle=only_if_idle)
+
+    def recover_worker_lost(self) -> list:
+        """Elastic pod recovery (beyond the reference, whose node loss
+        loses the work outright, README.md:194-202): when the pod
+        guard reports heartbeats resumed, requeue every unfinished
+        execution whose LAST failure was attributed to the pod
+        (``workerLost`` — a pre-submit refusal, or a mesh job whose
+        collective errored while the pod was degraded). A checkpointed
+        train then picks up at its latest orbax step with NO server
+        restart. Not eligible: jobs whose newest failure is a genuine
+        (non-pod) error — re-running those on every degrade/heal flap
+        would loop a broken fit forever — and jobs whose original
+        thread is still live (the atomic ``only_if_idle`` submit skips
+        them; a thread wedged in a dead collective can only be cleared
+        by a pod restart, which boot recovery then handles)."""
+        requeued = []
+        for meta in self.ctx.catalog.list_collections():
+            if meta.get(D.FINISHED_FIELD):
+                continue
+            name = meta.get(D.NAME_FIELD)
+            type_string = str(meta.get(D.TYPE_FIELD, ""))
+            verb = type_string.split("/")[0]
+            if verb not in EXECUTION_VERBS or \
+                    meta.get(D.METHOD_FIELD) is None:
+                continue
+            docs = self.ctx.catalog.get_documents(name)
+            exc_docs = [d for d in docs if d.get(D.EXCEPTION_FIELD)]
+            if not exc_docs or not exc_docs[-1].get("workerLost"):
+                continue
+            try:
+                self._requeue_execution(name, type_string, meta,
+                                        only_if_idle=True)
+                requeued.append(name)
+            except Exception as exc:  # noqa: BLE001 — recovery must
+                # not kill the guard thread; record and move on
+                self.ctx.catalog.append_document(
+                    name, D.execution_document(
+                        meta.get(D.DESCRIPTION_FIELD, ""), None,
+                        exception=f"requeue-on-reform failed: {exc!r}"))
+        if requeued:
+            print(f"pod re-form: requeued {len(requeued)} worker-lost "
+                  f"job(s): {requeued}", flush=True)
+        return requeued
 
     # ------------------------------------------------------------------
     def dispatch(self, method: str, path: str, params: Dict[str, Any],

@@ -184,3 +184,169 @@ def test_job_manager_prunes_completed_futures(tmp_config):
     finally:
         jobs.shutdown()
         cat.close()
+
+
+def test_pod_reform_requeues_checkpointed_train(tmp_config):
+    """Elastic pod recovery (VERDICT r4 item 6): a train refused while
+    the pod is degraded (WorkerLost) requeues AUTOMATICALLY when the
+    guard sees heartbeats resume — the checkpointed run finishes, from
+    its saved step, with NO server restart."""
+    from learningorchestra_tpu.services.context import ServiceContext
+    from learningorchestra_tpu.services.server import Api
+
+    state = {"failure": None}
+    ctx = ServiceContext(tmp_config,
+                         pod_failure_fn=lambda: state["failure"],
+                         force_pod_guard=True)
+    api = Api(ctx)
+    P = "/api/learningOrchestra/v1"
+    try:
+        s, b, _ = api.dispatch("POST", P + "/function/python", {}, {
+            "name": "rf_data", "functionParameters": {},
+            "function": ("import numpy as np\n"
+                         "rng = np.random.default_rng(0)\n"
+                         "x = rng.normal(size=(64, 8)).astype(np.float32)\n"
+                         "y = (x[:, 0] > 0).astype(np.int32)\n"
+                         "response = {'x': x, 'y': y}\n")})
+        assert s == 201, b
+        api.ctx.jobs.wait("rf_data", timeout=120)
+        s, b, _ = api.dispatch("POST", P + "/model/tensorflow", {}, {
+            "modelName": "rf_model",
+            "modulePath": "learningorchestra_tpu.models",
+            "class": "NeuralModel",
+            "classParameters": {"layer_configs": [
+                {"kind": "dense", "units": 4, "activation": "relu"},
+                {"kind": "dense", "units": 2, "activation": "softmax"}]}})
+        assert s == 201, b
+        api.ctx.jobs.wait("rf_model", timeout=120)
+
+        # phase 1: healthy pod, checkpointed 2-epoch train completes
+        s, b, _ = api.dispatch("POST", P + "/train/tensorflow", {}, {
+            "name": "rf_train", "modelName": "rf_model",
+            "method": "fit",
+            "methodParameters": {"x": "$rf_data.x", "y": "$rf_data.y",
+                                 "epochs": 2, "batch_size": 8,
+                                 "checkpoint": True}})
+        assert s == 201, b
+        api.ctx.jobs.wait("rf_train", timeout=240)
+        assert api.ctx.catalog.get_metadata(
+            "rf_train")[D.FINISHED_FIELD] is True
+
+        # phase 2: pod degrades; a PATCH re-run (total budget 4
+        # epochs) is REFUSED with a typed WorkerLost document
+        state["failure"] = "worker host(s) [1] stopped heartbeating"
+        s, b, _ = api.dispatch("PATCH", P + "/train/tensorflow/rf_train",
+                               {}, {"methodParameters": {
+                                   "x": "$rf_data.x", "y": "$rf_data.y",
+                                   "epochs": 4, "batch_size": 8,
+                                   "checkpoint": True}})
+        assert s == 200, b
+        api.ctx.jobs.wait("rf_train", timeout=120)
+        docs = api.ctx.catalog.get_documents("rf_train")
+        assert docs[-1].get("workerLost") is True, docs[-1]
+        assert api.ctx.catalog.get_metadata(
+            "rf_train")[D.FINISHED_FIELD] is False
+        # hold the failure window open past the guard's poll interval
+        # so it OBSERVES the degraded state (in production a heartbeat
+        # loss persists >= the 10x-interval timeout; here it's faked)
+        time.sleep(2.5)
+
+        # phase 3: heartbeats resume — the guard requeues the train
+        # automatically; it resumes from the epoch-2 checkpoint and
+        # finishes WITHOUT any server restart
+        state["failure"] = None
+        deadline = time.time() + 120
+        while time.time() < deadline:
+            if api.ctx.catalog.get_metadata(
+                    "rf_train").get(D.FINISHED_FIELD):
+                break
+            time.sleep(0.5)
+        meta = api.ctx.catalog.get_metadata("rf_train")
+        assert meta[D.FINISHED_FIELD] is True, meta
+        docs = api.ctx.catalog.get_documents("rf_train")
+        resumed = [d["epochRecord"]["epoch"] for d in docs
+                   if "epochRecord" in d]
+        # the auto-requeued run trained epochs 2..3 only (resume), on
+        # top of phase 1's 0..1
+        assert resumed.count(2) == 1 and resumed.count(3) == 1, resumed
+        assert resumed.count(0) == 1 and resumed.count(1) == 1, resumed
+
+        # phase 4: a job whose newest failure is a GENUINE error (bad
+        # params, healthy pod) must NOT re-run on later degrade/heal
+        # flaps — only pod-attributed failures are elastic
+        s, b, _ = api.dispatch("PATCH", P + "/train/tensorflow/rf_train",
+                               {}, {"methodParameters": {
+                                   "x": "$rf_data.x", "y": "$rf_data.y",
+                                   "epochs": 6, "batch_size": 8,
+                                   "checkpoint": True,
+                                   "grad_accum": "not-a-number"}})
+        assert s == 200, b
+        api.ctx.jobs.wait("rf_train", timeout=120)
+        docs = api.ctx.catalog.get_documents("rf_train")
+        assert docs[-1].get(D.EXCEPTION_FIELD), docs[-1]
+        assert not docs[-1].get("workerLost")
+        n_docs = len(docs)
+        state["failure"] = "worker host(s) [1] stopped heartbeating"
+        time.sleep(2.5)
+        state["failure"] = None
+        time.sleep(2.5)
+        assert len(api.ctx.catalog.get_documents("rf_train")) == n_docs
+    finally:
+        api.ctx.close()
+
+
+def test_boot_recovery_requeues_worker_lost(tmp_config):
+    """A server RESTART must also requeue worker-lost executions (the
+    pod was degraded when the server stopped; at boot it is healthy,
+    so the guard never sees a transition) — a workerLost failure doc
+    is the pod's fault, not a terminal job failure."""
+    from learningorchestra_tpu.services.context import ServiceContext
+    from learningorchestra_tpu.services.server import Api
+
+    # server #1: pod degrades right before the train — it is refused
+    # with a trailing workerLost doc and stays unfinished
+    state = {"failure": None}
+    ctx1 = ServiceContext(tmp_config,
+                          pod_failure_fn=lambda: state["failure"])
+    api1 = Api(ctx1)
+    P = "/api/learningOrchestra/v1"
+    s, b, _ = api1.dispatch("POST", P + "/function/python", {}, {
+        "name": "bl_data", "functionParameters": {},
+        "function": ("import numpy as np\n"
+                     "rng = np.random.default_rng(0)\n"
+                     "x = rng.normal(size=(64, 8)).astype(np.float32)\n"
+                     "y = (x[:, 0] > 0).astype(np.int32)\n"
+                     "response = {'x': x, 'y': y}\n")})
+    assert s == 201, b
+    api1.ctx.jobs.wait("bl_data", timeout=120)
+    s, b, _ = api1.dispatch("POST", P + "/model/tensorflow", {}, {
+        "modelName": "bl_model",
+        "modulePath": "learningorchestra_tpu.models",
+        "class": "NeuralModel",
+        "classParameters": {"layer_configs": [
+            {"kind": "dense", "units": 4, "activation": "relu"},
+            {"kind": "dense", "units": 2, "activation": "softmax"}]}})
+    assert s == 201, b
+    api1.ctx.jobs.wait("bl_model", timeout=120)
+    state["failure"] = "worker 1 lost"
+    s, b, _ = api1.dispatch("POST", P + "/train/tensorflow", {}, {
+        "name": "bl_train", "modelName": "bl_model", "method": "fit",
+        "methodParameters": {"x": "$bl_data.x", "y": "$bl_data.y",
+                             "epochs": 2, "batch_size": 8}})
+    assert s == 201, b
+    api1.ctx.jobs.wait("bl_train", timeout=120)
+    docs = api1.ctx.catalog.get_documents("bl_train")
+    assert docs[-1].get("workerLost") is True, docs[-1]
+    assert api1.ctx.catalog.get_metadata(
+        "bl_train")[D.FINISHED_FIELD] is False
+    api1.ctx.close()
+
+    # server #2 (fresh boot, healthy pod): recover_unfinished requeues
+    # the worker-lost train instead of treating it as terminal
+    api2 = Api()
+    try:
+        api2.ctx.jobs.wait("bl_train", timeout=240)
+        meta = api2.ctx.catalog.get_metadata("bl_train")
+        assert meta[D.FINISHED_FIELD] is True, meta
+    finally:
+        api2.ctx.close()
