@@ -6,6 +6,9 @@ parallelism library and every sharded engine:
 ==========  =====================================================
 axis        meaning
 ==========  =====================================================
+``dcn``     cross-slice data parallel (OUTERMOST axis; spans pod
+            slices over the data-center network — only the gradient
+            all-reduce crosses it, everything else stays in-slice)
 ``dp``      data parallel (batch dim; gradients all-reduced)
 ``fsdp``    fully-sharded data parallel (params sharded over it too)
 ``tp``      tensor parallel (weight matrices split; activations
@@ -14,6 +17,13 @@ axis        meaning
 ``sp``      sequence/context parallel (ring attention over seq dim)
 ``ep``      expert parallel (MoE experts)
 ==========  =====================================================
+
+Multi-slice discipline (SURVEY §2.5; scaling-book): DCN bandwidth is
+orders of magnitude below ICI, so ``dcn`` carries ONLY per-step
+gradient all-reduces (weight-update cost, overlappable); params and
+optimizer state replicate across slices and every tp/sp/ep/pp
+collective stays inside a slice. ``build_mesh`` enforces dcn
+outermost so device order maps slice boundaries to the dcn axis.
 
 The reference has no device concept at all — its "cluster" is Docker
 Swarm placement (SURVEY §2.4). Here the mesh is the cluster.
@@ -28,8 +38,9 @@ import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-DP, FSDP, TP, PP, SP, EP = "dp", "fsdp", "tp", "pp", "sp", "ep"
-KNOWN_AXES = (DP, FSDP, TP, PP, SP, EP)
+DCN, DP, FSDP, TP, PP, SP, EP = \
+    "dcn", "dp", "fsdp", "tp", "pp", "sp", "ep"
+KNOWN_AXES = (DCN, DP, FSDP, TP, PP, SP, EP)
 
 
 def parse_mesh_spec(spec: str) -> Dict[str, int]:
@@ -67,6 +78,12 @@ def build_mesh(spec: str = "auto",
     if spec == "auto":
         return jax.make_mesh((n,), (DP,), auto, devices=devices)
     sizes = parse_mesh_spec(spec)
+    if DCN in sizes and next(iter(sizes)) != DCN:
+        # slice-crossing traffic must map to the outermost axis, so
+        # contiguous device blocks (slices, in a real multislice
+        # topology) land on the inner in-slice axes
+        raise ValueError(
+            f"dcn must be the OUTERMOST (first) mesh axis: {spec!r}")
     unknown = [a for a, s in sizes.items() if s == -1]
     if len(unknown) > 1:
         raise ValueError("at most one -1 axis allowed")
@@ -104,9 +121,10 @@ def reset_default_mesh() -> None:
 
 
 def data_axes(mesh: Mesh) -> Tuple[str, ...]:
-    """Axes the batch dimension is sharded over (dp and fsdp both
-    shard data)."""
-    return tuple(a for a in (DP, FSDP) if a in mesh.axis_names)
+    """Axes the batch dimension is sharded over (dcn, dp and fsdp all
+    shard data; dcn outermost so each slice holds a contiguous batch
+    block and only gradients cross the slice boundary)."""
+    return tuple(a for a in (DCN, DP, FSDP) if a in mesh.axis_names)
 
 
 def batch_sharding(mesh: Mesh) -> NamedSharding:

@@ -177,12 +177,22 @@ class JobManager:
 
         with self._lock:
             existing = self._futures.get(name)
-            if only_if_idle and existing is not None \
-                    and not existing.done():
+            if only_if_idle:
                 # elastic-recovery guard vs a concurrent client PATCH:
-                # the check and the registration share one lock, so
-                # the same job can never be double-submitted
-                return existing
+                # the live-future check, the finished re-check and the
+                # registration share one lock, so the same job can
+                # never be double-submitted — and a job that FINISHED
+                # between the caller's catalog read and this point is
+                # not re-run either
+                if existing is not None and not existing.done():
+                    return existing
+                meta = self._catalog.get_metadata(name)
+                if meta is not None and meta.get(D.FINISHED_FIELD):
+                    if existing is not None:
+                        return existing
+                    done_future: Future = Future()
+                    done_future.set_result(None)
+                    return done_future
             future = self._pool.submit(run)
             # prune finished entries so a long-lived server doesn't
             # leak a Future per job (results live in the catalog; wait()
@@ -235,15 +245,6 @@ class JobManager:
     def running(self) -> int:
         with self._lock:
             return sum(1 for f in self._futures.values() if not f.done())
-
-    def is_active(self, name: str) -> bool:
-        """True while job ``name`` has a live (unfinished) future —
-        re-form recovery must not requeue a job whose original thread
-        is still running (a transient heartbeat pause leaves the
-        in-flight job healthy; requeueing it would double-run)."""
-        with self._lock:
-            future = self._futures.get(name)
-        return future is not None and not future.done()
 
     def shutdown(self) -> None:
         self._pool.shutdown(wait=False, cancel_futures=True)

@@ -129,12 +129,19 @@ class Api:
             # mid-flight (no terminal record) are recovered, or every
             # restart would re-run failed fits / stack duplicate
             # InterruptedError docs. EXCEPTION: a WorkerLost failure
-            # is the pod's fault, not the job's — elastic-recovery
-            # policy requeues those here too, or a restart would
-            # strand jobs the running server auto-recovers.
+            # on a REQUEUEABLE job is the pod's fault, not the job's —
+            # elastic-recovery policy requeues those here too, or a
+            # restart would strand jobs the running server
+            # auto-recovers. Non-requeueable worker-lost jobs (model/
+            # builder) keep their typed WorkerLost record as-is.
+            requeueable = (
+                (verb in EXECUTION_VERBS and
+                 meta.get(D.METHOD_FIELD) is not None) or
+                (verb == "function" and
+                 meta.get(D.FUNCTION_FIELD) is not None))
             docs = self.ctx.catalog.get_documents(name)
             if docs and docs[-1].get(D.EXCEPTION_FIELD) and \
-                    not docs[-1].get("workerLost"):
+                    not (docs[-1].get("workerLost") and requeueable):
                 continue
             try:
                 if verb in EXECUTION_VERBS and \
@@ -220,11 +227,15 @@ class Api:
                                         only_if_idle=True)
                 requeued.append(name)
             except Exception as exc:  # noqa: BLE001 — recovery must
-                # not kill the guard thread; record and move on
+                # not kill the guard thread; record and move on. The
+                # doc keeps the workerLost attribution so a transient
+                # requeue error leaves the job retryable by the next
+                # heal / the next boot instead of stranding it
                 self.ctx.catalog.append_document(
                     name, D.execution_document(
                         meta.get(D.DESCRIPTION_FIELD, ""), None,
-                        exception=f"requeue-on-reform failed: {exc!r}"))
+                        exception=f"requeue-on-reform failed: {exc!r}",
+                        extra={"workerLost": True}))
         if requeued:
             print(f"pod re-form: requeued {len(requeued)} worker-lost "
                   f"job(s): {requeued}", flush=True)

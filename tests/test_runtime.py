@@ -27,6 +27,50 @@ def test_mesh_spec_parse_and_build():
     assert M.data_parallel_size(mesh) == 4
 
 
+def test_dcn_mesh_axis():
+    """Multi-slice grammar (SURVEY §2.5): a ``dcn`` outer axis models
+    pod slices joined over DCN. It must be outermost (slice-contiguous
+    device blocks land on the inner ICI axes) and it shards data, so
+    the only cross-slice collective is the gradient all-reduce."""
+    from learningorchestra_tpu.runtime import mesh as M
+
+    mesh = M.build_mesh("dcn=2,dp=2,tp=2")
+    assert mesh.shape == {"dcn": 2, "dp": 2, "tp": 2}
+    assert M.data_axes(mesh) == ("dcn", "dp")
+    assert M.data_parallel_size(mesh) == 4
+    with pytest.raises(ValueError, match="OUTERMOST"):
+        M.build_mesh("dp=2,dcn=2,tp=2")
+
+
+def test_dcn_training_matches_flat_dp(tmp_config):
+    """A dcn=2,dp=4 two-slice mesh must train numerically like plain
+    dp=8 — params replicate across slices, the batch splits over
+    dcn x dp, gradients all-reduce across everything."""
+    import optax
+
+    from learningorchestra_tpu.runtime import engine as E
+    from learningorchestra_tpu.runtime import mesh as M
+    from learningorchestra_tpu.runtime.data import ArrayBatcher
+
+    def apply_fn(params, model_state, batch, train, rng_):
+        return batch["x"] @ params["w"], model_state
+
+    x = np.random.default_rng(0).normal(size=(32, 3)).astype(np.float32)
+    y = (x[:, 0] > 0).astype(np.float32)
+
+    losses = {}
+    for spec in ("dp=8", "dcn=2,dp=4"):
+        eng = E.Engine(apply_fn, E.mse_loss, optax.sgd(0.1),
+                       mesh=M.build_mesh(spec),
+                       compute_dtype=jnp.float32)
+        st = eng.init_state({"w": jnp.zeros((3, 1))})
+        batcher = ArrayBatcher({"x": x, "y": y}, 16, dp_multiple=8)
+        _, hist = eng.fit(st, batcher, epochs=2)
+        losses[spec] = [h["loss"] for h in hist]
+    np.testing.assert_allclose(losses["dp=8"], losses["dcn=2,dp=4"],
+                               rtol=1e-5)
+
+
 def test_batcher_pads_and_masks(tmp_config):
     from learningorchestra_tpu.runtime.data import ArrayBatcher, MASK_KEY
     b = ArrayBatcher({"x": np.arange(10, dtype=np.float32)},
