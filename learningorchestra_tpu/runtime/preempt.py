@@ -23,18 +23,42 @@ from typing import Callable, Optional
 _tls = threading.local()
 
 
-def install(fn: Callable[[], None]) -> None:
+def install(fn: Callable[[], None],
+            contended_fn: Optional[Callable[[], bool]] = None) -> None:
     """Register ``fn`` as this thread's between-epochs yield point
-    (called by the mesh lease when a job thread acquires it)."""
+    (called by the mesh lease when a job thread acquires it).
+    ``contended_fn`` lets long jobs ASK whether a yield is wanted
+    without performing one — sweeps use it to drain in-flight trials
+    before handing the lease over."""
     _tls.fn = fn
+    _tls.contended = contended_fn
 
 
 def clear() -> None:
     _tls.fn = None
+    _tls.contended = None
 
 
 def current() -> Optional[Callable[[], None]]:
     return getattr(_tls, "fn", None)
+
+
+def contended() -> bool:
+    """True when another job is waiting for this thread's lease (a
+    yield at the next safe point would hand it over). Always False
+    outside the service layer."""
+    fn = getattr(_tls, "contended", None)
+    return bool(fn()) if fn is not None else False
+
+
+def snapshot():
+    """(yield_fn, contended_fn) for save/restore around nested
+    installs (the lease CM restores its predecessor on exit)."""
+    return (getattr(_tls, "fn", None), getattr(_tls, "contended", None))
+
+
+def restore(snap) -> None:
+    _tls.fn, _tls.contended = snap
 
 
 def maybe_yield() -> None:

@@ -158,15 +158,15 @@ class FairLease:
             token.preempted_seconds += start[0] - t_wait
             token.yields += 1
 
-        previous = preempt.current()
-        preempt.install(yield_point)
+        previous = preempt.snapshot()
+        preempt.install(
+            yield_point,
+            contended_fn=lambda: can_yield and
+            self.contended_by_other(pool))
         try:
             yield token
         finally:
-            if previous is None:
-                preempt.clear()
-            else:
-                preempt.install(previous)
+            preempt.restore(previous)
             self.release(pool, time.monotonic() - start[0])
 
 
