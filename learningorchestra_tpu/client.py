@@ -197,8 +197,9 @@ class Tool:
     def run_builder(self, train_dataset: str, test_dataset: str,
                     modeling_code: str, classifiers: List[str],
                     **extra: Any) -> Any:
-        """``extra`` passes the out-of-core knobs through:
-        ``streaming=True``, ``labelColumn=``, ``featureColumns=``,
+        """``extra`` passes the out-of-core and placement knobs
+        through: ``streaming=True``, ``meshParallel=True``,
+        ``labelColumn=``, ``featureColumns=``,
         ``evaluationDatasetName=``, ``batchSize=``."""
         return self.post({
             "trainDatasetName": train_dataset,
