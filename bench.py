@@ -878,6 +878,8 @@ def main(argv=None):
         "LO_BENCH_TLM_D": "128", "LO_BENCH_TLM_LAYERS": "2",
         "LO_BENCH_TLM_N": "128", "LO_BENCH_TLM_BATCH": "8",
         "LO_BENCH_TLM_EPOCHS": "2", "LO_BENCH_TLM_SEQ": "128",
+        # 2M-row jax LR at CPU dispatch overhead would eat minutes
+        "LO_BENCH_BUILDER_MESH_ROWS": "200000",
     }
     env = None if tpu_ok else cpu_env
 
