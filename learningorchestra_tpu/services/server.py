@@ -294,6 +294,9 @@ class Api:
         out["jobsRunning"] = self.ctx.jobs.running()
         out["collections"] = len(self.ctx.catalog.list_collections())
         out["getCache"] = self.read_cache.stats()
+        out["meshSecondsByPool"] = {
+            pool: round(seconds, 3) for pool, seconds in
+            sorted(self.ctx.jobs.mesh_served().items())}
         return out
 
     def metrics_prometheus(self) -> bytes:
@@ -329,6 +332,16 @@ class Api:
             f"lo_jobs_running {m['jobsRunning']}",
             "# TYPE lo_collections gauge",
             f"lo_collections {m['collections']}",
+            "# TYPE lo_mesh_seconds_total counter",
+        ]
+        for pool, seconds in m["meshSecondsByPool"].items():
+            lines.append(
+                f'lo_mesh_seconds_total{{pool="{esc(pool)}"}} {seconds}')
+        lines += [
+            "# TYPE lo_get_cache_hits_total counter",
+            f"lo_get_cache_hits_total {m['getCache']['hits']}",
+            "# TYPE lo_get_cache_misses_total counter",
+            f"lo_get_cache_misses_total {m['getCache']['misses']}",
         ]
         return ("\n".join(lines) + "\n").encode()
 

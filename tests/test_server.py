@@ -107,6 +107,13 @@ def test_metrics_endpoint(server):
     assert m["meanDispatchSeconds"] is not None
     assert m["uptimeSeconds"] > 0
     assert "jobsRunning" in m and "collections" in m
+    assert "getCache" in m and "meshSecondsByPool" in m
+    status, raw = _call(server, "GET", "/metrics",
+                        params="?format=prometheus")
+    assert status == 200
+    text = raw.decode()
+    assert "lo_get_cache_hits_total" in text and \
+        "lo_mesh_seconds_total" in text
 
 
 def test_dataset_rest_roundtrip(server, titanic_csv):
