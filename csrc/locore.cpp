@@ -457,7 +457,33 @@ static inline double hgb_leaf(double g, double h, double l2, double lr) {
   return -lr * g / (h + l2 + 1e-12);
 }
 
-// builds ONE regression tree on (g, h); updates scores in place
+// one histogram cell, array-of-structs so a row's (g, h, count)
+// update touches one cache line instead of three far-apart arrays
+struct HistCell {
+  double g, h;
+  int64_t c;
+};
+
+// shared by the binary and multiclass gradient passes: one row's
+// (g, h) lands in the tree's root histogram as the gradients are
+// computed, so no separate root-accumulation scan exists
+static inline void hgb_root_add(HistCell* root, const uint8_t* row,
+                                int nfeats, int max_bins, double gi,
+                                double hi) {
+  for (int f = 0; f < nfeats; ++f) {
+    HistCell& cell = root[f * max_bins + row[f]];
+    cell.g += gi;
+    cell.h += hi;
+    cell.c += 1;
+  }
+}
+
+// builds ONE regression tree on (g, h); updates scores in place.
+// Histograms use the LightGBM sibling-subtraction trick: after level
+// 0, only the SMALLER child of each split is accumulated from rows;
+// the larger child is parent - sibling (counts exact; g/h differ from
+// direct accumulation only by float summation order). This roughly
+// halves the dominant per-level accumulate work.
 static void hgb_build_tree(const uint8_t* codes, int64_t nrows, int nfeats,
                            const double* g, const double* h,
                            double* scores, int64_t score_stride,
@@ -466,7 +492,8 @@ static void hgb_build_tree(const uint8_t* codes, int64_t nrows, int nfeats,
                            std::vector<int>& feat_out,
                            std::vector<uint8_t>& bin_out,
                            std::vector<double>& val_out,
-                           std::vector<int32_t>& assign) {
+                           std::vector<int32_t>& assign,
+                           std::vector<HistCell>& root_hist) {
   const int slots = (1 << (max_depth + 1)) - 1;
   const int base_slot = (int)feat_out.size();
   feat_out.insert(feat_out.end(), slots, -2);
@@ -479,71 +506,90 @@ static void hgb_build_tree(const uint8_t* codes, int64_t nrows, int nfeats,
   std::fill(assign.begin(), assign.end(), 0);
   tfeat[0] = -1;  // provisional leaf (filled from level-0 totals below)
 
+  const size_t fb = (size_t)nfeats * max_bins;  // cells per node hist
+
+  // Pass structure (single-core: passes over rows dominate, so each
+  // level costs ONE fused pass): level 0's root histogram is built by
+  // a plain scan; every later level's build-marked histograms are
+  // accumulated DURING the routing pass that moves rows down through
+  // the parents' splits. The smaller child of each split is known at
+  // split time (CL vs C-CL), so the build marks exist before routing;
+  // larger siblings are derived parent - sibling before selection.
+  std::vector<int> active(1, 0);        // nodes of the current level
+  std::vector<int> id_in_level(1, 0);   // in-level -> hist idx (-1 none)
+  std::vector<char> build_flag(1, 1);   // accumulated from rows?
+  // the root histogram arrives pre-filled: the caller accumulates it
+  // during its gradient pass, saving one full scan of the rows
+  std::vector<HistCell> hist = std::move(root_hist);
+  std::vector<HistCell> parent_hist;
+  std::vector<int> parent_id;
+  std::vector<double> leaf_g, leaf_h;   // deepest-level totals
+
   for (int depth = 0; depth < max_depth; ++depth) {
     const int first = (1 << depth) - 1;
-    const int count = 1 << depth;
-    // any node still marked provisional-leaf at this level is active
-    std::vector<int> active;
-    for (int n = first; n < first + count; ++n)
-      if (tfeat[n] == -1) active.push_back(n);
     if (active.empty()) break;
 
-    // node-local histogram ids (small dense table for this level)
-    std::vector<int> hist_id(count, -1);
-    for (size_t a = 0; a < active.size(); ++a)
-      hist_id[active[a] - first] = (int)a;
-    const size_t hist_cells = active.size() * (size_t)nfeats * max_bins;
-    std::vector<double> hg(hist_cells, 0.0), hh(hist_cells, 0.0);
-    std::vector<int64_t> hc(active.size() * (size_t)nfeats * max_bins, 0);
-
-    // one pass over all rows fills every active node''s histograms
-    for (int64_t i = 0; i < nrows; ++i) {
-      const int32_t node = assign[i];
-      if (node < first || node >= first + count) continue;
-      const int id = hist_id[node - first];
-      if (id < 0) continue;
-      const uint8_t* row = codes + i * nfeats;
-      const double gi = g[i], hi = h[i];
-      double* hgp = hg.data() + (size_t)id * nfeats * max_bins;
-      double* hhp = hh.data() + (size_t)id * nfeats * max_bins;
-      int64_t* hcp = hc.data() + (size_t)id * nfeats * max_bins;
-      for (int f = 0; f < nfeats; ++f) {
-        const int b = row[f];
-        hgp[f * max_bins + b] += gi;
-        hhp[f * max_bins + b] += hi;
-        hcp[f * max_bins + b] += 1;
+    // complete the level: derive non-built siblings from parents
+    if (depth > 0) {
+      const int pfirst = (1 << (depth - 1)) - 1;
+      for (size_t a = 0; a < active.size(); ++a) {
+        const int node = active[a];
+        const int in_level = node - first;
+        if (build_flag[in_level]) continue;
+        const int parent = (node - 1) / 2;
+        // left children sit at EVEN in-level offsets (left = 2p+1 =
+        // first + 2j)
+        const int sib = (in_level % 2 == 0) ? in_level + 1
+                                            : in_level - 1;
+        const HistCell* pp = parent_hist.data() +
+            (size_t)parent_id[parent - pfirst] * fb;
+        const HistCell* sp = hist.data() +
+            (size_t)id_in_level[sib] * fb;
+        HistCell* dp = hist.data() +
+            (size_t)id_in_level[in_level] * fb;
+        for (size_t cix = 0; cix < fb; ++cix) {
+          dp[cix].g = pp[cix].g - sp[cix].g;
+          dp[cix].h = pp[cix].h - sp[cix].h;
+          dp[cix].c = pp[cix].c - sp[cix].c;
+        }
       }
     }
 
+    // split selection; build marks for the next level come straight
+    // from each winning split's left/right row counts
+    const int next_first = (1 << (depth + 1)) - 1;
+    const int next_count = 1 << (depth + 1);
+    std::vector<char> next_build(next_count, 0);
+    std::vector<int> next_active;
     bool any_split = false;
     for (size_t a = 0; a < active.size(); ++a) {
       const int node = active[a];
-      const double* hgp = hg.data() + a * (size_t)nfeats * max_bins;
-      const double* hhp = hh.data() + a * (size_t)nfeats * max_bins;
-      const int64_t* hcp = hc.data() + a * (size_t)nfeats * max_bins;
+      const HistCell* hp = hist.data() +
+          (size_t)id_in_level[node - first] * fb;
       double G = 0.0, H = 0.0;
       int64_t C = 0;
       for (int b = 0; b < max_bins; ++b) {
-        G += hgp[b]; H += hhp[b]; C += hcp[b];
+        G += hp[b].g; H += hp[b].h; C += hp[b].c;
       }
       // (feature 0 totals == node totals; every feature sums the same rows)
       const double parent_obj = G * G / (H + l2 + 1e-12);
       double best_gain = 1e-7;
       int best_f = -1, best_b = -1;
+      int64_t best_cl = 0;
       for (int f = 0; f < nfeats; ++f) {
         double GL = 0.0, HL = 0.0;
         int64_t CL = 0;
-        const double* fg = hgp + (size_t)f * max_bins;
-        const double* fh = hhp + (size_t)f * max_bins;
-        const int64_t* fc = hcp + (size_t)f * max_bins;
+        const HistCell* fp = hp + (size_t)f * max_bins;
         for (int b = 0; b < max_bins - 1; ++b) {
-          GL += fg[b]; HL += fh[b]; CL += fc[b];
+          GL += fp[b].g; HL += fp[b].h; CL += fp[b].c;
           const int64_t CR = C - CL;
           if (CL < min_leaf || CR < min_leaf) continue;
           const double HR = H - HL, GR = G - GL;
           const double gain = GL * GL / (HL + l2 + 1e-12) +
                               GR * GR / (HR + l2 + 1e-12) - parent_obj;
-          if (gain > best_gain) { best_gain = gain; best_f = f; best_b = b; }
+          if (gain > best_gain) {
+            best_gain = gain; best_f = f; best_b = b; best_cl = CL;
+          }
         }
       }
       if (best_f < 0 || depth + 1 >= max_depth + 1) {
@@ -553,37 +599,73 @@ static void hgb_build_tree(const uint8_t* codes, int64_t nrows, int nfeats,
       tfeat[node] = best_f;
       tbin[node] = (uint8_t)best_b;
       const int left = 2 * node + 1, right = 2 * node + 2;
-      if (left < slots) { tfeat[left] = -1; tfeat[right] = -1; }
+      if (left < slots) {
+        tfeat[left] = -1;
+        tfeat[right] = -1;
+        next_active.push_back(left);
+        next_active.push_back(right);
+        // accumulate only the smaller child; the other subtracts
+        const int small = (best_cl <= C - best_cl) ? left : right;
+        next_build[small - next_first] = 1;
+      }
       any_split = true;
     }
     if (!any_split) break;
 
-    // re-assign rows through this level''s new splits
+    // prepare next-level storage
+    std::vector<int> next_id(next_count, -1);
+    for (size_t a = 0; a < next_active.size(); ++a)
+      next_id[next_active[a] - next_first] = (int)a;
+    std::vector<HistCell> next_hist;
+    const bool last_level = (depth + 1 == max_depth);
+    if (!last_level) {
+      next_hist.assign(next_active.size() * fb, HistCell{0.0, 0.0, 0});
+    } else {
+      leaf_g.assign(next_count, 0.0);
+      leaf_h.assign(next_count, 0.0);
+    }
+
+    // ONE fused pass: route each row through its node's new split and
+    // accumulate it into its child's histogram (or, at the deepest
+    // level, into the child leaf's g/h totals)
+    const int count = 1 << depth;
     for (int64_t i = 0; i < nrows; ++i) {
       const int32_t node = assign[i];
       if (node < first || node >= first + count) continue;
-      if (tfeat[node] >= 0) {
-        const uint8_t c = codes[i * nfeats + tfeat[node]];
-        assign[i] = (c <= tbin[node]) ? 2 * node + 1 : 2 * node + 2;
+      if (tfeat[node] < 0) continue;
+      const uint8_t* row = codes + i * nfeats;
+      const uint8_t c = row[tfeat[node]];
+      const int child = (c <= tbin[node]) ? 2 * node + 1 : 2 * node + 2;
+      assign[i] = child;
+      const int child_in = child - next_first;
+      if (last_level) {
+        leaf_g[child_in] += g[i];
+        leaf_h[child_in] += h[i];
+      } else if (next_build[child_in]) {
+        const double gi = g[i], hi = h[i];
+        HistCell* hp = next_hist.data() +
+            (size_t)next_id[child_in] * fb;
+        for (int f = 0; f < nfeats; ++f) {
+          HistCell& cell = hp[f * max_bins + row[f]];
+          cell.g += gi;
+          cell.h += hi;
+          cell.c += 1;
+        }
       }
     }
 
-    // deepest level: finalize provisional leaves from fresh totals next
-    if (depth + 1 == max_depth) {
-      const int lfirst = (1 << (depth + 1)) - 1;
-      const int lcount = 1 << (depth + 1);
-      std::vector<double> lg(lcount, 0.0), lh(lcount, 0.0);
-      for (int64_t i = 0; i < nrows; ++i) {
-        const int32_t node = assign[i];
-        if (node >= lfirst && node < lfirst + lcount) {
-          lg[node - lfirst] += g[i];
-          lh[node - lfirst] += h[i];
-        }
-      }
-      for (int n = 0; n < lcount; ++n)
-        if (tfeat[lfirst + n] == -1)
-          tval[lfirst + n] = hgb_leaf(lg[n], lh[n], l2, lr);
+    if (last_level) {
+      for (int n = 0; n < next_count; ++n)
+        if (next_first + n < slots && tfeat[next_first + n] == -1)
+          tval[next_first + n] = hgb_leaf(leaf_g[n], leaf_h[n], l2, lr);
+      break;
     }
+    parent_hist = std::move(hist);
+    parent_id = std::move(id_in_level);
+    hist = std::move(next_hist);
+    id_in_level = std::move(next_id);
+    build_flag = std::move(next_build);
+    active = std::move(next_active);
   }
 
   // update scores: every row adds its leaf''s value
@@ -633,17 +715,25 @@ void* lo_hgb_train(const uint8_t* codes, int64_t nrows, int nfeats,
   std::vector<int32_t> assign(nrows);
   std::vector<double> probs;  // multiclass: nrows x K, one softmax/iter
   if (nclass > 2) probs.resize((size_t)nrows * K);
+  const size_t fb = (size_t)nfeats * max_bins;
+  std::vector<HistCell> root_hist;
 
   for (int it = 0; it < n_iter; ++it) {
     if (nclass == 2) {
+      root_hist.assign(fb, HistCell{0.0, 0.0, 0});
       for (int64_t i = 0; i < nrows; ++i) {
         const double p = 1.0 / (1.0 + std::exp(-scores[i]));
-        g[i] = p - (double)y[i];
-        h[i] = std::max(p * (1.0 - p), 1e-12);
+        const double gi = p - (double)y[i];
+        const double hi = std::max(p * (1.0 - p), 1e-12);
+        g[i] = gi;
+        h[i] = hi;
+        hgb_root_add(root_hist.data(), codes + i * nfeats, nfeats,
+                     max_bins, gi, hi);
       }
       hgb_build_tree(codes, nrows, nfeats, g.data(), h.data(),
                      scores.data(), 1, max_depth, max_bins, lr, l2,
-                     min_samples_leaf, m->feat, m->bin, m->val, assign);
+                     min_samples_leaf, m->feat, m->bin, m->val, assign,
+                     root_hist);
       ++m->n_trees;
     } else {
       // standard softmax boosting: ONE softmax per iteration drives
@@ -662,14 +752,20 @@ void* lo_hgb_train(const uint8_t* codes, int64_t nrows, int nfeats,
         for (int j = 0; j < K; ++j) p[j] /= denom;
       }
       for (int k = 0; k < K; ++k) {
+        root_hist.assign(fb, HistCell{0.0, 0.0, 0});
         for (int64_t i = 0; i < nrows; ++i) {
           const double pk = probs[i * K + k];
-          g[i] = pk - (y[i] == k ? 1.0 : 0.0);
-          h[i] = std::max(pk * (1.0 - pk), 1e-12);
+          const double gi = pk - (y[i] == k ? 1.0 : 0.0);
+          const double hi = std::max(pk * (1.0 - pk), 1e-12);
+          g[i] = gi;
+          h[i] = hi;
+          hgb_root_add(root_hist.data(), codes + i * nfeats, nfeats,
+                       max_bins, gi, hi);
         }
         hgb_build_tree(codes, nrows, nfeats, g.data(), h.data(),
                        scores.data() + k, K, max_depth, max_bins, lr, l2,
-                       min_samples_leaf, m->feat, m->bin, m->val, assign);
+                       min_samples_leaf, m->feat, m->bin, m->val, assign,
+                       root_hist);
         ++m->n_trees;
       }
     }
