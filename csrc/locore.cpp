@@ -130,28 +130,66 @@ inline const char* parse_record(const char* p, const char* limit,
   return limit;
 }
 
-// strtod on a bounded view; empty/whitespace-only cells are "missing"
-// (NaN, still numeric — matches the Python fallback's strip-then-empty).
-inline bool parse_float(const Cell& cell, double* out) {
-  bool all_ws = true;
-  for (int64_t i = 0; i < cell.len; ++i) {
-    if (cell.ptr[i] != ' ' && cell.ptr[i] != '\t') {
-      all_ws = false;
-      break;
+// Fast decimal path (Clinger): for plain [+-]ddd[.ddd] cells with at
+// most 15 mantissa digits and at most 22 fractional digits, mantissa
+// and 10^frac are both exact doubles, so ONE IEEE division yields the
+// correctly rounded value — bit-identical to strtod, ~6x cheaper (no
+// copy, no locale machinery). Everything else (exponents, inf/nan,
+// hex, long digit strings) falls back to bounded strtod.
+static const double kPow10[23] = {
+    1e0,  1e1,  1e2,  1e3,  1e4,  1e5,  1e6,  1e7,
+    1e8,  1e9,  1e10, 1e11, 1e12, 1e13, 1e14, 1e15,
+    1e16, 1e17, 1e18, 1e19, 1e20, 1e21, 1e22};
+
+inline bool fast_decimal(const char* s, int64_t len, double* out) {
+  int64_t i = 0;
+  bool neg = false;
+  if (i < len && (s[i] == '+' || s[i] == '-')) {
+    neg = (s[i] == '-');
+    ++i;
+  }
+  uint64_t mant = 0;
+  int digits = 0, frac = 0;
+  bool seen_dot = false, any_digit = false;
+  for (; i < len; ++i) {
+    const char c = s[i];
+    if (c >= '0' && c <= '9') {
+      if (digits >= 15) return false;  // strtod for full precision
+      mant = mant * 10 + (uint64_t)(c - '0');
+      if (mant) ++digits;  // leading zeros don't consume the budget
+      any_digit = true;
+      if (seen_dot) ++frac;
+    } else if (c == '.' && !seen_dot) {
+      seen_dot = true;
+    } else {
+      return false;
     }
   }
-  if (all_ws) {
+  if (!any_digit || frac > 22) return false;
+  const double v = (double)mant / kPow10[frac];
+  *out = neg ? -v : v;
+  return true;
+}
+
+// numeric cell parse; empty/whitespace-only cells are "missing"
+// (NaN, still numeric — matches the Python fallback's strip-then-empty).
+inline bool parse_float(const Cell& cell, double* out) {
+  const char* p = cell.ptr;
+  int64_t len = cell.len;
+  while (len > 0 && (*p == ' ' || *p == '\t')) { ++p; --len; }
+  while (len > 0 && (p[len - 1] == ' ' || p[len - 1] == '\t')) --len;
+  if (len == 0) {
     *out = std::nan("");
     return true;
   }
-  if (cell.len >= 64) return false;
+  if (fast_decimal(p, len, out)) return true;
+  if (len >= 64) return false;
   char tmp[64];
-  std::memcpy(tmp, cell.ptr, cell.len);
-  tmp[cell.len] = '\0';
+  std::memcpy(tmp, p, len);
+  tmp[len] = '\0';
   char* end = nullptr;
   double v = std::strtod(tmp, &end);
-  while (end && *end == ' ') ++end;
-  if (end != tmp + cell.len) return false;
+  if (end != tmp + len) return false;
   *out = v;
   return true;
 }
@@ -642,21 +680,16 @@ static void hgb_build_tree(const uint8_t* codes, int64_t nrows, int nfeats,
         leaf_g[child_in] += g[i];
         leaf_h[child_in] += h[i];
       } else if (next_build[child_in]) {
-        const double gi = g[i], hi = h[i];
-        HistCell* hp = next_hist.data() +
-            (size_t)next_id[child_in] * fb;
-        for (int f = 0; f < nfeats; ++f) {
-          HistCell& cell = hp[f * max_bins + row[f]];
-          cell.g += gi;
-          cell.h += hi;
-          cell.c += 1;
-        }
+        hgb_root_add(next_hist.data() + (size_t)next_id[child_in] * fb,
+                     row, nfeats, max_bins, g[i], h[i]);
       }
     }
 
     if (last_level) {
+      // next_first + next_count - 1 == slots - 1 at the last level,
+      // so every slot index here is in bounds by construction
       for (int n = 0; n < next_count; ++n)
-        if (next_first + n < slots && tfeat[next_first + n] == -1)
+        if (tfeat[next_first + n] == -1)
           tval[next_first + n] = hgb_leaf(leaf_g[n], leaf_h[n], l2, lr);
       break;
     }

@@ -233,3 +233,44 @@ def test_chunked_native_ingest_large(tmp_config, tmp_path):
     rows = ctx.catalog.read_rows("big", skip=n - 1)
     assert rows[0]["x"] == n - 1 + 0.5
     assert rows[0]["label"] == f"row{(n - 1) % 7}"
+
+
+def test_csv_float_fast_path_bit_identical_to_strtod():
+    """The parser's Clinger fast path (plain decimals, <=15 digits)
+    must produce BIT-IDENTICAL doubles to the strtod fallback /
+    Python float(): mantissa and 10^frac are both exact, so the one
+    division is correctly rounded. Exotic forms (exponents, inf/nan,
+    16+ digits, hex) take the fallback and must also match."""
+    import random
+
+    rng = random.Random(7)
+    values = []
+    # plain decimals across magnitudes and digit counts (fast path)
+    for _ in range(500):
+        digits = rng.randint(1, 15)
+        frac = rng.randint(0, min(digits, 12))
+        s = "".join(rng.choice("0123456789") for _ in range(digits))
+        if frac:
+            s = (s[:-frac] or "0") + "." + s[-frac:]
+        if rng.random() < 0.5:
+            s = "-" + s
+        if rng.random() < 0.2:
+            s = " " + s + " "
+        values.append(s)
+    # fallback forms
+    values += ["1e10", "-2.5E-3", "inf", "-inf", "nan",
+               "0.12345678901234567890", "9" * 17,
+               "123456789012345678",
+               "+4.25", "000123.5", ".5", "5.", "0", "-0.0"]
+    csv = "x\n" + "\n".join(values) + "\n"
+    cols, types = ops.parse_csv(csv.encode())
+    assert types == [0], types
+    expected = [float(v.strip()) for v in values]
+    got = list(cols[0])
+    assert len(got) == len(expected)
+    for s, e, g in zip(values, expected, got):
+        if math.isnan(e):
+            assert math.isnan(g), s
+        else:
+            assert g == e and math.copysign(1, g) == \
+                math.copysign(1, e), (s, e, g)
