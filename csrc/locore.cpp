@@ -812,6 +812,10 @@ void lo_hgb_predict(void* model, const uint8_t* codes, int64_t nrows,
   HgbModel* m = (HgbModel*)model;
   const int K = (m->nclass == 2) ? 1 : m->nclass;
   const int slots = m->slots_per_tree;
+  // tree-outer on purpose: the serially-dependent node walk dominates
+  // (codes re-streaming is ~30 ms for 2M x 5 rows), and per-tree
+  // branch patterns predict far better when one tree processes all
+  // rows before the next (row-outer measured 40% SLOWER here)
   for (int64_t i = 0; i < nrows; ++i)
     for (int k = 0; k < K; ++k) out[i * K + k] = m->bases[k];
   for (int t = 0; t < m->n_trees; ++t) {
