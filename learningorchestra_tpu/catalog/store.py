@@ -574,6 +574,14 @@ class DatasetWriter:
         self._writer: Optional[pq.ParquetWriter] = None
         self._path: Optional[str] = None
         self._rows = 0
+        # small ingest chunks coalesce into one row group per
+        # ~LO_PARQUET_GROUP_ROWS rows: 70+ tiny row groups per file
+        # dominate write time (each flush pays encoder + page + footer
+        # bookkeeping) and slow every later scan
+        self._group_rows = int(os.environ.get(
+            "LO_PARQUET_GROUP_ROWS", "262144"))
+        self._pending: List[pa.Table] = []
+        self._pending_rows = 0
 
     def write_batch(self, batch) -> None:
         if isinstance(batch, dict):
@@ -590,8 +598,31 @@ class DatasetWriter:
             self._path = os.path.join(
                 self._dir, f"part-{self._part:05d}.parquet")
             self._writer = pq.ParquetWriter(self._path, batch.schema)
-        self._writer.write_table(batch)
+        elif batch.schema != self._schema:
+            # fail at the offending write_batch (as the un-buffered
+            # writer did), not later at flush where attribution is lost
+            raise ValueError(
+                f"batch schema {batch.schema.names} does not match "
+                f"this writer session's schema {self._schema.names}; "
+                f"heterogeneous appends need a new writer session")
+        self._pending.append(batch)
+        self._pending_rows += batch.num_rows
         self._rows += batch.num_rows
+        if self._pending_rows >= self._group_rows:
+            self._flush_pending()
+
+    def _flush_pending(self) -> None:
+        if not self._pending:
+            return
+        table = (self._pending[0] if len(self._pending) == 1
+                 else pa.concat_tables(self._pending))
+        # buffer clears only AFTER the write lands: a transient write
+        # failure (ENOSPC, remote fs) must surface to the caller with
+        # the rows still buffered, not silently drop a row group while
+        # rows_written keeps counting it
+        self._writer.write_table(table)
+        self._pending = []
+        self._pending_rows = 0
 
     @property
     def rows_written(self) -> int:
@@ -602,8 +633,14 @@ class DatasetWriter:
 
     def close(self) -> None:
         if self._writer is not None:
-            self._writer.close()
-            self._writer = None
+            try:
+                self._flush_pending()
+            finally:
+                # the footer write must happen even if the final flush
+                # fails, or every previously flushed row group in the
+                # part becomes unreadable (no parquet footer)
+                self._writer.close()
+                self._writer = None
 
     def __enter__(self) -> "DatasetWriter":
         return self
