@@ -1,0 +1,63 @@
+#!/usr/bin/env bash
+# Round-5 follow-up measurement queue: waits for the round-4 runner
+# (scripts/measure_r4.sh) to finish its list, then lands the rows the
+# round-5 features added. Same discipline: bounded probe before every
+# experiment, resumable outputs, one probe timeout per wedge.
+#
+#   bash scripts/measure_r5.sh [OUT_DIR]
+set -u
+cd "$(dirname "$0")/.."
+OUT="${1:-queued_results}"
+mkdir -p "$OUT"
+PROBE_INTERVAL="${LO_PROBE_INTERVAL:-120}"
+PHASE_TIMEOUT="${LO_PHASE_TIMEOUT:-1500}"
+
+probe() {
+  timeout 90 python - <<'EOF' >/dev/null 2>&1
+import faulthandler
+faulthandler.dump_traceback_later(80, exit=True)
+import jax
+assert any(d.platform != "cpu" for d in jax.devices())
+import jax.numpy as jnp
+assert float(jnp.ones((8, 8)).sum()) == 64.0
+EOF
+}
+
+wait_for_chip() {
+  until probe; do
+    echo "$(date -u +%FT%TZ) chip not answering; retry in ${PROBE_INTERVAL}s"
+    sleep "$PROBE_INTERVAL"
+  done
+}
+
+run() {  # run NAME ENV... -- ARGS...
+  local name="$1"; shift
+  if [ -s "$OUT/$name.out" ] && grep -q '"ok": true' "$OUT/$name.out"; then
+    echo "$(date -u +%FT%TZ) [$name] already done, skipping"
+    return
+  fi
+  local envs=()
+  while [ "$1" != "--" ]; do envs+=("$1"); shift; done
+  shift
+  wait_for_chip
+  echo "$(date -u +%FT%TZ) [$name] env ${envs[*]-} bench $*"
+  env "${envs[@]}" timeout "$PHASE_TIMEOUT" \
+      python bench.py "$@" > "$OUT/$name.out" 2> "$OUT/$name.err"
+  echo "exit=$? $(tail -c 400 "$OUT/$name.out")"
+}
+
+# never run two chip users at once: wait for the r4 runner to exit
+while pgrep -f "measure_r4.sh" >/dev/null 2>&1; do
+  echo "$(date -u +%FT%TZ) waiting for measure_r4.sh to finish"
+  sleep 120
+done
+
+# mesh-parallel Builder on silicon (jax LR on the chip vs host sklearn)
+run builder_mesh_tpu LO_NOOP=1 -- --phase builder_mesh
+# MQA decode (kv=1): the full KV-cache-shrink story next to kv=2
+run gen_mqa LO_BENCH_GEN_KV=1 -- --phase gen
+# combined d=512 closing attempt: fused head (default) + fused_proj +
+# dots-remat + batch 32 in ONE config
+run tlm_combo LO_TLM_FUSED_PROJ=1 LO_TLM_REMAT=dots \
+    LO_BENCH_TLM_BATCH=32 -- --phase tlm
+echo "$(date -u +%FT%TZ) r5 follow-up queue done — results in $OUT/"
