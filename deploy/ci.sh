@@ -1,0 +1,22 @@
+#!/usr/bin/env bash
+# CI gate: repo self-lint, then the tier-1 test suite.
+#
+# Usage: deploy/ci.sh            (from anywhere; paths are self-rooted)
+# Env:   LO_CI_TIMEOUT  seconds for the tier-1 run (default 870)
+
+set -euo pipefail
+
+REPO="$(cd "$(dirname "${BASH_SOURCE[0]}")/.." && pwd)"
+cd "$REPO"
+
+echo "== selflint =="
+python scripts/selflint.py
+
+echo "== tier-1 tests =="
+TIMEOUT="${LO_CI_TIMEOUT:-870}"
+timeout -k 10 "$TIMEOUT" env JAX_PLATFORMS=cpu \
+    python -m pytest tests/ -q -m 'not slow' \
+    --continue-on-collection-errors \
+    -p no:cacheprovider -p no:xdist -p no:randomly
+
+echo "== ci: OK =="

@@ -1,0 +1,42 @@
+"""Static pre-flight analysis (net-new subsystem, no reference
+counterpart — the reference validates requests with shallow
+importlib/getattr reflection only and lets shape, dtype, and
+sandbox-escape errors surface minutes later inside an async job).
+
+Two passes, both producing structured :class:`Finding` records:
+
+- :mod:`code_lint` — AST screening of user code (Function service and
+  the ``#`` DSL) before any ``exec``: forbidden imports, forbidden
+  calls, dunder traversal, and advisory TPU-hazard warnings.
+- :mod:`preflight` — GSPMD-style static shape/dtype inference over a
+  submitted pipeline spec via ``jax.eval_shape`` on
+  ``ShapeDtypeStruct``s derived from catalog metadata, so a
+  shape-mismatched spec is rejected with HTTP 406 at submit time
+  instead of failing inside the job.
+
+Both passes are gated by ``Config.preflight`` and NEVER false-reject:
+anything the analyzer cannot model is bypassed, not failed.
+"""
+
+from learningorchestra_tpu.analysis.findings import (  # noqa: F401
+    Finding,
+    LintRejected,
+    SEVERITY_ERROR,
+    SEVERITY_WARNING,
+    error_findings,
+    findings_to_dicts,
+    warning_findings,
+)
+from learningorchestra_tpu.analysis.code_lint import (  # noqa: F401
+    DANGEROUS_DUNDERS,
+    assert_code_safe,
+    lint_code,
+)
+from learningorchestra_tpu.analysis.preflight import (  # noqa: F401
+    RESULT_SHAPES_FIELD,
+    check_builder,
+    check_execution,
+    check_model,
+    lint_parameter_code,
+    result_shapes,
+)

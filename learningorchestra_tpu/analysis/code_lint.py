@@ -1,0 +1,246 @@
+"""AST safety lint for user-supplied code.
+
+Screens Function-service code and ``#``-DSL expressions BEFORE any
+``exec`` (reference executes blind: code_execution.py:169-196,
+binary_execution.py:52-64). The rules mirror the sandbox's runtime
+jail (:mod:`learningorchestra_tpu.services.sandbox`) so a request that
+would die inside the job is rejected at submit time with the finding
+list in the 406 body — and escape attempts are refused even in the
+``trusted``/``restricted`` modes whose runtime jail is weaker.
+
+Rules (ids are stable; see docs/ANALYSIS.md):
+
+- ``syntax-error`` — code does not parse. Error in every mode.
+- ``forbidden-import`` — import outside the sandbox module whitelist
+  (or a relative import). Error under ``subprocess``/``restricted``
+  where the runtime would refuse it anyway; advisory warning under
+  ``trusted``.
+- ``forbidden-call`` — call to an exec-family builtin the sandbox
+  withholds (``eval``, ``exec``, ``__import__``, ``open``, …). Same
+  mode policy as ``forbidden-import``.
+- ``dunder-attribute`` — attribute traversal through an
+  escape-capable dunder (``__class__``, ``__subclasses__``,
+  ``__globals__``, …). Error in EVERY mode: there is no legitimate
+  use in pipeline code and it defeats the in-process jails.
+- ``dunder-string-smuggle`` — the same dunders smuggled as constant
+  strings through ``getattr``/``setattr``/``delattr``. Error in every
+  mode (dynamic names are caught at runtime by the restricted-mode
+  guard in sandbox.py).
+- ``tpu-sync-in-loop`` — ``.block_until_ready()`` inside a Python
+  loop (forces a device round-trip per iteration). Warning.
+- ``tpu-traced-branch`` — Python ``if``/``while`` on an argument of a
+  jitted function (traced values have no runtime truth value; this
+  either fails under jit or silently bakes in one branch). Warning.
+
+Anything the linter cannot model is permitted, never rejected — the
+rules above only fire on positively identified constructs.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Optional
+
+from learningorchestra_tpu.analysis.findings import (
+    Finding,
+    LintRejected,
+    SEVERITY_ERROR,
+    SEVERITY_WARNING,
+    error_findings,
+)
+from learningorchestra_tpu.services.sandbox import (
+    DANGEROUS_DUNDERS,  # noqa: F401 — re-exported; single source of truth
+    _ALLOWED_MODULE_PREFIXES,
+    _SHIMMED_MODULES,
+)
+
+# exec-family builtins the sandbox withholds (_SAFE_BUILTIN_NAMES);
+# calling them is either a NameError-to-be (restricted/subprocess) or
+# an open door (trusted)
+_FORBIDDEN_CALLS = frozenset({
+    "eval", "exec", "__import__", "open", "compile", "globals",
+    "locals", "breakpoint", "input",
+})
+
+# getattr/setattr/delattr can smuggle a dunder as a string
+_ATTR_SMUGGLERS = frozenset({"getattr", "setattr", "delattr"})
+
+_JIT_NAMES = frozenset({"jit", "pjit"})
+
+
+def module_allowed(name: str) -> bool:
+    """Mirror of sandbox._restricted_import's whitelist decision."""
+    root = name.split(".")[0]
+    if root in _SHIMMED_MODULES or name in _SHIMMED_MODULES:
+        return True
+    return any(root == p for p in _ALLOWED_MODULE_PREFIXES)
+
+
+def _is_jit_decorator(node: ast.expr) -> bool:
+    """``@jit`` / ``@jax.jit`` / ``@partial(jax.jit, ...)`` etc."""
+    if isinstance(node, ast.Name):
+        return node.id in _JIT_NAMES
+    if isinstance(node, ast.Attribute):
+        return node.attr in _JIT_NAMES
+    if isinstance(node, ast.Call):
+        if _is_jit_decorator(node.func):
+            return True
+        return any(_is_jit_decorator(a) for a in node.args)
+    return False
+
+
+class _Walker(ast.NodeVisitor):
+    def __init__(self, blocking_severity: str):
+        self.findings: List[Finding] = []
+        # severity of forbidden-import/forbidden-call in this mode
+        self._blocking = blocking_severity
+        self._loop_depth = 0
+        # argument names of the innermost jitted function, if any
+        self._jit_args: List[set] = []
+
+    def _add(self, severity: str, rule: str, node: ast.AST,
+             message: str) -> None:
+        loc = f"line {getattr(node, 'lineno', '?')}:" \
+              f"{getattr(node, 'col_offset', '?')}"
+        self.findings.append(Finding(severity, rule, loc, message))
+
+    # -- imports -------------------------------------------------------
+    def visit_Import(self, node: ast.Import) -> None:
+        for alias in node.names:
+            if not module_allowed(alias.name):
+                self._add(self._blocking, "forbidden-import", node,
+                          f"import of {alias.name!r} is outside the "
+                          f"sandbox module whitelist")
+        self.generic_visit(node)
+
+    def visit_ImportFrom(self, node: ast.ImportFrom) -> None:
+        if node.level != 0:
+            self._add(self._blocking, "forbidden-import", node,
+                      "relative imports are not allowed in sandboxed "
+                      "code")
+        elif node.module and not module_allowed(node.module):
+            self._add(self._blocking, "forbidden-import", node,
+                      f"import from {node.module!r} is outside the "
+                      f"sandbox module whitelist")
+        self.generic_visit(node)
+
+    # -- calls ---------------------------------------------------------
+    def visit_Call(self, node: ast.Call) -> None:
+        func = node.func
+        if isinstance(func, ast.Name):
+            if func.id in _FORBIDDEN_CALLS:
+                self._add(self._blocking, "forbidden-call", node,
+                          f"call to {func.id}() is not available in "
+                          f"sandboxed code")
+            if func.id in _ATTR_SMUGGLERS:
+                self._check_smuggle(node, func.id)
+        if isinstance(func, ast.Attribute) and \
+                func.attr == "block_until_ready" and self._loop_depth:
+            self._add(SEVERITY_WARNING, "tpu-sync-in-loop", node,
+                      ".block_until_ready() inside a Python loop "
+                      "forces a host-device sync every iteration; "
+                      "hoist it after the loop")
+        self.generic_visit(node)
+
+    def _check_smuggle(self, node: ast.Call, fname: str) -> None:
+        if len(node.args) < 2:
+            return
+        name_arg = node.args[1]
+        if isinstance(name_arg, ast.Constant) and \
+                isinstance(name_arg.value, str) and \
+                name_arg.value in DANGEROUS_DUNDERS:
+            self._add(SEVERITY_ERROR, "dunder-string-smuggle", node,
+                      f"{fname}(..., {name_arg.value!r}) smuggles an "
+                      f"escape-capable dunder attribute by name")
+
+    # -- attribute traversal -------------------------------------------
+    def visit_Attribute(self, node: ast.Attribute) -> None:
+        if node.attr in DANGEROUS_DUNDERS:
+            self._add(SEVERITY_ERROR, "dunder-attribute", node,
+                      f"attribute access .{node.attr} reaches "
+                      f"interpreter internals and is refused in user "
+                      f"code")
+        self.generic_visit(node)
+
+    # -- loops / jitted branches ---------------------------------------
+    def _visit_loop(self, node: ast.AST) -> None:
+        self._loop_depth += 1
+        self.generic_visit(node)
+        self._loop_depth -= 1
+
+    def visit_For(self, node: ast.For) -> None:
+        self._visit_loop(node)
+
+    def visit_While(self, node: ast.While) -> None:
+        if self._jit_args:
+            self._check_traced_test(node, node.test)
+        self._visit_loop(node)
+
+    def visit_If(self, node: ast.If) -> None:
+        if self._jit_args:
+            self._check_traced_test(node, node.test)
+        self.generic_visit(node)
+
+    def _check_traced_test(self, node: ast.AST, test: ast.expr) -> None:
+        args = self._jit_args[-1]
+        names = {n.id for n in ast.walk(test) if isinstance(n, ast.Name)}
+        traced = sorted(names & args)
+        if traced:
+            self._add(SEVERITY_WARNING, "tpu-traced-branch", node,
+                      f"Python branch on traced value(s) "
+                      f"{', '.join(traced)} inside a jitted function; "
+                      f"use jax.lax.cond/select instead")
+
+    def _visit_function(self, node) -> None:
+        jitted = any(_is_jit_decorator(d) for d in node.decorator_list)
+        if jitted:
+            a = node.args
+            names = {p.arg for p in (a.posonlyargs + a.args
+                                     + a.kwonlyargs)}
+            self._jit_args.append(names)
+            self.generic_visit(node)
+            self._jit_args.pop()
+        else:
+            self.generic_visit(node)
+
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        self._visit_function(node)
+
+    def visit_AsyncFunctionDef(self, node) -> None:
+        self._visit_function(node)
+
+
+def lint_code(code: str, mode: str = "subprocess",
+              filename: str = "<user-code>") -> List[Finding]:
+    """Lint ``code`` under sandbox trust level ``mode``
+    (``subprocess`` / ``restricted`` / ``trusted``). Returns all
+    findings; never raises on bad user code (a parse failure is
+    itself a finding)."""
+    try:
+        tree = ast.parse(code, filename=filename)
+    except SyntaxError as e:
+        loc = f"line {e.lineno or '?'}:{(e.offset or 1) - 1}"
+        return [Finding(SEVERITY_ERROR, "syntax-error", loc,
+                        f"code does not parse: {e.msg}")]
+    # trusted mode is the reference's trust model: imports/builtins
+    # outside the whitelist still WORK there, so they only warn;
+    # dunder traversal stays an error in every mode
+    blocking = SEVERITY_WARNING if mode == "trusted" else SEVERITY_ERROR
+    walker = _Walker(blocking_severity=blocking)
+    walker.visit(tree)
+    return walker.findings
+
+
+def assert_code_safe(code: str, mode: Optional[str] = None,
+                     filename: str = "<user-code>") -> List[Finding]:
+    """Lint and raise :class:`LintRejected` if any error-severity
+    finding fired; otherwise return the (warning-only) findings for
+    the caller to store with the job."""
+    if mode is None:
+        from learningorchestra_tpu.config import get_config
+
+        mode = get_config().sandbox_mode
+    findings = lint_code(code, mode=mode, filename=filename)
+    if error_findings(findings):
+        raise LintRejected(findings)
+    return findings

@@ -1,0 +1,65 @@
+"""Structured analysis findings.
+
+Every analyzer rule emits :class:`Finding` records instead of bare
+strings so rejections carry machine-readable *why*: the REST layer
+returns them in the 406 body and accepted-with-warnings jobs store
+them on the catalog document under ``"analysis"``.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, Iterable, List
+
+SEVERITY_ERROR = "error"
+SEVERITY_WARNING = "warning"
+
+
+@dataclass(frozen=True)
+class Finding:
+    """One analyzer observation.
+
+    ``severity`` — ``"error"`` (blocks the request) or ``"warning"``
+    (advisory, stored with the job).
+    ``rule`` — stable kebab-case rule id (see docs/ANALYSIS.md).
+    ``location`` — where in the analyzed artifact (``"line L:C"`` for
+    code, a field path for specs, ``""`` when not applicable).
+    ``message`` — human-readable explanation.
+    """
+
+    severity: str
+    rule: str
+    location: str
+    message: str
+
+    def to_dict(self) -> Dict[str, str]:
+        return {"severity": self.severity, "rule": self.rule,
+                "location": self.location, "message": self.message}
+
+
+def error_findings(findings: Iterable[Finding]) -> List[Finding]:
+    return [f for f in findings if f.severity == SEVERITY_ERROR]
+
+
+def warning_findings(findings: Iterable[Finding]) -> List[Finding]:
+    return [f for f in findings if f.severity == SEVERITY_WARNING]
+
+
+def findings_to_dicts(findings: Iterable[Finding]) -> List[Dict[str, str]]:
+    return [f.to_dict() for f in findings]
+
+
+class LintRejected(Exception):
+    """Raised when analysis finds error-severity problems. Carries the
+    full finding list (errors AND warnings) so the service layer can
+    return all of them in one 406 body."""
+
+    def __init__(self, findings: List[Finding], summary: str = ""):
+        self.findings = list(findings)
+        errs = error_findings(self.findings)
+        head = summary or (errs[0].message if errs
+                           else "analysis rejected the request")
+        if len(errs) > 1:
+            head = f"{head} (+{len(errs) - 1} more finding(s))"
+        super().__init__(head)
+        self.summary = head

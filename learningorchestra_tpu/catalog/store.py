@@ -222,11 +222,17 @@ class Catalog:
             raise CollectionNotFound(name)
         conn = self._conn()
         with conn:
-            cur = conn.execute(
+            # id allocation stays a single INSERT..SELECT (atomic under
+            # SQLite's one-writer rule); RETURNING needs sqlite >= 3.35,
+            # so the allocated id is read back inside the same write
+            # transaction instead (no other writer can interleave)
+            conn.execute(
                 "INSERT INTO docs (collection, id, body) "
                 "SELECT ?, COALESCE(MAX(id), 0) + 1, ? FROM docs "
-                "WHERE collection = ? RETURNING id",
+                "WHERE collection = ?",
                 (name, json.dumps({}), name))
+            cur = conn.execute(
+                "SELECT MAX(id) FROM docs WHERE collection = ?", (name,))
             new_id = cur.fetchone()[0]
             body = dict(body)
             body[D.ID] = new_id

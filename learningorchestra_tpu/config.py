@@ -95,6 +95,14 @@ class Config:
     # (LO_SANDBOX_MAX=restricted|trusted).
     sandbox_max_mode: str = dataclasses.field(
         default_factory=lambda: os.environ.get("LO_SANDBOX_MAX", ""))
+    # Pre-flight static analysis (analysis/): pipeline shape/dtype
+    # inference over submitted specs + AST safety lint of user code,
+    # rejecting provably-broken requests with 406 BEFORE a job
+    # document or accelerator lease exists. On by default; LO_PREFLIGHT=0
+    # restores submit-blind reference behavior (docs/ANALYSIS.md).
+    preflight: bool = dataclasses.field(
+        default_factory=lambda: os.environ.get(
+            "LO_PREFLIGHT", "1") not in ("0", "false", "no"))
     # subprocess-jail resource limits
     sandbox_cpu_seconds: int = dataclasses.field(
         default_factory=lambda: int(os.environ.get(

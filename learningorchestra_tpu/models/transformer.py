@@ -312,7 +312,7 @@ def _dispatch_attention(q, k, v, *, impl: str, causal: bool, mesh=None,
                      mesh_lib.TP if tp > 1 else None, None)
             # check_vma=False: pallas_call emits ShapeDtypeStructs with
             # no varying-mesh-axes info, which the vma checker rejects
-            fn = jax.shard_map(
+            fn = mesh_lib.shard_map(
                 lambda a, b_, c: attn_ops.flash_attention(
                     a, b_, c, causal=causal, window=window),
                 mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
@@ -707,7 +707,7 @@ def _fused_head_loss_sharded(out: FusedHeadOut, batch, weights,
             n_sum = jax.lax.psum(n_sum, row_axes)
         return loss_sum, ok_sum, n_sum
 
-    loss_sum, ok_sum, n_sum = jax.shard_map(
+    loss_sum, ok_sum, n_sum = mesh_lib.shard_map(
         local_loss, mesh=mesh,
         in_specs=(h_spec, t_spec, t_spec, k_spec),
         out_specs=(P(), P(), P()), check_vma=False)(

@@ -40,6 +40,10 @@ from jax.experimental import pallas as pl
 
 from jax.experimental.pallas import tpu as pltpu
 
+# jax >= 0.5 renamed TPUCompilerParams -> CompilerParams; accept both
+_CompilerParams = getattr(pltpu, "CompilerParams", None) or \
+    getattr(pltpu, "TPUCompilerParams")
+
 NEG_INF = -1e30
 
 
@@ -321,7 +325,7 @@ def _fwd_pallas(q, k, v, *, scale: float, causal: bool,
         # bh and the Q-tile axis own disjoint outputs/accumulator
         # streaks -> Mosaic may split them across megacore; the KV
         # stream axis accumulates and must stay sequential
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
     )(q, k, v)
@@ -529,7 +533,7 @@ def _bwd_pallas(q, k, v, o, lse, do, *, scale: float, causal: bool,
         out_shape=jax.ShapeDtypeStruct((bh, group * sq_p, d_p),
                                        jnp.float32),
         scratch_shapes=[pltpu.VMEM((block_q, d_p), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
     )(q, k, v, do, lse_l, delta_l)
@@ -554,7 +558,7 @@ def _bwd_pallas(q, k, v, o, lse, do, *, scale: float, causal: bool,
                    jax.ShapeDtypeStruct((bh, sk_p, d_p), jnp.float32)],
         scratch_shapes=[pltpu.VMEM((block_k, d_p), jnp.float32),
                         pltpu.VMEM((block_k, d_p), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
     )(q, k, v, do, lse_l, delta_l)

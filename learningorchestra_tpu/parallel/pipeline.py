@@ -80,8 +80,8 @@ def pipeline_apply_local(stage_fn: Callable[[Any, jax.Array], jax.Array],
 
     # scan carries become pp-varying (each stage computes different
     # values), so the initial values must be cast varying too
-    zero = lax.pcast(jnp.zeros_like(micro[0]), axis_name, to="varying")
-    out0 = lax.pcast(jnp.zeros_like(micro), axis_name, to="varying")
+    zero = mesh_lib.pcast(jnp.zeros_like(micro[0]), axis_name, to="varying")
+    out0 = mesh_lib.pcast(jnp.zeros_like(micro), axis_name, to="varying")
     (_, out), _ = lax.scan(tick, (zero, out0),
                            jnp.arange(m + _static_size(n) - 1))
     # only the last stage holds real outputs; replicate via masked psum
@@ -157,9 +157,9 @@ def pipeline_1f1b_local(stage_fn: Callable[[Any, jax.Array], jax.Array],
         # not all devices reach); reductions happen explicitly at the
         # end of the pass instead
         def one(x):
-            vma = getattr(jax.typeof(x), "vma", frozenset())
+            vma = getattr(mesh_lib.typeof(x), "vma", frozenset())
             missing = tuple(a for a in mesh_axes if a not in vma)
-            return lax.pcast(x, missing, to="varying") if missing else x
+            return mesh_lib.pcast(x, missing, to="varying") if missing else x
 
         return jax.tree_util.tree_map(one, v)
 
@@ -317,7 +317,7 @@ def pipeline_value_and_grad_1f1b(
     # block's flash attention), whose ShapeDtypeStructs carry no
     # varying-mesh-axes info — the vma checker rejects them (same as
     # the tp flash path and ring_flash)
-    fn = jax.shard_map(
+    fn = mesh_lib.shard_map(
         body, mesh=mesh,
         in_specs=(pspec, hspec, xspec, xspec),
         out_specs=(P(), pspec, hspec, xspec),
@@ -340,7 +340,7 @@ def pipeline_apply(stage_fn: Callable[[Any, jax.Array], jax.Array],
         stage_params)
     # check_vma=False: see value_and_grad_1f1b — stage bodies may
     # contain pallas_call
-    fn = jax.shard_map(
+    fn = mesh_lib.shard_map(
         functools.partial(pipeline_apply_local, stage_fn,
                           num_microbatches=num_microbatches,
                           axis_name=mesh_lib.PP),

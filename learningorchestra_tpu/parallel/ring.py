@@ -255,7 +255,7 @@ def ring_attention_sharded(q: jax.Array, k: jax.Array, v: jax.Array,
     # pallas_call emits ShapeDtypeStructs with no varying-mesh-axes
     # info, which the vma checker rejects (same as the tp flash path)
     extra = {"check_vma": False} if block_impl == "flash" else {}
-    fn = jax.shard_map(
+    fn = mesh_lib.shard_map(
         functools.partial(inner, axis_name=mesh_lib.SP,
                           causal=causal, scale=scale, window=window),
         mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec, **extra)

@@ -86,7 +86,7 @@ def ulysses_attention_sharded(q: jax.Array, k: jax.Array, v: jax.Array,
         raise ValueError("mesh has no 'sp' axis")
     data = mesh_lib.data_axes(mesh)
     spec = P(data if data else None, mesh_lib.SP, None, None)
-    fn = jax.shard_map(
+    fn = mesh_lib.shard_map(
         functools.partial(ulysses_attention, axis_name=mesh_lib.SP,
                           causal=causal, scale=scale, window=window),
         mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)

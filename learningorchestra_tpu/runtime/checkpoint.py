@@ -3,101 +3,219 @@
 The reference has NO mid-training checkpointing — persistence is the
 final artifact only, and a failed job is simply re-run from its stored
 parent (SURVEY §5: binary_executor utils.py:195-208, server.py:74-118).
-Here training jobs checkpoint per-epoch/step via Orbax and can resume,
-and pytree artifacts are serialized with msgpack (flax.serialization)
-instead of pickles.
+Here training jobs checkpoint per-epoch/step via Orbax on TPU and can
+resume, and pytree artifacts are serialized with msgpack
+(flax.serialization) instead of pickles.
+
+Off-TPU the step checkpoints use the same msgpack serialization
+instead of Orbax: on this jaxlib, tensorstore reads (Orbax restore)
+and XLA:CPU executables deserialized from jax's persistent
+compilation cache corrupt the glibc heap when they share a process
+("corrupted double-linked list" / SIGSEGV in the next jitted step),
+and once the cache is warm no amount of disabling-at-restore helps —
+the poisoned executable has already run during fit. Keeping
+tensorstore out of CPU processes entirely removes the conflict while
+the compilation cache stays on.
 """
 
 from __future__ import annotations
 
+import json
 import os
-from typing import Any, Optional
+import shutil
+from typing import Any, List, Optional
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 from flax import serialization
 
+_MSGPACK_NAME = "checkpoint.msgpack"
+
+
+def _use_orbax() -> bool:
+    return jax.default_backend() == "tpu"
+
+
+def _place_like(restored: Any, target: Any) -> Any:
+    """Put restored host leaves back onto the target's shardings."""
+
+    def _place(leaf, tgt):
+        if isinstance(tgt, jax.Array):
+            return jax.device_put(
+                jnp.asarray(leaf, tgt.dtype), tgt.sharding)
+        return leaf
+
+    return jax.tree_util.tree_map(_place, restored, target)
+
+
+class _NullAsyncManager:
+    """Orbax-shaped facade for the msgpack backend: saves are
+    synchronous, so finishing/closing are no-ops."""
+
+    def wait_until_finished(self) -> None:
+        pass
+
+    def close(self) -> None:
+        pass
+
 
 class Checkpointer:
-    """Thin Orbax wrapper: save(step, pytree) / latest() / restore."""
+    """save(step, pytree) / latest_step() / restore — Orbax on TPU,
+    msgpack files off-TPU (same directory-per-step layout)."""
 
     def __init__(self, directory: str, max_to_keep: int = 3):
-        import orbax.checkpoint as ocp
-
         self._dir = os.path.abspath(directory)
         os.makedirs(self._dir, exist_ok=True)
-        self._mgr = ocp.CheckpointManager(
-            self._dir,
-            options=ocp.CheckpointManagerOptions(
-                max_to_keep=max_to_keep, create=True),
-        )
+        self._max_to_keep = max_to_keep
+        if _use_orbax():
+            import orbax.checkpoint as ocp
+
+            self._mgr = ocp.CheckpointManager(
+                self._dir,
+                options=ocp.CheckpointManagerOptions(
+                    max_to_keep=max_to_keep, create=True),
+            )
+        else:
+            self._mgr = _NullAsyncManager()
+
+    # -- msgpack layout helpers ----------------------------------------
+    def _step_dirs(self) -> List[int]:
+        steps = []
+        for name in os.listdir(self._dir):
+            if not name.isdigit():
+                continue
+            if os.path.exists(
+                    os.path.join(self._dir, name, _MSGPACK_NAME)):
+                steps.append(int(name))
+        return sorted(steps)
+
+    def _step_path(self, step: int) -> str:
+        return os.path.join(self._dir, str(step), _MSGPACK_NAME)
 
     def save(self, step: int, tree: Any) -> None:
-        import orbax.checkpoint as ocp
+        if _use_orbax():
+            import orbax.checkpoint as ocp
 
-        self._mgr.save(step, args=ocp.args.StandardSave(tree))
+            self._mgr.save(step, args=ocp.args.StandardSave(tree))
+            return
+        host = jax.tree_util.tree_map(np.asarray, tree)
+        data = serialization.to_bytes(host)
+        step_dir = os.path.join(self._dir, str(step))
+        os.makedirs(step_dir, exist_ok=True)
+        path = self._step_path(step)
+        with open(path + ".tmp", "wb") as f:
+            f.write(data)
+        os.replace(path + ".tmp", path)
+        for old in self._step_dirs()[:-self._max_to_keep]:
+            shutil.rmtree(os.path.join(self._dir, str(old)),
+                          ignore_errors=True)
 
     def latest_step(self) -> Optional[int]:
-        return self._mgr.latest_step()
+        if _use_orbax():
+            return self._mgr.latest_step()
+        steps = self._step_dirs()
+        return steps[-1] if steps else None
 
     def restore(self, target: Any, step: Optional[int] = None) -> Any:
-        import orbax.checkpoint as ocp
-
         if step is None:
-            step = self._mgr.latest_step()
+            step = self.latest_step()
         if step is None:
             return None
-        return self._mgr.restore(step, args=ocp.args.StandardRestore(target))
+        if _use_orbax():
+            import orbax.checkpoint as ocp
+
+            return self._mgr.restore(
+                step, args=ocp.args.StandardRestore(target))
+        with open(self._step_path(step), "rb") as f:
+            data = f.read()
+        host_target = jax.tree_util.tree_map(np.asarray, target)
+        # raises ValueError on structural drift (missing/extra keys) —
+        # same contract the engine's migration fallback keys off
+        restored = serialization.from_bytes(host_target, data)
+        for got, want in zip(jax.tree_util.tree_leaves(restored),
+                             jax.tree_util.tree_leaves(host_target)):
+            if np.shape(got) != np.shape(want):
+                raise ValueError(
+                    f"checkpoint leaf shape {np.shape(got)} does not "
+                    f"match target shape {np.shape(want)}")
+        return _place_like(restored, target)
 
     def saved_metadata(self, step: Optional[int] = None) -> Any:
-        """The SAVED tree's structure as a pytree of ArrayMetadata
-        leaves (shape/dtype) — reads checkpoint metadata only, no
-        array data. This is the layout-drift discriminator: comparing
-        it structurally against the live state beats sniffing orbax's
-        mismatch message, which rewords across releases."""
+        """The SAVED tree's structure as a pytree whose leaves carry
+        shape/dtype — the layout-drift discriminator: comparing it
+        structurally against the live state beats sniffing a restore
+        error message, which rewords across releases."""
         if step is None:
-            step = self._mgr.latest_step()
+            step = self.latest_step()
         if step is None:
             return None
-        meta = self._mgr.item_metadata(step)
-        return getattr(meta, "tree", meta)
+        if _use_orbax():
+            meta = self._mgr.item_metadata(step)
+            return getattr(meta, "tree", meta)
+        with open(self._step_path(step), "rb") as f:
+            data = f.read()
+        # raw nested state dict; numpy leaves expose .shape/.dtype
+        return serialization.msgpack_restore(data)
 
     def restore_partial(self, target_subtree: Any,
                         step: Optional[int] = None) -> Any:
         """Restore only the subtrees named in ``target_subtree`` (e.g.
         params + step, skipping a drifted opt_state entirely, so the
-        stale optimizer arrays are never read into host memory). Uses
-        a fresh read-only manager: the instance manager's handler
-        registry is pinned to StandardRestore by the failed full
-        restore that precedes a migration."""
-        import orbax.checkpoint as ocp
-
+        stale optimizer arrays are never grafted into the new state)."""
         if step is None:
-            step = self._mgr.latest_step()
+            step = self.latest_step()
         if step is None:
             return None
+        if _use_orbax():
+            return self._restore_partial_orbax(target_subtree, step)
+        with open(self._step_path(step), "rb") as f:
+            raw = serialization.msgpack_restore(f.read())
+        if not isinstance(raw, dict):
+            return None
+        out = {}
+        for key, sub_target in target_subtree.items():
+            if key not in raw:
+                return None
+            out[key] = serialization.from_state_dict(sub_target, raw[key])
+        return out
+
+    def _restore_partial_orbax(self, target_subtree: Any,
+                               step: int) -> Any:
+        """Uses a fresh read-only manager: the instance manager's
+        handler registry is pinned to StandardRestore by the failed
+        full restore that precedes a migration."""
+        import orbax.checkpoint as ocp
+
         mgr = ocp.CheckpointManager(self._dir)
         try:
-            return mgr.restore(step, args=ocp.args.PyTreeRestore(
-                item=target_subtree, partial_restore=True))
+            # newer orbax spells partial restore `partial_restore=True`;
+            # 0.7.x uses the empty-transforms idiom (keys absent from
+            # ``item`` are skipped, present ones restore 1:1 — which
+            # requires explicit per-leaf restore_args)
+            try:
+                return mgr.restore(step, args=ocp.args.PyTreeRestore(
+                    item=target_subtree, partial_restore=True))
+            except TypeError:
+                restore_args = jax.tree_util.tree_map(
+                    lambda _: ocp.RestoreArgs(), target_subtree)
+                return mgr.restore(step, args=ocp.args.PyTreeRestore(
+                    item=target_subtree, restore_args=restore_args,
+                    transforms={}))
         finally:
             mgr.close()
 
     # -- sidecar progress metadata ------------------------------------
     # Epoch progress can't be reconstructed from the restored step when
     # a re-run reshapes the feed (different batch_size / data size), so
-    # the engine records it here next to the orbax steps.
+    # the engine records it here next to the step checkpoints.
     def save_meta(self, meta: dict) -> None:
-        import json
-
         path = os.path.join(self._dir, "progress.json")
         with open(path + ".tmp", "w") as f:
             json.dump(meta, f)
         os.replace(path + ".tmp", path)
 
     def load_meta(self) -> Optional[dict]:
-        import json
-
         path = os.path.join(self._dir, "progress.json")
         if not os.path.exists(path):
             return None

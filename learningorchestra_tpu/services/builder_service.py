@@ -29,6 +29,7 @@ from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
+from learningorchestra_tpu import analysis as A
 from learningorchestra_tpu.catalog import documents as D
 from learningorchestra_tpu.services import sandbox
 from learningorchestra_tpu.services import validators as V
@@ -197,6 +198,11 @@ class BuilderService:
             self._validator.existing_finished(eval_name)
         if not isinstance(classifiers, list) or not classifiers:
             raise V.HttpError(V.HTTP_NOT_ACCEPTABLE, "invalid classifier")
+        if code and self._ctx.config.preflight:
+            # modelingCode is exec'd per classifier in the sandbox —
+            # screen it once at submit (406 + findings on escapes)
+            V.run_preflight(A.check_builder(
+                code, mode=self._ctx.config.sandbox_mode))
         for c in classifiers:
             if c not in CLASSIFIER_NAMES:
                 raise V.HttpError(V.HTTP_NOT_ACCEPTABLE,

@@ -29,10 +29,12 @@ from __future__ import annotations
 
 from typing import Any, Dict, Optional, Tuple
 
+from learningorchestra_tpu import analysis as A
 from learningorchestra_tpu.catalog import documents as D
 from learningorchestra_tpu.services import validators as V
 
 NAME_FIELD = "name"
+ANALYSIS_FIELD = "analysis"
 MODEL_NAME_FIELD = "modelName"
 PARENT_NAME_FIELD = "parentName"
 DESCRIPTION_FIELD = "description"
@@ -110,13 +112,17 @@ class ExecutionService:
         self._validator.existing_finished(parent_name)
         root_meta = self.root_model_metadata(parent_name)
         self._validate_method(root_meta, method, method_parameters)
+        analysis = self._preflight(root_meta, method, method_parameters)
         type_string = D.normalize_type(f"{verb}/{tool}")
-        self._ctx.catalog.create_collection(name, type_string, {
+        extra = {
             D.PARENT_NAME_FIELD: parent_name,
             D.METHOD_FIELD: method,
             D.METHOD_PARAMETERS_FIELD: method_parameters,
             D.DESCRIPTION_FIELD: description,
-        })
+        }
+        if analysis:
+            extra[ANALYSIS_FIELD] = analysis
+        self._ctx.catalog.create_collection(name, type_string, extra)
         self._submit(name, type_string, parent_name, method,
                      method_parameters, description)
         return V.HTTP_CREATED, {
@@ -133,8 +139,10 @@ class ExecutionService:
         parent_name = meta[D.PARENT_NAME_FIELD]
         root_meta = self.root_model_metadata(parent_name)
         self._validate_method(root_meta, method, method_parameters)
+        analysis = self._preflight(root_meta, method, method_parameters)
         self._ctx.catalog.update_metadata(
             name, {D.METHOD_PARAMETERS_FIELD: method_parameters,
+                   ANALYSIS_FIELD: analysis,
                    D.FINISHED_FIELD: False})
         self._submit(name, meta[D.TYPE_FIELD], parent_name, method,
                      method_parameters, description)
@@ -155,6 +163,19 @@ class ExecutionService:
         return V.HTTP_SUCCESS, {"result": f"deleted {name}"}
 
     # ------------------------------------------------------------------
+    def _preflight(self, root_meta: Dict[str, Any], method: str,
+                   method_parameters: Dict[str, Any]) -> list:
+        """Static shape pre-flight + '#'-DSL lint BEFORE the job
+        document exists: a provably-broken spec 406s here and leaves
+        no ``finished: False`` orphan. Advisory findings come back for
+        the job document."""
+        if not self._ctx.config.preflight:
+            return []
+        findings = A.check_execution(
+            self._ctx.catalog, root_meta, method, method_parameters,
+            mode=self._ctx.config.sandbox_mode)
+        return V.run_preflight(findings)
+
     def _submit(self, name: str, type_string: str, parent_name: str,
                 method: str, method_parameters: Dict[str, Any],
                 description: str, only_if_idle: bool = False) -> None:
@@ -175,6 +196,7 @@ class ExecutionService:
             if type_string.startswith(_INSTANCE_RESULT_PREFIXES):
                 result = instance  # the fitted object is the artifact
             self._ctx.artifacts.save(result, name, type_string)
+            _record_result_shapes(self._ctx, name, result)
             summary = summarize_result(result)
             if summary is not None:
                 self._ctx.catalog.append_document(name, {"result": summary})
@@ -189,6 +211,20 @@ class ExecutionService:
             pool=type_string.split("/", 1)[0],
             only_if_idle=only_if_idle,
             max_retries=self._ctx.config.job_max_retries)
+
+
+def _record_result_shapes(ctx, name: str, result: Any) -> None:
+    """Record the result's static array shapes on the metadata doc so
+    later executions referencing ``$name``/``$name.key`` get shape
+    pre-flight (analysis/preflight.py). Best-effort: shape metadata
+    must never sink a finished job."""
+    try:
+        shapes = A.result_shapes(result)
+        if shapes:
+            ctx.catalog.update_metadata(
+                name, {A.RESULT_SHAPES_FIELD: shapes})
+    except Exception:  # noqa: BLE001
+        pass
 
 
 def _inject_epoch_log(ctx, name: str, instance: Any, method: str,

@@ -20,11 +20,13 @@ from __future__ import annotations
 
 from typing import Any, Dict, Optional, Tuple
 
+from learningorchestra_tpu import analysis as A
 from learningorchestra_tpu.catalog import documents as D
 from learningorchestra_tpu.services import sandbox
 from learningorchestra_tpu.services import validators as V
 
 NAME_FIELD = "name"
+ANALYSIS_FIELD = "analysis"
 DESCRIPTION_FIELD = "description"
 FUNCTION_FIELD = "function"
 FUNCTION_PARAMETERS_FIELD = "functionParameters"
@@ -91,13 +93,17 @@ class FunctionService:
         description = body.get(DESCRIPTION_FIELD, "")
         mode = resolve_sandbox_mode(self._ctx.config,
                                     body.get(SANDBOX_MODE_FIELD))
+        analysis = self._preflight(function, parameters, mode)
         type_string = f"function/{tool}"
-        self._ctx.catalog.create_collection(name, type_string, {
+        extra = {
             D.FUNCTION_FIELD: function,
             D.FUNCTION_PARAMETERS_FIELD: parameters,
             D.DESCRIPTION_FIELD: description,
             SANDBOX_MODE_FIELD: mode,  # boot requeue replays the same mode
-        })
+        }
+        if analysis:
+            extra[ANALYSIS_FIELD] = analysis
+        self._ctx.catalog.create_collection(name, type_string, extra)
         self._submit(name, type_string, function, parameters, description,
                      mode=mode)
         return V.HTTP_CREATED, {
@@ -113,10 +119,12 @@ class FunctionService:
         description = body.get(DESCRIPTION_FIELD, "")
         mode = resolve_sandbox_mode(self._ctx.config,
                                     body.get(SANDBOX_MODE_FIELD))
+        analysis = self._preflight(function, parameters, mode)
         self._ctx.catalog.update_metadata(
             name, {D.FUNCTION_FIELD: function,
                    D.FUNCTION_PARAMETERS_FIELD: parameters,
                    SANDBOX_MODE_FIELD: mode,
+                   ANALYSIS_FIELD: analysis,
                    D.FINISHED_FIELD: False})
         self._submit(name, meta[D.TYPE_FIELD], function, parameters,
                      description, mode=mode)
@@ -131,6 +139,21 @@ class FunctionService:
         return V.HTTP_SUCCESS, {"result": f"deleted {name}"}
 
     # ------------------------------------------------------------------
+    def _preflight(self, function: str, parameters: Dict[str, Any],
+                   mode: str) -> list:
+        """Submit-time AST lint of inline code and '#'-DSL parameters
+        (URL-referenced code is screened at run time by the sandbox's
+        own lint hook). 406 with findings on provable escapes."""
+        if not self._ctx.config.preflight:
+            return []
+        findings = []
+        if isinstance(function, str) and not function.startswith(
+                ("http://", "https://", "file://")):
+            findings.extend(A.lint_code(function, mode=mode,
+                                        filename="<function>"))
+        findings.extend(A.lint_parameter_code(parameters, mode))
+        return V.run_preflight(findings)
+
     def _submit(self, name: str, type_string: str, function: str,
                 parameters: Dict[str, Any], description: str,
                 mode: Optional[str] = None) -> None:
@@ -146,6 +169,13 @@ class FunctionService:
                     "variable")
             result = ctx_vars[RESPONSE_VARIABLE]
             self._ctx.artifacts.save(result, name, type_string)
+            try:
+                shapes = A.result_shapes(result)
+                if shapes:
+                    self._ctx.catalog.update_metadata(
+                        name, {A.RESULT_SHAPES_FIELD: shapes})
+            except Exception:  # noqa: BLE001 — advisory metadata only
+                pass
             self._ctx.catalog.append_document(
                 name, {D.FUNCTION_MESSAGE_FIELD: stdout})
             return result

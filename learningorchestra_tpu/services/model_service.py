@@ -20,10 +20,12 @@ from __future__ import annotations
 
 from typing import Any, Dict, Optional, Tuple
 
+from learningorchestra_tpu import analysis as A
 from learningorchestra_tpu.catalog import documents as D
 from learningorchestra_tpu.services import validators as V
 
 MODEL_NAME_FIELD = "modelName"
+ANALYSIS_FIELD = "analysis"
 DESCRIPTION_FIELD = "description"
 MODULE_PATH_FIELD = "modulePath"
 CLASS_FIELD = "class"
@@ -48,13 +50,18 @@ class ModelService:
         self._validator.not_duplicate(name)
         cls = self._validator.valid_class(module_path, class_name)
         self._validator.valid_class_parameters(cls, class_parameters)
+        analysis = self._preflight(module_path, class_name,
+                                   class_parameters)
         type_string = D.normalize_type(f"model/{tool}")
-        self._ctx.catalog.create_collection(name, type_string, {
+        extra = {
             D.MODULE_PATH_FIELD: module_path,
             D.CLASS_FIELD: class_name,
             D.CLASS_PARAMETERS_FIELD: class_parameters,
             D.DESCRIPTION_FIELD: description,
-        })
+        }
+        if analysis:
+            extra[ANALYSIS_FIELD] = analysis
+        self._ctx.catalog.create_collection(name, type_string, extra)
         self._submit(name, type_string, cls, class_parameters, description)
         return V.HTTP_CREATED, {
             "result": f"/api/learningOrchestra/v1/model/{tool}/{name}"}
@@ -68,9 +75,12 @@ class ModelService:
         cls = self._validator.valid_class(
             meta[D.MODULE_PATH_FIELD], meta[D.CLASS_FIELD])
         self._validator.valid_class_parameters(cls, class_parameters)
+        analysis = self._preflight(meta[D.MODULE_PATH_FIELD],
+                                   meta[D.CLASS_FIELD], class_parameters)
         type_string = meta[D.TYPE_FIELD]
         self._ctx.catalog.update_metadata(
             name, {D.CLASS_PARAMETERS_FIELD: class_parameters,
+                   ANALYSIS_FIELD: analysis,
                    D.FINISHED_FIELD: False})
         self._submit(name, type_string, cls, class_parameters, description)
         return V.HTTP_SUCCESS, {
@@ -83,6 +93,16 @@ class ModelService:
         return V.HTTP_SUCCESS, {"result": f"deleted model {name}"}
 
     # ------------------------------------------------------------------
+    def _preflight(self, module_path, class_name, class_parameters) -> list:
+        """Pre-flight the spec (406 on provable failure); returns the
+        advisory findings to store on the document."""
+        if not self._ctx.config.preflight:
+            return []
+        findings = A.check_model(module_path, class_name,
+                                 class_parameters,
+                                 mode=self._ctx.config.sandbox_mode)
+        return V.run_preflight(findings)
+
     def _submit(self, name: str, type_string: str, cls,
                 class_parameters: Dict[str, Any], description: str) -> None:
         def run():

@@ -55,6 +55,21 @@ _SHIMMED_MODULES = {
     "keras": "learningorchestra_tpu.models.tf_compat.keras",
 }
 
+# Dunders that reach interpreter internals from any object — the
+# building blocks of every namespace-jail escape chain (object ->
+# __class__ -> __subclasses__ -> ... -> __globals__['__builtins__']).
+# Source of truth for BOTH the static AST lint
+# (analysis/code_lint.py) and the runtime getattr/setattr/vars guards
+# below, so the two jails can never drift apart.
+DANGEROUS_DUNDERS = frozenset({
+    "__class__", "__bases__", "__base__", "__mro__", "__subclasses__",
+    "__globals__", "__closure__", "__code__", "__func__", "__self__",
+    "__dict__", "__getattribute__", "__getattr__", "__setattr__",
+    "__delattr__", "__init_subclass__", "__reduce__", "__reduce_ex__",
+    "__builtins__", "__import__", "__loader__", "__spec__",
+    "__subclasshook__", "__new__", "__getstate__", "__setstate__",
+})
+
 _SAFE_BUILTIN_NAMES = [
     "abs", "all", "any", "bool", "bytes", "callable", "chr", "dict",
     "divmod", "enumerate", "filter", "float", "format", "frozenset",
@@ -105,6 +120,37 @@ def _restricted_import(name: str, globals=None, locals=None, fromlist=(),
     return _builtins.__import__(name, globals, locals, fromlist, level)
 
 
+def _guarded_getattr(obj, name, *default):
+    """getattr that refuses dunder names smuggled as strings — the
+    static lint (analysis/code_lint.py) catches constant names;
+    this closes the dynamic case (``getattr(o, "__cl" + "ass__")``)."""
+    if isinstance(name, str) and name in DANGEROUS_DUNDERS:
+        raise AttributeError(
+            f"attribute {name!r} is blocked in sandboxed code")
+    return getattr(obj, name, *default)
+
+
+def _guarded_setattr(obj, name, value):
+    if isinstance(name, str) and name in DANGEROUS_DUNDERS:
+        raise AttributeError(
+            f"attribute {name!r} is blocked in sandboxed code")
+    return setattr(obj, name, value)
+
+
+def _guarded_vars(*obj):
+    # vars(x) is x.__dict__ by another name; no-argument vars() only
+    # reflects the (already-reachable) sandbox namespace
+    if obj:
+        raise TypeError(
+            "vars(object) is blocked in sandboxed code (it is "
+            "__dict__ access); use dataclasses.asdict or explicit "
+            "attributes")
+    import inspect
+
+    frame = inspect.currentframe().f_back
+    return frame.f_locals if frame is not None else {}
+
+
 def make_sandbox_globals(extra: Optional[Dict[str, Any]] = None,
                          trusted: bool = False) -> Dict[str, Any]:
     if trusted:
@@ -113,6 +159,9 @@ def make_sandbox_globals(extra: Optional[Dict[str, Any]] = None,
         safe = {n: getattr(_builtins, n) for n in _SAFE_BUILTIN_NAMES
                 if hasattr(_builtins, n)}
         safe["__import__"] = _restricted_import
+        safe["getattr"] = _guarded_getattr
+        safe["setattr"] = _guarded_setattr
+        safe["vars"] = _guarded_vars
         g = {"__builtins__": safe}
     g["__name__"] = "__lo_sandbox__"
     if extra:
@@ -135,6 +184,7 @@ def run_user_code(code: str,
                   trusted: bool = False,
                   inject_tensorflow: bool = True,
                   mode: Optional[str] = None,
+                  lint: bool = True,
                   ) -> Tuple[Dict[str, Any], str]:
     """Execute user code with injected parameter globals, capturing
     stdout (the Function-service contract: result left in a
@@ -146,6 +196,8 @@ def run_user_code(code: str,
     trusted). Returns (context_variables, captured_stdout).
     """
     resolved = _resolve_mode(trusted, mode)
+    if lint:
+        _lint_before_exec(code, resolved)
     if resolved == "subprocess":
         return _run_in_subprocess(code, parameters, inject_tensorflow)
     g = make_sandbox_globals(parameters, trusted=resolved == "trusted")
@@ -155,6 +207,27 @@ def run_user_code(code: str,
     with redirect_stdout(stdout):
         exec(compile(code, "<lo-user-code>", "exec"), g)  # noqa: S102
     return g, stdout.getvalue()
+
+
+def _lint_before_exec(code: str, mode: str) -> None:
+    """Last-line-of-defense AST screen gated on ``Config.preflight``
+    (services lint at submit time too, but URL-fetched code and
+    job-time-resolved ``#`` expressions only pass through here).
+    Raises :class:`analysis.LintRejected` on error findings."""
+    from learningorchestra_tpu.config import get_config
+
+    try:
+        enabled = get_config().preflight
+    except Exception:  # noqa: BLE001 — no config yet: stay safe, lint
+        enabled = True
+    if not enabled:
+        return
+    # imported lazily: analysis.code_lint imports this module's
+    # whitelist constants at its own import time
+    from learningorchestra_tpu.analysis import code_lint
+
+    code_lint.assert_code_safe(code, mode=mode,
+                               filename="<lo-user-code>")
 
 
 def eval_hash_expressions(exprs: List[str], trusted: bool = False,

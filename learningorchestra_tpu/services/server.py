@@ -254,7 +254,10 @@ class Api:
         try:
             out = self._route(method, path, params, body)
         except V.HttpError as e:
-            out = e.status, {"result": e.message}, "application/json"
+            payload = {"result": e.message}
+            if e.findings:
+                payload["analysis"] = e.findings
+            out = e.status, payload, "application/json"
         except Exception as e:  # noqa: BLE001
             out = 500, {"result": f"internal error: {e!r}"}, \
                 "application/json"

@@ -37,11 +37,32 @@ MESSAGE_INVALID_FIELD = "invalid field"
 MESSAGE_MISSING_FIELD = "missing required field"
 
 
+MESSAGE_ANALYSIS_REJECTED = "analysis rejected the request"
+
+
 class HttpError(Exception):
-    def __init__(self, status: int, message: str):
+    def __init__(self, status: int, message: str, findings=None):
         super().__init__(message)
         self.status = status
         self.message = message
+        # structured analyzer findings (list of dicts) for the
+        # response body, when the rejection came from pre-flight
+        self.findings = list(findings) if findings else []
+
+
+def run_preflight(findings) -> list:
+    """Gate a request on analyzer findings: raise a 406 carrying the
+    full structured finding list if any error-severity finding fired,
+    else return ALL findings as dicts for the caller to store on the
+    job document (warnings ride along with accepted jobs)."""
+    from learningorchestra_tpu import analysis as A
+
+    if A.error_findings(findings):
+        summary = A.LintRejected(findings).summary
+        raise HttpError(HTTP_NOT_ACCEPTABLE,
+                        f"{MESSAGE_ANALYSIS_REJECTED}: {summary}",
+                        findings=A.findings_to_dicts(findings))
+    return A.findings_to_dicts(findings)
 
 
 class RequestValidator:
@@ -145,7 +166,9 @@ class RequestValidator:
             return
         for key in parameters:
             if key not in names:
-                raise HttpError(HTTP_NOT_ACCEPTABLE, f"{message}: {key}")
+                raise HttpError(
+                    HTTP_NOT_ACCEPTABLE,
+                    f"{message}: {key} (accepted: {', '.join(names)})")
 
     # -- dataset fields -------------------------------------------------
     def valid_fields(self, dataset_name: str,

@@ -1,0 +1,81 @@
+#!/usr/bin/env python
+"""Repo self-lint: the framework's own source held to the standards
+it enforces on user code.
+
+Reuses the analysis AST machinery to flag, under
+``learningorchestra_tpu/``:
+
+- bare ``exec(`` / ``eval(`` calls anywhere except
+  ``services/sandbox.py`` (the one module allowed to execute user
+  code — everything else must route through it);
+- ``jax.debug.*`` calls and ``breakpoint()`` leftovers (debug
+  scaffolding that must not ship: ``jax.debug.print`` /
+  ``jax.debug.breakpoint`` silently serialize TPU programs).
+
+Exit 0 when clean, 1 with a finding listing otherwise. Run by
+``deploy/ci.sh`` before the tier-1 suite.
+"""
+
+from __future__ import annotations
+
+import ast
+import pathlib
+import sys
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+PACKAGE = REPO / "learningorchestra_tpu"
+
+# the one module that legitimately exec()s (user code, in the jail)
+EXEC_ALLOWED = {PACKAGE / "services" / "sandbox.py"}
+
+_EXEC_FAMILY = {"exec", "eval"}
+
+
+def _findings_for(path: pathlib.Path) -> list:
+    try:
+        tree = ast.parse(path.read_text(), filename=str(path))
+    except SyntaxError as e:
+        return [(path, e.lineno or 0, f"does not parse: {e.msg}")]
+    out = []
+    exec_ok = path in EXEC_ALLOWED
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        func = node.func
+        if isinstance(func, ast.Name):
+            if func.id in _EXEC_FAMILY and not exec_ok:
+                out.append((path, node.lineno,
+                            f"bare {func.id}() outside services/"
+                            f"sandbox.py — route through the sandbox"))
+            elif func.id == "breakpoint":
+                out.append((path, node.lineno,
+                            "breakpoint() left in library code"))
+        elif isinstance(func, ast.Attribute):
+            # jax.debug.print / jax.debug.breakpoint / jax.debug.callback
+            value = func.value
+            if isinstance(value, ast.Attribute) and \
+                    value.attr == "debug" and \
+                    isinstance(value.value, ast.Name) and \
+                    value.value.id == "jax":
+                out.append((path, node.lineno,
+                            f"jax.debug.{func.attr}() left in library "
+                            f"code"))
+    return out
+
+
+def main() -> int:
+    findings = []
+    for path in sorted(PACKAGE.rglob("*.py")):
+        findings.extend(_findings_for(path))
+    for path, lineno, message in findings:
+        rel = path.relative_to(REPO)
+        print(f"{rel}:{lineno}: {message}", file=sys.stderr)
+    if findings:
+        print(f"selflint: {len(findings)} finding(s)", file=sys.stderr)
+        return 1
+    print("selflint: clean")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

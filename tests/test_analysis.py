@@ -1,0 +1,384 @@
+"""Pre-flight static analyzer tests (analysis/).
+
+Every lint/preflight rule gets at least one accepting and one
+rejecting case, plus the end-to-end contract: a shape-mismatched
+train spec is rejected with HTTP 406 at submit time — leaving NO job
+document behind — while the equivalent well-shaped spec runs to
+completion through the same services.
+"""
+
+import numpy as np
+import pytest
+
+from learningorchestra_tpu import analysis as A
+from learningorchestra_tpu.services import validators as V
+
+MODES = ("subprocess", "restricted", "trusted")
+
+
+def _rules(findings):
+    return [(f.severity, f.rule) for f in findings]
+
+
+# ----------------------------------------------------------------------
+# code lint: one accept + one reject per rule
+# ----------------------------------------------------------------------
+def test_syntax_error_rule():
+    assert _rules(A.lint_code("def f(:")) == [("error", "syntax-error")]
+    assert A.lint_code("def f(x):\n    return x\n") == []
+
+
+def test_forbidden_import_rule():
+    bad = A.lint_code("import os", mode="subprocess")
+    assert ("error", "forbidden-import") in _rules(bad)
+    assert A.lint_code("import numpy as np", mode="subprocess") == []
+    # the tensorflow shim and submodule imports are whitelisted
+    assert A.lint_code("from tensorflow.keras import layers") == []
+    # relative imports are refused outright
+    assert ("error", "forbidden-import") in _rules(
+        A.lint_code("from . import secrets_mod"))
+
+
+def test_forbidden_import_is_advisory_in_trusted_mode():
+    # trusted mode is the reference's trust model: the import WORKS
+    # there, so it must not block — but it still warns
+    fs = A.lint_code("import os", mode="trusted")
+    assert _rules(fs) == [("warning", "forbidden-import")]
+
+
+def test_forbidden_call_rule():
+    bad = A.lint_code("data = open('/etc/passwd').read()")
+    assert ("error", "forbidden-call") in _rules(bad)
+    bad = A.lint_code("eval('1+1')")
+    assert ("error", "forbidden-call") in _rules(bad)
+    assert A.lint_code("print(len([1, 2]))") == []
+
+
+def test_dunder_attribute_rule_errors_in_every_mode():
+    # the acceptance gate: dunder traversal is an ERROR under all
+    # three sandbox modes — there is no trusted-mode pass for it
+    for mode in MODES:
+        fs = A.lint_code("x = ().__class__.__mro__", mode=mode)
+        assert ("error", "dunder-attribute") in _rules(fs), mode
+    for mode in MODES:
+        assert A.lint_code("x = arr.shape[0]", mode=mode) == []
+
+
+def test_dunder_string_smuggle_rule():
+    for mode in MODES:
+        fs = A.lint_code("x = getattr((), '__subclasses__')", mode=mode)
+        assert ("error", "dunder-string-smuggle") in _rules(fs), mode
+    assert A.lint_code("x = getattr(cfg, 'units')") == []
+    assert ("error", "dunder-string-smuggle") in _rules(
+        A.lint_code("setattr(o, '__getattr__', f)"))
+
+
+def test_tpu_sync_in_loop_rule():
+    fs = A.lint_code(
+        "for step in range(10):\n"
+        "    loss = train(step)\n"
+        "    loss.block_until_ready()\n")
+    assert ("warning", "tpu-sync-in-loop") in _rules(fs)
+    assert A.lint_code(
+        "for step in range(10):\n"
+        "    loss = train(step)\n"
+        "loss.block_until_ready()\n") == []
+
+
+def test_tpu_traced_branch_rule():
+    fs = A.lint_code(
+        "import jax\n"
+        "@jax.jit\n"
+        "def step(x, lr):\n"
+        "    if lr > 0.1:\n"
+        "        return x * lr\n"
+        "    return x\n")
+    assert ("warning", "tpu-traced-branch") in _rules(fs)
+    # branches on non-traced names in plain functions are fine
+    assert A.lint_code(
+        "def step(x, lr):\n"
+        "    if lr > 0.1:\n"
+        "        return x * lr\n"
+        "    return x\n") == []
+
+
+def test_assert_code_safe_raises_with_findings():
+    with pytest.raises(A.LintRejected) as exc:
+        A.assert_code_safe("import socket", mode="restricted")
+    assert any(f.rule == "forbidden-import" for f in exc.value.findings)
+    # warnings alone do not raise; they come back for storage
+    fs = A.assert_code_safe(
+        "for i in range(3):\n    x.block_until_ready()\n",
+        mode="restricted")
+    assert _rules(fs) == [("warning", "tpu-sync-in-loop")]
+
+
+def test_lint_parameter_code_walks_hash_dsl():
+    fs = A.lint_parameter_code(
+        {"optimizer": "#tensorflow.keras.optimizers.Adam(0.01)",
+         "nested": {"cb": ["#open('/etc/passwd')"]}},
+        mode="subprocess")
+    assert ("error", "forbidden-call") in _rules(fs)
+    assert any(f.location.startswith("nested.cb[0]") for f in fs)
+    assert A.lint_parameter_code(
+        {"optimizer": "#tensorflow.keras.optimizers.Adam(0.01)"},
+        mode="subprocess") == []
+
+
+# ----------------------------------------------------------------------
+# shape preflight units
+# ----------------------------------------------------------------------
+_NEURAL = ("learningorchestra_tpu.models", "NeuralModel")
+
+
+def test_check_model_accepts_valid_stack_and_bypasses_foreign():
+    assert A.check_model(*_NEURAL, {"layer_configs": [
+        {"kind": "dense", "units": 4, "activation": "relu"},
+        {"kind": "dense", "units": 2, "activation": "softmax"}]}) == []
+    # non-NeuralModel specs are never shape-checked (bypass, not fail)
+    assert A.check_model("sklearn.linear_model", "LogisticRegression",
+                         {"C": 0.1}) == []
+
+
+def test_check_model_rejects_unknown_layer_kind():
+    fs = A.check_model(*_NEURAL, {"layer_configs": [
+        {"kind": "input", "shape": [8]},
+        {"kind": "wurble", "units": 4}]})
+    assert ("error", "unknown-layer") in _rules(fs)
+
+
+def test_check_model_rejects_structurally_broken_config():
+    fs = A.check_model(*_NEURAL, {"layer_configs": [
+        {"kind": "dense", "units": 4}, "not-a-dict"]})
+    assert ("error", "shape-mismatch") in _rules(fs)
+    fs = A.check_model(*_NEURAL, {"layer_configs": [{"units": 4}]})
+    assert ("error", "shape-mismatch") in _rules(fs)
+
+
+def test_check_model_rejects_undersized_stack_on_declared_input():
+    # conv2d on a declared 1-D feature vector cannot trace
+    fs = A.check_model(*_NEURAL, {"layer_configs": [
+        {"kind": "input", "shape": [8]},
+        {"kind": "conv2d", "filters": 4, "kernel": 3}]})
+    assert any(sev == "error" for sev, _ in _rules(fs))
+
+
+class _FakeCatalog:
+    def __init__(self, shapes_by_name):
+        self._shapes = shapes_by_name
+
+    def get_metadata(self, name):
+        shapes = self._shapes.get(name)
+        if shapes is None:
+            return None
+        return {A.RESULT_SHAPES_FIELD: shapes}
+
+
+def _root_meta(configs):
+    return {"modulePath": _NEURAL[0], "class": _NEURAL[1],
+            "classParameters": {"layer_configs": configs}}
+
+
+_DATA = _FakeCatalog({"d": {
+    "x": {"shape": [32, 8], "dtype": "float32"},
+    "y": {"shape": [32], "dtype": "int32"},
+    "y_short": {"shape": [16], "dtype": "int32"},
+}})
+_DENSE = [{"kind": "dense", "units": 4, "activation": "relu"},
+          {"kind": "dense", "units": 2, "activation": "softmax"}]
+
+
+def test_check_execution_accepts_matching_spec():
+    fs = A.check_execution(_DATA, _root_meta(_DENSE), "fit",
+                           {"x": "$d.x", "y": "$d.y", "epochs": 1,
+                            "batch_size": 8})
+    assert [r for r in _rules(fs) if r[0] == "error"] == []
+
+
+def test_check_execution_rejects_xy_count_mismatch():
+    fs = A.check_execution(_DATA, _root_meta(_DENSE), "fit",
+                           {"x": "$d.x", "y": "$d.y_short"})
+    assert ("error", "shape-mismatch") in _rules(fs)
+
+
+def test_check_execution_rejects_declared_input_contradiction():
+    configs = [{"kind": "input", "shape": [4]}] + _DENSE
+    fs = A.check_execution(_DATA, _root_meta(configs), "fit",
+                           {"x": "$d.x", "y": "$d.y"})
+    assert ("error", "shape-mismatch") in _rules(fs)
+
+
+def test_check_execution_bypasses_unknown_artifacts():
+    # unknown artifact, no recorded shapes -> never a false rejection
+    assert A.check_execution(_DATA, _root_meta(_DENSE), "fit",
+                             {"x": "$elsewhere.x", "y": "$elsewhere.y"}) \
+        == []
+    # non-fit methods without resolvable x bypass too
+    assert A.check_execution(_DATA, _root_meta(_DENSE), "generate",
+                             {"prompt": "hi"}) == []
+
+
+def test_check_execution_warns_on_mesh_indivisible_batch(tmp_config):
+    from learningorchestra_tpu.runtime import mesh as mesh_lib
+
+    dp = mesh_lib.data_parallel_size(mesh_lib.get_default_mesh())
+    if dp <= 1:
+        pytest.skip("single-device mesh cannot be indivisible")
+    fs = A.check_execution(_DATA, _root_meta(_DENSE), "fit",
+                           {"x": "$d.x", "y": "$d.y",
+                            "batch_size": dp + 1})
+    assert ("warning", "mesh-divisibility") in _rules(fs)
+    fs = A.check_execution(_DATA, _root_meta(_DENSE), "fit",
+                           {"x": "$d.x", "y": "$d.y", "batch_size": dp})
+    assert ("warning", "mesh-divisibility") not in _rules(fs)
+
+
+def test_result_shapes_round_trip():
+    rec = A.result_shapes({"x": np.zeros((32, 8), np.float32),
+                           "y": np.zeros((32,), np.int32),
+                           "other": "not-an-array"})
+    assert rec == {"x": {"shape": [32, 8], "dtype": "float32"},
+                   "y": {"shape": [32], "dtype": "int32"}}
+    assert A.result_shapes(np.zeros((4,), np.float32)) == {
+        "": {"shape": [4], "dtype": "float32"}}
+    assert A.result_shapes("scalar-ish") is None
+
+
+# ----------------------------------------------------------------------
+# end-to-end: submit-time 406 vs clean run through the real services
+# ----------------------------------------------------------------------
+def _make_data(ctx, name="pf_data"):
+    from learningorchestra_tpu.services.function_service import (
+        FunctionService)
+
+    FunctionService(ctx).create({
+        "name": name, "functionParameters": {},
+        "function": ("import numpy as np\n"
+                     "rng = np.random.default_rng(0)\n"
+                     "x = rng.normal(size=(32, 8)).astype(np.float32)\n"
+                     "y = (x[:, 0] > 0).astype(np.int32)\n"
+                     "response = {'x': x, 'y': y}\n")})
+    ctx.jobs.wait(name, timeout=180)
+    meta = ctx.catalog.get_metadata(name)
+    assert meta["finished"], meta
+    return meta
+
+
+def test_preflight_rejects_bad_shape_spec_and_runs_good_one(tmp_config):
+    """The tentpole acceptance pair: same data, two specs differing
+    only in declared input shape — the contradictory one 406s at
+    submit with structured findings and leaves NO job document; the
+    consistent one trains end-to-end."""
+    from learningorchestra_tpu.services.context import ServiceContext
+    from learningorchestra_tpu.services.execution import ExecutionService
+    from learningorchestra_tpu.services.model_service import ModelService
+
+    ctx = ServiceContext(tmp_config)
+    try:
+        meta = _make_data(ctx)
+        # the function's result shapes were recorded for pre-flight
+        assert meta[A.RESULT_SHAPES_FIELD]["x"]["shape"] == [32, 8]
+
+        ms = ModelService(ctx)
+        for model_name, feat in (("pf_good", 8), ("pf_bad", 4)):
+            ms.create({
+                "modelName": model_name,
+                "modulePath": "learningorchestra_tpu.models",
+                "class": "NeuralModel",
+                "classParameters": {"layer_configs": [
+                    {"kind": "input", "shape": [feat]},
+                    {"kind": "dense", "units": 4, "activation": "relu"},
+                    {"kind": "dense", "units": 2,
+                     "activation": "softmax"}]}}, "tensorflow")
+            ctx.jobs.wait(model_name, timeout=180)
+
+        es = ExecutionService(ctx)
+        body = {"name": "pf_train_bad", "modelName": "pf_bad",
+                "method": "fit",
+                "methodParameters": {"x": "$pf_data.x", "y": "$pf_data.y",
+                                     "epochs": 1, "batch_size": 8}}
+        with pytest.raises(V.HttpError) as exc:
+            es.create(body, "train", "tensorflow")
+        assert exc.value.status == V.HTTP_NOT_ACCEPTABLE
+        assert any(f["rule"] == "shape-mismatch"
+                   for f in exc.value.findings)
+        # rejected BEFORE the job document was created: no orphaned
+        # `finished: False` collection for clients to poll forever
+        assert ctx.catalog.get_metadata("pf_train_bad") is None
+
+        es.create({"name": "pf_train_good", "modelName": "pf_good",
+                   "method": "fit",
+                   "methodParameters": {"x": "$pf_data.x",
+                                        "y": "$pf_data.y",
+                                        "epochs": 1, "batch_size": 8}},
+                  "train", "tensorflow")
+        ctx.jobs.wait("pf_train_good", timeout=300)
+        assert ctx.catalog.get_metadata("pf_train_good")["finished"]
+    finally:
+        ctx.close()
+
+
+def test_function_service_rejects_escape_code_at_submit(tmp_config):
+    from learningorchestra_tpu.services.context import ServiceContext
+    from learningorchestra_tpu.services.function_service import (
+        FunctionService)
+
+    ctx = ServiceContext(tmp_config)
+    try:
+        with pytest.raises(V.HttpError) as exc:
+            FunctionService(ctx).create({
+                "name": "esc", "functionParameters": {},
+                "function": "response = ().__class__.__base__"
+                            ".__subclasses__()"})
+        assert exc.value.status == V.HTTP_NOT_ACCEPTABLE
+        assert any(f["rule"] == "dunder-attribute"
+                   for f in exc.value.findings)
+        assert ctx.catalog.get_metadata("esc") is None
+    finally:
+        ctx.close()
+
+
+def test_preflight_flag_bypasses_all_submit_checks(tmp_config):
+    import dataclasses
+
+    from learningorchestra_tpu.services.context import ServiceContext
+    from learningorchestra_tpu.services.function_service import (
+        FunctionService)
+
+    ctx = ServiceContext(dataclasses.replace(tmp_config, preflight=False))
+    try:
+        # reference-equivalent submit-blind behavior: accepted at POST
+        # (the runtime jail still owns the actual execution)
+        status, _ = FunctionService(ctx).create({
+            "name": "blind", "functionParameters": {},
+            "function": "response = ().__class__.__name__"})
+        assert status == V.HTTP_CREATED
+    finally:
+        ctx.close()
+
+
+def test_builder_rejects_escaping_modeling_code(tmp_config):
+    from learningorchestra_tpu.services.builder_service import (
+        BuilderService)
+    from learningorchestra_tpu.services.context import ServiceContext
+
+    ctx = ServiceContext(tmp_config)
+    try:
+        import pandas as pd
+
+        for ds in ("btrain", "btest"):
+            ctx.catalog.create_collection(ds, "dataset/csv")
+            ctx.catalog.write_dataframe(ds, pd.DataFrame(
+                {"a": [1.0, 2.0], "label": [0, 1]}))
+            ctx.catalog.mark_finished(ds)
+        with pytest.raises(V.HttpError) as exc:
+            BuilderService(ctx).create({
+                "trainDatasetName": "btrain", "testDatasetName": "btest",
+                "classifiersList": ["LR"],
+                "modelingCode": "import os\n"
+                                "features_training = training_df\n"})
+        assert exc.value.status == V.HTTP_NOT_ACCEPTABLE
+        assert any(f["rule"] == "forbidden-import"
+                   for f in exc.value.findings)
+    finally:
+        ctx.close()

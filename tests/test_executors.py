@@ -320,19 +320,40 @@ def test_function_service(ctx, iris_csv):
     assert resolved.shape == (120, 2)
 
 
-def test_function_sandbox_blocks_os(ctx):
+def test_function_sandbox_blocks_os(ctx, tmp_config):
+    import dataclasses
+
+    from learningorchestra_tpu.services import validators as V
+    from learningorchestra_tpu.services.context import ServiceContext
     from learningorchestra_tpu.services.function_service import (
         FunctionService)
 
-    fs = FunctionService(ctx)
-    fs.create({"name": "evil",
-               "function": "import os\nresponse = os.listdir('/')",
-               "functionParameters": {}})
-    ctx.jobs.wait("evil", timeout=30)
-    meta = ctx.catalog.get_metadata("evil")
-    assert meta["finished"] is False
-    docs = ctx.catalog.get_documents("evil")
-    assert any("ImportError" in (d.get("exception") or "") for d in docs)
+    body = {"name": "evil",
+            "function": "import os\nresponse = os.listdir('/')",
+            "functionParameters": {}}
+    # layer 1: the pre-flight lint refuses the import at submit time
+    with pytest.raises(V.HttpError) as exc:
+        FunctionService(ctx).create(dict(body))
+    assert exc.value.status == V.HTTP_NOT_ACCEPTABLE
+    assert ctx.catalog.get_metadata("evil") is None
+    # layer 2: with pre-flight off (reference submit-blind behavior)
+    # the runtime jail still kills the job with ImportError
+    from learningorchestra_tpu import config as config_mod
+
+    blind_cfg = dataclasses.replace(tmp_config, preflight=False)
+    config_mod.set_config(blind_cfg)  # sandbox lint hook reads global
+    blind = ServiceContext(blind_cfg)
+    try:
+        FunctionService(blind).create(dict(body))
+        blind.jobs.wait("evil", timeout=30)
+        meta = blind.catalog.get_metadata("evil")
+        assert meta["finished"] is False
+        docs = blind.catalog.get_documents("evil")
+        assert any("ImportError" in (d.get("exception") or "")
+                   for d in docs)
+    finally:
+        blind.close()
+        config_mod.set_config(tmp_config)
 
 
 # ------------------------------------------------- histogram/projection/dt

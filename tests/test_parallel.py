@@ -112,7 +112,7 @@ def test_ulysses_with_flash_blocks_matches_full():
     q, k, v = _qkv(s=32, seed=11)
     want = ring.full_attention_reference(q, k, v, causal=True)
     spec = P(None, "sp", None, None)
-    fn = jax.shard_map(
+    fn = mesh_lib.shard_map(
         functools.partial(
             ulysses.ulysses_attention, causal=True,
             attn_fn=functools.partial(attn_ops.flash_attention,

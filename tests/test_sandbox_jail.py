@@ -65,7 +65,7 @@ def test_jail_blocks_dunder_escape_to_os_system(tmp_config, tmp_path):
         "os = cls().load_module('os')\n"
         f"response = os.system('touch {marker}')\n")
     with pytest.raises(PermissionError, match="os.system"):
-        sandbox.run_user_code(code, mode="subprocess")
+        sandbox.run_user_code(code, mode="subprocess", lint=False)
     assert not marker.exists()
 
 
@@ -80,7 +80,7 @@ def test_jail_blocks_ctypes_ffi_escape(tmp_config, tmp_path):
         "libc = ct.CDLL(None)\n"
         f"response = libc.system(b'touch {marker}')\n")
     with pytest.raises(PermissionError, match="ctypes"):
-        sandbox.run_user_code(code, mode="subprocess")
+        sandbox.run_user_code(code, mode="subprocess", lint=False)
     assert not marker.exists()
 
 
@@ -104,7 +104,7 @@ def test_jail_blocks_write_outside_scratch(tmp_config, tmp_path):
         "f.write('x')\n"
         "response = 1\n")
     with pytest.raises(PermissionError, match="denied"):
-        sandbox.run_user_code(code, mode="subprocess")
+        sandbox.run_user_code(code, mode="subprocess", lint=False)
     assert not target.exists()
 
 
@@ -126,7 +126,7 @@ def test_jail_blocks_rename_out_of_scratch(tmp_config, tmp_path):
             f"{fn}('inside.txt', '{target}')\n"
             "response = 1\n")
         with pytest.raises(PermissionError, match="denied"):
-            sandbox.run_user_code(code, mode="subprocess")
+            sandbox.run_user_code(code, mode="subprocess", lint=False)
         assert not target.exists()
 
 
@@ -145,7 +145,7 @@ def test_jail_blocks_symlink_and_link_out(tmp_config, tmp_path):
             f"{call}\n"
             "response = 1\n")
         with pytest.raises(PermissionError, match="denied"):
-            sandbox.run_user_code(code, mode="subprocess")
+            sandbox.run_user_code(code, mode="subprocess", lint=False)
         assert not target.exists()
 
 
@@ -168,10 +168,10 @@ def test_jail_dropped_vars_surface_reason(tmp_config):
 def test_jail_import_allowlist_still_applies(tmp_config):
     with pytest.raises(ImportError):
         sandbox.run_user_code("import os\nresponse = 1",
-                              mode="subprocess")
+                              mode="subprocess", lint=False)
     with pytest.raises(ImportError):
         sandbox.run_user_code("import subprocess\nresponse = 1",
-                              mode="subprocess")
+                              mode="subprocess", lint=False)
 
 
 def test_jail_hash_dsl_returns_spec_objects(tmp_config):
@@ -304,3 +304,60 @@ def test_jail_function_service_end_to_end(tmp_config):
                    for d in docs)
     finally:
         ctx.close()
+
+
+# ----------------------------------------------------------------------
+# restricted-mode runtime guards: dunder names smuggled as STRINGS
+# through getattr/setattr/vars must die at run time even with the
+# static lint off (dynamic names are invisible to the AST pass).
+# The `lint=False` above/below is deliberate: these tests prove the
+# RUNTIME layer holds on its own; submit-time rejection of the same
+# payloads is covered in test_analysis.py.
+# ----------------------------------------------------------------------
+def test_restricted_getattr_blocks_dynamic_dunder_smuggle(tmp_config):
+    code = (
+        "name = '__cl' + 'ass__'\n"  # invisible to the AST lint
+        "response = getattr((), name)\n")
+    with pytest.raises(AttributeError, match="blocked"):
+        sandbox.run_user_code(code, mode="restricted", lint=False)
+
+
+def test_restricted_setattr_blocks_dunder_smuggle(tmp_config):
+    code = (
+        "class Foo:\n"
+        "    pass\n"
+        "setattr(Foo, '__getattr' + '__', lambda s, n: n)\n"
+        "response = 1\n")
+    with pytest.raises(AttributeError, match="blocked"):
+        sandbox.run_user_code(code, mode="restricted", lint=False)
+
+
+def test_restricted_vars_blocks_dict_access(tmp_config):
+    code = (
+        "class Foo:\n"
+        "    pass\n"
+        "response = vars(Foo)\n")
+    with pytest.raises(TypeError, match="blocked"):
+        sandbox.run_user_code(code, mode="restricted", lint=False)
+
+
+def test_restricted_guards_allow_normal_attribute_use(tmp_config):
+    g, _ = sandbox.run_user_code(
+        "import math\n"
+        "response = getattr(math, 'pi')\n"
+        "class Box:\n"
+        "    pass\n"
+        "b = Box()\n"
+        "setattr(b, 'x', 3)\n"
+        "response = response + b.x\n", mode="restricted", lint=False)
+    assert g["response"] > 6
+
+
+def test_subprocess_jail_also_blocks_dynamic_dunder_smuggle(tmp_config):
+    """The guarded builtins ship into the child process too."""
+    code = (
+        "name = '__subcl' + 'asses__'\n"
+        "response = getattr((), '__class__', None) or "
+        "getattr((), name)\n")
+    with pytest.raises(AttributeError, match="blocked"):
+        sandbox.run_user_code(code, mode="subprocess", lint=False)

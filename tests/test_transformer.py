@@ -236,7 +236,7 @@ def test_ring_attention_32k_step_lowers(tmp_path):
     # 8-way sp mesh (the ppermute appears only after XLA partitioning,
     # which .compile() would run — lowering is the static-shape proof)
     assert "num_partitions = 8" in text
-    assert "manual_computation" in text
+    assert "manual_computation" in text or "SPMDFullToShardShape" in text
     # the invariant that makes 32k viable: nothing in the lowered
     # program materializes the (s, s) score/mask tensor (the dot path
     # lowers a 32768x32768 buffer here; the ring must not)
@@ -262,7 +262,7 @@ def test_ulysses_16k_mixed_mesh_step_lowers(tmp_path):
     text = step.lower(state, {"x": jax.ShapeDtypeStruct(
         (2, 16384), jnp.int32)}, jax.random.PRNGKey(0)).as_text()
     assert "num_partitions = 8" in text
-    assert "manual_computation" in text
+    assert "manual_computation" in text or "SPMDFullToShardShape" in text
     assert "all_to_all" in text
 
 
