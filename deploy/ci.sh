@@ -1,8 +1,11 @@
 #!/usr/bin/env bash
-# CI gate: repo self-lint, then the tier-1 test suite.
+# CI gate: repo self-lint, the tier-1 test suite, then a chaos stage
+# that re-runs the fault/lifecycle suites under an injecting
+# environment (docs/LIFECYCLE.md).
 #
 # Usage: deploy/ci.sh            (from anywhere; paths are self-rooted)
-# Env:   LO_CI_TIMEOUT  seconds for the tier-1 run (default 870)
+# Env:   LO_CI_TIMEOUT        seconds for the tier-1 run (default 870)
+#        LO_CI_CHAOS_TIMEOUT  seconds for the chaos stage (default 300)
 
 set -euo pipefail
 
@@ -17,6 +20,17 @@ TIMEOUT="${LO_CI_TIMEOUT:-870}"
 timeout -k 10 "$TIMEOUT" env JAX_PLATFORMS=cpu \
     python -m pytest tests/ -q -m 'not slow' \
     --continue-on-collection-errors \
+    -p no:cacheprovider -p no:xdist -p no:randomly
+
+echo "== chaos: lifecycle under fault injection =="
+# A bounded hang at the job_run site (reclaimed by deadlines/cancel)
+# plus a slow artifact store. Tests that arm their own LO_FAULT_INJECT
+# override this ambient spec; the point is that the lifecycle suites
+# keep passing with chaos in the environment.
+CHAOS_TIMEOUT="${LO_CI_CHAOS_TIMEOUT:-300}"
+timeout -k 10 "$CHAOS_TIMEOUT" env JAX_PLATFORMS=cpu \
+    LO_FAULT_INJECT="job_run:1:hang:0.2,artifact_save:1:latency:0.05" \
+    python -m pytest tests/test_faults.py tests/test_lifecycle.py -q \
     -p no:cacheprovider -p no:xdist -p no:randomly
 
 echo "== ci: OK =="

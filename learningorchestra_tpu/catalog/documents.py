@@ -37,6 +37,22 @@ FUNCTION_FIELD = "function"
 FUNCTION_PARAMETERS_FIELD = "functionParameters"
 FUNCTION_MESSAGE_FIELD = "functionMessage"
 
+# --- job lifecycle (beyond the reference: its only job state is the
+# boolean ``finished`` flag, binary_execution.py:118-175 — clients
+# cannot tell running from stuck from dead. The metadata ``status``
+# field narrates queued -> running -> terminal; see docs/LIFECYCLE.md)
+STATUS_FIELD = "status"
+PROGRESS_FIELD = "progress"
+STATUS_QUEUED = "queued"
+STATUS_RUNNING = "running"
+STATUS_FINISHED = "finished"
+STATUS_TIMED_OUT = "timedOut"
+STATUS_CANCELLED = "cancelled"
+STATUS_STALLED = "stalled"
+STATUS_DEAD_LETTERED = "deadLettered"
+STATUS_SHUTDOWN_ABORTED = "shutdownAborted"
+STATUS_WORKER_LOST = "workerLost"
+
 # --- artifact type strings (reference constants.py:41-76 + krakend routes) ---
 DATASET_CSV_TYPE = "dataset/csv"
 DATASET_GENERIC_TYPE = "dataset/generic"

@@ -120,13 +120,24 @@ class Tool:
         _, payload = self._http.request("DELETE", f"{self._base}/{name}")
         return payload["result"]
 
+    def cancel(self, name: str) -> Any:
+        """Request cooperative cancellation of ``name``'s running job
+        (``DELETE .../{name}/run``). The collection and its documents
+        survive; the job records a terminal ``cancelled`` execution
+        document at its next yield point (docs/LIFECYCLE.md)."""
+        _, payload = self._http.request("DELETE",
+                                        f"{self._base}/{name}/run")
+        return payload["result"]
+
     def wait(self, name: str, timeout: float = 600.0,
              poll_interval: float = 0.25) -> Dict[str, Any]:
         """Block until ``finished`` is True (the platform's universal
         job-completion idiom). Raises on timeout; surfacing job
-        exceptions is the caller's read of the execution documents."""
-        deadline = time.time() + timeout
-        while time.time() < deadline:
+        exceptions is the caller's read of the execution documents.
+        Monotonic deadline: an NTP step mid-wait must not hang or
+        truncate the poll loop."""
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
             meta = self.metadata(name)
             if meta.get("finished"):
                 return meta
@@ -151,12 +162,18 @@ class Tool:
 
     def run(self, name: str, model_name: str, method: str,
             parameters: Optional[Dict[str, Any]] = None,
-            description: str = "") -> Any:
-        """train/tune/evaluate/predict method execution."""
-        return self.post({
+            description: str = "",
+            timeout: Optional[float] = None) -> Any:
+        """train/tune/evaluate/predict method execution. ``timeout``
+        is the job's server-side deadline in seconds (past it the job
+        is cancelled with a terminal ``timedOut`` document)."""
+        body = {
             "name": name, "modelName": model_name, "method": method,
             "methodParameters": parameters or {},
-            "description": description})
+            "description": description}
+        if timeout is not None:
+            body["timeout"] = timeout
+        return self.post(body)
 
     def run_class(self, name: str, module_path: str, class_name: str,
                   class_parameters: Optional[Dict[str, Any]] = None,
@@ -172,14 +189,18 @@ class Tool:
     def run_function(self, name: str, function: str,
                      parameters: Optional[Dict[str, Any]] = None,
                      description: str = "",
-                     sandbox_mode: Optional[str] = None) -> Any:
+                     sandbox_mode: Optional[str] = None,
+                     timeout: Optional[float] = None) -> Any:
         """``sandbox_mode`` escalates this request up to the server's
-        ceiling (needed to pass live objects like stored models)."""
+        ceiling (needed to pass live objects like stored models);
+        ``timeout`` is the job's server-side deadline in seconds."""
         body = {"name": name, "function": function,
                 "functionParameters": parameters or {},
                 "description": description}
         if sandbox_mode:
             body["sandboxMode"] = sandbox_mode
+        if timeout is not None:
+            body["timeout"] = timeout
         return self.post(body)
 
     def run_projection(self, input_dataset: str, output_dataset: str,
@@ -271,11 +292,12 @@ class Context:
         """Observe-driven wait on any collection's ``finished`` flag
         (event-driven; falls back to the poll in Tool.wait only through
         the observe timeout loop)."""
-        deadline = time.time() + timeout
+        deadline = time.monotonic() + timeout
         seq = 0
-        while time.time() < deadline:
-            result = self.observe(name, seq=seq,
-                                  timeout=min(25.0, deadline - time.time()))
+        while time.monotonic() < deadline:
+            result = self.observe(
+                name, seq=seq,
+                timeout=min(25.0, deadline - time.monotonic()))
             meta = result.get("metadata")
             if meta and meta.get("finished"):
                 return meta

@@ -121,6 +121,27 @@ class Config:
     # (services/faults.py; e.g. "artifact_save:2").
     job_max_retries: int = dataclasses.field(
         default_factory=lambda: int(os.environ.get("LO_JOB_RETRIES", "0")))
+    # Job lifecycle (docs/LIFECYCLE.md). Default per-job deadline in
+    # seconds (0 = none; a request's "timeout" field overrides).
+    job_timeout_seconds: float = dataclasses.field(
+        default_factory=lambda: float(os.environ.get("LO_JOB_TIMEOUT", "0")))
+    # Stall watchdog: a job whose progress heartbeat goes quiet for
+    # this long is marked "stalled" (0 disables the watchdog) and, when
+    # escalation is on (single-host only), cancelled cooperatively.
+    stall_seconds: float = dataclasses.field(
+        default_factory=lambda: float(os.environ.get(
+            "LO_STALL_SECONDS", "300")))
+    stall_escalate: bool = dataclasses.field(
+        default_factory=lambda: os.environ.get(
+            "LO_STALL_ESCALATE", "1") not in ("0", "false", "no"))
+    # Exponential backoff between classified-transient retry attempts:
+    # base * 2^attempt seconds, capped, with +/-50% jitter.
+    retry_backoff_seconds: float = dataclasses.field(
+        default_factory=lambda: float(os.environ.get(
+            "LO_RETRY_BACKOFF", "0.5")))
+    retry_backoff_max_seconds: float = dataclasses.field(
+        default_factory=lambda: float(os.environ.get(
+            "LO_RETRY_BACKOFF_MAX", "30")))
     # byte budget for the $name DataFrame resolution cache (0 disables)
     param_cache_bytes: int = dataclasses.field(
         default_factory=lambda: int(os.environ.get(

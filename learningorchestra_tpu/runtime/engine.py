@@ -533,6 +533,11 @@ class Engine:
                          for k, v in padded.items()}
         history: List[Dict[str, Any]] = []
         for epoch in range(start_epoch, epochs):
+            # lifecycle boundary: honor a deadline/cancel before
+            # dispatching the next whole-epoch scan, and publish
+            # progress for the stall watchdog
+            preempt.check_cancel()
+            preempt.heartbeat(epoch=epoch)
             t0 = time.perf_counter()
             if epoch == start_epoch:
                 one = {k: v[:bs] for k, v in padded.items()}
@@ -620,6 +625,11 @@ class Engine:
             # first step completes (one extra sync, once per fit)
             t_steady, steady_steps = t0, 0
             for batch in self._device_feed(batcher, epoch):
+                # per-step lifecycle point (dispatch is async, so this
+                # is host-side and nearly free): a cancelled/expired
+                # job stops mid-epoch instead of finishing it out
+                preempt.check_cancel()
+                preempt.heartbeat(epoch=epoch, step=host_step)
                 rng = jax.random.fold_in(base_rng, host_step)
                 host_step += 1
                 if steps == 0 and epoch == start_epoch:
@@ -656,7 +666,9 @@ class Engine:
             self._eval_step = self._build_eval_step()
         sums: Dict[str, Any] = {}
         counts: Dict[str, Any] = {}
-        for batch in self._device_feed(batcher, 0):
+        for step, batch in enumerate(self._device_feed(batcher, 0)):
+            preempt.check_cancel()
+            preempt.heartbeat(phase="evaluate", step=step)
             metrics = self._eval_step(state, batch)
             for k, (s, c) in metrics.items():
                 sums[k] = sums.get(k, 0) + s
@@ -669,7 +681,9 @@ class Engine:
         if self._predict_step is None:
             self._predict_step = self._build_predict_step()
         outs = []
-        for batch in self._device_feed(batcher, 0):
+        for step, batch in enumerate(self._device_feed(batcher, 0)):
+            preempt.check_cancel()
+            preempt.heartbeat(phase="predict", step=step)
             outs.append(np.asarray(self._predict_step(state, batch)))
         full = np.concatenate(outs, axis=0)
         return full[:batcher.num_samples]  # drop padding

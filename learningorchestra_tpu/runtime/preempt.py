@@ -1,4 +1,4 @@
-"""Cooperative preemption hook for long device jobs.
+"""Cooperative preemption + cancellation hooks for long device jobs.
 
 The reference gives each Spark service its own FAIR scheduler pool so
 a long job cannot monopolize the cluster
@@ -13,16 +13,127 @@ The engine can't import the services layer (layering), so the lease
 installs a thread-local callback here and the engine's epoch loops
 call :func:`maybe_yield` between epochs. No lease installed (direct
 library use, tests, workers) → no-op.
+
+The SAME yield points double as cancellation points: the job manager
+installs a :class:`CancelToken` per job thread and the engine's
+epoch/step loops call :func:`check_cancel` / :func:`heartbeat` — so a
+deadline expiry or a ``DELETE .../run`` surfaces as
+:class:`JobCancelled` at the next safe boundary, the lease is
+released, and no single request can wedge the accelerator
+(docs/LIFECYCLE.md).
 """
 
 from __future__ import annotations
 
 import threading
-from typing import Callable, Optional
+import time
+from typing import Any, Callable, Dict, Optional
 
 _tls = threading.local()
 
 
+class JobCancelled(Exception):
+    """Cooperative cancellation signal. ``reason`` is the terminal
+    lifecycle state it produces: ``"timedOut"`` (deadline expired),
+    ``"cancelled"`` (user DELETE), or ``"stalled"`` (watchdog
+    escalation). Raised from :meth:`CancelToken.check` at the engine /
+    sandbox / scheduler yield points, caught by the job manager."""
+
+    def __init__(self, reason: str, message: str = ""):
+        super().__init__(message or f"job {reason}")
+        self.reason = reason
+
+
+class CancelToken:
+    """Per-job cancellation + progress record.
+
+    - ``cancel(reason)`` flips a latched event (first reason wins:
+      a user cancel that races the deadline keeps its attribution);
+    - ``deadline`` (``time.monotonic`` basis) is checked lazily on
+      every :meth:`cancelled` call, so an expired job cancels itself
+      at its next cooperative check with no timer thread per job;
+    - ``beat(**progress)`` publishes a heartbeat (step/epoch
+      counters) the stall watchdog reads via :meth:`heartbeat_age`.
+    """
+
+    def __init__(self, deadline: Optional[float] = None):
+        self._event = threading.Event()
+        self._lock = threading.Lock()
+        self.deadline = deadline
+        self.reason: Optional[str] = None
+        self.progress: Dict[str, Any] = {}
+        self.last_beat: Optional[float] = None
+        self.started: Optional[float] = None
+
+    # -- cancellation --------------------------------------------------
+    def cancel(self, reason: str = "cancelled") -> bool:
+        """Latch the token. Returns True if this call set the reason
+        (False when already cancelled — the original reason stands)."""
+        with self._lock:
+            if self.reason is None:
+                self.reason = reason
+                self._event.set()
+                return True
+            return False
+
+    def cancelled(self) -> bool:
+        if self._event.is_set():
+            return True
+        if self.deadline is not None and \
+                time.monotonic() >= self.deadline:
+            self.cancel("timedOut")
+            return True
+        return False
+
+    def check(self) -> None:
+        if self.cancelled():
+            raise JobCancelled(self.reason or "cancelled")
+
+    def wait(self, seconds: float) -> bool:
+        """Cancel-aware sleep (retry backoff): returns True the moment
+        the token cancels, False after the full wait. Deadline-based
+        expiry is honored too — the wait is clipped so a backoff never
+        outsleeps the job's own deadline."""
+        end = time.monotonic() + max(0.0, seconds)
+        while True:
+            if self.cancelled():
+                return True
+            now = time.monotonic()
+            if now >= end:
+                return False
+            step = end - now
+            if self.deadline is not None:
+                step = min(step, max(0.0, self.deadline - now))
+            if self._event.wait(min(step, 0.5) or 0.001):
+                return True
+
+    def remaining(self) -> Optional[float]:
+        if self.deadline is None:
+            return None
+        return max(0.0, self.deadline - time.monotonic())
+
+    # -- progress heartbeat --------------------------------------------
+    def beat(self, **progress: Any) -> None:
+        with self._lock:
+            self.last_beat = time.monotonic()
+            self.progress.update(progress)
+
+    def heartbeat_age(self) -> Optional[float]:
+        """Seconds since the last beat; None before the first beat
+        (jobs that never publish progress — sklearn fits, ingests —
+        are exempt from stall detection)."""
+        last = self.last_beat
+        return None if last is None else time.monotonic() - last
+
+    def progress_snapshot(self) -> Dict[str, Any]:
+        with self._lock:
+            return dict(self.progress)
+
+
+# ----------------------------------------------------------------------
+# thread-local install points (yield + cancel are separate slots: the
+# lease CM owns the yield slot, the job manager owns the cancel slot)
+# ----------------------------------------------------------------------
 def install(fn: Callable[[], None],
             contended_fn: Optional[Callable[[], bool]] = None) -> None:
     """Register ``fn`` as this thread's between-epochs yield point
@@ -61,9 +172,41 @@ def restore(snap) -> None:
     _tls.fn, _tls.contended = snap
 
 
+def install_cancel(token: Optional[CancelToken]) -> None:
+    """Bind ``token`` to this thread (job manager, around each job)."""
+    _tls.cancel = token
+
+
+def clear_cancel() -> None:
+    _tls.cancel = None
+
+
+def current_cancel() -> Optional[CancelToken]:
+    return getattr(_tls, "cancel", None)
+
+
+def check_cancel() -> None:
+    """Raise :class:`JobCancelled` if this thread's job was cancelled
+    or ran past its deadline. No token installed → no-op (direct
+    library use, tests, workers)."""
+    token = current_cancel()
+    if token is not None:
+        token.check()
+
+
+def heartbeat(**progress: Any) -> None:
+    """Publish step/epoch progress for the stall watchdog. No token
+    installed → no-op."""
+    token = current_cancel()
+    if token is not None:
+        token.beat(**progress)
+
+
 def maybe_yield() -> None:
-    """Engine epoch boundary: hand the mesh lease to a waiting job of
-    another pool (if any) and re-acquire it through the fair queue."""
+    """Engine epoch boundary: first honor any pending cancellation,
+    then hand the mesh lease to a waiting job of another pool (if any)
+    and re-acquire it through the fair queue."""
+    check_cancel()
     fn = current()
     if fn is not None:
         fn()

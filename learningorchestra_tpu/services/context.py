@@ -38,7 +38,15 @@ class ServiceContext:
                                mesh_leases=self.config.mesh_leases,
                                pod_failure_fn=self.pod_failure_fn,
                                pool_weights=parse_pool_weights(
-                                   self.config.pool_weights))
+                                   self.config.pool_weights),
+                               default_timeout=self.config
+                               .job_timeout_seconds,
+                               stall_seconds=self.config.stall_seconds,
+                               stall_escalate=self.config.stall_escalate,
+                               retry_backoff=self.config
+                               .retry_backoff_seconds,
+                               retry_backoff_max=self.config
+                               .retry_backoff_max_seconds)
         self.params = ParameterResolver(self)
         # callbacks fired by the pod guard when a degraded pod's
         # heartbeats resume (the Api registers worker-lost requeue)

@@ -108,6 +108,7 @@ class ExecutionService:
         method = body[METHOD_FIELD]
         method_parameters = body[METHOD_PARAMETERS_FIELD] or {}
         description = body.get(DESCRIPTION_FIELD, "")
+        timeout = V.valid_timeout(body.get(V.TIMEOUT_FIELD))
         self._validator.not_duplicate(name)
         self._validator.existing_finished(parent_name)
         root_meta = self.root_model_metadata(parent_name)
@@ -120,11 +121,15 @@ class ExecutionService:
             D.METHOD_PARAMETERS_FIELD: method_parameters,
             D.DESCRIPTION_FIELD: description,
         }
+        if timeout is not None:
+            # stored in metadata so boot/elastic requeues replay the
+            # same deadline (server._requeue_execution)
+            extra[V.TIMEOUT_FIELD] = timeout
         if analysis:
             extra[ANALYSIS_FIELD] = analysis
         self._ctx.catalog.create_collection(name, type_string, extra)
         self._submit(name, type_string, parent_name, method,
-                     method_parameters, description)
+                     method_parameters, description, timeout=timeout)
         return V.HTTP_CREATED, {
             "result": f"/api/learningOrchestra/v1/{verb}/{tool}/{name}"}
 
@@ -136,6 +141,8 @@ class ExecutionService:
             METHOD_PARAMETERS_FIELD, meta.get(D.METHOD_PARAMETERS_FIELD)) \
             or {}
         description = body.get(DESCRIPTION_FIELD, "")
+        timeout = V.valid_timeout(
+            body.get(V.TIMEOUT_FIELD, meta.get(V.TIMEOUT_FIELD)))
         parent_name = meta[D.PARENT_NAME_FIELD]
         root_meta = self.root_model_metadata(parent_name)
         self._validate_method(root_meta, method, method_parameters)
@@ -143,9 +150,10 @@ class ExecutionService:
         self._ctx.catalog.update_metadata(
             name, {D.METHOD_PARAMETERS_FIELD: method_parameters,
                    ANALYSIS_FIELD: analysis,
+                   V.TIMEOUT_FIELD: timeout,
                    D.FINISHED_FIELD: False})
         self._submit(name, meta[D.TYPE_FIELD], parent_name, method,
-                     method_parameters, description)
+                     method_parameters, description, timeout=timeout)
         return V.HTTP_SUCCESS, {
             "result": f"/api/learningOrchestra/v1/{verb}/{tool}/{name}"}
 
@@ -178,7 +186,8 @@ class ExecutionService:
 
     def _submit(self, name: str, type_string: str, parent_name: str,
                 method: str, method_parameters: Dict[str, Any],
-                description: str, only_if_idle: bool = False) -> None:
+                description: str, only_if_idle: bool = False,
+                timeout: Optional[float] = None) -> None:
         def run():
             _broadcast_to_workers(name, type_string, parent_name, method,
                                   method_parameters)
@@ -210,7 +219,8 @@ class ExecutionService:
             # (reference spark_image/fairscheduler.xml:1-8)
             pool=type_string.split("/", 1)[0],
             only_if_idle=only_if_idle,
-            max_retries=self._ctx.config.job_max_retries)
+            max_retries=self._ctx.config.job_max_retries,
+            timeout=timeout)
 
 
 def _record_result_shapes(ctx, name: str, result: Any) -> None:

@@ -1,47 +1,147 @@
 """Deterministic fault injection (SURVEY §5: the reference has no
 fault injection anywhere; its swarm restart_policy is the only failure
 response). ``Config.fault_inject`` (env ``LO_FAULT_INJECT``) names
-injection sites and counts — ``"artifact_save:2"`` makes the first two
-artifact-store writes raise — so failure-handling paths (retries,
-failure execution documents, boot requeue) are testable end-to-end
-through the real REST/job stack instead of only with hand-made flaky
-callables."""
+injection sites with a budget, mode and argument —
+``site[:count[:mode[:arg]]]`` comma-separated:
+
+- ``"artifact_save:2"`` — the first two artifact-store writes raise
+  :class:`InjectedFault` (mode ``raise``, the default);
+- ``"job_run:1:hang"`` — the first job attempt blocks cooperatively
+  (checking the job's cancel token, so deadlines/DELETE still fire)
+  until cancelled or ``arg`` seconds pass (default 3600);
+- ``"job_run:3:latency:0.5"`` — the first three attempts sleep 0.5 s
+  and then proceed normally.
+
+So failure-handling paths (classified retries, deadlines, stall
+watchdog, failure execution documents, boot requeue) are testable
+end-to-end through the real REST/job stack instead of only with
+hand-made flaky callables. Known sites: ``artifact_save``
+(catalog/artifacts.py) and ``job_run`` (services/jobs.py, fired while
+the mesh lease is held)."""
 
 from __future__ import annotations
 
+import dataclasses
 import threading
+import time
 from typing import Dict
 
 _lock = threading.Lock()
 _used: Dict[str, int] = {}
+_parsed: Dict[str, Dict[str, "FaultSpec"]] = {}
+
+_MODES = ("raise", "hang", "latency")
+_DEFAULT_HANG_SECONDS = 3600.0
+_DEFAULT_LATENCY_SECONDS = 0.1
 
 
 class InjectedFault(IOError):
     pass
 
 
+@dataclasses.dataclass(frozen=True)
+class FaultSpec:
+    site: str
+    count: int = 1
+    mode: str = "raise"
+    arg: float | None = None
+
+
 def reset() -> None:
+    """Clear consumed budgets (test isolation — each test arms its own
+    spec against a fresh counter)."""
     with _lock:
         _used.clear()
 
 
-def maybe_inject(site: str) -> None:
-    """Raise InjectedFault if ``site`` still has injection budget in
-    ``Config.fault_inject`` (comma-separated ``site:count`` entries)."""
+def parse_spec(spec: str) -> Dict[str, FaultSpec]:
+    """``"site[:count[:mode[:arg]]]"`` comma-separated ->
+    ``{site: FaultSpec}``. Raises :class:`ValueError` on malformed
+    entries (bad count/arg numbers, unknown modes, empty sites) so a
+    typo'd LO_FAULT_INJECT fails loudly instead of silently injecting
+    nothing."""
+    entries: Dict[str, FaultSpec] = {}
+    for part in (spec or "").split(","):
+        part = part.strip()
+        if not part:
+            continue
+        fields = part.split(":")
+        if len(fields) > 4:
+            raise ValueError(
+                f"bad fault entry {part!r}: want site[:count[:mode[:arg]]]")
+        site = fields[0].strip()
+        if not site:
+            raise ValueError(f"bad fault entry {part!r}: empty site")
+        count, mode, arg = 1, "raise", None
+        if len(fields) > 1 and fields[1].strip():
+            try:
+                count = int(fields[1])
+            except ValueError:
+                raise ValueError(
+                    f"bad fault count in {part!r}: {fields[1]!r} is not "
+                    f"an integer") from None
+        if len(fields) > 2:
+            mode = fields[2].strip() or "raise"
+            if mode not in _MODES:
+                raise ValueError(
+                    f"bad fault mode in {part!r}: {mode!r} (one of "
+                    f"{_MODES})")
+        if len(fields) > 3 and fields[3].strip():
+            try:
+                arg = float(fields[3])
+            except ValueError:
+                raise ValueError(
+                    f"bad fault arg in {part!r}: {fields[3]!r} is not a "
+                    f"number") from None
+        entries[site] = FaultSpec(site, count, mode, arg)
+    return entries
+
+
+def _spec_for(site: str) -> FaultSpec | None:
     from learningorchestra_tpu.config import get_config
 
     spec = getattr(get_config(), "fault_inject", "") or ""
     if not spec:
+        return None
+    with _lock:
+        parsed = _parsed.get(spec)
+        if parsed is None:
+            parsed = _parsed[spec] = parse_spec(spec)
+    return parsed.get(site)
+
+
+def _cooperative_hang(site: str, seconds: float) -> None:
+    """Block like a wedged collective would — but honor the job's
+    cancel token, so the deadline/stall/DELETE machinery under test
+    can reclaim the thread (that IS the scenario being exercised)."""
+    from learningorchestra_tpu.runtime import preempt
+
+    end = time.monotonic() + seconds
+    while time.monotonic() < end:
+        preempt.check_cancel()
+        time.sleep(0.05)
+
+
+def maybe_inject(site: str) -> None:
+    """Fire ``site``'s configured fault if it still has budget in
+    ``Config.fault_inject``: raise :class:`InjectedFault`, hang
+    cooperatively, or add latency (see module docstring)."""
+    entry = _spec_for(site)
+    if entry is None:
         return
-    for part in spec.split(","):
-        name, _, count = part.strip().partition(":")
-        if name != site:
-            continue
-        budget = int(count or 1)
-        with _lock:
-            used = _used.get(site, 0)
-            if used < budget:
-                _used[site] = used + 1
-                raise InjectedFault(
-                    f"injected fault at {site} ({used + 1}/{budget})")
-        return
+    with _lock:
+        used = _used.get(site, 0)
+        if used >= entry.count:
+            return
+        _used[site] = used + 1
+        fired = used + 1
+    if entry.mode == "raise":
+        raise InjectedFault(
+            f"injected fault at {site} ({fired}/{entry.count})")
+    if entry.mode == "hang":
+        _cooperative_hang(site, entry.arg
+                          if entry.arg is not None
+                          else _DEFAULT_HANG_SECONDS)
+    elif entry.mode == "latency":
+        time.sleep(entry.arg if entry.arg is not None
+                   else _DEFAULT_LATENCY_SECONDS)

@@ -91,6 +91,7 @@ class FunctionService:
         function = body[FUNCTION_FIELD]
         parameters = body[FUNCTION_PARAMETERS_FIELD] or {}
         description = body.get(DESCRIPTION_FIELD, "")
+        timeout = V.valid_timeout(body.get(V.TIMEOUT_FIELD))
         mode = resolve_sandbox_mode(self._ctx.config,
                                     body.get(SANDBOX_MODE_FIELD))
         analysis = self._preflight(function, parameters, mode)
@@ -101,11 +102,13 @@ class FunctionService:
             D.DESCRIPTION_FIELD: description,
             SANDBOX_MODE_FIELD: mode,  # boot requeue replays the same mode
         }
+        if timeout is not None:
+            extra[V.TIMEOUT_FIELD] = timeout  # requeues replay it too
         if analysis:
             extra[ANALYSIS_FIELD] = analysis
         self._ctx.catalog.create_collection(name, type_string, extra)
         self._submit(name, type_string, function, parameters, description,
-                     mode=mode)
+                     mode=mode, timeout=timeout)
         return V.HTTP_CREATED, {
             "result": f"/api/learningOrchestra/v1/function/{tool}/{name}"}
 
@@ -117,6 +120,8 @@ class FunctionService:
             FUNCTION_PARAMETERS_FIELD,
             meta.get(D.FUNCTION_PARAMETERS_FIELD)) or {}
         description = body.get(DESCRIPTION_FIELD, "")
+        timeout = V.valid_timeout(
+            body.get(V.TIMEOUT_FIELD, meta.get(V.TIMEOUT_FIELD)))
         mode = resolve_sandbox_mode(self._ctx.config,
                                     body.get(SANDBOX_MODE_FIELD))
         analysis = self._preflight(function, parameters, mode)
@@ -125,9 +130,10 @@ class FunctionService:
                    D.FUNCTION_PARAMETERS_FIELD: parameters,
                    SANDBOX_MODE_FIELD: mode,
                    ANALYSIS_FIELD: analysis,
+                   V.TIMEOUT_FIELD: timeout,
                    D.FINISHED_FIELD: False})
         self._submit(name, meta[D.TYPE_FIELD], function, parameters,
-                     description, mode=mode)
+                     description, mode=mode, timeout=timeout)
         return V.HTTP_SUCCESS, {
             "result": f"/api/learningOrchestra/v1/function/{tool}/{name}"}
 
@@ -156,7 +162,8 @@ class FunctionService:
 
     def _submit(self, name: str, type_string: str, function: str,
                 parameters: Dict[str, Any], description: str,
-                mode: Optional[str] = None) -> None:
+                mode: Optional[str] = None,
+                timeout: Optional[float] = None) -> None:
         def run():
             code = fetch_function_code(function)
             treated = self._ctx.params.treat(parameters)
@@ -182,4 +189,5 @@ class FunctionService:
 
         self._ctx.jobs.submit(name, run, description=description,
                               parameters=parameters,
-                              max_retries=self._ctx.config.job_max_retries)
+                              max_retries=self._ctx.config.job_max_retries,
+                              timeout=timeout)

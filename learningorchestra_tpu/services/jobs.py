@@ -19,7 +19,21 @@ README.md:194-198):
   parity) and long fits yield it at epoch boundaries; a preempted
   job's device state stays in HBM, so LO_MESH_YIELD=0 restores
   strict serialization when concurrent footprints would not fit.
-- **Retry.** ``max_retries`` re-runs a failed pipeline; each attempt
+- **Lifecycle** (docs/LIFECYCLE.md). Every job carries a cooperative
+  :class:`~learningorchestra_tpu.runtime.preempt.CancelToken`:
+  per-job deadlines (``timeout`` request field / ``LO_JOB_TIMEOUT``),
+  user cancellation (``DELETE .../run``), and a stall watchdog that
+  flags jobs whose progress heartbeat went quiet
+  (``LO_STALL_SECONDS``) — so a hung user function or wedged
+  collective is reclaimed at the next yield point instead of holding
+  the mesh lease forever. The metadata ``status`` field tracks
+  queued → running → {finished, timedOut, cancelled, stalled,
+  deadLettered, shutdownAborted}.
+- **Classified retries.** ``max_retries`` re-runs a failed pipeline
+  only for TRANSIENT errors (I/O, OOM/RESOURCE_EXHAUSTED, injected
+  faults), with exponential backoff + jitter between attempts;
+  permanent errors (validation, user-code bugs) dead-letter
+  immediately, and an exhausted budget dead-letters too. Each attempt
   appends its own execution document.
 - **Timing.** Every execution document records ``elapsedSeconds``
   (superset of the reference's builder-only ``fitTime``,
@@ -29,6 +43,7 @@ README.md:194-198):
 from __future__ import annotations
 
 import contextlib
+import random
 import threading
 import time
 import traceback
@@ -37,6 +52,49 @@ from typing import Any, Callable, Dict, Optional
 
 from learningorchestra_tpu.catalog import documents as D
 from learningorchestra_tpu.catalog.store import Catalog
+from learningorchestra_tpu.runtime import preempt
+from learningorchestra_tpu.services import faults
+
+TRANSIENT = "transient"
+PERMANENT = "permanent"
+
+# message substrings that mark an otherwise-unclassified exception as
+# retryable (XLA surfaces HBM OOM as XlaRuntimeError RESOURCE_EXHAUSTED,
+# not MemoryError; grpc/gcs failures carry UNAVAILABLE; "TRANSIENT"
+# honors errors that self-describe as retryable)
+_TRANSIENT_MARKERS = ("RESOURCE_EXHAUSTED", "OUT OF MEMORY",
+                      "UNAVAILABLE", "DATA_LOSS", "CONNECTION RESET",
+                      "TRANSIENT")
+
+
+def classify_error(exception: BaseException) -> str:
+    """``transient`` (worth a retry: the same code may succeed on a
+    re-run) vs ``permanent`` (validation/user-code errors a retry
+    would only repeat). :class:`faults.InjectedFault` is an IOError
+    subclass, so injected faults exercise the transient path."""
+    if isinstance(exception, (OSError, MemoryError, InterruptedError,
+                              TimeoutError, ConnectionError)):
+        return TRANSIENT
+    text = f"{type(exception).__name__}: {exception}".upper()
+    if any(marker in text for marker in _TRANSIENT_MARKERS):
+        return TRANSIENT
+    return PERMANENT
+
+
+def _single_host() -> bool:
+    """Stall escalation is single-host only — mirroring the lease's
+    yield rule: on a multi-host pod a coordinator-side cancellation
+    would diverge the SPMD program the workers are replaying."""
+    try:
+        from learningorchestra_tpu.runtime import distributed as dist
+
+        if not dist.is_initialized():
+            return True
+        import jax
+
+        return jax.process_count() <= 1
+    except Exception:  # noqa: BLE001 — no runtime formed yet
+        return True
 
 
 class JobManager:
@@ -44,7 +102,12 @@ class JobManager:
                  mesh_leases: int = 1,
                  pod_failure_fn: Optional[Callable[[], Optional[str]]]
                  = None,
-                 pool_weights: Optional[Dict[str, float]] = None):
+                 pool_weights: Optional[Dict[str, float]] = None,
+                 default_timeout: float = 0.0,
+                 stall_seconds: float = 0.0,
+                 stall_escalate: bool = True,
+                 retry_backoff: float = 0.5,
+                 retry_backoff_max: float = 30.0):
         from learningorchestra_tpu.services.scheduler import FairLease
 
         self._catalog = catalog
@@ -52,22 +115,75 @@ class JobManager:
                                         thread_name_prefix="lo-job")
         self._mesh = FairLease(mesh_leases, pool_weights)
         self._futures: Dict[str, Future] = {}
-        self._mesh_jobs: Dict[str, Dict[str, Any]] = {}
+        # name -> {description, parameters, needs_mesh, token}: the
+        # lifecycle registry (cancel API, stall watchdog, shutdown
+        # documentation, worker-lost marking)
+        self._job_info: Dict[str, Dict[str, Any]] = {}
         self._lock = threading.Lock()
         # returns a failure description when the multi-host pod has
         # lost a worker (runtime.distributed.pod_failure); mesh jobs
         # are then refused instead of hanging in a collective
         self._pod_failure_fn = pod_failure_fn or (lambda: None)
+        self._default_timeout = max(0.0, float(default_timeout or 0.0))
+        self._stall_seconds = max(0.0, float(stall_seconds or 0.0))
+        self._stall_escalate = bool(stall_escalate)
+        self._retry_backoff = max(0.0, float(retry_backoff))
+        self._retry_backoff_max = max(self._retry_backoff,
+                                      float(retry_backoff_max))
+        self._counters: Dict[str, int] = {"retries": 0, "cancelled": 0,
+                                          "timedOut": 0}
+        self._stalled: set = set()
+        self._watchdog_stop = threading.Event()
+        if self._stall_seconds > 0:
+            threading.Thread(target=self._watch_stalls, daemon=True,
+                             name="lo-stall-watchdog").start()
 
     # ------------------------------------------------------------------
-    def mesh_lease(self, pool: str = "default"):
+    def mesh_lease(self, pool: str = "default", cancel=None):
         """Context manager granting accelerator access through the
         fair queue (``with jobs.mesh_lease(): ...``)."""
-        return self._mesh.lease(pool)
+        return self._mesh.lease(pool, cancel=cancel)
 
     def mesh_served(self) -> Dict[str, float]:
         """Cumulative mesh seconds per pool (observability)."""
         return self._mesh.served()
+
+    def lifecycle_counters(self) -> Dict[str, int]:
+        """Monotonic lifecycle counters + the currently-stalled gauge
+        (exported as ``lo_job_retries_total`` etc. by the Api)."""
+        with self._lock:
+            out = dict(self._counters)
+            out["stalled"] = sum(
+                1 for k in self._stalled
+                if k in self._futures and not self._futures[k].done())
+        return out
+
+    # ------------------------------------------------------------------
+    def _set_status(self, name: str, status: str) -> None:
+        # advisory lifecycle state on the metadata document; a
+        # collection deleted mid-run must not sink the job thread
+        try:
+            self._catalog.update_metadata(name, {D.STATUS_FIELD: status})
+        except Exception:  # noqa: BLE001
+            pass
+
+    def _count(self, key: str, delta: int = 1) -> None:
+        with self._lock:
+            self._counters[key] = self._counters.get(key, 0) + delta
+
+    def _count_cancel(self, status: str) -> None:
+        self._count("timedOut" if status == D.STATUS_TIMED_OUT
+                    else "cancelled")
+
+    def _backoff_seconds(self, attempt: int) -> float:
+        """Exponential backoff with full jitter: base * 2^attempt,
+        scaled by a uniform [0.5, 1.5) factor so synchronized retries
+        (N jobs felled by one transient) don't re-converge."""
+        if self._retry_backoff <= 0:
+            return 0.0
+        base = min(self._retry_backoff * (2 ** attempt),
+                   self._retry_backoff_max)
+        return base * (0.5 + random.random())
 
     # ------------------------------------------------------------------
     def submit(self, name: str, fn: Callable[[], Any], *,
@@ -80,6 +196,7 @@ class JobManager:
                mark_finished: bool = True,
                failure_names: Optional[list] = None,
                only_if_idle: bool = False,
+               timeout: Optional[float] = None,
                ) -> Future:
         """Run ``fn`` asynchronously under the reference's
         finished-flag contract for collection ``name`` (which must
@@ -87,8 +204,15 @@ class JobManager:
         (Builder: one collection per classifier) pass
         ``failure_names`` so a TERMINAL job failure documents EVERY
         output — a client polling any of them must see the error, not
-        hang on a forever-False finished flag."""
+        hang on a forever-False finished flag. ``timeout`` (seconds)
+        is this job's deadline; None falls back to the manager-wide
+        default (``LO_JOB_TIMEOUT``), 0 disables."""
         doc_names = list(failure_names) if failure_names else [name]
+        effective_timeout = (self._default_timeout if timeout is None
+                             else max(0.0, float(timeout)))
+        token = preempt.CancelToken(
+            deadline=(time.monotonic() + effective_timeout)
+            if effective_timeout > 0 else None)
 
         def fail_all(document: Dict[str, Any]) -> None:
             for n in doc_names:
@@ -101,79 +225,171 @@ class JobManager:
                         continue
                 self._catalog.append_document(n, dict(document))
 
+        def record_cancel(exc: preempt.JobCancelled, attempt: int,
+                          extra: Dict[str, Any]) -> None:
+            status = exc.reason or D.STATUS_CANCELLED
+            extra = dict(extra)
+            extra.update({D.STATUS_FIELD: status, "cancelReason": status,
+                          "attempt": attempt})
+            fail_all(D.execution_document(
+                description, parameters,
+                exception=f"JobCancelled({status!r}: {exc})",
+                extra=extra))
+            self._set_status(name, status)
+            self._count_cancel(status)
+
         def run() -> Any:
             submitted = time.monotonic()
+            token.started = submitted
             attempts = max_retries + 1
-            for attempt in range(attempts):
-                if needs_mesh:
-                    failure = self._pod_failure_fn()
-                    if failure:
-                        # a degraded pod cannot run mesh collectives:
-                        # record a TERMINAL typed failure instead of
-                        # entering a jit that would hang forever
-                        fail_all(D.execution_document(
-                            description, parameters,
-                            exception=f"WorkerLost({failure!r})",
-                            extra={"workerLost": True,
-                                   "attempt": attempt + 1}))
-                        return None
-                lease = (self._mesh.lease(pool) if needs_mesh
-                         else contextlib.nullcontext())
-                with lease as token:
-                    queue_wait = time.monotonic() - submitted
-                    start = time.monotonic()
-
-                    def timing(extra_base):
-                        # elapsedSeconds is the job's OWN runtime:
-                        # epochs spent preempted (lease handed to
-                        # another pool) are reported separately so
-                        # throughput comparisons stay meaningful
-                        # under contention
-                        elapsed = time.monotonic() - start
-                        preempted = getattr(token, "preempted_seconds",
-                                            0.0)
-                        extra = dict(extra_base)
-                        extra["elapsedSeconds"] = round(
-                            elapsed - preempted, 6)
-                        if preempted > 0:
-                            extra["preemptedSeconds"] = round(
-                                preempted, 6)
-                            extra["leaseYields"] = token.yields
-                        return extra
-
-                    try:
-                        result = fn()
-                        if on_success is not None:
-                            on_success(result)
-                        if mark_finished:
-                            self._catalog.mark_finished(name)
-                        self._catalog.append_document(
-                            name, D.execution_document(
+            preempt.install_cancel(token)
+            try:
+                for attempt in range(attempts):
+                    if needs_mesh:
+                        failure = self._pod_failure_fn()
+                        if failure:
+                            # a degraded pod cannot run mesh
+                            # collectives: record a TERMINAL typed
+                            # failure instead of entering a jit that
+                            # would hang forever
+                            fail_all(D.execution_document(
                                 description, parameters,
-                                extra=timing(
-                                    {"queueWaitSeconds": round(
-                                        queue_wait, 6),
-                                     "attempt": attempt + 1})))
-                        return result
-                    except Exception as exception:  # noqa: BLE001
-                        traceback.print_exc()
-                        terminal = attempt + 1 >= attempts
-                        extra = timing({"attempt": attempt + 1})
-                        if needs_mesh and self._pod_failure_fn():
-                            # a mesh job failing WHILE the pod is
-                            # degraded is a worker-loss casualty (a
-                            # collective erroring out under it), not a
-                            # code failure — flag it so elastic
-                            # recovery requeues it on heal
-                            extra["workerLost"] = True
-                        doc = D.execution_document(
-                            description, parameters,
-                            exception=repr(exception), extra=extra)
-                        if terminal:
-                            fail_all(doc)
-                            # finished stays False (reference parity)
+                                exception=f"WorkerLost({failure!r})",
+                                extra={"workerLost": True,
+                                       "attempt": attempt + 1}))
                             return None
-                        self._catalog.append_document(name, doc)
+                    try:
+                        # cancelled/expired while queued in the thread
+                        # pool or during retry backoff: terminal, no
+                        # lease ever taken
+                        token.check()
+                        lease = (self._mesh.lease(pool, cancel=token)
+                                 if needs_mesh
+                                 else contextlib.nullcontext())
+                        with lease as lease_token:
+                            queue_wait = time.monotonic() - submitted
+                            self._set_status(name, D.STATUS_RUNNING)
+                            start = time.monotonic()
+
+                            def timing(extra_base):
+                                # elapsedSeconds is the job's OWN
+                                # runtime: epochs spent preempted
+                                # (lease handed to another pool) are
+                                # reported separately so throughput
+                                # comparisons stay meaningful under
+                                # contention
+                                elapsed = time.monotonic() - start
+                                preempted = getattr(
+                                    lease_token, "preempted_seconds",
+                                    0.0)
+                                extra = dict(extra_base)
+                                extra["elapsedSeconds"] = round(
+                                    elapsed - preempted, 6)
+                                if preempted > 0:
+                                    extra["preemptedSeconds"] = round(
+                                        preempted, 6)
+                                    extra["leaseYields"] = \
+                                        lease_token.yields
+                                return extra
+
+                            try:
+                                # chaos site: fires with the lease held
+                                # (hang mode simulates a wedged job
+                                # holding the mesh; raise mode a
+                                # transient attempt failure)
+                                faults.maybe_inject("job_run")
+                                result = fn()
+                                if on_success is not None:
+                                    on_success(result)
+                                if mark_finished:
+                                    self._catalog.mark_finished(name)
+                                self._set_status(name,
+                                                 D.STATUS_FINISHED)
+                                self._catalog.append_document(
+                                    name, D.execution_document(
+                                        description, parameters,
+                                        extra=timing(
+                                            {"queueWaitSeconds": round(
+                                                queue_wait, 6),
+                                             "attempt": attempt + 1})))
+                                return result
+                            except preempt.JobCancelled as exc:
+                                # deadline / DELETE / stall escalation
+                                # fired at a cooperative check inside
+                                # the job: terminal typed document,
+                                # lease released by the CM. A
+                                # checkpointed fit stays resumable — a
+                                # PATCH re-run picks up at the latest
+                                # orbax step.
+                                record_cancel(exc, attempt + 1, timing(
+                                    {"queueWaitSeconds": round(
+                                        queue_wait, 6)}))
+                                return None
+                            except Exception as exception:  # noqa: BLE001
+                                traceback.print_exc()
+                                kind = classify_error(exception)
+                                terminal = (kind == PERMANENT or
+                                            attempt + 1 >= attempts)
+                                extra = timing({"attempt": attempt + 1,
+                                                "errorKind": kind})
+                                if needs_mesh and self._pod_failure_fn():
+                                    # a mesh job failing WHILE the pod
+                                    # is degraded is a worker-loss
+                                    # casualty (a collective erroring
+                                    # out under it), not a code
+                                    # failure — flag it so elastic
+                                    # recovery requeues it on heal
+                                    extra["workerLost"] = True
+                                if terminal:
+                                    # worker-lost jobs stay out of the
+                                    # dead-letter state: the pod, not
+                                    # the job, failed, and elastic /
+                                    # boot recovery requeues them
+                                    if not extra.get("workerLost"):
+                                        extra[D.STATUS_FIELD] = \
+                                            D.STATUS_DEAD_LETTERED
+                                        extra["deadLettered"] = True
+                                        if kind == PERMANENT and \
+                                                max_retries > 0:
+                                            extra["retriesSkipped"] = \
+                                                "permanent error class"
+                                    doc = D.execution_document(
+                                        description, parameters,
+                                        exception=repr(exception),
+                                        extra=extra)
+                                    fail_all(doc)
+                                    if not extra.get("workerLost"):
+                                        self._set_status(
+                                            name,
+                                            D.STATUS_DEAD_LETTERED)
+                                    # finished stays False (reference
+                                    # parity)
+                                    return None
+                                backoff = self._backoff_seconds(attempt)
+                                extra["nextRetryInSeconds"] = round(
+                                    backoff, 3)
+                                self._catalog.append_document(
+                                    name, D.execution_document(
+                                        description, parameters,
+                                        exception=repr(exception),
+                                        extra=extra))
+                                self._count("retries")
+                                self._set_status(name, D.STATUS_QUEUED)
+                                # cancel-aware sleep: a DELETE or the
+                                # deadline interrupts the backoff and
+                                # the next loop's token.check() records
+                                # the terminal state
+                                token.wait(backoff)
+                    except preempt.JobCancelled as exc:
+                        # cancelled before holding the lease (thread-
+                        # pool queue, fair-queue wait, retry backoff)
+                        record_cancel(exc, attempt + 1, {
+                            "elapsedSeconds": round(
+                                time.monotonic() - submitted, 6),
+                            "queuedOnly": True})
+                        return None
+            finally:
+                preempt.clear_cancel()
 
         with self._lock:
             existing = self._futures.get(name)
@@ -193,6 +409,10 @@ class JobManager:
                     done_future: Future = Future()
                     done_future.set_result(None)
                     return done_future
+            # status must be queued BEFORE the pool can start run()
+            # (which flips it to running) — the reverse order could
+            # overwrite running with queued
+            self._set_status(name, D.STATUS_QUEUED)
             future = self._pool.submit(run)
             # prune finished entries so a long-lived server doesn't
             # leak a Future per job (results live in the catalog; wait()
@@ -201,21 +421,111 @@ class JobManager:
                     if f.done() and k != name]
             for k in done:
                 del self._futures[k]
-                self._mesh_jobs.pop(k, None)
+                self._job_info.pop(k, None)
+                self._stalled.discard(k)
             self._futures[name] = future
-            if needs_mesh:
-                self._mesh_jobs[name] = {"description": description,
-                                         "parameters": parameters}
+            self._job_info[name] = {"description": description,
+                                    "parameters": parameters,
+                                    "needs_mesh": needs_mesh,
+                                    "token": token}
         return future
 
+    # ------------------------------------------------------------------
+    def cancel(self, name: str, reason: str = D.STATUS_CANCELLED) -> bool:
+        """Request cooperative cancellation of job ``name`` (the
+        ``DELETE /{service}/{tool}/{name}/run`` backend). A job still
+        queued in the thread pool is cancelled outright (with its
+        terminal document written here, since ``run`` never executes);
+        a running job's token is flipped and the job records its own
+        terminal state at the next cooperative check. Returns False
+        when no live job exists under that name."""
+        with self._lock:
+            future = self._futures.get(name)
+            info = self._job_info.get(name)
+        if future is None or info is None or future.done():
+            return False
+        token: preempt.CancelToken = info["token"]
+        if future.cancel():
+            token.cancel(reason)
+            try:
+                self._catalog.append_document(
+                    name, D.execution_document(
+                        info.get("description", ""),
+                        info.get("parameters"),
+                        exception=f"JobCancelled({reason!r}: cancelled "
+                                  f"before the job started)",
+                        extra={D.STATUS_FIELD: reason,
+                               "cancelReason": reason,
+                               "attempt": 0, "queuedOnly": True}))
+            except Exception:  # noqa: BLE001 — collection may be gone
+                pass
+            self._set_status(name, reason)
+            self._count_cancel(reason)
+            return True
+        token.cancel(reason)
+        return True
+
+    # ------------------------------------------------------------------
+    def _watch_stalls(self) -> None:
+        """Stall watchdog (single-host mirror of the multi-host pod
+        guard): a live job whose progress heartbeat
+        (:func:`preempt.heartbeat`) went quiet for more than
+        ``stall_seconds`` is marked ``stalled`` in its metadata and —
+        when escalation is enabled — cancelled through its token.
+        Jobs that never beat (sklearn fits, ingests, functions) are
+        exempt; only a job that WAS reporting progress and stopped is
+        suspect. Heartbeat progress (step/epoch) is also published to
+        the metadata document here, throttled to the watch interval."""
+        interval = min(max(self._stall_seconds / 4.0, 0.05), 5.0)
+        while not self._watchdog_stop.wait(interval):
+            with self._lock:
+                live = [(k, v["token"]) for k, v in
+                        self._job_info.items()
+                        if k in self._futures and
+                        not self._futures[k].done()]
+            for name, token in live:
+                age = token.heartbeat_age()
+                if age is None:
+                    continue
+                progress = token.progress_snapshot()
+                if progress:
+                    try:
+                        self._catalog.update_metadata(
+                            name, {D.PROGRESS_FIELD: dict(
+                                progress,
+                                heartbeatAgeSeconds=round(age, 3))})
+                    except Exception:  # noqa: BLE001
+                        pass
+                if token.cancelled():
+                    continue
+                if age > self._stall_seconds:
+                    with self._lock:
+                        newly = name not in self._stalled
+                        self._stalled.add(name)
+                    if newly:
+                        self._set_status(name, D.STATUS_STALLED)
+                        self._count("stalledSeen")
+                        if self._stall_escalate and _single_host():
+                            token.cancel(D.STATUS_STALLED)
+                else:
+                    with self._lock:
+                        was = name in self._stalled
+                        self._stalled.discard(name)
+                    if was:
+                        # heartbeats resumed (a long compile, not a
+                        # wedge): un-flag, same as the pod guard's
+                        # heal path
+                        self._set_status(name, D.STATUS_RUNNING)
+
+    # ------------------------------------------------------------------
     def fail_running_mesh_jobs(self, reason: str) -> int:
         """Append a terminal ``WorkerLost`` execution document to every
         in-flight mesh job (their threads are stuck in collectives a
         dead worker will never join — clients polling the documents
         must see a typed failure, not silence). Returns the count."""
         with self._lock:
-            stuck = [(k, v) for k, v in self._mesh_jobs.items()
-                     if k in self._futures
+            stuck = [(k, v) for k, v in self._job_info.items()
+                     if v.get("needs_mesh") and k in self._futures
                      and not self._futures[k].done()]
         for name, info in stuck:
             self._catalog.append_document(
@@ -246,5 +556,29 @@ class JobManager:
         with self._lock:
             return sum(1 for f in self._futures.values() if not f.done())
 
-    def shutdown(self) -> None:
-        self._pool.shutdown(wait=False, cancel_futures=True)
+    def shutdown(self, cancel_futures: bool = True) -> None:
+        self._watchdog_stop.set()
+        self._pool.shutdown(wait=False, cancel_futures=cancel_futures)
+        if not cancel_futures:
+            return
+        # queued jobs the pool dropped would otherwise be silent
+        # finished=False orphans: record a terminal shutdownAborted
+        # document (requeueable executions/functions are picked up by
+        # the next boot's recover_unfinished)
+        with self._lock:
+            aborted = [(k, self._job_info.get(k) or {})
+                       for k, f in self._futures.items()
+                       if f.cancelled()]
+        for name, info in aborted:
+            try:
+                self._catalog.append_document(
+                    name, D.execution_document(
+                        info.get("description", ""),
+                        info.get("parameters"),
+                        exception="ShutdownAborted('server shut down "
+                                  "before this queued job started')",
+                        extra={D.STATUS_FIELD: D.STATUS_SHUTDOWN_ABORTED,
+                               "shutdownAborted": True}))
+                self._set_status(name, D.STATUS_SHUTDOWN_ABORTED)
+            except Exception:  # noqa: BLE001 — teardown best-effort
+                pass

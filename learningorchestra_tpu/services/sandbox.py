@@ -469,17 +469,54 @@ def _run_in_subprocess(code: str, parameters: Optional[Dict[str, Any]],
             "LANG": os.environ.get("LANG", "C.UTF-8"),
         }
         wall = max(30.0, cfg.sandbox_cpu_seconds * 2.0)
-        try:
-            proc = subprocess.run(
+        # Popen + poll instead of subprocess.run: the wait loop checks
+        # the job's cancel token, so a deadline expiry / DELETE /
+        # stall escalation kills the child interpreter promptly — the
+        # sandbox is the one user-code path with no cooperative
+        # check_cancel inside it. stderr goes to a file (not a pipe:
+        # nobody drains it while we poll, and a chatty child would
+        # deadlock on a full pipe buffer).
+        import time as _time
+
+        from learningorchestra_tpu.runtime import preempt
+
+        stderr_path = os.path.join(scratch, "__lo_stderr__")
+        token = preempt.current_cancel()
+        with open(stderr_path, "wb") as stderr_f:
+            proc = subprocess.Popen(
                 [sys.executable, "-c", _CHILD_BOOT],
-                input=pickle.dumps(payload), env=env, cwd=scratch,
-                capture_output=True, timeout=wall)
-        except subprocess.TimeoutExpired as e:
-            raise TimeoutError(
-                f"sandboxed code exceeded {wall:.0f}s wall clock") from e
+                stdin=subprocess.PIPE, stdout=subprocess.DEVNULL,
+                stderr=stderr_f, env=env, cwd=scratch)
+            try:
+                try:
+                    proc.stdin.write(pickle.dumps(payload))
+                    proc.stdin.close()
+                except BrokenPipeError:
+                    pass  # child died early; the exit path reports it
+                deadline = _time.monotonic() + wall
+                while True:
+                    try:
+                        proc.wait(timeout=0.1)
+                        break
+                    except subprocess.TimeoutExpired:
+                        pass
+                    if token is not None and token.cancelled():
+                        raise preempt.JobCancelled(
+                            token.reason or "cancelled",
+                            "sandboxed code cancelled")
+                    if _time.monotonic() >= deadline:
+                        raise TimeoutError(
+                            f"sandboxed code exceeded {wall:.0f}s "
+                            f"wall clock")
+            except BaseException:
+                if proc.poll() is None:
+                    proc.kill()
+                    proc.wait()
+                raise
         result_path = os.path.join(scratch, _RESULT_FILE)
         if not os.path.exists(result_path):
-            detail = (proc.stderr or b"")[-2000:].decode(errors="replace")
+            with open(stderr_path, "rb") as f:
+                detail = f.read()[-2000:].decode(errors="replace")
             raise RuntimeError(
                 f"sandboxed code died (exit {proc.returncode}): {detail}")
         with open(result_path, "rb") as f:

@@ -101,14 +101,29 @@ class FairLease:
             self._cv.notify_all()
 
     # -- mechanics -----------------------------------------------------
-    def acquire(self, pool: str = "default") -> None:
+    def acquire(self, pool: str = "default",
+                cancel: Optional["preempt.CancelToken"] = None) -> None:
+        """Block until granted. With a ``cancel`` token the wait is
+        cooperative: a cancelled/expired job raises
+        :class:`preempt.JobCancelled` from the QUEUE — it never takes
+        a lease it can no longer use, and a grant that races the
+        cancellation is handed back to the next waiter."""
         with self._cv:
             seq = self._seq
             self._seq += 1
             self._waiters.append((seq, pool))
             self._grant_next()
             while seq not in self._granted:
-                self._cv.wait()
+                self._cv.wait(0.1 if cancel is not None else None)
+                if cancel is not None and cancel.cancelled():
+                    if seq in self._granted:
+                        self._granted.discard(seq)
+                        self._grant_next()
+                    elif (seq, pool) in self._waiters:
+                        self._waiters.remove((seq, pool))
+                    raise preempt.JobCancelled(
+                        cancel.reason or "cancelled",
+                        "cancelled while waiting for the mesh lease")
             self._granted.discard(seq)
             self._holders += 1
 
@@ -137,23 +152,32 @@ class FairLease:
 
     # -- job-facing surface --------------------------------------------
     @contextlib.contextmanager
-    def lease(self, pool: str = "default") -> Iterator["LeaseToken"]:
+    def lease(self, pool: str = "default",
+              cancel: Optional["preempt.CancelToken"] = None,
+              ) -> Iterator["LeaseToken"]:
         """Hold the mesh fairly; installs the epoch-boundary yield
         point for the duration (so engine fits running on this thread
         hand the device to waiting pools between epochs). Yields a
         :class:`LeaseToken` whose ``preempted_seconds`` lets callers
-        subtract hand-off idle time from a job's own runtime."""
-        self.acquire(pool)
+        subtract hand-off idle time from a job's own runtime. With a
+        ``cancel`` token, both the initial acquire and every
+        post-yield re-acquire abort with :class:`preempt.JobCancelled`
+        the moment the job is cancelled or past its deadline — a
+        preempted-then-cancelled job never reclaims the device."""
+        self.acquire(pool, cancel)
         token = LeaseToken()
         start = [time.monotonic()]
+        held = [True]
         can_yield = _yield_enabled()
 
         def yield_point() -> None:
             if not can_yield or not self.contended_by_other(pool):
                 return
             self.release(pool, time.monotonic() - start[0])
+            held[0] = False
             t_wait = time.monotonic()
-            self.acquire(pool)
+            self.acquire(pool, cancel)
+            held[0] = True
             start[0] = time.monotonic()
             token.preempted_seconds += start[0] - t_wait
             token.yields += 1
@@ -167,7 +191,8 @@ class FairLease:
             yield token
         finally:
             preempt.restore(previous)
-            self.release(pool, time.monotonic() - start[0])
+            if held[0]:
+                self.release(pool, time.monotonic() - start[0])
 
 
 class LeaseToken:

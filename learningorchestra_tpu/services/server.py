@@ -139,9 +139,14 @@ class Api:
                  meta.get(D.METHOD_FIELD) is not None) or
                 (verb == "function" and
                  meta.get(D.FUNCTION_FIELD) is not None))
+            # shutdownAborted is the same story for a DRAINED server:
+            # the job never ran; the doc only exists so the orphan is
+            # not silent — requeue it like a mid-flight interruption
             docs = self.ctx.catalog.get_documents(name)
             if docs and docs[-1].get(D.EXCEPTION_FIELD) and \
-                    not (docs[-1].get("workerLost") and requeueable):
+                    not ((docs[-1].get("workerLost") or
+                          docs[-1].get("shutdownAborted"))
+                         and requeueable):
                 continue
             try:
                 if verb in EXECUTION_VERBS and \
@@ -163,7 +168,8 @@ class Api:
                     self.function._submit(
                         name, type_string, meta[D.FUNCTION_FIELD],
                         meta.get(D.FUNCTION_PARAMETERS_FIELD) or {},
-                        meta.get(D.DESCRIPTION_FIELD, ""), mode=mode)
+                        meta.get(D.DESCRIPTION_FIELD, ""), mode=mode,
+                        timeout=meta.get(V.TIMEOUT_FIELD))
                     requeued.append(name)
                 else:
                     self.ctx.catalog.append_document(
@@ -192,7 +198,8 @@ class Api:
             meta[D.METHOD_FIELD],
             meta.get(D.METHOD_PARAMETERS_FIELD) or {},
             meta.get(D.DESCRIPTION_FIELD, ""),
-            only_if_idle=only_if_idle)
+            only_if_idle=only_if_idle,
+            timeout=meta.get(V.TIMEOUT_FIELD))
 
     def recover_worker_lost(self) -> list:
         """Elastic pod recovery (beyond the reference, whose node loss
@@ -300,6 +307,7 @@ class Api:
         out["meshSecondsByPool"] = {
             pool: round(seconds, 3) for pool, seconds in
             sorted(self.ctx.jobs.mesh_served().items())}
+        out["jobLifecycle"] = self.ctx.jobs.lifecycle_counters()
         return out
 
     def metrics_prometheus(self) -> bytes:
@@ -345,6 +353,17 @@ class Api:
             f"lo_get_cache_hits_total {m['getCache']['hits']}",
             "# TYPE lo_get_cache_misses_total counter",
             f"lo_get_cache_misses_total {m['getCache']['misses']}",
+        ]
+        lifecycle = m["jobLifecycle"]
+        lines += [
+            "# TYPE lo_job_retries_total counter",
+            f"lo_job_retries_total {lifecycle.get('retries', 0)}",
+            "# TYPE lo_jobs_cancelled_total counter",
+            f"lo_jobs_cancelled_total {lifecycle.get('cancelled', 0)}",
+            "# TYPE lo_jobs_timed_out_total counter",
+            f"lo_jobs_timed_out_total {lifecycle.get('timedOut', 0)}",
+            "# TYPE lo_jobs_stalled gauge",
+            f"lo_jobs_stalled {lifecycle.get('stalled', 0)}",
         ]
         return ("\n".join(lines) + "\n").encode()
 
@@ -502,6 +521,13 @@ class Api:
 
     def _delete(self, service: str, tool: str, name: str,
                 ) -> Tuple[int, Any, str]:
+        # ``DELETE .../{name}/run`` cancels the RUNNING JOB, keeping
+        # the collection (safe_name forbids "/", so no real collection
+        # can shadow the suffix). The job's cancel token flips and the
+        # terminal ``cancelled`` document is written at the next
+        # cooperative check (docs/LIFECYCLE.md).
+        if name.endswith("/run") and len(name) > len("/run"):
+            return self._cancel_run(name[:-len("/run")])
         if service == "dataset":
             status, payload = self.dataset.delete_file(name)
         elif service == "model":
@@ -513,6 +539,17 @@ class Api:
         else:
             raise V.HttpError(404, "unknown route")
         return status, payload, "application/json"
+
+    def _cancel_run(self, name: str) -> Tuple[int, Any, str]:
+        if self.ctx.catalog.get_metadata(name) is None:
+            raise V.HttpError(V.HTTP_NOT_FOUND,
+                              f"{V.MESSAGE_NONEXISTENT_FILE}: {name}")
+        if not self.ctx.jobs.cancel(name):
+            raise V.HttpError(V.HTTP_NOT_ACCEPTABLE,
+                              f"no cancellable job for {name} (already "
+                              f"finished or never submitted here)")
+        return 200, {"result": f"cancellation requested for {name}"}, \
+            "application/json"
 
     def _get(self, service: str, tool: str, name: Optional[str],
              params: Dict[str, Any]) -> Tuple[int, Any, str]:

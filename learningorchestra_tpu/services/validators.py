@@ -50,6 +50,25 @@ class HttpError(Exception):
         self.findings = list(findings) if findings else []
 
 
+TIMEOUT_FIELD = "timeout"
+
+
+def valid_timeout(value: Any) -> Optional[float]:
+    """Optional per-job deadline request field: a positive number of
+    seconds, or None (falls back to ``LO_JOB_TIMEOUT``). Bools are
+    rejected explicitly — ``"timeout": true`` is a spec typo, and bool
+    is an int subclass."""
+    if value is None:
+        return None
+    if isinstance(value, bool) or not isinstance(value, (int, float)) \
+            or value <= 0:
+        raise HttpError(
+            HTTP_NOT_ACCEPTABLE,
+            f"{MESSAGE_INVALID_FIELD}: timeout must be a positive "
+            f"number of seconds, got {value!r}")
+    return float(value)
+
+
 def run_preflight(findings) -> list:
     """Gate a request on analyzer findings: raise a 406 carrying the
     full structured finding list if any error-severity finding fired,

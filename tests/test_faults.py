@@ -4,8 +4,11 @@ deterministically fails chosen sites; job_max_retries re-runs the
 pipeline; execution documents record every attempt."""
 
 import dataclasses
+import threading
+import time
 
 import numpy as np
+import pytest
 
 from learningorchestra_tpu.services import faults
 from learningorchestra_tpu.services.context import ServiceContext
@@ -20,6 +23,100 @@ def _ctx(tmp_config, **overrides):
     cfg = dataclasses.replace(tmp_config, **overrides)
     config_mod.set_config(cfg)
     return ServiceContext(cfg)
+
+
+# ----------------------------------------------------------------------
+# spec grammar: site[:count[:mode[:arg]]], comma-separated
+# ----------------------------------------------------------------------
+def test_parse_spec_multi_site_and_defaults():
+    entries = faults.parse_spec("a, b:3, c:2:latency:0.5, d::hang")
+    assert entries["a"] == faults.FaultSpec("a", 1, "raise", None)
+    assert entries["b"].count == 3 and entries["b"].mode == "raise"
+    assert entries["c"].count == 2
+    assert entries["c"].mode == "latency" and entries["c"].arg == 0.5
+    assert entries["d"].count == 1 and entries["d"].mode == "hang"
+    assert faults.parse_spec("") == {}
+    # last entry per site wins (operator override idiom)
+    assert faults.parse_spec("s:1, s:7")["s"].count == 7
+
+
+def test_parse_spec_malformed_entries_raise():
+    for bad in ("site:x",          # count not an int
+                ":3",              # empty site
+                "s:1:explode",     # unknown mode
+                "s:1:latency:abc",  # arg not a float
+                "s:1:hang:1:extra"):  # too many fields
+        with pytest.raises(ValueError):
+            faults.parse_spec(bad)
+
+
+def test_reset_isolates_budgets(tmp_config):
+    """reset() clears the fired budget so each test arms a fresh
+    injector — the per-site count re-fires after reset."""
+    import dataclasses as dc
+
+    from learningorchestra_tpu import config as config_mod
+
+    config_mod.set_config(dc.replace(tmp_config,
+                                     fault_inject="site_x:1"))
+    faults.reset()
+    with pytest.raises(faults.InjectedFault):
+        faults.maybe_inject("site_x")
+    faults.maybe_inject("site_x")  # budget consumed -> no-op
+    faults.maybe_inject("other_site")  # un-armed site -> no-op
+    faults.reset()
+    with pytest.raises(faults.InjectedFault):
+        faults.maybe_inject("site_x")  # fresh budget after reset
+    faults.reset()
+
+
+def test_latency_mode_delays_then_proceeds(tmp_config):
+    import dataclasses as dc
+
+    from learningorchestra_tpu import config as config_mod
+
+    config_mod.set_config(dc.replace(
+        tmp_config, fault_inject="lat_site:1:latency:0.2"))
+    faults.reset()
+    try:
+        t0 = time.monotonic()
+        faults.maybe_inject("lat_site")  # injects the delay, no raise
+        assert time.monotonic() - t0 >= 0.15
+        t0 = time.monotonic()
+        faults.maybe_inject("lat_site")  # budget exhausted
+        assert time.monotonic() - t0 < 0.1
+    finally:
+        faults.reset()
+
+
+def test_hang_mode_is_bounded_and_cancellable(tmp_config):
+    """hang mode wedges cooperatively: a bounded hang returns on its
+    own; an open-ended one is reclaimed through the cancel token (the
+    mechanism the deadline/stall watchdog relies on)."""
+    import dataclasses as dc
+
+    from learningorchestra_tpu import config as config_mod
+    from learningorchestra_tpu.runtime import preempt
+
+    config_mod.set_config(dc.replace(
+        tmp_config, fault_inject="h_short:1:hang:0.2,h_long:1:hang:30"))
+    faults.reset()
+    try:
+        t0 = time.monotonic()
+        faults.maybe_inject("h_short")  # bounded: returns by itself
+        assert 0.15 <= time.monotonic() - t0 < 5
+        token = preempt.CancelToken()
+        preempt.install_cancel(token)
+        try:
+            threading.Timer(0.2, token.cancel).start()
+            t0 = time.monotonic()
+            with pytest.raises(preempt.JobCancelled):
+                faults.maybe_inject("h_long")
+            assert time.monotonic() - t0 < 5
+        finally:
+            preempt.clear_cancel()
+    finally:
+        faults.reset()
 
 
 def test_injected_fault_fails_job_and_records_attempt(tmp_config):

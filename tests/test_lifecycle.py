@@ -1,0 +1,395 @@
+"""Job lifecycle hardening (docs/LIFECYCLE.md): per-job deadlines,
+cooperative cancellation (DELETE .../run), the stall watchdog, and
+classified retries with backoff. The reference's only job state is the
+``finished`` flag and its only failure response is Swarm restart
+(SURVEY §5, §L2) — these tests pin the rebuild's guarantee that no
+single request can wedge the accelerator."""
+
+import dataclasses
+import threading
+import time
+
+import pytest
+
+from learningorchestra_tpu.catalog import documents as D
+from learningorchestra_tpu.runtime import preempt
+from learningorchestra_tpu.services import faults
+from learningorchestra_tpu.services.context import ServiceContext
+from learningorchestra_tpu.services.function_service import FunctionService
+from learningorchestra_tpu.services.jobs import JobManager, classify_error
+
+
+def _ctx(tmp_config, **overrides):
+    """Install the overridden config GLOBALLY (faults/sandbox read
+    get_config()) and build a context on it."""
+    from learningorchestra_tpu import config as config_mod
+
+    cfg = dataclasses.replace(tmp_config, **overrides)
+    config_mod.set_config(cfg)
+    return ServiceContext(cfg)
+
+
+# ----------------------------------------------------------------------
+# deadlines
+# ----------------------------------------------------------------------
+def test_timed_out_job_releases_mesh_lease_for_next_job(tmp_config):
+    """The acceptance scenario: an injected-hang job exceeds its
+    deadline, its mesh lease is released (a second mesh job then runs
+    to completion), and the terminal document records timedOut with
+    elapsed/attempt fields."""
+    faults.reset()
+    ctx = _ctx(tmp_config, fault_inject="job_run:1:hang")
+    try:
+        ctx.catalog.create_collection("hang_job", "train/tensorflow")
+        ctx.catalog.create_collection("next_job", "evaluate/tensorflow")
+        ctx.jobs.submit("hang_job", lambda: "never", needs_mesh=True,
+                        pool="train", timeout=0.5)
+        ctx.jobs.submit("next_job", lambda: "ran", needs_mesh=True,
+                        pool="evaluate")
+        # the second job can only complete if the hung job's deadline
+        # fired and handed the (capacity-1) lease back
+        assert ctx.jobs.wait("next_job", timeout=30) == "ran"
+        ctx.jobs.wait("hang_job", timeout=30)
+
+        meta = ctx.catalog.get_metadata("hang_job")
+        assert meta["finished"] is False
+        assert meta[D.STATUS_FIELD] == D.STATUS_TIMED_OUT
+        doc = ctx.catalog.get_documents("hang_job")[-1]
+        assert "JobCancelled" in doc["exception"]
+        assert "timedOut" in doc["exception"]
+        assert doc[D.STATUS_FIELD] == D.STATUS_TIMED_OUT
+        assert doc["attempt"] == 1
+        assert doc["elapsedSeconds"] > 0
+        assert ctx.catalog.get_metadata("next_job")["finished"] is True
+        assert ctx.jobs.lifecycle_counters()["timedOut"] == 1
+    finally:
+        faults.reset()
+        ctx.close()
+
+
+def test_function_timeout_kills_sandbox_subprocess(tmp_config):
+    """A function job past its request-level deadline is reclaimed
+    even though the user code runs in a separate process (the sandbox
+    poll loop honors the cancel token and kills the child)."""
+    ctx = _ctx(tmp_config)
+    try:
+        fs = FunctionService(ctx)
+        fs.create({"name": "slowf",
+                   "function": "import time\n"
+                               "for _ in range(600):\n"
+                               "    time.sleep(0.1)\n"
+                               "response = 1\n",
+                   "functionParameters": {}, "timeout": 2.0})
+        ctx.jobs.wait("slowf", timeout=60)
+        meta = ctx.catalog.get_metadata("slowf")
+        assert meta["finished"] is False
+        assert meta[D.STATUS_FIELD] == D.STATUS_TIMED_OUT
+        assert meta["timeout"] == 2.0  # requeues replay the deadline
+        doc = ctx.catalog.get_documents("slowf")[-1]
+        assert doc[D.STATUS_FIELD] == D.STATUS_TIMED_OUT
+        assert doc["cancelReason"] == "timedOut"
+    finally:
+        ctx.close()
+
+
+def test_timeout_field_validation(tmp_config):
+    from learningorchestra_tpu.services import validators as V
+
+    ctx = _ctx(tmp_config)
+    try:
+        fs = FunctionService(ctx)
+        for bad in (-1, 0, True, "5"):
+            with pytest.raises(V.HttpError):
+                fs.create({"name": "tv", "function": "response = 1",
+                           "functionParameters": {}, "timeout": bad})
+        assert V.valid_timeout(None) is None
+        assert V.valid_timeout(3) == 3.0
+    finally:
+        ctx.close()
+
+
+# ----------------------------------------------------------------------
+# cancellation API
+# ----------------------------------------------------------------------
+def test_client_cancel_via_rest(tmp_config):
+    """End-to-end: Client.cancel() -> DELETE .../{name}/run -> the
+    running job's terminal document says ``cancelled`` (distinct from
+    timedOut)."""
+    from learningorchestra_tpu.client import Context
+    from learningorchestra_tpu.services.server import RestServer
+
+    ctx = _ctx(tmp_config)
+    server = RestServer(ctx, host="127.0.0.1", port=0).start()
+    try:
+        client = Context(server.base_url)
+        client.function_python.run_function(
+            "cancel_me",
+            "import time\n"
+            "for _ in range(600):\n"
+            "    time.sleep(0.1)\n"
+            "response = 1\n")
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            meta = ctx.catalog.get_metadata("cancel_me")
+            if meta.get(D.STATUS_FIELD) == D.STATUS_RUNNING:
+                break
+            time.sleep(0.05)
+        result = client.function_python.cancel("cancel_me")
+        assert "cancellation requested" in result
+        try:
+            ctx.jobs.wait("cancel_me", timeout=30)
+        except Exception:  # noqa: BLE001 — future cancelled pre-start
+            pass
+        meta = client.function_python.metadata("cancel_me")
+        assert meta["finished"] is False
+        assert meta[D.STATUS_FIELD] == D.STATUS_CANCELLED
+        docs = ctx.catalog.get_documents("cancel_me")
+        assert any("JobCancelled" in (d.get("exception") or "")
+                   for d in docs)
+        assert ctx.jobs.lifecycle_counters()["cancelled"] == 1
+        # a second cancel finds nothing cancellable -> 406
+        from learningorchestra_tpu.client import ApiError
+
+        with pytest.raises(ApiError) as err:
+            client.function_python.cancel("cancel_me")
+        assert err.value.status == 406
+        # unknown name -> 404
+        with pytest.raises(ApiError) as err:
+            client.function_python.cancel("never_existed")
+        assert err.value.status == 404
+    finally:
+        server.stop()
+
+
+def test_cancel_while_waiting_for_lease(tmp_config, catalog):
+    """A job cancelled while queued behind the mesh lease never takes
+    the device: it records a queued-only cancelled document and the
+    holder is undisturbed."""
+    jobs = JobManager(catalog, max_workers=4, mesh_leases=1)
+    catalog.create_collection("holder", "train/tensorflow")
+    catalog.create_collection("queued", "evaluate/tensorflow")
+    release = threading.Event()
+    started = threading.Event()
+
+    def hold():
+        started.set()
+        release.wait(20)
+        return "held"
+
+    jobs.submit("holder", hold, needs_mesh=True, pool="train")
+    assert started.wait(10)
+    jobs.submit("queued", lambda: "nope", needs_mesh=True,
+                pool="evaluate")
+    time.sleep(0.3)  # let it reach the fair queue's cancel-aware wait
+    assert jobs.cancel("queued") is True
+    try:
+        jobs.wait("queued", timeout=10)
+    except Exception:  # noqa: BLE001 — future cancelled pre-start
+        pass
+    release.set()
+    assert jobs.wait("holder", timeout=10) == "held"
+    doc = catalog.get_documents("queued")[-1]
+    assert doc[D.STATUS_FIELD] == D.STATUS_CANCELLED
+    assert catalog.get_metadata("queued")[D.STATUS_FIELD] == \
+        D.STATUS_CANCELLED
+    assert catalog.get_metadata("queued")["finished"] is False
+    assert jobs.cancel("queued") is False  # nothing live anymore
+    jobs.shutdown()
+
+
+def test_cancel_unknown_job_returns_false(tmp_config, catalog):
+    jobs = JobManager(catalog, max_workers=2)
+    assert jobs.cancel("ghost") is False
+    jobs.shutdown()
+
+
+# ----------------------------------------------------------------------
+# classified retries with backoff
+# ----------------------------------------------------------------------
+def test_classify_error_taxonomy():
+    assert classify_error(faults.InjectedFault("x")) == "transient"
+    assert classify_error(IOError("disk detached")) == "transient"
+    assert classify_error(MemoryError()) == "transient"
+    assert classify_error(ConnectionResetError()) == "transient"
+    assert classify_error(
+        RuntimeError("RESOURCE_EXHAUSTED: out of memory while "
+                     "allocating")) == "transient"
+    assert classify_error(ValueError("bad arg")) == "permanent"
+    assert classify_error(TypeError("wrong type")) == "permanent"
+    assert classify_error(KeyError("missing")) == "permanent"
+
+
+def test_transient_fault_retries_with_backoff_then_succeeds(tmp_config):
+    faults.reset()
+    ctx = _ctx(tmp_config, fault_inject="job_run:2",
+               retry_backoff_seconds=0.05,
+               retry_backoff_max_seconds=0.2)
+    try:
+        ctx.catalog.create_collection("r1", "train/tensorflow")
+        ctx.jobs.submit("r1", lambda: "ok", max_retries=3)
+        assert ctx.jobs.wait("r1", timeout=30) == "ok"
+        meta = ctx.catalog.get_metadata("r1")
+        assert meta["finished"] is True
+        assert meta[D.STATUS_FIELD] == D.STATUS_FINISHED
+        docs = ctx.catalog.get_documents("r1")
+        errs = [d for d in docs if d.get("exception")]
+        assert len(errs) == 2
+        assert all(d["errorKind"] == "transient" for d in errs)
+        assert all("nextRetryInSeconds" in d for d in errs)
+        assert docs[-1]["attempt"] == 3
+        assert ctx.jobs.lifecycle_counters()["retries"] == 2
+    finally:
+        faults.reset()
+        ctx.close()
+
+
+def test_permanent_error_dead_letters_without_retry(tmp_config):
+    ctx = _ctx(tmp_config)
+    try:
+        ctx.catalog.create_collection("p1", "function/python")
+        calls = []
+
+        def bad():
+            calls.append(1)
+            raise ValueError("user bug")
+
+        ctx.jobs.submit("p1", bad, max_retries=3)
+        ctx.jobs.wait("p1", timeout=30)
+        assert calls == [1]  # no retry for a permanent error class
+        meta = ctx.catalog.get_metadata("p1")
+        assert meta["finished"] is False
+        assert meta[D.STATUS_FIELD] == D.STATUS_DEAD_LETTERED
+        doc = ctx.catalog.get_documents("p1")[-1]
+        assert doc["deadLettered"] is True
+        assert doc["errorKind"] == "permanent"
+        assert doc["retriesSkipped"] == "permanent error class"
+        assert "ValueError" in doc["exception"]
+    finally:
+        ctx.close()
+
+
+def test_exhausted_transient_budget_dead_letters(tmp_config):
+    ctx = _ctx(tmp_config, retry_backoff_seconds=0.02)
+    try:
+        ctx.catalog.create_collection("x1", "function/python")
+        calls = []
+
+        def always_transient():
+            calls.append(1)
+            raise IOError("flaky forever")
+
+        ctx.jobs.submit("x1", always_transient, max_retries=2)
+        ctx.jobs.wait("x1", timeout=30)
+        assert calls == [1, 1, 1]  # initial + 2 retries
+        meta = ctx.catalog.get_metadata("x1")
+        assert meta[D.STATUS_FIELD] == D.STATUS_DEAD_LETTERED
+        doc = ctx.catalog.get_documents("x1")[-1]
+        assert doc["deadLettered"] is True
+        assert doc["errorKind"] == "transient"
+        assert doc["attempt"] == 3
+    finally:
+        ctx.close()
+
+
+# ----------------------------------------------------------------------
+# stall watchdog
+# ----------------------------------------------------------------------
+def test_stall_watchdog_marks_and_escalates(tmp_config, catalog):
+    """A job that published a heartbeat and then went quiet past
+    LO_STALL_SECONDS is marked stalled and (single-host) escalated to
+    cooperative cancellation."""
+    jobs = JobManager(catalog, max_workers=2, stall_seconds=0.3,
+                      stall_escalate=True)
+    catalog.create_collection("wedge", "train/tensorflow")
+
+    def wedged():
+        preempt.heartbeat(step=1, epoch=0)  # one beat, then silence
+        while True:
+            preempt.check_cancel()
+            time.sleep(0.02)
+
+    jobs.submit("wedge", wedged)
+    jobs.wait("wedge", timeout=20)
+    meta = catalog.get_metadata("wedge")
+    assert meta["finished"] is False
+    assert meta[D.STATUS_FIELD] == D.STATUS_STALLED
+    # the watchdog published the last-seen progress counters
+    assert meta[D.PROGRESS_FIELD]["step"] == 1
+    doc = catalog.get_documents("wedge")[-1]
+    assert doc[D.STATUS_FIELD] == D.STATUS_STALLED
+    assert "stalled" in doc["exception"]
+    jobs.shutdown()
+
+
+def test_job_without_heartbeats_is_never_stalled(tmp_config, catalog):
+    """Jobs that never publish progress (sklearn fits, ingests) are
+    exempt: only a heartbeat that STOPPED is suspect."""
+    jobs = JobManager(catalog, max_workers=2, stall_seconds=0.1,
+                      stall_escalate=True)
+    catalog.create_collection("quiet", "function/python")
+
+    def quiet():
+        time.sleep(0.5)  # longer than stall_seconds, no beats
+        return "done"
+
+    jobs.submit("quiet", quiet)
+    assert jobs.wait("quiet", timeout=10) == "done"
+    assert catalog.get_metadata("quiet")[D.STATUS_FIELD] == \
+        D.STATUS_FINISHED
+    jobs.shutdown()
+
+
+# ----------------------------------------------------------------------
+# shutdown + metrics
+# ----------------------------------------------------------------------
+def test_shutdown_records_aborted_docs(tmp_config, catalog):
+    """A drained server leaves no silent finished=False orphans: jobs
+    the pool dropped get a terminal shutdownAborted document."""
+    jobs = JobManager(catalog, max_workers=1)
+    catalog.create_collection("blocker", "function/python")
+    catalog.create_collection("starved", "function/python")
+    release = threading.Event()
+    started = threading.Event()
+
+    def hold():
+        started.set()
+        release.wait(10)
+        return "done"
+
+    jobs.submit("blocker", hold)
+    assert started.wait(5)
+    jobs.submit("starved", lambda: "never")
+    jobs.shutdown()
+    release.set()
+    doc = catalog.get_documents("starved")[-1]
+    assert "ShutdownAborted" in doc["exception"]
+    assert doc[D.STATUS_FIELD] == D.STATUS_SHUTDOWN_ABORTED
+    assert doc["shutdownAborted"] is True
+    assert catalog.get_metadata("starved")[D.STATUS_FIELD] == \
+        D.STATUS_SHUTDOWN_ABORTED
+
+
+def test_lifecycle_metrics_exported(tmp_config):
+    from learningorchestra_tpu.services.server import Api
+
+    ctx = _ctx(tmp_config)
+    api = Api(ctx)
+    try:
+        assert api.metrics()["jobLifecycle"]["retries"] == 0
+        text = api.metrics_prometheus().decode()
+        for metric in ("lo_job_retries_total", "lo_jobs_cancelled_total",
+                       "lo_jobs_timed_out_total", "lo_jobs_stalled"):
+            assert metric in text
+    finally:
+        ctx.close()
+
+
+def test_status_field_narrates_success(tmp_config, catalog):
+    jobs = JobManager(catalog, max_workers=2)
+    catalog.create_collection("okj", "function/python")
+    jobs.submit("okj", lambda: 7)
+    assert jobs.wait("okj", timeout=10) == 7
+    meta = catalog.get_metadata("okj")
+    assert meta["finished"] is True
+    assert meta[D.STATUS_FIELD] == D.STATUS_FINISHED
+    jobs.shutdown()
