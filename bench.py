@@ -93,7 +93,8 @@ TLM_ATTENTION = os.environ.get("LO_BENCH_TLM_ATTENTION", "auto")
 # per-phase wall-clock bounds (seconds); overridable for local smoke
 # runs via LO_BENCH_TIMEOUT_<PHASE>
 PHASE_TIMEOUTS = {"cnn": 600, "lstm": 600, "tlm": 900, "proxy": 120,
-                  "builder": 600, "builder_mesh": 600, "flash": 600,
+                  "builder": 600, "builder_mesh": 600,
+                  "warm_pipeline": 600, "flash": 600,
                   "ingest": 600, "gen": 900}
 
 # out-of-core Builder (reference config 4: 10M-row GBT via Spark)
@@ -569,6 +570,68 @@ def phase_builder_mesh():
     return out
 
 
+def phase_warm_pipeline():
+    """Feature-plane cache effect (docs/PERFORMANCE.md): the SAME
+    mesh-parallel builder pipeline run twice on an unchanged dataset.
+    The cold run pays Parquet read -> pandas -> numpy -> device_put ->
+    trace+compile; the warm run should serve the host tier, the HBM
+    arena and the executable cache — the reported deltas are the
+    regression guard CI's perf-smoke stage asserts on."""
+    import jax
+
+    from learningorchestra_tpu.runtime import arena as arena_lib
+    from learningorchestra_tpu.runtime import engine as engine_lib
+
+    rows = int(os.environ.get("LO_BENCH_WARM_ROWS", "200000"))
+    api, prefix = _make_api()
+    cat = api.ctx.catalog
+    _write_builder_synth(cat, "wp_train", rows, 1)
+    _write_builder_synth(cat, "wp_test", max(rows // 20, 1), 2)
+    modeling = (
+        "import numpy as np\n"
+        "feats = [c for c in training_df.columns"
+        " if c not in ('label', '_id')]\n"
+        "features_training = (training_df[feats].to_numpy(np.float32),"
+        " training_df['label'].to_numpy())\n"
+        "features_testing = testing_df[feats].to_numpy(np.float32)\n"
+        "features_evaluation = (testing_df[feats].to_numpy(np.float32),"
+        " testing_df['label'].to_numpy())\n")
+
+    out = {"rows": rows}
+    for label in ("cold", "warm"):
+        t0 = time.perf_counter()
+        status, body, _ = api.dispatch(
+            "POST", f"{prefix}/builder/sparkml", {}, {
+                "trainDatasetName": "wp_train",
+                "testDatasetName": "wp_test",
+                "evaluationDatasetName": "wp_test",
+                "modelingCode": modeling,
+                "classifiersList": ["LR", "NB"],
+                "meshParallel": True})
+        _expect_created(status, body)
+        for uri in body["result"]:
+            _wait(api, uri, timeout=540)
+        elapsed = time.perf_counter() - t0
+        out[label] = {
+            "pipeline_seconds": round(elapsed, 2),
+            "featureCache": api.ctx.features.stats(),
+            "arena": arena_lib.get_default_arena().stats(),
+            "executableCache": engine_lib.executable_cache_stats()}
+    api.ctx.jobs.shutdown()
+    out["warm_feature_hits"] = (out["warm"]["featureCache"]["hits"]
+                                - out["cold"]["featureCache"]["hits"])
+    out["warm_arena_hits"] = (out["warm"]["arena"]["hits"]
+                              - out["cold"]["arena"]["hits"])
+    out["warm_executable_hits"] = (
+        out["warm"]["executableCache"]["hits"]
+        - out["cold"]["executableCache"]["hits"])
+    out["speedup"] = round(
+        out["cold"]["pipeline_seconds"]
+        / max(out["warm"]["pipeline_seconds"], 1e-9), 2)
+    out["platform"] = jax.devices()[0].platform
+    return out
+
+
 def phase_ingest():
     """Dataset-ingest throughput via POST /dataset/csv (SURVEY §3.1
     calls the reference's per-row insert_one loop "a known throughput
@@ -709,6 +772,7 @@ def phase_proxy(max_seconds=60.0):
 PHASES = {"cnn": phase_cnn, "lstm": phase_lstm, "tlm": phase_tlm,
           "proxy": phase_proxy, "builder": phase_builder,
           "builder_mesh": phase_builder_mesh,
+          "warm_pipeline": phase_warm_pipeline,
           "flash": phase_flash, "ingest": phase_ingest,
           "gen": phase_gen}
 
@@ -880,6 +944,7 @@ def main(argv=None):
         "LO_BENCH_TLM_EPOCHS": "2", "LO_BENCH_TLM_SEQ": "128",
         # 2M-row jax LR at CPU dispatch overhead would eat minutes
         "LO_BENCH_BUILDER_MESH_ROWS": "200000",
+        "LO_BENCH_WARM_ROWS": "50000",
     }
     env = None if tpu_ok else cpu_env
 
@@ -904,6 +969,7 @@ def main(argv=None):
             models["transformer_lm"] = retry
     models["builder_10m_streaming"] = _run_phase("builder", env)
     models["builder_mesh_2m"] = _run_phase("builder_mesh", env)
+    models["warm_pipeline"] = _run_phase("warm_pipeline", env)
     models["csv_ingest"] = _run_phase("ingest", env)
     gen_cpu_env = dict(cpu_env, LO_BENCH_GEN_TOKENS="32",
                        LO_BENCH_GEN_PROMPT="16", LO_BENCH_GEN_BATCH="2")

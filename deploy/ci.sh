@@ -6,6 +6,7 @@
 # Usage: deploy/ci.sh            (from anywhere; paths are self-rooted)
 # Env:   LO_CI_TIMEOUT        seconds for the tier-1 run (default 870)
 #        LO_CI_CHAOS_TIMEOUT  seconds for the chaos stage (default 300)
+#        LO_CI_PERF_TIMEOUT   seconds for the perf-smoke stage (default 600)
 
 set -euo pipefail
 
@@ -32,5 +33,41 @@ timeout -k 10 "$CHAOS_TIMEOUT" env JAX_PLATFORMS=cpu \
     LO_FAULT_INJECT="job_run:1:hang:0.2,artifact_save:1:latency:0.05" \
     python -m pytest tests/test_faults.py tests/test_lifecycle.py -q \
     -p no:cacheprovider -p no:xdist -p no:randomly
+
+echo "== perf-smoke: warm pipeline must hit the feature-plane cache =="
+# Runs the builder pipeline twice on one small dataset (bench.py
+# warm_pipeline) and asserts the warm run actually reused cached
+# state: cache hits > 0 and warm pipeline_seconds <= cold. The XLA
+# compilation cache gets a FRESH directory — deserializing persisted
+# CPU executables is unreliable on this jaxlib (see tests/conftest.py).
+PERF_TIMEOUT="${LO_CI_PERF_TIMEOUT:-600}"
+PERF_CACHE="$(mktemp -d)"
+PERF_OUT="$(mktemp)"
+trap 'rm -rf "$PERF_CACHE" "$PERF_OUT"' EXIT
+timeout -k 10 "$PERF_TIMEOUT" env JAX_PLATFORMS=cpu \
+    JAX_COMPILATION_CACHE_DIR="$PERF_CACHE" \
+    LO_COMPUTE_DTYPE=float32 \
+    LO_BENCH_WARM_ROWS=20000 \
+    python bench.py --phase warm_pipeline | tee "$PERF_OUT"
+python - "$PERF_OUT" <<'EOF'
+import json, sys
+
+mark = "@@LO_BENCH_RESULT@@"
+result = None
+for line in reversed(open(sys.argv[1]).read().splitlines()):
+    if line.startswith(mark):
+        result = json.loads(line[len(mark):])
+        break
+assert result is not None, "perf-smoke: no bench result line"
+assert "error" not in result, f"perf-smoke: phase failed: {result}"
+result = result.get("result", result)  # unwrap the ok-envelope
+hits = (result["warm_feature_hits"] + result["warm_arena_hits"]
+        + result["warm_executable_hits"])
+cold = result["cold"]["pipeline_seconds"]
+warm = result["warm"]["pipeline_seconds"]
+assert hits > 0, f"perf-smoke: warm run hit no caches: {result}"
+assert warm <= cold, f"perf-smoke: warm {warm}s slower than cold {cold}s"
+print(f"perf-smoke: OK (cold {cold}s, warm {warm}s, {hits} cache hits)")
+EOF
 
 echo "== ci: OK =="

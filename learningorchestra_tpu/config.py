@@ -146,6 +146,16 @@ class Config:
     param_cache_bytes: int = dataclasses.field(
         default_factory=lambda: int(os.environ.get(
             "LO_PARAM_CACHE", str(256 << 20))))
+    # Feature-plane cache (docs/PERFORMANCE.md). HBM tier budget:
+    # bytes of device memory the arena may hold resident between jobs;
+    # -1 = auto (a quarter of one device's memory), 0 disables.
+    arena_bytes: int = dataclasses.field(
+        default_factory=lambda: int(os.environ.get("LO_ARENA_BYTES", "-1")))
+    # Persistent XLA compilation cache directory; empty = off. Opt-in:
+    # deserializing XLA:CPU executables is unstable on some jaxlib
+    # builds (tests/conftest.py), so this never defaults on.
+    xla_cache_dir: str = dataclasses.field(
+        default_factory=lambda: os.environ.get("LO_XLA_CACHE_DIR", ""))
     fault_inject: str = dataclasses.field(
         default_factory=lambda: os.environ.get("LO_FAULT_INJECT", ""))
 
@@ -220,6 +230,13 @@ def _reset_mesh() -> None:
         from learningorchestra_tpu.runtime import mesh as mesh_lib
         mesh_lib.reset_default_mesh()
     except ImportError:  # jax not importable in this context
+        pass
+    # arena entries are keyed by mesh + dataset version; both are
+    # invalid across a config swap
+    try:
+        from learningorchestra_tpu.runtime import arena as arena_lib
+        arena_lib.reset_default_arena()
+    except ImportError:
         pass
 
 

@@ -23,6 +23,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from learningorchestra_tpu.runtime import arena as arena_lib
 from learningorchestra_tpu.runtime import data as data_lib
 from learningorchestra_tpu.runtime import engine as engine_lib
 from learningorchestra_tpu.runtime import mesh as mesh_lib
@@ -45,6 +46,11 @@ class LogisticRegressionJAX:
         self.params: Any = None
         self.history: list = []
         self._mesh_override = None
+        # content identity of the upcoming fit's (x, y), set by the
+        # builder (feature cache token): enables arena reuse of the
+        # staged device arrays and executable sharing across jobs
+        self.feature_token = None
+        self.feature_tags: tuple = ()
 
     def set_mesh(self, mesh) -> None:
         self._mesh_override = mesh
@@ -70,7 +76,11 @@ class LogisticRegressionJAX:
             loss_fn=engine_lib.sparse_softmax_loss,
             optimizer=optax.adam(self.learning_rate),
             mesh=mesh,
-            metrics={"accuracy": engine_lib.accuracy_metric})
+            metrics={"accuracy": engine_lib.accuracy_metric},
+            # apply/loss/metrics are module-static; the optimizer is
+            # fully determined by the learning rate — so engines of
+            # equal key trace identical programs
+            cache_key=("estimators.LR", self.learning_rate))
         d = x.shape[1]
         params = {"w": jnp.zeros((d, n_classes), jnp.float32),
                   "b": jnp.zeros((n_classes,), jnp.float32)}
@@ -78,7 +88,9 @@ class LogisticRegressionJAX:
         batcher = data_lib.ArrayBatcher(
             {"x": x, "y": y_idx.astype(np.int32)},
             min(self.batch_size, len(x)), shuffle=True, seed=self.seed,
-            dp_multiple=mesh_lib.data_parallel_size(mesh))
+            dp_multiple=mesh_lib.data_parallel_size(mesh),
+            cache_token=self.feature_token,
+            cache_tags=self.feature_tags)
         state, history = eng.fit(state, batcher, epochs=self.epochs,
                                  seed=self.seed)
         self.params = engine_lib.to_host(state.params)
@@ -120,6 +132,10 @@ class GaussianNBJAX:
         self.var_: Optional[np.ndarray] = None    # (C, d) variances
         self.class_prior_: Optional[np.ndarray] = None
         self._mesh_override = None
+        # content identity of the fit's (x, y) — see
+        # LogisticRegressionJAX.feature_token
+        self.feature_token = None
+        self.feature_tags: tuple = ()
 
     def set_mesh(self, mesh) -> None:
         self._mesh_override = mesh
@@ -144,27 +160,47 @@ class GaussianNBJAX:
         x_c = x - shift[None, :]
         onehot_np = np.zeros((len(x), len(self.classes_)), np.float32)
         onehot_np[np.arange(len(x)), y_idx] = 1.0
-        xj, onehot = jnp.asarray(x_c), jnp.asarray(onehot_np)
+        entry = None
         if self._mesh_override is not None:
             # place the pass on THIS estimator's sub-slice, rows
             # sharded over dp; zero-padded rows have all-zero one-hot
             # so they contribute nothing to any statistic
             mesh = self._mesh_override
             dp = mesh_lib.data_parallel_size(mesh)
-            pad = (-len(x)) % dp
-            if pad:
-                xj = jnp.concatenate(
-                    [xj, jnp.zeros((pad,) + xj.shape[1:], xj.dtype)])
-                onehot = jnp.concatenate(
-                    [onehot, jnp.zeros((pad, onehot.shape[1]),
-                                       onehot.dtype)])
             sharding = mesh_lib.batch_sharding(mesh)
-            xj = jax.device_put(xj, sharding)
-            onehot = jax.device_put(onehot, sharding)
-        counts, sums, sq_sums = self._sufficient_stats(xj, onehot)
-        counts = np.asarray(counts, np.float64)
-        sums = np.asarray(sums, np.float64)
-        sq_sums = np.asarray(sq_sums, np.float64)
+
+            def stage():
+                xs, hs = jnp.asarray(x_c), jnp.asarray(onehot_np)
+                pad = (-len(x)) % dp
+                if pad:
+                    xs = jnp.concatenate(
+                        [xs, jnp.zeros((pad,) + xs.shape[1:], xs.dtype)])
+                    hs = jnp.concatenate(
+                        [hs, jnp.zeros((pad, hs.shape[1]), hs.dtype)])
+                return {"x": jax.device_put(xs, sharding),
+                        "onehot": jax.device_put(hs, sharding)}
+
+            if self.feature_token is not None:
+                # centered x + one-hot are deterministic functions of
+                # the (x, y) content the token identifies, so a repeat
+                # fit reuses the resident device copies
+                entry = arena_lib.get_default_arena().get_or_put(
+                    ("nb_stats", self.feature_token, mesh), stage,
+                    tags=self.feature_tags)
+                xj, onehot = entry.arrays["x"], entry.arrays["onehot"]
+            else:
+                staged = stage()
+                xj, onehot = staged["x"], staged["onehot"]
+        else:
+            xj, onehot = jnp.asarray(x_c), jnp.asarray(onehot_np)
+        try:
+            counts, sums, sq_sums = self._sufficient_stats(xj, onehot)
+            counts = np.asarray(counts, np.float64)
+            sums = np.asarray(sums, np.float64)
+            sq_sums = np.asarray(sq_sums, np.float64)
+        finally:
+            if entry is not None:
+                entry.release()
         n = np.maximum(counts, 1.0)[:, None]
         theta_c = sums / n          # class means of CENTERED data
         self.theta_ = theta_c + shift[None, :].astype(np.float64)

@@ -1,0 +1,252 @@
+"""Device-memory feature arena: the HBM tier of the feature-plane
+cache (docs/PERFORMANCE.md).
+
+Every compute step used to pay the full host->device data path on
+every fit — ``read_dataframe`` -> pandas -> numpy -> ``device_put`` —
+even when the same dataset version had been staged seconds earlier by
+another classifier or pipeline step (SparkNet's observation that
+caching the training set in executor memory across iterations is the
+dominant cluster-ML win, PAPERS.md). The arena keeps *sharded device
+arrays* resident between jobs:
+
+- entries are dicts of ``jax.Array`` keyed by an opaque content token
+  (dataset versions + projection + dtype policy) plus the mesh and
+  sharding they were staged under — a GSPMD global array only makes
+  sense relative to its mesh;
+- a byte budget (``LO_ARENA_BYTES``; default a quarter of one
+  device's memory, 1 GiB when the backend doesn't report it) bounds
+  residency with LRU eviction;
+- readers *pin* entries while a fit consumes them. Eviction only
+  unlinks an entry from the table; the arrays themselves stay alive
+  until the last pin (Python reference) drops, so an in-flight fit
+  can never observe a corrupted or freed batch. Pins are released in
+  ``finally`` blocks, so cancelled / timed-out jobs
+  (docs/LIFECYCLE.md) release them on the ``JobCancelled`` unwind;
+- write-invalidation is driven by the catalog change feed through
+  per-entry *tags* (collection names): ``invalidate(name)`` drops
+  every entry staged from that collection.
+
+The module never imports jax at top level: metrics endpoints and
+config plumbing must be able to touch arena *stats* without
+initializing an accelerator backend.
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+from typing import Any, Callable, Dict, Iterable, Optional, Tuple
+
+
+def _auto_budget() -> int:
+    """A quarter of one device's reported memory; 1 GiB fallback
+    (XLA:CPU and some PJRT plugins report no ``bytes_limit``)."""
+    try:
+        import jax
+
+        stats = jax.local_devices()[0].memory_stats() or {}
+        limit = int(stats.get("bytes_limit", 0))
+        if limit > 0:
+            return limit // 4
+    except Exception:  # noqa: BLE001 — budget sizing must never raise
+        pass
+    return 1 << 30
+
+
+class ArenaEntry:
+    """A pinned handle on one resident dict of device arrays. Use as a
+    context manager (or call :meth:`release`) so the pin drops on ANY
+    exit path, including ``JobCancelled``."""
+
+    __slots__ = ("key", "arrays", "nbytes", "tags", "_arena", "_released")
+
+    def __init__(self, key: Any, arrays: Dict[str, Any], nbytes: int,
+                 tags: Tuple[str, ...], arena: Optional["DeviceArena"]):
+        self.key = key
+        self.arrays = arrays
+        self.nbytes = nbytes
+        self.tags = tags
+        self._arena = arena
+        self._released = False
+
+    def release(self) -> None:
+        if self._released:
+            return
+        self._released = True
+        if self._arena is not None:
+            self._arena._unpin(self.key)
+
+    def __enter__(self) -> "ArenaEntry":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+
+class _Resident:
+    __slots__ = ("arrays", "nbytes", "tags", "pins")
+
+    def __init__(self, arrays, nbytes, tags):
+        self.arrays = arrays
+        self.nbytes = nbytes
+        self.tags = tags
+        self.pins = 0
+
+
+class DeviceArena:
+    """Byte-budgeted LRU of staged device-array dicts with reader
+    pins and tag-based invalidation. Thread-safe: builder classifier
+    threads and concurrent jobs share one arena."""
+
+    def __init__(self, byte_budget: Optional[int] = None):
+        # None = resolve lazily from the device on first insertion
+        # (stats() must stay accelerator-free); <= 0 = disabled.
+        self._budget = byte_budget
+        self._entries: "collections.OrderedDict[Any, _Resident]" = \
+            collections.OrderedDict()
+        self._bytes = 0
+        self._lock = threading.Lock()
+        self.hits = 0
+        self.misses = 0
+        self.evictions = 0
+        self.invalidations = 0
+
+    # -- core ----------------------------------------------------------
+    def get_or_put(self, key: Any, build: Callable[[], Dict[str, Any]],
+                   tags: Iterable[str] = ()) -> ArenaEntry:
+        """Pinned entry for ``key``, building (and staging) it on miss.
+
+        The build runs outside the lock; a concurrent miss on the same
+        key may build twice, in which case the first insert wins and
+        the loser's arrays are garbage-collected — duplicate staging
+        is cheaper than serializing every fit behind one transfer.
+        """
+        tags = tuple(tags)
+        with self._lock:
+            res = self._entries.get(key)
+            if res is not None:
+                self._entries.move_to_end(key)
+                res.pins += 1
+                self.hits += 1
+                return ArenaEntry(key, res.arrays, res.nbytes, res.tags,
+                                  self)
+            self.misses += 1
+        arrays = build()
+        nbytes = sum(int(getattr(a, "nbytes", 0)) for a in arrays.values())
+        with self._lock:
+            if self._budget is None:
+                self._budget = _auto_budget()
+            if self._budget <= 0 or nbytes > self._budget:
+                # uncacheable: hand back an untracked pinned-by-nobody
+                # entry; release() is a no-op
+                return ArenaEntry(key, arrays, nbytes, tags, None)
+            res = self._entries.get(key)
+            if res is not None:  # lost the build race — reuse the winner
+                self._entries.move_to_end(key)
+                res.pins += 1
+                return ArenaEntry(key, res.arrays, res.nbytes, res.tags,
+                                  self)
+            res = _Resident(arrays, nbytes, tags)
+            res.pins = 1
+            self._entries[key] = res
+            self._bytes += nbytes
+            self._evict_locked()
+            return ArenaEntry(key, arrays, nbytes, tags, self)
+
+    def _unpin(self, key: Any) -> None:
+        with self._lock:
+            res = self._entries.get(key)
+            if res is not None and res.pins > 0:
+                res.pins -= 1
+
+    def _evict_locked(self) -> None:
+        """LRU-evict unpinned entries until under budget. Pinned
+        entries are skipped — an over-budget arena full of in-flight
+        readers degrades to 'no caching' rather than corrupting them;
+        their bytes free when the pins drop and the next insert
+        sweeps again."""
+        if self._budget is None or self._budget <= 0:
+            return
+        while self._bytes > self._budget:
+            victim = None
+            for key, res in self._entries.items():  # oldest first
+                if res.pins == 0:
+                    victim = key
+                    break
+            if victim is None:
+                return
+            res = self._entries.pop(victim)
+            self._bytes -= res.nbytes
+            self.evictions += 1
+
+    # -- invalidation --------------------------------------------------
+    def invalidate(self, collection: str) -> int:
+        """Drop every entry tagged with ``collection`` (catalog change
+        feed / version-mismatch hook). Pinned entries are dropped from
+        the table too — their arrays survive for the in-flight reader,
+        but no future reader can hit the stale version."""
+        dropped = 0
+        with self._lock:
+            for key in [k for k, r in self._entries.items()
+                        if collection in r.tags]:
+                res = self._entries.pop(key)
+                self._bytes -= res.nbytes
+                dropped += 1
+            self.invalidations += dropped
+        return dropped
+
+    def clear(self) -> None:
+        with self._lock:
+            self._entries.clear()
+            self._bytes = 0
+
+    # -- observability -------------------------------------------------
+    @property
+    def bytes_in_use(self) -> int:
+        with self._lock:
+            return self._bytes
+
+    def stats(self) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                "entries": len(self._entries),
+                "bytesInUse": self._bytes,
+                "byteBudget": self._budget,
+                "pins": sum(r.pins for r in self._entries.values()),
+                "hits": self.hits,
+                "misses": self.misses,
+                "evictions": self.evictions,
+                "invalidations": self.invalidations,
+            }
+
+
+# ----------------------------------------------------------------------
+# process-wide default (the mesh is process-wide, so the arrays staged
+# onto it are too); config swaps reset it like the default mesh
+# ----------------------------------------------------------------------
+_default_arena: Optional[DeviceArena] = None
+_default_lock = threading.Lock()
+
+
+def _configured_budget() -> Optional[int]:
+    from learningorchestra_tpu.config import get_config
+
+    raw = getattr(get_config(), "arena_bytes", -1)
+    return None if raw < 0 else int(raw)  # None = auto-size lazily
+
+
+def get_default_arena() -> DeviceArena:
+    global _default_arena
+    with _default_lock:
+        if _default_arena is None:
+            _default_arena = DeviceArena(_configured_budget())
+        return _default_arena
+
+
+def reset_default_arena() -> None:
+    """Drop the process arena (config swap / test teardown): entries
+    are keyed by mesh + dataset version, both invalid across a config
+    change."""
+    global _default_arena
+    with _default_lock:
+        _default_arena = None

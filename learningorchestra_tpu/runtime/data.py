@@ -40,7 +40,8 @@ class ArrayBatcher:
     def __init__(self, arrays: Dict[str, np.ndarray], batch_size: int,
                  *, shuffle: bool = False, seed: int = 0,
                  dp_multiple: int = 1,
-                 sample_weight: Optional[np.ndarray] = None):
+                 sample_weight: Optional[np.ndarray] = None,
+                 cache_token=None, cache_tags: Sequence[str] = ()):
         if not arrays:
             raise ValueError("empty feed")
         sizes = {k: len(v) for k, v in arrays.items()}
@@ -70,6 +71,17 @@ class ArrayBatcher:
         self.batch_size = batch_size
         self._shuffle = shuffle
         self._seed = seed
+        # hashable CONTENT identity of `arrays` (dataset versions +
+        # projection + dtype policy, from FeatureCache.token). When
+        # set, the engine's scan fast path keeps the staged device
+        # arrays in the feature arena between fits; `cache_tags`
+        # (collection names) drive its change-feed invalidation. A
+        # custom sample_weight alters the staged MASK column without
+        # being part of the token, so it disables arena reuse.
+        if sample_weight is not None:
+            cache_token = None
+        self.cache_token = cache_token
+        self.cache_tags = tuple(cache_tags)
 
     @property
     def steps_per_epoch(self) -> int:

@@ -16,7 +16,9 @@ This is what replaces the reference's hot loop — ``getattr(instance,
 
 from __future__ import annotations
 
+import collections
 import os
+import threading
 import time
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
@@ -26,6 +28,7 @@ import numpy as np
 import optax
 from flax import struct
 
+from learningorchestra_tpu.runtime import arena as arena_lib
 from learningorchestra_tpu.runtime import data as data_lib
 from learningorchestra_tpu.runtime import mesh as mesh_lib
 from learningorchestra_tpu.runtime import preempt
@@ -45,6 +48,43 @@ Metrics = Dict[str, Tuple[jax.Array, jax.Array]]  # name -> (sum, count)
 def default_grad_accum() -> int:
     """Process-wide microbatch-count default (LO_GRAD_ACCUM env)."""
     return max(1, int(os.environ.get("LO_GRAD_ACCUM", "1")))
+
+
+# ----------------------------------------------------------------------
+# In-process executable cache (docs/PERFORMANCE.md). Engines are built
+# per fit — the builder constructs a fresh classifier (and Engine) per
+# job — so per-instance jitted steps recompile identical programs on
+# every repeat job. Engines constructed with a ``cache_key`` share
+# their jitted callables here, keyed on everything that changes the
+# traced program: (model spec hash, step kind, mesh, sharding,
+# donation, compute dtype, grad_accum, step shape qualifiers). Same
+# key + same batch shapes -> jax's own C++ dispatch cache hit: zero
+# retrace, zero recompile. The jit objects hold no device state, so
+# sharing them across threads/jobs is safe.
+# ----------------------------------------------------------------------
+_EXEC_CACHE: "collections.OrderedDict[Any, Callable]" = \
+    collections.OrderedDict()
+_EXEC_LOCK = threading.Lock()
+_EXEC_STATS = {"hits": 0, "misses": 0}
+_EXEC_CACHE_CAP = 64
+# measured per-step flops by executable key: lets a warm fit skip the
+# _measure_flops lowering (a full trace) entirely
+_FLOPS_CACHE: Dict[Any, float] = {}
+
+
+def executable_cache_stats() -> Dict[str, int]:
+    with _EXEC_LOCK:
+        return {"entries": len(_EXEC_CACHE),
+                "hits": _EXEC_STATS["hits"],
+                "misses": _EXEC_STATS["misses"]}
+
+
+def reset_executable_cache() -> None:
+    with _EXEC_LOCK:
+        _EXEC_CACHE.clear()
+        _FLOPS_CACHE.clear()
+        _EXEC_STATS["hits"] = 0
+        _EXEC_STATS["misses"] = 0
 
 
 def resolve_grad_accum(requested: Optional[int],
@@ -81,7 +121,8 @@ class Engine:
                  batch_sharding=None,
                  predict_transform: Optional[Callable] = None,
                  flops_floor_fn: Optional[Callable] = None,
-                 grad_accum: int = 1):
+                 grad_accum: int = 1,
+                 cache_key: Any = None):
         self._apply_fn = apply_fn
         self._loss_fn = loss_fn
         self._optimizer = optimizer
@@ -114,6 +155,12 @@ class Engine:
         # microbatch, letting memory-bound shapes train at batch sizes
         # HBM could not hold in one pass
         self._grad_accum = max(1, int(grad_accum))
+        # hashable identity of the PROGRAM this engine computes: it
+        # must uniquely determine apply_fn / loss_fn / optimizer /
+        # metrics / predict_transform behavior, because engines with
+        # equal keys share jitted steps via _EXEC_CACHE. None opts out
+        # (custom callables with no stable identity).
+        self._cache_key = cache_key
 
     # ------------------------------------------------------------------
     def init_state(self, params, model_state=None) -> TrainState:
@@ -237,6 +284,39 @@ class Engine:
                    for k, (s, c) in metrics.items()}
         return grads, new_model_state, metrics
 
+    def _exec_key(self, kind: str, extra: Tuple = ()):
+        if self._cache_key is None:
+            return None
+        return (self._cache_key, kind, self._mesh, self._batch_sharding,
+                self._donate, str(self._compute_dtype), self._grad_accum,
+                extra)
+
+    def _shared_step(self, kind: str, build: Callable[[], Callable],
+                     extra: Tuple = ()) -> Callable:
+        """The jitted step for ``kind``, shared process-wide when this
+        engine carries a cache_key (else built per instance as before).
+        ``build`` runs outside the lock; a lost race reuses the first
+        insert (discarding an unexecuted jit wrapper is free)."""
+        key = self._exec_key(kind, extra)
+        if key is None:
+            return build()
+        with _EXEC_LOCK:
+            fn = _EXEC_CACHE.get(key)
+            if fn is not None:
+                _EXEC_CACHE.move_to_end(key)
+                _EXEC_STATS["hits"] += 1
+                return fn
+            _EXEC_STATS["misses"] += 1
+        fn = build()
+        with _EXEC_LOCK:
+            existing = _EXEC_CACHE.get(key)
+            if existing is not None:
+                return existing
+            _EXEC_CACHE[key] = fn
+            while len(_EXEC_CACHE) > _EXEC_CACHE_CAP:
+                _EXEC_CACHE.popitem(last=False)
+        return fn
+
     def _build_train_step(self):
         donate = (0,) if self._donate else ()
         return jax.jit(self._train_step_body, donate_argnums=donate)
@@ -351,6 +431,15 @@ class Engine:
         key = tuple(sorted((k, tuple(v.shape)) for k, v in batch.items()))
         if self._step_flops is not None and key == self._flops_key:
             return
+        shared_key = self._exec_key("flops", key)
+        if shared_key is not None:
+            cached = _FLOPS_CACHE.get(shared_key)
+            if cached is not None:
+                # warm job: reuse the measured value — lowering below
+                # is a full trace, exactly what a repeat fit must skip
+                self._step_flops = cached
+                self._flops_key = key
+                return
         self._flops_key = key
         try:
             fn = step_fn if step_fn is not None else self._train_step
@@ -370,6 +459,8 @@ class Engine:
                 self._step_flops = max(self._step_flops or 0.0, floor)
             except Exception:  # noqa: BLE001
                 pass
+        if shared_key is not None and self._step_flops is not None:
+            _FLOPS_CACHE[shared_key] = self._step_flops
 
     def _should_scan(self, batcher: data_lib.ArrayBatcher) -> bool:
         from learningorchestra_tpu.config import get_config
@@ -521,54 +612,83 @@ class Engine:
         key = (steps, bs, batcher.shuffles)
         epoch_step = self._epoch_steps.get(key)
         if epoch_step is None:
-            epoch_step = self._epoch_steps[key] = \
-                self._build_epoch_step(steps, bs, batcher.shuffles)
+            epoch_step = self._epoch_steps[key] = self._shared_step(
+                "epoch",
+                lambda: self._build_epoch_step(steps, bs,
+                                               batcher.shuffles),
+                extra=key)
         base_rng = jax.random.PRNGKey(seed)
         shuffle_rng = _shuffle_rng(batcher.seed)
         # one host->HBM transfer for the whole fit; epochs shuffle in
-        # HBM (the host link, not the MXU, is the scarce resource)
+        # HBM (the host link, not the MXU, is the scarce resource).
+        # Batchers carrying a content token keep the staged arrays in
+        # the device arena BETWEEN fits: a repeat job (or the next
+        # classifier over the same dataset) skips pad+transfer too.
         sharding = self._resolve_batch_sharding()
-        padded = batcher.padded_arrays()
-        device_arrays = {k: data_lib.stage_to_device(v, sharding)
-                         for k, v in padded.items()}
+        token = getattr(batcher, "cache_token", None)
+        entry = None
+
+        def stage() -> Dict[str, Any]:
+            return {k: data_lib.stage_to_device(v, sharding)
+                    for k, v in batcher.padded_arrays().items()}
+
+        if token is not None:
+            entry = arena_lib.get_default_arena().get_or_put(
+                ("fit_arrays", token, steps, bs, batcher.shuffles,
+                 self._mesh, sharding),
+                stage, tags=getattr(batcher, "cache_tags", ()))
+            device_arrays = entry.arrays
+        else:
+            device_arrays = stage()
         history: List[Dict[str, Any]] = []
-        for epoch in range(start_epoch, epochs):
-            # lifecycle boundary: honor a deadline/cancel before
-            # dispatching the next whole-epoch scan, and publish
-            # progress for the stall watchdog
-            preempt.check_cancel()
-            preempt.heartbeat(epoch=epoch)
-            t0 = time.perf_counter()
-            if epoch == start_epoch:
-                one = {k: v[:bs] for k, v in padded.items()}
-                self._measure_flops(
-                    state, one, base_rng,
-                    step_fn=jax.jit(self._train_step_body))
-            state, totals = epoch_step(state, device_arrays, base_rng,
-                                       shuffle_rng, jnp.asarray(epoch))
-            jax.block_until_ready(state.params)
-            dt = time.perf_counter() - t0
-            record = {k: float(s) / max(float(c), 1e-9)
-                      for k, (s, c) in totals.items()}
-            record.update(epoch=epoch, epochSeconds=round(dt, 4),
-                          samplesPerSecond=round(
-                              batcher.num_samples / dt, 2))
-            # compile epoch has no steady-state window in scan mode;
-            # roofline numbers start with the second executed epoch
-            if epoch > start_epoch:
-                self._roofline_record(record, steps, dt)
-            history.append(record)
-            if checkpointer is not None:
-                self._save_checkpoint(checkpointer, state, epoch)
-            if log_fn is not None:
-                log_fn(record)
-            # fair scheduling: offer the mesh lease to waiting jobs of
-            # other pools (no-op outside the service layer); the epoch
-            # is checkpointed, so the hand-off is durable. Never after
-            # the last epoch — a finishing job must not block on
-            # re-acquiring a lease it has no more work for.
-            if epoch + 1 < epochs:
-                preempt.maybe_yield()
+        try:
+            for epoch in range(start_epoch, epochs):
+                # lifecycle boundary: honor a deadline/cancel before
+                # dispatching the next whole-epoch scan, and publish
+                # progress for the stall watchdog
+                preempt.check_cancel()
+                preempt.heartbeat(epoch=epoch)
+                t0 = time.perf_counter()
+                if epoch == start_epoch:
+                    # sliced from the device copy so an arena hit never
+                    # re-materializes the padded host arrays
+                    one = {k: v[:bs] for k, v in device_arrays.items()}
+                    self._measure_flops(
+                        state, one, base_rng,
+                        step_fn=jax.jit(self._train_step_body))
+                state, totals = epoch_step(state, device_arrays,
+                                           base_rng, shuffle_rng,
+                                           jnp.asarray(epoch))
+                jax.block_until_ready(state.params)
+                dt = time.perf_counter() - t0
+                record = {k: float(s) / max(float(c), 1e-9)
+                          for k, (s, c) in totals.items()}
+                record.update(epoch=epoch, epochSeconds=round(dt, 4),
+                              samplesPerSecond=round(
+                                  batcher.num_samples / dt, 2))
+                # compile epoch has no steady-state window in scan
+                # mode; roofline numbers start with the second epoch
+                if epoch > start_epoch:
+                    self._roofline_record(record, steps, dt)
+                history.append(record)
+                if checkpointer is not None:
+                    self._save_checkpoint(checkpointer, state, epoch)
+                if log_fn is not None:
+                    log_fn(record)
+                # fair scheduling: offer the mesh lease to waiting
+                # jobs of other pools (no-op outside the service
+                # layer); the epoch is checkpointed, so the hand-off
+                # is durable. Never after the last epoch — a finishing
+                # job must not block on re-acquiring a lease it has no
+                # more work for.
+                if epoch + 1 < epochs:
+                    preempt.maybe_yield()
+        finally:
+            # the pin must drop on EVERY exit — a JobCancelled /
+            # timed-out unwind included (docs/LIFECYCLE.md) — or the
+            # entry could never be evicted
+            if entry is not None:
+                entry.release()
         return state, history
 
     def fit(self, state: TrainState, batcher: data_lib.ArrayBatcher,
@@ -604,7 +724,8 @@ class Engine:
                                      checkpointer, log_fn,
                                      start_epoch=start_epoch)
         if self._train_step is None:
-            self._train_step = self._build_train_step()
+            self._train_step = self._shared_step(
+                "train", self._build_train_step)
         base_rng = jax.random.PRNGKey(seed)
         history: List[Dict[str, Any]] = []
         # Host-side step counter for the dropout rng: reading
@@ -663,7 +784,8 @@ class Engine:
     def evaluate(self, state: TrainState, batcher: data_lib.ArrayBatcher,
                  ) -> Dict[str, float]:
         if self._eval_step is None:
-            self._eval_step = self._build_eval_step()
+            self._eval_step = self._shared_step(
+                "eval", self._build_eval_step)
         sums: Dict[str, Any] = {}
         counts: Dict[str, Any] = {}
         for step, batch in enumerate(self._device_feed(batcher, 0)):
@@ -679,7 +801,8 @@ class Engine:
     def predict(self, state: TrainState, batcher: data_lib.ArrayBatcher,
                 ) -> np.ndarray:
         if self._predict_step is None:
-            self._predict_step = self._build_predict_step()
+            self._predict_step = self._shared_step(
+                "predict", self._build_predict_step)
         outs = []
         for step, batch in enumerate(self._device_feed(batcher, 0)):
             preempt.check_cancel()

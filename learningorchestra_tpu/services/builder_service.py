@@ -257,10 +257,30 @@ class BuilderService:
     def _run(self, train_name: str, test_name: str, code: str,
              outputs: Dict[str, str], mesh_parallel: bool = False,
              ) -> None:
-        training_df = self._ctx.catalog.read_dataframe(train_name)
-        testing_df = self._ctx.catalog.read_dataframe(test_name)
+        import hashlib
+
+        features = self._ctx.features
+        training_df = features.dataframe(train_name)
+        testing_df = features.dataframe(test_name)
+        # content identity of the derived (x, y): both datasets'
+        # versions plus the modeling code that transforms them — a
+        # repeat job with identical inputs reuses the arena's staged
+        # device arrays; any dataset mutation changes the token
+        feature_token = ("builder",
+                         train_name, features.version(train_name),
+                         test_name, features.version(test_name),
+                         hashlib.sha256(code.encode()).hexdigest())
+        feature_tags = (train_name, test_name)
+        # the in-process sandbox modes exec user code directly on
+        # these frames — deep-copy so a mutating modelingCode can't
+        # corrupt the cached copies (the subprocess jail pickles its
+        # own copies to the child, so shallow is safe there)
+        sb_train, sb_test = training_df, testing_df
+        if self._ctx.config.sandbox_mode != "subprocess":
+            sb_train = training_df.copy(deep=True)
+            sb_test = testing_df.copy(deep=True)
         ctx_vars, _ = sandbox.run_user_code(
-            code, {"training_df": training_df, "testing_df": testing_df},
+            code, {"training_df": sb_train, "testing_df": sb_test},
             mode=self._ctx.config.sandbox_mode)
         try:
             features_training = ctx_vars["features_training"]
@@ -275,11 +295,11 @@ class BuilderService:
         x_eval, y_eval = _split_xy(features_evaluation, needs_label=True) \
             if features_evaluation is not None else (None, None)
 
-        slice_pool = None
+        slice_map: Dict[str, Any] = {}
         sequential_jax: List[str] = []
         errors: Dict[str, Exception] = {}
         if mesh_parallel:
-            slice_pool, sequential_jax = self._mesh_slices(outputs)
+            slice_map, sequential_jax = self._mesh_slices(outputs)
         # multi-host: every host must replay identical device programs
         # in identical order — JAX fits run sequentially on the full
         # mesh, in sorted order, before the host pool. A failure here
@@ -289,7 +309,9 @@ class BuilderService:
             try:
                 self._fit_one(c, x_train, y_train, x_test, x_eval,
                               y_eval, testing_df, outputs[c],
-                              slice_pool=slice_pool)
+                              sub_mesh=slice_map.get(c),
+                              feature_token=feature_token,
+                              feature_tags=feature_tags)
             except Exception as e:  # noqa: BLE001
                 errors[c] = e
                 self._ctx.catalog.append_document(
@@ -301,7 +323,9 @@ class BuilderService:
             futures = {
                 c: pool.submit(self._fit_one, c, x_train, y_train,
                                x_test, x_eval, y_eval, testing_df,
-                               outputs[c], slice_pool=slice_pool)
+                               outputs[c], sub_mesh=slice_map.get(c),
+                               feature_token=feature_token,
+                               feature_tags=feature_tags)
                 for c in pooled}
             for c, fut in futures.items():
                 try:
@@ -316,13 +340,14 @@ class BuilderService:
             raise RuntimeError(f"classifier failures: {errors}")
 
     def _mesh_slices(self, outputs: Dict[str, str]):
-        """(free-queue of disjoint sub-meshes, classifiers to run
-        sequentially). Single-host: one slice per JAX family, trained
-        concurrently (SURVEY §7's 'N models as parallel jobs over mesh
-        slices'). Multi-host: sub-slice thread timing would diverge
-        the SPMD replay, so JAX fits serialize over the full mesh."""
-        import queue as queue_mod
-
+        """({classifier: sub-mesh}, classifiers to run sequentially).
+        Single-host: one slice per JAX family, trained concurrently
+        (SURVEY §7's 'N models as parallel jobs over mesh slices');
+        the classifier -> slice assignment is DETERMINISTIC (sorted
+        order) so a repeat job lands each family on the same slice and
+        its arena entries / cached executables (keyed by mesh) hit.
+        Multi-host: sub-slice thread timing would diverge the SPMD
+        replay, so JAX fits serialize over the full mesh."""
         import jax
 
         from learningorchestra_tpu.models.sweep import sub_meshes
@@ -330,15 +355,16 @@ class BuilderService:
 
         jax_families = sorted(c for c in outputs if c in _JAX_FAMILIES)
         if not jax_families:
-            return None, []
+            return {}, []
         mesh = mesh_lib.get_default_mesh()
-        free = queue_mod.Queue()
         if jax.process_count() > 1:
-            free.put(mesh)
-            return free, jax_families
-        for s in sub_meshes(mesh, len(jax_families)):
-            free.put(s)
-        return free, []
+            return {c: mesh for c in jax_families}, jax_families
+        slices = sub_meshes(mesh, len(jax_families))
+        if len(slices) < len(jax_families):
+            # fewer devices than families: serialize on the full mesh
+            # instead of racing threads over one shared slice
+            return {c: mesh for c in jax_families}, jax_families
+        return dict(zip(jax_families, slices)), []
 
     # ------------------------------------------------------------------
     # out-of-core path (reference config 4: GBTClassifier on 10M rows
@@ -540,27 +566,27 @@ class BuilderService:
 
     def _fit_one(self, classifier_name: str, x_train, y_train, x_test,
                  x_eval, y_eval, testing_df, out_name: str,
-                 slice_pool=None) -> None:
+                 sub_mesh=None, feature_token=None,
+                 feature_tags: tuple = ()) -> None:
         from sklearn.metrics import accuracy_score, f1_score
 
         metrics: Dict[str, Any] = {"classifier": classifier_name}
-        sub = None
-        use_jax = (slice_pool is not None
+        use_jax = (sub_mesh is not None
                    and classifier_name in _JAX_FAMILIES)
         if use_jax:
-            sub = slice_pool.get()
-            clf = _make_jax_classifier(classifier_name, sub)
+            clf = _make_jax_classifier(classifier_name, sub_mesh)
+            # content identity of (x_train, y_train): lets the fit
+            # reuse arena-resident device arrays and shared executables
+            # when a repeat job presents the same dataset versions
+            clf.feature_token = feature_token
+            clf.feature_tags = feature_tags
             metrics["engine"] = "jax"
-            metrics["meshDevices"] = int(sub.size)
+            metrics["meshDevices"] = int(sub_mesh.size)
         else:
             clf = _make_classifier(classifier_name)
             metrics["engine"] = "sklearn"
         t0 = time.perf_counter()
-        try:
-            clf.fit(x_train, y_train)
-        finally:
-            if sub is not None:
-                slice_pool.put(sub)
+        clf.fit(x_train, y_train)
         fit_time = time.perf_counter() - t0
         metrics["fitTime"] = round(fit_time, 6)
         if x_eval is not None and y_eval is not None:

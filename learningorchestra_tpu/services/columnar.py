@@ -118,7 +118,10 @@ class DataTypeService:
         import numpy as np
         import pandas as pd
 
-        df = self._ctx.catalog.read_dataframe(name)
+        # feature-cache read (whole-column assignment on the shallow
+        # copy never touches the cached frame); the write below bumps
+        # the version, so the next reader re-materializes
+        df = self._ctx.features.dataframe(name)
         for field, target in types.items():
             if target == STRING_TYPE:
                 col = df[field].astype(object)

@@ -22,6 +22,7 @@ class ServiceContext:
     def __init__(self, config: Optional[Config] = None,
                  pod_failure_fn=None, force_pod_guard: bool = False):
         from learningorchestra_tpu.runtime import distributed as dist
+        from learningorchestra_tpu.services.feature_cache import FeatureCache
         from learningorchestra_tpu.services.jobs import JobManager
         from learningorchestra_tpu.services.params import ParameterResolver
         from learningorchestra_tpu.services.scheduler import \
@@ -47,7 +48,12 @@ class ServiceContext:
                                .retry_backoff_seconds,
                                retry_backoff_max=self.config
                                .retry_backoff_max_seconds)
+        # feature-plane cache (docs/PERFORMANCE.md): the host tier all
+        # dataset reads route through; shares the $name-cache budget
+        self.features = FeatureCache(
+            self.catalog, host_bytes=self.config.param_cache_bytes)
         self.params = ParameterResolver(self)
+        _wire_xla_cache(self.config)
         # callbacks fired by the pod guard when a degraded pod's
         # heartbeats resume (the Api registers worker-lost requeue)
         self.on_pod_healthy: list = []
@@ -67,6 +73,25 @@ class ServiceContext:
             self._pod_guard.set()
         self.jobs.shutdown()
         self.catalog.close()
+
+
+def _wire_xla_cache(config: Config) -> None:
+    """Point jax's persistent compilation cache at LO_XLA_CACHE_DIR so
+    repeat jobs skip recompiles across process restarts. Strictly
+    opt-in (empty = off): deserializing XLA:CPU executables from disk
+    is unstable on some jaxlib builds (tests/conftest.py)."""
+    if not config.xla_cache_dir:
+        return
+    import os
+
+    try:
+        import jax
+
+        os.makedirs(config.xla_cache_dir, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir",
+                          config.xla_cache_dir)
+    except Exception as exc:  # noqa: BLE001 — cache is best-effort
+        print(f"xla cache: disabled ({exc!r})", flush=True)
 
 
 def _start_pod_guard(ctx: "ServiceContext", force: bool = False):

@@ -1,0 +1,216 @@
+"""Feature-plane cache: the host tier and façade every compute
+service routes dataset reads through (docs/PERFORMANCE.md).
+
+``builder_service._run``, the execution verbs' ``$name`` resolution
+(services/params.py) and the columnar transforms all used to call
+``catalog.read_dataframe`` independently — a full Parquet read +
+pandas materialization per pipeline step, per classifier. This cache
+memoizes the materialized host data once per *content version* and
+hands device staging off to the HBM arena (``runtime/arena.py``).
+
+Keying: ``(collection, version, projection, dtype policy)`` where
+version is ``(catalog.collection_seq(name), catalog.dataset_version(
+name))`` — the same pair the gateway GET cache revalidates on
+(services/server.py ``_get``). Both components are required: parquet
+part swaps don't ride the change feed, and ``delete_collection``
+removes the files whose stat the dataset_version reflects.
+
+Invalidation is belt and braces:
+
+- *revalidate-on-read*: every hit re-checks the stored version, so a
+  mutated dataset (append / replace / delete) can never serve stale
+  rows to the next job;
+- *change-feed sweep*: each access drains ``changes_since(last_seq)``
+  and drops touched collections from both tiers (including the
+  arena's tagged device arrays) so deleted datasets free budget
+  promptly instead of lingering until LRU pressure.
+
+Reads use a bounded stable-version loop (read version, read data,
+re-read version; retry on mismatch) so a reader racing
+``write_dataframe``'s staging-rename swap caches either the old or
+the new version in full — never a mix.
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from learningorchestra_tpu.runtime import arena as arena_lib
+
+# attempts at reading a frame under one stable version before giving
+# up on caching it (the data is still returned)
+_STABLE_READ_ATTEMPTS = 3
+
+
+class FeatureCache:
+    """Version-keyed host-tier cache of materialized DataFrames /
+    numpy column dicts, bounded by a byte budget with LRU eviction."""
+
+    def __init__(self, catalog, host_bytes: int = 256 << 20,
+                 arena: Optional[arena_lib.DeviceArena] = None):
+        self._catalog = catalog
+        self._limit = int(host_bytes)
+        self._arena = arena
+        self._entries: "collections.OrderedDict[Any, tuple]" = \
+            collections.OrderedDict()  # key -> (version, value, nbytes)
+        self._bytes = 0
+        self._lock = threading.Lock()
+        self.hits = 0
+        self.misses = 0
+        self.invalidations = 0
+        self._last_seq = catalog.latest_seq()
+
+    # -- identity ------------------------------------------------------
+    @property
+    def arena(self) -> arena_lib.DeviceArena:
+        return self._arena or arena_lib.get_default_arena()
+
+    def version(self, name: str) -> Tuple[Any, Any]:
+        """Content version of a collection: change-feed seq + parquet
+        part stats (either alone misses a class of mutations)."""
+        return (self._catalog.collection_seq(name),
+                self._catalog.dataset_version(name))
+
+    def token(self, name: str, *extra: Any) -> Tuple[Any, ...]:
+        """Opaque, hashable identity of this collection's CURRENT
+        content (+ caller qualifiers) — the arena key component that
+        makes device-tier entries self-invalidate on version change."""
+        return ("ds", name, self.version(name)) + extra
+
+    # -- host tier -----------------------------------------------------
+    def dataframe(self, name: str,
+                  columns: Optional[Sequence[str]] = None):
+        """The collection as a DataFrame, served from the version-keyed
+        host tier. Callers get a shallow copy: adding/dropping columns
+        never corrupts the cached frame (same contract the parameter
+        resolver's cache had)."""
+        key = ("df", name, tuple(columns) if columns else None)
+        df = self._get(key, name, lambda: self._catalog.read_dataframe(
+            name, columns=list(columns) if columns else None))
+        return df.copy(deep=False)
+
+    def arrays(self, name: str, columns: Sequence[str],
+               dtype) -> Dict[str, Any]:
+        """Materialized numpy column dict (feature-plane layout) for
+        ``columns`` under one dtype policy."""
+        import numpy as np
+
+        cols = tuple(columns)
+        key = ("np", name, cols, np.dtype(dtype).str)
+
+        def build():
+            df = self._catalog.read_dataframe(name, columns=list(cols))
+            return {c: df[c].to_numpy(dtype) for c in cols}
+
+        return dict(self._get(key, name, build))
+
+    def _get(self, key: Any, name: str, build) -> Any:
+        self._sweep()
+        version = self.version(name)
+        with self._lock:
+            hit = self._entries.get(key)
+            if hit is not None:
+                if hit[0] == version:
+                    self._entries.move_to_end(key)
+                    self.hits += 1
+                    return hit[1]
+                # stale: the parquet parts changed under the change
+                # feed's nose — drop this entry AND the arena's device
+                # copies of the old version
+                self._drop_locked(key)
+                self.invalidations += 1
+            self.misses += 1
+        value, version = self._stable_read(name, version, build)
+        if version is not None:
+            self._insert(key, version, value)
+        return value
+
+    def _stable_read(self, name: str, version, build):
+        """(value, version-or-None): re-reads until the version is
+        identical before and after the data read, so a read racing a
+        writer returns one coherent snapshot. None = never stabilized;
+        the last read is returned uncached."""
+        for _ in range(_STABLE_READ_ATTEMPTS):
+            value = build()
+            after = self.version(name)
+            if after == version:
+                return value, version
+            version = after
+        return value, None
+
+    def _insert(self, key: Any, version, value) -> None:
+        nbytes = _sizeof(value)
+        if nbytes is None or nbytes <= 0 or nbytes > self._limit:
+            return
+        with self._lock:
+            self._drop_locked(key)
+            while self._entries and self._bytes + nbytes > self._limit:
+                old_key, (_, _, evicted) = self._entries.popitem(last=False)
+                self._bytes -= evicted
+            self._entries[key] = (version, value, nbytes)
+            self._bytes += nbytes
+
+    def _drop_locked(self, key: Any) -> None:
+        old = self._entries.pop(key, None)
+        if old is not None:
+            self._bytes -= old[2]
+
+    # -- invalidation --------------------------------------------------
+    def _sweep(self) -> None:
+        """Drain the catalog change feed and drop touched collections
+        from both tiers. Cheap (one indexed sqlite query when idle)."""
+        seq = self._catalog.latest_seq()
+        if seq == self._last_seq:
+            return
+        with self._lock:
+            if seq == self._last_seq:
+                return
+            last, self._last_seq = self._last_seq, seq
+        touched = {c["collection"]
+                   for c in self._catalog.changes_since(last)}
+        for name in touched:
+            self.invalidate(name)
+
+    def invalidate(self, name: str) -> int:
+        """Drop every host-tier entry for ``name`` and the arena's
+        device arrays staged from it."""
+        dropped = 0
+        with self._lock:
+            for key in [k for k in self._entries if k[1] == name]:
+                self._drop_locked(key)
+                dropped += 1
+            self.invalidations += dropped
+        dropped += self.arena.invalidate(name)
+        return dropped
+
+    def clear(self) -> None:
+        with self._lock:
+            self._entries.clear()
+            self._bytes = 0
+
+    # -- observability -------------------------------------------------
+    def stats(self) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                "entries": len(self._entries),
+                "bytesInUse": self._bytes,
+                "byteBudget": self._limit,
+                "hits": self.hits,
+                "misses": self.misses,
+                "invalidations": self.invalidations,
+            }
+
+
+def _sizeof(value: Any) -> Optional[int]:
+    """Approximate host bytes of a cached value; None = unsizable
+    (exotic dtypes) -> skip caching, matching the old resolver cache."""
+    try:
+        if hasattr(value, "memory_usage"):  # DataFrame
+            return int(value.memory_usage(index=True, deep=False).sum())
+        if isinstance(value, dict):
+            return sum(int(v.nbytes) for v in value.values())
+        return int(value.nbytes)
+    except Exception:  # noqa: BLE001
+        return None

@@ -43,20 +43,7 @@ def is_object_type(type_string: str) -> bool:
 
 class ParameterResolver:
     def __init__(self, context: "ServiceContext"):  # noqa: F821
-        import collections
-        import threading
-
         self._ctx = context
-        # version-keyed LRU of resolved DataFrames: multi-step
-        # pipelines resolve the same ``$dataset`` on every Train/
-        # Evaluate/Predict step, and each resolution used to re-read
-        # and re-materialize the full table (round-1 weak #8). Keyed
-        # by the Parquet parts' (path, mtime, size) so appends and
-        # rewrites invalidate naturally.
-        self._df_cache: "collections.OrderedDict" = \
-            collections.OrderedDict()
-        self._df_cache_bytes = 0
-        self._df_cache_lock = threading.Lock()
 
     # -- public ---------------------------------------------------------
     def treat(self, method_parameters: Optional[Dict[str, Any]],
@@ -117,41 +104,18 @@ class ParameterResolver:
     def load_artifact(self, name: str) -> Any:
         """``$name``: object types -> live object; tabular types ->
         DataFrame of the full collection (reference
-        get_dataset_content, utils.py:318-326). Tabular reads are
-        served from a bounded version-keyed cache; callers get a
-        shallow copy so adding/dropping columns never corrupts the
-        cached frame."""
+        get_dataset_content, utils.py:318-326). Tabular reads go
+        through the feature-plane cache's host tier (which replaced
+        the resolver's private version-keyed LRU), so a pipeline's
+        Train/Evaluate/Predict steps and the builder all share one
+        materialized copy; callers get a shallow copy so adding/
+        dropping columns never corrupts the cached frame."""
         t = self.artifact_type(name)
         if t is None:
             raise KeyError(f"unknown artifact: {name}")
         if is_object_type(t):
             return self._ctx.artifacts.load(name, t)
-        version = self._ctx.catalog.dataset_version(name)
-        with self._df_cache_lock:
-            hit = self._df_cache.get(name)
-            if hit is not None and hit[0] == version:
-                self._df_cache.move_to_end(name)
-                return hit[1].copy(deep=False)
-        df = self._ctx.catalog.read_dataframe(name)
-        try:
-            nbytes = int(df.memory_usage(index=True, deep=False).sum())
-        except Exception:  # noqa: BLE001 — exotic dtypes: skip caching
-            return df
-        limit = int(getattr(self._ctx.config, "param_cache_bytes",
-                            256 << 20))
-        if 0 < nbytes <= limit:
-            with self._df_cache_lock:
-                old = self._df_cache.pop(name, None)
-                if old is not None:
-                    self._df_cache_bytes -= old[2]
-                while self._df_cache and \
-                        self._df_cache_bytes + nbytes > limit:
-                    _, (_, _, evicted) = self._df_cache.popitem(last=False)
-                    self._df_cache_bytes -= evicted
-                self._df_cache[name] = (version, df, nbytes)
-                self._df_cache_bytes += nbytes
-            return df.copy(deep=False)
-        return df
+        return self._ctx.features.dataframe(name)
 
     def load_object(self, name: str) -> Any:
         t = self.artifact_type(name)

@@ -308,6 +308,13 @@ class Api:
             pool: round(seconds, 3) for pool, seconds in
             sorted(self.ctx.jobs.mesh_served().items())}
         out["jobLifecycle"] = self.ctx.jobs.lifecycle_counters()
+        # feature-plane cache tiers (docs/PERFORMANCE.md). Lazy
+        # imports: arena/engine stats never initialize a backend.
+        out["featureCache"] = self.ctx.features.stats()
+        from learningorchestra_tpu.runtime import arena as arena_lib
+        from learningorchestra_tpu.runtime import engine as engine_lib
+        out["arena"] = arena_lib.get_default_arena().stats()
+        out["executableCache"] = engine_lib.executable_cache_stats()
         return out
 
     def metrics_prometheus(self) -> bytes:
@@ -353,6 +360,31 @@ class Api:
             f"lo_get_cache_hits_total {m['getCache']['hits']}",
             "# TYPE lo_get_cache_misses_total counter",
             f"lo_get_cache_misses_total {m['getCache']['misses']}",
+            "# TYPE lo_get_cache_entries gauge",
+            f"lo_get_cache_entries {m['getCache']['entries']}",
+        ]
+        feature = m["featureCache"]
+        arena = m["arena"]
+        exec_cache = m["executableCache"]
+        lines += [
+            "# TYPE lo_feature_cache_hits_total counter",
+            f"lo_feature_cache_hits_total {feature['hits']}",
+            "# TYPE lo_feature_cache_misses_total counter",
+            f"lo_feature_cache_misses_total {feature['misses']}",
+            "# TYPE lo_feature_cache_bytes_in_use gauge",
+            f"lo_feature_cache_bytes_in_use {feature['bytesInUse']}",
+            "# TYPE lo_arena_bytes_in_use gauge",
+            f"lo_arena_bytes_in_use {arena['bytesInUse']}",
+            "# TYPE lo_arena_evictions_total counter",
+            f"lo_arena_evictions_total {arena['evictions']}",
+            "# TYPE lo_arena_hits_total counter",
+            f"lo_arena_hits_total {arena['hits']}",
+            "# TYPE lo_arena_misses_total counter",
+            f"lo_arena_misses_total {arena['misses']}",
+            "# TYPE lo_executable_cache_hits_total counter",
+            f"lo_executable_cache_hits_total {exec_cache['hits']}",
+            "# TYPE lo_executable_cache_misses_total counter",
+            f"lo_executable_cache_misses_total {exec_cache['misses']}",
         ]
         lifecycle = m["jobLifecycle"]
         lines += [

@@ -137,6 +137,36 @@ def test_change_feed(catalog):
     assert catalog.watch(catalog.latest_seq(), timeout=0.05) == []
 
 
+def test_dataset_version_tracks_parquet_mutations(catalog):
+    # parquet writes never ride the change feed (see _record_change
+    # call sites); dataset_version must move on every mutation so the
+    # feature-plane cache key (collection_seq, dataset_version)
+    # catches them (services/feature_cache.py)
+    catalog.create_collection("ds", "dataset/csv")
+    assert catalog.dataset_version("ds") == ()
+    catalog.write_dataframe("ds", pd.DataFrame({"a": [1]}))
+    v1 = catalog.dataset_version("ds")
+    assert len(v1) == 1
+    catalog.write_dataframe("ds", pd.DataFrame({"a": [2]}), replace=False)
+    v2 = catalog.dataset_version("ds")
+    assert v2 != v1 and len(v2) == 2  # append -> new part
+    catalog.write_dataframe("ds", pd.DataFrame({"a": [3]}))
+    v3 = catalog.dataset_version("ds")
+    assert v3 != v2 and len(v3) == 1  # replace -> swapped single part
+
+
+def test_collection_seq_and_delete_in_feed(catalog):
+    catalog.create_collection("ds", "dataset/csv")
+    s1 = catalog.collection_seq("ds")
+    assert s1 > 0
+    seq = catalog.latest_seq()
+    catalog.mark_finished("ds")
+    assert catalog.collection_seq("ds") > s1
+    catalog.delete_collection("ds")
+    ops = [c["op"] for c in catalog.changes_since(seq, collection="ds")]
+    assert ops == ["update", "delete"]  # deletes are cache-observable
+
+
 def test_paging_past_first_part(catalog):
     # regression: whole-file fast-skip must consume `skip`
     catalog.create_collection("ds", "dataset/csv")

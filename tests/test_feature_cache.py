@@ -1,0 +1,276 @@
+"""Feature-plane cache (docs/PERFORMANCE.md): host-tier versioned
+memoization, HBM arena LRU/pinning, change-feed invalidation, and the
+acceptance property — a warm builder run on an unchanged dataset does
+ZERO catalog reads and ZERO retraces."""
+
+import threading
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from learningorchestra_tpu.catalog.store import CollectionNotFound
+from learningorchestra_tpu.runtime.arena import DeviceArena
+from learningorchestra_tpu.services.feature_cache import FeatureCache
+
+
+def _write(catalog, name, df):
+    if not catalog.exists(name):
+        catalog.create_collection(name, "dataset/csv", {})
+    catalog.write_dataframe(name, df)
+    catalog.mark_finished(name)
+
+
+# ------------------------------------------------------------ host tier
+def test_host_tier_hit_then_append_revalidates(catalog):
+    fc = FeatureCache(catalog, arena=DeviceArena(0))
+    _write(catalog, "ds", pd.DataFrame({"a": [1, 2]}))
+    assert fc.dataframe("ds")["a"].tolist() == [1, 2]
+    assert fc.dataframe("ds")["a"].tolist() == [1, 2]
+    s = fc.stats()
+    assert (s["hits"], s["misses"]) == (1, 1)
+    # appends write parquet parts WITHOUT a change-feed entry; only
+    # the dataset_version component of the key can catch them
+    catalog.write_dataframe("ds", pd.DataFrame({"a": [3]}), replace=False)
+    assert fc.dataframe("ds")["a"].tolist() == [1, 2, 3]
+    s = fc.stats()
+    assert s["misses"] == 2 and s["invalidations"] == 1
+    # in-place replace (the dataType service rewrite) as well
+    catalog.write_dataframe("ds", pd.DataFrame({"a": [9]}))
+    assert fc.dataframe("ds")["a"].tolist() == [9]
+
+
+def test_projection_and_dtype_key_separately(catalog):
+    fc = FeatureCache(catalog, arena=DeviceArena(0))
+    _write(catalog, "ds", pd.DataFrame({"a": [1.0, 2.0], "b": [3, 4]}))
+    full = fc.dataframe("ds")
+    proj = fc.dataframe("ds", columns=["a"])
+    assert list(full.columns) == ["a", "b"]
+    assert list(proj.columns) == ["a"]
+    arrs = fc.arrays("ds", ["a", "b"], np.float32)
+    assert arrs["a"].dtype == np.float32
+    assert fc.stats()["entries"] == 3
+    # each keyed independently -> repeat access hits
+    fc.dataframe("ds", columns=["a"])
+    fc.arrays("ds", ["a", "b"], np.float32)
+    assert fc.stats()["hits"] == 2
+
+
+def test_cached_frame_isolated_from_caller_mutation(catalog):
+    fc = FeatureCache(catalog, arena=DeviceArena(0))
+    _write(catalog, "ds", pd.DataFrame({"a": [1]}))
+    df = fc.dataframe("ds")
+    df["extra"] = 7  # whole-column add on the shallow copy
+    again = fc.dataframe("ds")
+    assert "extra" not in again.columns
+    assert fc.stats()["hits"] == 1  # and it WAS served from cache
+
+
+def test_delete_collection_sweeps_both_tiers(catalog):
+    arena = DeviceArena(1 << 20)
+    fc = FeatureCache(catalog, arena=arena)
+    _write(catalog, "doomed", pd.DataFrame({"a": [1]}))
+    _write(catalog, "other", pd.DataFrame({"b": [2]}))
+    fc.dataframe("doomed")
+    arena.get_or_put(fc.token("doomed"),
+                     lambda: {"x": np.ones(8, np.float32)},
+                     tags=("doomed",)).release()
+    assert fc.stats()["entries"] == 1 and arena.stats()["entries"] == 1
+    catalog.delete_collection("doomed")
+    # the delete rides the change feed; the next access sweeps it out
+    # of BOTH tiers so budget frees promptly
+    fc.dataframe("other")
+    assert fc.stats()["entries"] == 1  # only "other" remains
+    assert arena.stats()["entries"] == 0
+    assert arena.stats()["invalidations"] == 1
+
+
+# ------------------------------------------------------------ HBM arena
+def test_arena_lru_eviction_skips_pinned_readers():
+    arena = DeviceArena(byte_budget=3000)
+
+    def block():
+        return {"x": np.ones(250, np.float32)}  # 1000 bytes
+
+    pinned = arena.get_or_put("k1", block, tags=("c1",))  # stays pinned
+    arena.get_or_put("k2", block, tags=("c2",)).release()
+    arena.get_or_put("k3", block, tags=("c3",)).release()
+    arena.get_or_put("k4", block, tags=("c4",)).release()  # over budget
+    s = arena.stats()
+    assert s["evictions"] == 1 and s["bytesInUse"] == 3000
+    # k1 was the LRU victim candidate but is pinned -> k2 went instead
+    arena.get_or_put(
+        "k1", lambda: pytest.fail("pinned entry was evicted")).release()
+    assert arena.stats()["hits"] == 1
+    # the in-flight reader's arrays are intact throughout
+    assert float(pinned.arrays["x"].sum()) == 250.0
+    pinned.release()
+    pinned.release()  # idempotent
+
+
+def test_arena_all_pinned_degrades_to_no_eviction():
+    arena = DeviceArena(byte_budget=1500)
+    a = arena.get_or_put("a", lambda: {"x": np.ones(250, np.float32)})
+    b = arena.get_or_put("b", lambda: {"x": np.ones(250, np.float32)})
+    s = arena.stats()  # over budget but every entry is in use
+    assert s["bytesInUse"] == 2000 and s["evictions"] == 0
+    a.release(), b.release()
+    arena.get_or_put("c", lambda: {"x": np.ones(250, np.float32)}).release()
+    assert arena.stats()["bytesInUse"] <= 1500  # pins gone -> swept
+
+
+def test_arena_invalidate_keeps_inflight_arrays():
+    arena = DeviceArena(byte_budget=1 << 20)
+    entry = arena.get_or_put(("k", 0), lambda: {"x": np.arange(10)},
+                             tags=("ds",))
+    assert arena.invalidate("ds") == 1
+    assert arena.stats()["entries"] == 0
+    # the reader mid-fit keeps its (now unlinked) arrays
+    assert int(entry.arrays["x"].sum()) == 45
+    entry.release()  # must not raise on the unlinked key
+    rebuilt = arena.get_or_put(("k", 0), lambda: {"x": np.arange(10)})
+    assert arena.stats()["misses"] == 2
+    rebuilt.release()
+
+
+def test_arena_zero_budget_disables_caching():
+    arena = DeviceArena(byte_budget=0)
+    e = arena.get_or_put("k", lambda: {"x": np.ones(4)})
+    assert int(e.arrays["x"].sum()) == 4
+    e.release()
+    assert arena.stats()["entries"] == 0
+    assert arena.get_or_put("k", lambda: {"x": np.ones(4)}).arrays is not None
+    assert arena.stats()["hits"] == 0  # every access rebuilds
+
+
+# ---------------------------------------------------- read-during-write
+def test_concurrent_read_during_write_never_mixes(catalog):
+    """Readers racing write_dataframe's staging-rename swap must see
+    one coherent version — every row from the same write."""
+    def frame(i):
+        return pd.DataFrame({"v": [i] * 256, "w": [i] * 256})
+
+    _write(catalog, "ds", frame(0))
+    fc = FeatureCache(catalog, arena=DeviceArena(0))
+    stop = threading.Event()
+    bad = []
+
+    def reader():
+        while not stop.is_set():
+            try:
+                df = fc.dataframe("ds")
+            except CollectionNotFound:
+                continue  # transient mid-rename window
+            vals = set(df["v"].tolist()) | set(df["w"].tolist())
+            if len(vals) != 1:
+                bad.append(sorted(vals))
+
+    threads = [threading.Thread(target=reader) for _ in range(4)]
+    for t in threads:
+        t.start()
+    for i in range(1, 40):
+        catalog.write_dataframe("ds", frame(i))
+    stop.set()
+    for t in threads:
+        t.join()
+    assert not bad, f"mixed-version frames observed: {bad[:3]}"
+    assert fc.dataframe("ds")["v"].iloc[0] == 39  # converges to newest
+
+
+# ------------------------------------------------- warm builder pipeline
+@pytest.fixture()
+def ctx(tmp_config):
+    from learningorchestra_tpu.services.context import ServiceContext
+    c = ServiceContext(tmp_config)
+    yield c
+    c.close()
+
+
+def _synth(n, seed, d=4):
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(n, d)).astype(np.float32)
+    y = (x @ np.array([1.0, -2.0, 0.5, 1.5])[:d] > 0).astype(np.int64)
+    return x, y
+
+
+def _write_synth(catalog, name, n, seed):
+    import pyarrow as pa
+
+    x, y = _synth(n, seed)
+    catalog.create_collection(name, "dataset/csv", {})
+    with catalog.dataset_writer(name) as w:
+        w.write_batch(pa.table({
+            "f0": x[:, 0], "f1": x[:, 1], "f2": x[:, 2], "f3": x[:, 3],
+            "label": y}))
+    catalog.mark_finished(name)
+
+
+MODELING = """
+import numpy as np
+feats = ["f0", "f1", "f2", "f3"]
+features_training = (training_df[feats].to_numpy(np.float32),
+                     training_df["label"].to_numpy())
+features_testing = testing_df[feats].to_numpy(np.float32)
+features_evaluation = (testing_df[feats].to_numpy(np.float32),
+                       testing_df["label"].to_numpy())
+"""
+
+
+def test_warm_builder_run_zero_reads_zero_retraces(ctx, monkeypatch):
+    """ISSUE acceptance: the second builder run on an unchanged
+    dataset must touch neither the catalog (zero read_dataframe) nor
+    the tracer (zero executable-cache misses), then a mutation must be
+    observed by the very next run."""
+    from learningorchestra_tpu.runtime import engine as engine_lib
+    from learningorchestra_tpu.services.builder_service import BuilderService
+
+    _write_synth(ctx.catalog, "fcb_train", 2048, seed=1)
+    _write_synth(ctx.catalog, "fcb_test", 512, seed=2)
+    svc = BuilderService(ctx)
+    body = {"trainDatasetName": "fcb_train", "testDatasetName": "fcb_test",
+            "evaluationDatasetName": "fcb_test", "modelingCode": MODELING,
+            "classifiersList": ["LR", "NB"], "meshParallel": True}
+
+    status, _ = svc.create(dict(body))
+    assert status == 201
+    ctx.jobs.wait("fcb_testLR", timeout=600)
+
+    calls = []
+    orig = ctx.catalog.read_dataframe
+
+    def counted(*a, **k):
+        calls.append(a)
+        return orig(*a, **k)
+
+    monkeypatch.setattr(ctx.catalog, "read_dataframe", counted)
+    fc0, ex0 = ctx.features.stats(), engine_lib.executable_cache_stats()
+
+    status, _ = svc.create(dict(body))
+    assert status == 201
+    ctx.jobs.wait("fcb_testLR", timeout=600)
+
+    fc1, ex1 = ctx.features.stats(), engine_lib.executable_cache_stats()
+    assert calls == [], f"warm run hit the catalog: {calls}"
+    assert fc1["hits"] - fc0["hits"] >= 2  # train + test served warm
+    assert fc1["misses"] == fc0["misses"]
+    assert ex1["misses"] == ex0["misses"], "warm run retraced"
+    assert ex1["hits"] > ex0["hits"]
+    for c in ("LR", "NB"):
+        meta = ctx.catalog.get_metadata(f"fcb_test{c}")
+        assert meta["finished"] is True and meta["engine"] == "jax", meta
+        assert meta["accuracy"] > 0.9, meta
+
+    # staleness: append rows -> the NEXT run must re-read and re-stage
+    x, y = _synth(256, seed=3)
+    ctx.catalog.write_dataframe("fcb_train", pd.DataFrame({
+        "f0": x[:, 0], "f1": x[:, 1], "f2": x[:, 2], "f3": x[:, 3],
+        "label": y}), replace=False)
+    status, _ = svc.create(dict(body))
+    assert status == 201
+    ctx.jobs.wait("fcb_testLR", timeout=600)
+    assert any(a[0] == "fcb_train" for a in calls), \
+        "mutated dataset was served stale"
+    fc2 = ctx.features.stats()
+    assert fc2["misses"] > fc1["misses"]
+    meta = ctx.catalog.get_metadata("fcb_testLR")
+    assert meta["finished"] is True and meta["accuracy"] > 0.9, meta
