@@ -94,8 +94,8 @@ TLM_ATTENTION = os.environ.get("LO_BENCH_TLM_ATTENTION", "auto")
 # runs via LO_BENCH_TIMEOUT_<PHASE>
 PHASE_TIMEOUTS = {"cnn": 600, "lstm": 600, "tlm": 900, "proxy": 120,
                   "builder": 600, "builder_mesh": 600,
-                  "warm_pipeline": 600, "flash": 600,
-                  "ingest": 600, "gen": 900}
+                  "warm_pipeline": 600, "concurrent_jobs": 600,
+                  "flash": 600, "ingest": 600, "gen": 900}
 
 # out-of-core Builder (reference config 4: 10M-row GBT via Spark)
 BUILDER_ROWS = int(os.environ.get("LO_BENCH_BUILDER_ROWS", "10000000"))
@@ -769,10 +769,74 @@ def phase_proxy(max_seconds=60.0):
     return {"samples_per_sec": round(steps * BATCH / dt, 2)}
 
 
+def phase_concurrent_jobs():
+    """Spatial slice multiplexing (docs/SCALING.md): the same TWO
+    small train fits run (a) serialized behind a single full-mesh
+    lease (LO_MESH_LEASES=1) and (b) concurrently on disjoint
+    half-mesh slices (LO_MESH_LEASES=2 + half-mesh footprints). Each
+    configuration runs once unmeasured (compiles both slice
+    executables; placement is deterministic so the timed run reuses
+    them) and once timed. CI gates on concurrent < 0.75x serialized."""
+    import jax
+    import numpy as np
+
+    from learningorchestra_tpu import config as config_mod
+    from learningorchestra_tpu.catalog import Catalog
+    from learningorchestra_tpu.models.estimators import (
+        LogisticRegressionJAX,
+    )
+    from learningorchestra_tpu.services.jobs import JobManager
+
+    total = len(jax.devices())
+    if total < 2:
+        return {"skipped": f"needs >=2 devices, have {total}"}
+    half = total // 2
+    rows = int(os.environ.get("LO_BENCH_CONCURRENT_ROWS", "8192"))
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(rows, 32)).astype(np.float32)
+    y = (x[:, 0] > 0).astype(np.int64)
+
+    def fit_job():
+        LogisticRegressionJAX(epochs=3, batch_size=1024).fit(x, y)
+        return "ok"
+
+    def run_round(leases, footprint):
+        home = tempfile.mkdtemp(prefix="lo_bench_slice_")
+        cfg = config_mod.set_config(
+            config_mod.Config(home=home, mesh_leases=leases))
+        cat = Catalog(cfg.catalog_path, cfg.datasets_dir)
+        jobs = JobManager(cat, max_workers=4, mesh_leases=leases)
+        try:
+            for batch in ("w", "t"):  # w = warm-up, t = timed
+                names = [f"{batch}{i}" for i in (1, 2)]
+                for n in names:
+                    cat.create_collection(n, "train/tensorflow")
+                t0 = time.perf_counter()
+                for n in names:
+                    jobs.submit(n, fit_job, needs_mesh=True,
+                                pool="train", footprint=footprint)
+                for n in names:
+                    jobs.wait(n, timeout=600)
+                elapsed = time.perf_counter() - t0
+            return elapsed
+        finally:
+            jobs.shutdown()
+            cat.close()
+
+    serialized = run_round(1, None)
+    concurrent = run_round(2, {"devices": half})
+    return {"devices_total": total, "slice_devices": half,
+            "serialized_seconds": round(serialized, 3),
+            "concurrent_seconds": round(concurrent, 3),
+            "ratio": round(concurrent / serialized, 3),
+            "platform": jax.devices()[0].platform}
+
+
 PHASES = {"cnn": phase_cnn, "lstm": phase_lstm, "tlm": phase_tlm,
           "proxy": phase_proxy, "builder": phase_builder,
           "builder_mesh": phase_builder_mesh,
           "warm_pipeline": phase_warm_pipeline,
+          "concurrent_jobs": phase_concurrent_jobs,
           "flash": phase_flash, "ingest": phase_ingest,
           "gen": phase_gen}
 

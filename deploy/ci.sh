@@ -43,7 +43,8 @@ echo "== perf-smoke: warm pipeline must hit the feature-plane cache =="
 PERF_TIMEOUT="${LO_CI_PERF_TIMEOUT:-600}"
 PERF_CACHE="$(mktemp -d)"
 PERF_OUT="$(mktemp)"
-trap 'rm -rf "$PERF_CACHE" "$PERF_OUT"' EXIT
+SLICE_OUT="$(mktemp)"
+trap 'rm -rf "$PERF_CACHE" "$PERF_OUT" "$SLICE_OUT"' EXIT
 timeout -k 10 "$PERF_TIMEOUT" env JAX_PLATFORMS=cpu \
     JAX_COMPILATION_CACHE_DIR="$PERF_CACHE" \
     LO_COMPUTE_DTYPE=float32 \
@@ -68,6 +69,40 @@ warm = result["warm"]["pipeline_seconds"]
 assert hits > 0, f"perf-smoke: warm run hit no caches: {result}"
 assert warm <= cold, f"perf-smoke: warm {warm}s slower than cold {cold}s"
 print(f"perf-smoke: OK (cold {cold}s, warm {warm}s, {hits} cache hits)")
+EOF
+
+echo "== slice-smoke: concurrent half-mesh jobs must beat serialization =="
+# Two identical small train jobs on an 8-device CPU mesh: serialized
+# behind one full-mesh lease vs concurrent on disjoint 4-device slices
+# (bench.py concurrent_jobs). The gate asserts spatial multiplexing
+# actually pays: concurrent wall-clock < 0.75x serialized.
+SLICE_TIMEOUT="${LO_CI_SLICE_TIMEOUT:-600}"
+timeout -k 10 "$SLICE_TIMEOUT" env JAX_PLATFORMS=cpu \
+    XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+    JAX_COMPILATION_CACHE_DIR="$PERF_CACHE" \
+    LO_COMPUTE_DTYPE=float32 \
+    python bench.py --phase concurrent_jobs | tee "$SLICE_OUT"
+python - "$SLICE_OUT" <<'EOF'
+import json, sys
+
+mark = "@@LO_BENCH_RESULT@@"
+result = None
+for line in reversed(open(sys.argv[1]).read().splitlines()):
+    if line.startswith(mark):
+        result = json.loads(line[len(mark):])
+        break
+assert result is not None, "slice-smoke: no bench result line"
+assert "error" not in result, f"slice-smoke: phase failed: {result}"
+result = result.get("result", result)  # unwrap the ok-envelope
+assert "skipped" not in result, f"slice-smoke: {result['skipped']}"
+serialized = result["serialized_seconds"]
+concurrent = result["concurrent_seconds"]
+ratio = result["ratio"]
+assert ratio < 0.75, (
+    f"slice-smoke: concurrent {concurrent}s is not < 0.75x "
+    f"serialized {serialized}s (ratio {ratio})")
+print(f"slice-smoke: OK (serialized {serialized}s, "
+      f"concurrent {concurrent}s, ratio {ratio})")
 EOF
 
 echo "== ci: OK =="

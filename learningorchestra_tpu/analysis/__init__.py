@@ -33,10 +33,12 @@ from learningorchestra_tpu.analysis.code_lint import (  # noqa: F401
     lint_code,
 )
 from learningorchestra_tpu.analysis.preflight import (  # noqa: F401
+    FOOTPRINT_FIELD,
     RESULT_SHAPES_FIELD,
     check_builder,
     check_execution,
     check_model,
+    estimate_footprint,
     lint_parameter_code,
     result_shapes,
 )

@@ -48,6 +48,11 @@ from learningorchestra_tpu.catalog import documents as D
 # shapes (written by function/execution services after artifact save)
 RESULT_SHAPES_FIELD = "resultShapes"
 
+# metadata key under which an execution's estimated HBM footprint is
+# recorded at submit time (consumed by the slice scheduler and shown
+# to clients polling the job document)
+FOOTPRINT_FIELD = "footprint"
+
 _NEURAL_MODULE = "learningorchestra_tpu.models"
 _NEURAL_CLASSES = ("NeuralModel",)
 _DATA_METHODS = ("fit", "evaluate", "predict", "score")
@@ -326,6 +331,100 @@ def check_execution(catalog: Any, root_meta: Optional[Dict[str, Any]],
                 f"data-parallel extent {dp}; the feed will zero-pad "
                 f"each step (wasted accelerator work)"))
     return findings
+
+
+# ----------------------------------------------------------------------
+# footprint estimation (slice scheduler)
+# ----------------------------------------------------------------------
+# heuristic fallback multiplier over raw param bytes: params + grads
+# + two adam moments all live in HBM during a fit
+_OPTIMIZER_MULTIPLIER = 4
+
+
+def _compiled_init_bytes(configs: List[Any],
+                         x_struct: Any) -> Optional[int]:
+    """Lower + compile the init step and read XLA's
+    ``memory_analysis()`` (argument + output + temp bytes). None on
+    backends that don't implement the analysis (notably CPU on some
+    jaxlib builds) — callers fall back to the heuristic."""
+    try:
+        import jax
+
+        from learningorchestra_tpu.models import neural as neural_lib
+
+        model = neural_lib.NeuralModel(layer_configs=list(configs))
+        module = model.module
+        sample = jax.ShapeDtypeStruct((1,) + tuple(x_struct.shape[1:]),
+                                      x_struct.dtype)
+        compiled = jax.jit(
+            functools.partial(module.init, train=False)).lower(
+            jax.random.PRNGKey(0), sample).compile()
+        analysis = compiled.memory_analysis()
+        if analysis is None:
+            return None
+        total = sum(int(getattr(analysis, field, 0) or 0) for field in (
+            "argument_size_in_bytes", "output_size_in_bytes",
+            "temp_size_in_bytes"))
+        return total or None
+    except Exception:  # noqa: BLE001 — estimation is best-effort
+        return None
+
+
+def estimate_footprint(catalog: Any,
+                       root_meta: Optional[Dict[str, Any]],
+                       method: Any,
+                       method_parameters: Any) -> Optional[Dict[str, Any]]:
+    """Best-effort HBM footprint for a NeuralModel data method:
+    ``{"hbmBytes", "paramBytes", "estimator"}`` where ``estimator`` is
+    ``"memory_analysis"`` (XLA measured the lowered init step) or
+    ``"heuristic"`` (param bytes × optimizer multiplier + two staged
+    batches). None for anything unmodelable — the scheduler then
+    gang-acquires the full mesh, which is always safe. Same bypass
+    discipline as every other pre-flight check: never wrong, possibly
+    absent."""
+    if method not in _DATA_METHODS or \
+            not isinstance(method_parameters, dict) or \
+            not isinstance(root_meta, dict):
+        return None
+    configs = _neural_spec(root_meta.get(D.MODULE_PATH_FIELD),
+                           root_meta.get(D.CLASS_FIELD),
+                           root_meta.get(D.CLASS_PARAMETERS_FIELD))
+    if configs is None:
+        return None
+    x_struct = _ref_struct(catalog, method_parameters.get("x"))
+    if x_struct is None or len(x_struct.shape) < 2:
+        return None
+    shapes, _ = _trace_init(configs, x_struct)
+    if shapes is None:
+        return None
+    try:
+        import jax
+
+        param_bytes = sum(
+            int(np.prod(leaf.shape) or 1) * np.dtype(leaf.dtype).itemsize
+            for leaf in jax.tree_util.tree_leaves(shapes))
+    except Exception:  # noqa: BLE001 — unmodelable tree: bypass
+        return None
+    batch = method_parameters.get("batch_size")
+    if not isinstance(batch, int) or batch <= 0:
+        from learningorchestra_tpu.config import get_config
+
+        batch = get_config().default_batch_size
+    feature_bytes = int(np.prod(x_struct.shape[1:]) or 1) * \
+        np.dtype(x_struct.dtype).itemsize
+    estimate = param_bytes * _OPTIMIZER_MULTIPLIER \
+        + 2 * batch * feature_bytes
+    estimator = "heuristic"
+    measured = _compiled_init_bytes(configs, x_struct)
+    if measured:
+        # the measured init covers params only; optimizer state and
+        # staged batches still come from the model above
+        estimate = max(estimate,
+                       measured * _OPTIMIZER_MULTIPLIER
+                       + 2 * batch * feature_bytes)
+        estimator = "memory_analysis"
+    return {"hbmBytes": int(estimate), "paramBytes": int(param_bytes),
+            "estimator": estimator}
 
 
 def check_builder(modeling_code: Any,

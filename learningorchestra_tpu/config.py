@@ -46,8 +46,25 @@ class Config:
         default_factory=lambda: int(os.environ.get("LO_MAX_WORKERS", "8")))
     # Max concurrent jobs holding the accelerator mesh (a TPU mesh is
     # an exclusive resource, unlike the reference's forgiving threads).
+    # At 1 (default): strict whole-mesh serialization. Above 1 the
+    # scheduler becomes a SLICE allocator: concurrent jobs are packed
+    # onto disjoint device sub-meshes sized by their declared
+    # footprint, and footprint-less jobs gang-acquire the full mesh
+    # (docs/SCALING.md "Slice scheduling").
     mesh_leases: int = dataclasses.field(
         default_factory=lambda: int(os.environ.get("LO_MESH_LEASES", "1")))
+    # Smallest slice the allocator will grant (footprints are rounded
+    # up to this many devices).
+    slice_min_devices: int = dataclasses.field(
+        default_factory=lambda: int(os.environ.get(
+            "LO_SLICE_MIN_DEVICES", "1")))
+    # Anti-starvation bound: a full-mesh (gang) job blocked at its
+    # pool head stops smaller jobs from backfilling around it after
+    # this many seconds, so releases drain devices toward it. 0 = no
+    # freeze (backfill forever).
+    slice_aging_seconds: float = dataclasses.field(
+        default_factory=lambda: float(os.environ.get(
+            "LO_SLICE_AGING", "30")))
     # Fair-scheduling pool weights, "train=2,tune=1" (unlisted pools
     # weigh 1) — reference fairscheduler.xml ``weight`` parity.
     pool_weights: str = dataclasses.field(
@@ -78,6 +95,11 @@ class Config:
     ingest_chunk_rows: int = dataclasses.field(
         default_factory=lambda: int(os.environ.get("LO_INGEST_CHUNK", "65536")))
     ingest_queue_depth: int = 8
+    # Device-prefetch pipeline depth: batches staged ahead of the
+    # training loop by runtime.data.prefetch_to_device.
+    prefetch_buffer: int = dataclasses.field(
+        default_factory=lambda: int(os.environ.get(
+            "LO_PREFETCH_BUFFER", "2")))
 
     # Function / '#' DSL sandboxing: 'subprocess' (separate process +
     # rlimits + fs/exec/socket audit guard — a real jail),

@@ -56,7 +56,7 @@ class LogisticRegressionJAX:
         self._mesh_override = mesh
 
     def _mesh(self):
-        return self._mesh_override or mesh_lib.get_default_mesh()
+        return self._mesh_override or mesh_lib.current_mesh()
 
     @staticmethod
     def _apply(params, model_state, batch, train, rng):
@@ -186,7 +186,8 @@ class GaussianNBJAX:
                 # fit reuses the resident device copies
                 entry = arena_lib.get_default_arena().get_or_put(
                     ("nb_stats", self.feature_token, mesh), stage,
-                    tags=self.feature_tags)
+                    tags=self.feature_tags, group=mesh,
+                    group_fraction=mesh_lib.mesh_fraction(mesh))
                 xj, onehot = entry.arrays["x"], entry.arrays["onehot"]
             else:
                 staged = stage()

@@ -105,7 +105,7 @@ class NeuralModel:
         self._state = None
 
     def _mesh(self):
-        return self._mesh_override or mesh_lib.get_default_mesh()
+        return self._mesh_override or mesh_lib.current_mesh()
 
     # ------------------------------------------------------------------
     def add(self, layer_config: Dict[str, Any]) -> None:

@@ -38,19 +38,10 @@ _OPTIMIZER_KEYS = {"kind", "learning_rate", "lr", "momentum",
 _FIT_KEYS = {"batch_size", "epochs"}
 
 
-def sub_meshes(mesh, k: int) -> List[Any]:
-    """Split a mesh into ``k`` disjoint data-parallel sub-meshes.
-
-    Trial parallelism beats intra-trial parallelism for sweeps of
-    small models, so sub-slices are 1-D ``dp`` meshes regardless of
-    the parent's axes.
-    """
-    devices = list(np.asarray(mesh.devices).flat)
-    k = max(1, min(k, len(devices)))
-    per = len(devices) // k
-    return [mesh_lib.build_mesh(f"dp={per}",
-                                devices=devices[i * per:(i + 1) * per])
-            for i in range(k)]
+# Deprecated re-export: sub-mesh construction is a runtime concern
+# now that the slice scheduler packs jobs onto device subsets — the
+# implementation lives in runtime.mesh. Import from there.
+sub_meshes = mesh_lib.sub_meshes
 
 
 def _clone(estimator):
@@ -217,7 +208,9 @@ class GridSearch:
 
         combos = self._combinations()
         tx, ty, vx, vy = self._split(x, y)
-        mesh = mesh_lib.get_default_mesh()
+        # current_mesh: a sweep running under a scheduler slice grant
+        # cuts ITS slice into trial sub-slices, not the whole mesh
+        mesh = mesh_lib.current_mesh()
         if jax.process_count() > 1:
             # multi-host: every host replays this fit (execution.py
             # fan-out) and must execute identical programs in identical

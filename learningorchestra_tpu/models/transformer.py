@@ -258,7 +258,7 @@ def _dispatch_attention(q, k, v, *, impl: str, causal: bool, mesh=None,
     impl: ring hops apply the exact banded mask at static cross-shard
     offsets (hops wholly below the band skip), Ulysses windows its
     local full-sequence attention."""
-    mesh = mesh or mesh_lib.get_default_mesh()
+    mesh = mesh or mesh_lib.current_mesh()
     b, s, h, _ = q.shape
     kvh = k.shape[2]
     group = h // kvh
@@ -357,7 +357,7 @@ class _MoE(nn.Module):
         wi, wo = _Experts(self.n_experts, d_model, self.d_ff,
                           name="experts")()
         params = {"gate": gate, "experts": {"wi": wi, "wo": wo}}
-        mesh = self.mesh or mesh_lib.get_default_mesh()
+        mesh = self.mesh or mesh_lib.current_mesh()
         ep_mesh = mesh if (mesh_lib.EP in mesh.axis_names and
                            mesh.shape[mesh_lib.EP] > 1) else None
         return moe_lib.moe_layer(params, x, k=self.k, mesh=ep_mesh)
@@ -490,7 +490,7 @@ class TransformerLM(nn.Module):
             raise ValueError(f"unknown attention impl: {self.attention!r}")
         d_ff = self.d_ff or 4 * self.d_model
         head_dim = self.d_model // self.n_heads
-        mesh = self.mesh or mesh_lib.get_default_mesh()
+        mesh = self.mesh or mesh_lib.current_mesh()
         fuse = self.fused_proj
 
         x = nn.Embed(self.vocab_size, self.d_model, name="embed")(tokens)
@@ -731,7 +731,7 @@ def next_token_loss(aux_coef: float = 0.01, head_chunk: int = 1024,
 
     def loss_fn(outputs, batch, weights):
         if isinstance(outputs, FusedHeadOut):
-            m = mesh or mesh_lib.get_default_mesh()
+            m = mesh or mesh_lib.current_mesh()
             b, s = batch["x"].shape[:2]
             sp = m.shape.get(mesh_lib.SP, 1)
             tp = m.shape.get(mesh_lib.TP, 1)
@@ -806,7 +806,7 @@ class TransformerEncoder(nn.Module):
         d_ff = self.d_ff or 4 * self.d_model
         head_dim = self.d_model // self.n_heads
         x = nn.Embed(self.vocab_size, self.d_model, name="embed")(tokens)
-        mesh = self.mesh or mesh_lib.get_default_mesh()
+        mesh = self.mesh or mesh_lib.current_mesh()
         x = sharding_lib.constrain(
             x, mesh, mesh_lib.data_axes(mesh) or None,
             mesh_lib.SP if self.attention in ("ring", "ulysses")
@@ -886,7 +886,7 @@ class TextClassifier:
         return "dot"
 
     def _mesh(self):
-        return self._mesh_override or mesh_lib.get_default_mesh()
+        return self._mesh_override or mesh_lib.current_mesh()
 
     def set_mesh(self, mesh) -> None:
         self._mesh_override = mesh
@@ -1162,7 +1162,7 @@ class LanguageModel:
         self._beam_cache_fns = {}
 
     def _mesh(self):
-        return self._mesh_override or mesh_lib.get_default_mesh()
+        return self._mesh_override or mesh_lib.current_mesh()
 
     # ------------------------------------------------------------------
     def _resolved_attention(self, seq_len: Optional[int] = None) -> str:

@@ -84,13 +84,14 @@ class ArenaEntry:
 
 
 class _Resident:
-    __slots__ = ("arrays", "nbytes", "tags", "pins")
+    __slots__ = ("arrays", "nbytes", "tags", "pins", "group")
 
-    def __init__(self, arrays, nbytes, tags):
+    def __init__(self, arrays, nbytes, tags, group=None):
         self.arrays = arrays
         self.nbytes = nbytes
         self.tags = tags
         self.pins = 0
+        self.group = group
 
 
 class DeviceArena:
@@ -105,6 +106,10 @@ class DeviceArena:
         self._entries: "collections.OrderedDict[Any, _Resident]" = \
             collections.OrderedDict()
         self._bytes = 0
+        # per-group residency (group = the mesh an entry was staged
+        # under); a slice-scheduled fit budgets against its slice's
+        # HBM fraction, not the whole arena
+        self._group_bytes: Dict[Any, int] = {}
         self._lock = threading.Lock()
         self.hits = 0
         self.misses = 0
@@ -113,13 +118,21 @@ class DeviceArena:
 
     # -- core ----------------------------------------------------------
     def get_or_put(self, key: Any, build: Callable[[], Dict[str, Any]],
-                   tags: Iterable[str] = ()) -> ArenaEntry:
+                   tags: Iterable[str] = (), group: Any = None,
+                   group_fraction: float = 1.0) -> ArenaEntry:
         """Pinned entry for ``key``, building (and staging) it on miss.
 
         The build runs outside the lock; a concurrent miss on the same
         key may build twice, in which case the first insert wins and
         the loser's arrays are garbage-collected — duplicate staging
         is cheaper than serializing every fit behind one transfer.
+
+        ``group`` partitions the budget: entries inserted under a
+        group are additionally bounded by ``budget * group_fraction``
+        with eviction scoped to that group — a fit running on a
+        half-mesh slice budgets against half the arena instead of
+        evicting full-mesh residents. ``group=None`` (the default)
+        keeps the single global budget exactly as before.
         """
         tags = tuple(tags)
         with self._lock:
@@ -146,10 +159,16 @@ class DeviceArena:
                 res.pins += 1
                 return ArenaEntry(key, res.arrays, res.nbytes, res.tags,
                                   self)
-            res = _Resident(arrays, nbytes, tags)
+            res = _Resident(arrays, nbytes, tags, group)
             res.pins = 1
             self._entries[key] = res
             self._bytes += nbytes
+            if group is not None:
+                self._group_bytes[group] = \
+                    self._group_bytes.get(group, 0) + nbytes
+                limit = int(self._budget * max(0.0, min(1.0,
+                                                        group_fraction)))
+                self._evict_group_locked(group, limit)
             self._evict_locked()
             return ArenaEntry(key, arrays, nbytes, tags, self)
 
@@ -158,6 +177,17 @@ class DeviceArena:
             res = self._entries.get(key)
             if res is not None and res.pins > 0:
                 res.pins -= 1
+
+    def _drop_locked(self, key: Any) -> "_Resident":
+        res = self._entries.pop(key)
+        self._bytes -= res.nbytes
+        if res.group is not None:
+            remaining = self._group_bytes.get(res.group, 0) - res.nbytes
+            if remaining > 0:
+                self._group_bytes[res.group] = remaining
+            else:
+                self._group_bytes.pop(res.group, None)
+        return res
 
     def _evict_locked(self) -> None:
         """LRU-evict unpinned entries until under budget. Pinned
@@ -175,8 +205,25 @@ class DeviceArena:
                     break
             if victim is None:
                 return
-            res = self._entries.pop(victim)
-            self._bytes -= res.nbytes
+            self._drop_locked(victim)
+            self.evictions += 1
+
+    def _evict_group_locked(self, group: Any, limit: int) -> None:
+        """LRU-evict unpinned entries of ``group`` until its bytes fit
+        ``limit`` — the slice-budget analogue of :meth:`_evict_locked`,
+        scoped so one slice's staging pressure only recycles its own
+        residents."""
+        if limit <= 0:
+            return
+        while self._group_bytes.get(group, 0) > limit:
+            victim = None
+            for key, res in self._entries.items():  # oldest first
+                if res.group == group and res.pins == 0:
+                    victim = key
+                    break
+            if victim is None:
+                return
+            self._drop_locked(victim)
             self.evictions += 1
 
     # -- invalidation --------------------------------------------------
@@ -189,8 +236,7 @@ class DeviceArena:
         with self._lock:
             for key in [k for k, r in self._entries.items()
                         if collection in r.tags]:
-                res = self._entries.pop(key)
-                self._bytes -= res.nbytes
+                self._drop_locked(key)
                 dropped += 1
             self.invalidations += dropped
         return dropped
@@ -199,6 +245,7 @@ class DeviceArena:
         with self._lock:
             self._entries.clear()
             self._bytes = 0
+            self._group_bytes.clear()
 
     # -- observability -------------------------------------------------
     @property
@@ -217,6 +264,7 @@ class DeviceArena:
                 "misses": self.misses,
                 "evictions": self.evictions,
                 "invalidations": self.invalidations,
+                "groups": len(self._group_bytes),
             }
 
 

@@ -183,13 +183,30 @@ def stage_to_device(arr: np.ndarray,
 
 def prefetch_to_device(iterator: Iterable[Dict[str, np.ndarray]],
                        sharding: Optional[NamedSharding] = None,
-                       buffer_size: int = 2,
+                       buffer_size: Optional[int] = None,
+                       cancel=None,
                        ) -> Iterator[Dict[str, jax.Array]]:
     """Stage batches onto devices ``buffer_size`` ahead of consumption.
 
     A daemon thread performs host slicing + ``device_put`` (async under
     JAX's dispatch) so step N+1's transfer overlaps step N's compute.
+    ``buffer_size`` None reads config ``prefetch_buffer``
+    (``LO_PREFETCH_BUFFER``). ``cancel`` (a
+    :class:`runtime.preempt.CancelToken`; defaults to the calling
+    thread's installed token) is checked per batch in the producer, so
+    a cancelled job's feed stops staging device batches instead of
+    filling the queue with HBM it no longer needs.
     """
+    if buffer_size is None:
+        from learningorchestra_tpu.config import get_config
+
+        buffer_size = max(1, int(get_config().prefetch_buffer))
+    if cancel is None:
+        # captured HERE, on the consumer's (job's) thread — the
+        # producer thread below has no thread-local cancel state
+        from learningorchestra_tpu.runtime import preempt
+
+        cancel = preempt.current_cancel()
     q: "queue_mod.Queue" = queue_mod.Queue(maxsize=buffer_size)
     _END = object()
     err: list = []
@@ -207,6 +224,8 @@ def prefetch_to_device(iterator: Iterable[Dict[str, np.ndarray]],
     def producer() -> None:
         try:
             for batch in iterator:
+                if cancel is not None and cancel.cancelled():
+                    return  # cancelled job: stop pinning HBM
                 if sharding is not None:
                     batch = {k: stage_to_device(v, sharding)
                              for k, v in batch.items()}

@@ -636,7 +636,11 @@ class Engine:
             entry = arena_lib.get_default_arena().get_or_put(
                 ("fit_arrays", token, steps, bs, batcher.shuffles,
                  self._mesh, sharding),
-                stage, tags=getattr(batcher, "cache_tags", ()))
+                stage, tags=getattr(batcher, "cache_tags", ()),
+                # slice-scheduled fits budget against their slice's
+                # share of HBM, not the whole arena
+                group=self._mesh,
+                group_fraction=mesh_lib.mesh_fraction(self._mesh))
             device_arrays = entry.arrays
         else:
             device_arrays = stage()

@@ -31,8 +31,10 @@ Swarm placement (SURVEY §2.4). Here the mesh is the cluster.
 
 from __future__ import annotations
 
+import contextlib
 import re
-from typing import Dict, Optional, Sequence, Tuple
+import threading
+from typing import Dict, Iterator, Optional, Sequence, Tuple
 
 import jax
 import numpy as np
@@ -161,6 +163,88 @@ def get_default_mesh() -> Mesh:
 def reset_default_mesh() -> None:
     global _default_mesh
     _default_mesh = None
+
+
+def slice_mesh(devices: Sequence[jax.Device],
+               spec: str = "auto") -> Mesh:
+    """First-class sub-mesh over an explicit device subset.
+
+    Axis names follow the same convention as :func:`build_mesh`
+    (``"auto"`` = 1-D ``dp``), so two slices over the SAME devices
+    compare equal — engine executable-cache keys that embed the mesh
+    stay stable across repeat grants of an identical slice.
+    """
+    return build_mesh(spec, devices=list(devices))
+
+
+def sub_meshes(mesh: Mesh, k: int) -> list:
+    """Split ``mesh`` into ``k`` disjoint equal 1-D dp sub-meshes
+    (trailing remainder devices are left unused). The scheduler's
+    slice allocator and the builder's per-family spatial multiplexing
+    both cut the mesh this way, so contiguous blocks map to the same
+    slices everywhere."""
+    devices = list(np.asarray(mesh.devices).flat)
+    k = max(1, min(k, len(devices)))
+    per = len(devices) // k
+    return [slice_mesh(devices[i * per:(i + 1) * per])
+            for i in range(k)]
+
+
+# -- per-job mesh override ------------------------------------------------
+# The slice scheduler grants a job a device subset; the job's thread
+# sees it through this thread-local so model code deep in the stack
+# (estimators, neural, sweep) trains on the granted slice without
+# threading a mesh through every signature. Absent an override,
+# current_mesh() is exactly get_default_mesh().
+_mesh_override = threading.local()
+
+
+def current_mesh() -> Mesh:
+    """The mesh THIS thread should compute on: the granted slice when
+    running under ``use_mesh`` (scheduler slice grants), else the
+    process-wide default mesh."""
+    mesh = getattr(_mesh_override, "mesh", None)
+    return mesh if mesh is not None else get_default_mesh()
+
+
+@contextlib.contextmanager
+def use_mesh(mesh: Optional[Mesh]) -> Iterator[Optional[Mesh]]:
+    """Scope ``current_mesh()`` to ``mesh`` on this thread (None is a
+    no-op, keeping the default-mesh fast path allocation-free)."""
+    if mesh is None:
+        yield None
+        return
+    previous = getattr(_mesh_override, "mesh", None)
+    _mesh_override.mesh = mesh
+    try:
+        yield mesh
+    finally:
+        _mesh_override.mesh = previous
+
+
+def mesh_for_slice(device_indices: Optional[Sequence[int]]) -> Mesh:
+    """Materialize a scheduler grant (indices into the default mesh's
+    flat device order) as a mesh. ``None`` or a full-cover grant
+    returns the default-mesh OBJECT itself so cache keys and ``is``
+    checks treat full-mesh jobs exactly as before slicing existed."""
+    base = get_default_mesh()
+    if device_indices is None:
+        return base
+    devices = list(np.asarray(base.devices).flat)
+    indices = sorted(int(i) for i in device_indices)
+    if len(indices) >= len(devices):
+        return base
+    return slice_mesh([devices[i] for i in indices])
+
+
+def mesh_fraction(mesh: Mesh) -> float:
+    """``mesh``'s share of the default mesh (per-slice arena budgets);
+    1.0 when the default mesh is unavailable or smaller."""
+    try:
+        base = get_default_mesh()
+        return min(1.0, float(mesh.size) / max(1, int(base.size)))
+    except Exception:  # noqa: BLE001 — no default mesh formed yet
+        return 1.0
 
 
 def data_axes(mesh: Mesh) -> Tuple[str, ...]:

@@ -350,16 +350,18 @@ class BuilderService:
         replay, so JAX fits serialize over the full mesh."""
         import jax
 
-        from learningorchestra_tpu.models.sweep import sub_meshes
         from learningorchestra_tpu.runtime import mesh as mesh_lib
 
         jax_families = sorted(c for c in outputs if c in _JAX_FAMILIES)
         if not jax_families:
             return {}, []
-        mesh = mesh_lib.get_default_mesh()
+        # current_mesh: under a scheduler slice grant the builder cuts
+        # ITS granted sub-mesh into per-family slices, not the whole
+        # mesh (devices it doesn't hold belong to concurrent jobs)
+        mesh = mesh_lib.current_mesh()
         if jax.process_count() > 1:
             return {c: mesh for c in jax_families}, jax_families
-        slices = sub_meshes(mesh, len(jax_families))
+        slices = mesh_lib.sub_meshes(mesh, len(jax_families))
         if len(slices) < len(jax_families):
             # fewer devices than families: serialize on the full mesh
             # instead of racing threads over one shared slice

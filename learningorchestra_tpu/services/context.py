@@ -47,7 +47,11 @@ class ServiceContext:
                                retry_backoff=self.config
                                .retry_backoff_seconds,
                                retry_backoff_max=self.config
-                               .retry_backoff_max_seconds)
+                               .retry_backoff_max_seconds,
+                               slice_min_devices=self.config
+                               .slice_min_devices,
+                               slice_aging_seconds=self.config
+                               .slice_aging_seconds)
         # feature-plane cache (docs/PERFORMANCE.md): the host tier all
         # dataset reads route through; shares the $name-cache budget
         self.features = FeatureCache(

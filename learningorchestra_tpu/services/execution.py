@@ -109,11 +109,15 @@ class ExecutionService:
         method_parameters = body[METHOD_PARAMETERS_FIELD] or {}
         description = body.get(DESCRIPTION_FIELD, "")
         timeout = V.valid_timeout(body.get(V.TIMEOUT_FIELD))
+        slice_devices = V.valid_slice_devices(
+            body.get(V.SLICE_DEVICES_FIELD))
         self._validator.not_duplicate(name)
         self._validator.existing_finished(parent_name)
         root_meta = self.root_model_metadata(parent_name)
         self._validate_method(root_meta, method, method_parameters)
         analysis = self._preflight(root_meta, method, method_parameters)
+        footprint = self._footprint(root_meta, method, method_parameters,
+                                    slice_devices)
         type_string = D.normalize_type(f"{verb}/{tool}")
         extra = {
             D.PARENT_NAME_FIELD: parent_name,
@@ -127,9 +131,14 @@ class ExecutionService:
             extra[V.TIMEOUT_FIELD] = timeout
         if analysis:
             extra[ANALYSIS_FIELD] = analysis
+        if footprint:
+            # the _id:0 record of what the scheduler was told — the
+            # "why did my job wait" answer for polling clients
+            extra[A.FOOTPRINT_FIELD] = footprint
         self._ctx.catalog.create_collection(name, type_string, extra)
         self._submit(name, type_string, parent_name, method,
-                     method_parameters, description, timeout=timeout)
+                     method_parameters, description, timeout=timeout,
+                     footprint=footprint)
         return V.HTTP_CREATED, {
             "result": f"/api/learningOrchestra/v1/{verb}/{tool}/{name}"}
 
@@ -143,17 +152,24 @@ class ExecutionService:
         description = body.get(DESCRIPTION_FIELD, "")
         timeout = V.valid_timeout(
             body.get(V.TIMEOUT_FIELD, meta.get(V.TIMEOUT_FIELD)))
+        slice_devices = V.valid_slice_devices(
+            body.get(V.SLICE_DEVICES_FIELD,
+                     (meta.get(A.FOOTPRINT_FIELD) or {}).get("devices")))
         parent_name = meta[D.PARENT_NAME_FIELD]
         root_meta = self.root_model_metadata(parent_name)
         self._validate_method(root_meta, method, method_parameters)
         analysis = self._preflight(root_meta, method, method_parameters)
+        footprint = self._footprint(root_meta, method, method_parameters,
+                                    slice_devices)
         self._ctx.catalog.update_metadata(
             name, {D.METHOD_PARAMETERS_FIELD: method_parameters,
                    ANALYSIS_FIELD: analysis,
+                   A.FOOTPRINT_FIELD: footprint,
                    V.TIMEOUT_FIELD: timeout,
                    D.FINISHED_FIELD: False})
         self._submit(name, meta[D.TYPE_FIELD], parent_name, method,
-                     method_parameters, description, timeout=timeout)
+                     method_parameters, description, timeout=timeout,
+                     footprint=footprint)
         return V.HTTP_SUCCESS, {
             "result": f"/api/learningOrchestra/v1/{verb}/{tool}/{name}"}
 
@@ -184,10 +200,29 @@ class ExecutionService:
             mode=self._ctx.config.sandbox_mode)
         return V.run_preflight(findings)
 
+    def _footprint(self, root_meta: Dict[str, Any], method: str,
+                   method_parameters: Dict[str, Any],
+                   slice_devices: Optional[int],
+                   ) -> Optional[Dict[str, Any]]:
+        """The slice-scheduler footprint for this execution: the
+        request's explicit ``sliceDevices`` merged over the preflight
+        HBM estimate (eval_shape init + lowered-step memory_analysis,
+        heuristic fallback). None = no claim; the scheduler
+        gang-acquires the full mesh, which is always safe."""
+        estimate = None
+        if self._ctx.config.preflight:
+            estimate = A.estimate_footprint(
+                self._ctx.catalog, root_meta, method, method_parameters)
+        footprint = dict(estimate) if estimate else {}
+        if slice_devices is not None:
+            footprint["devices"] = slice_devices
+        return footprint or None
+
     def _submit(self, name: str, type_string: str, parent_name: str,
                 method: str, method_parameters: Dict[str, Any],
                 description: str, only_if_idle: bool = False,
-                timeout: Optional[float] = None) -> None:
+                timeout: Optional[float] = None,
+                footprint: Optional[Dict[str, Any]] = None) -> None:
         def run():
             _broadcast_to_workers(name, type_string, parent_name, method,
                                   method_parameters)
@@ -220,7 +255,7 @@ class ExecutionService:
             pool=type_string.split("/", 1)[0],
             only_if_idle=only_if_idle,
             max_retries=self._ctx.config.job_max_retries,
-            timeout=timeout)
+            timeout=timeout, footprint=footprint)
 
 
 def _record_result_shapes(ctx, name: str, result: Any) -> None:

@@ -107,13 +107,17 @@ class JobManager:
                  stall_seconds: float = 0.0,
                  stall_escalate: bool = True,
                  retry_backoff: float = 0.5,
-                 retry_backoff_max: float = 30.0):
-        from learningorchestra_tpu.services.scheduler import FairLease
+                 retry_backoff_max: float = 30.0,
+                 slice_min_devices: int = 1,
+                 slice_aging_seconds: float = 30.0):
+        from learningorchestra_tpu.services.scheduler import SliceLease
 
         self._catalog = catalog
         self._pool = ThreadPoolExecutor(max_workers=max_workers,
                                         thread_name_prefix="lo-job")
-        self._mesh = FairLease(mesh_leases, pool_weights)
+        self._mesh = SliceLease(mesh_leases, pool_weights,
+                                min_devices=slice_min_devices,
+                                aging_seconds=slice_aging_seconds)
         self._futures: Dict[str, Future] = {}
         # name -> {description, parameters, needs_mesh, token}: the
         # lifecycle registry (cancel API, stall watchdog, shutdown
@@ -139,14 +143,22 @@ class JobManager:
                              name="lo-stall-watchdog").start()
 
     # ------------------------------------------------------------------
-    def mesh_lease(self, pool: str = "default", cancel=None):
+    def mesh_lease(self, pool: str = "default", cancel=None,
+                   footprint=None):
         """Context manager granting accelerator access through the
-        fair queue (``with jobs.mesh_lease(): ...``)."""
-        return self._mesh.lease(pool, cancel=cancel)
+        fair queue (``with jobs.mesh_lease(): ...``). ``footprint``
+        (``{"devices": n, "hbmBytes": b}``) sizes the slice grant when
+        slicing is enabled."""
+        return self._mesh.lease(pool, cancel=cancel, footprint=footprint)
 
     def mesh_served(self) -> Dict[str, float]:
         """Cumulative mesh seconds per pool (observability)."""
         return self._mesh.served()
+
+    def scheduler_stats(self) -> Dict[str, Any]:
+        """Slice-allocator occupancy/grant/wait aggregates (exported
+        as ``lo_mesh_devices_busy`` etc. by the Api)."""
+        return self._mesh.stats()
 
     def lifecycle_counters(self) -> Dict[str, int]:
         """Monotonic lifecycle counters + the currently-stalled gauge
@@ -197,6 +209,7 @@ class JobManager:
                failure_names: Optional[list] = None,
                only_if_idle: bool = False,
                timeout: Optional[float] = None,
+               footprint: Optional[Dict[str, Any]] = None,
                ) -> Future:
         """Run ``fn`` asynchronously under the reference's
         finished-flag contract for collection ``name`` (which must
@@ -206,7 +219,11 @@ class JobManager:
         output — a client polling any of them must see the error, not
         hang on a forever-False finished flag. ``timeout`` (seconds)
         is this job's deadline; None falls back to the manager-wide
-        default (``LO_JOB_TIMEOUT``), 0 disables."""
+        default (``LO_JOB_TIMEOUT``), 0 disables. ``footprint``
+        (``{"devices": n, "hbmBytes": b}``) sizes this mesh job's
+        slice grant under the slice scheduler; None gang-acquires the
+        full mesh. The granted slice flows into the job's thread as
+        ``runtime.mesh.current_mesh()``."""
         doc_names = list(failure_names) if failure_names else [name]
         effective_timeout = (self._default_timeout if timeout is None
                              else max(0.0, float(timeout)))
@@ -263,12 +280,41 @@ class JobManager:
                         # pool or during retry backoff: terminal, no
                         # lease ever taken
                         token.check()
-                        lease = (self._mesh.lease(pool, cancel=token)
+                        lease = (self._mesh.lease(pool, cancel=token,
+                                                  footprint=footprint)
                                  if needs_mesh
                                  else contextlib.nullcontext())
-                        with lease as lease_token:
+                        with lease as lease_token, \
+                                contextlib.ExitStack() as stack:
                             queue_wait = time.monotonic() - submitted
+                            slice_devices = getattr(
+                                lease_token, "devices", None)
+                            if slice_devices is not None:
+                                # the granted sub-mesh becomes this
+                                # thread's current_mesh() so engines
+                                # train on the slice; a full-mesh
+                                # grant (None) keeps the default-mesh
+                                # fast path untouched
+                                from learningorchestra_tpu.runtime \
+                                    import mesh as mesh_lib
+                                stack.enter_context(mesh_lib.use_mesh(
+                                    mesh_lib.mesh_for_slice(
+                                        slice_devices)))
                             self._set_status(name, D.STATUS_RUNNING)
+                            if needs_mesh:
+                                # surface WHY the job waited and WHERE
+                                # it landed on the metadata document
+                                meta = {"leaseWaitSeconds": round(
+                                    getattr(lease_token, "wait_seconds",
+                                            queue_wait), 6)}
+                                if slice_devices is not None:
+                                    meta["sliceDevices"] = \
+                                        list(slice_devices)
+                                try:
+                                    self._catalog.update_metadata(
+                                        name, meta)
+                                except Exception:  # noqa: BLE001
+                                    pass
                             start = time.monotonic()
 
                             def timing(extra_base):
@@ -290,6 +336,13 @@ class JobManager:
                                         preempted, 6)
                                     extra["leaseYields"] = \
                                         lease_token.yields
+                                if needs_mesh:
+                                    extra["leaseWaitSeconds"] = round(
+                                        getattr(lease_token,
+                                                "wait_seconds", 0.0), 6)
+                                    if slice_devices is not None:
+                                        extra["sliceDevices"] = \
+                                            list(slice_devices)
                                 return extra
 
                             try:
@@ -427,6 +480,7 @@ class JobManager:
             self._job_info[name] = {"description": description,
                                     "parameters": parameters,
                                     "needs_mesh": needs_mesh,
+                                    "footprint": footprint,
                                     "token": token}
         return future
 

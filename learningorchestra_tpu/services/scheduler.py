@@ -1,4 +1,4 @@
-"""Fair mesh scheduling.
+"""Fair mesh scheduling with spatial slice multiplexing.
 
 The reference runs every Spark service under a FAIR scheduler pool
 (one ``<pool weight=1 minShare=2>`` per service, reference
@@ -8,24 +8,42 @@ cluster instead of queuing behind each other. The round-4 rebuild had
 a single FIFO ``BoundedSemaphore`` — one long train starved every
 tune/evaluate behind it.
 
-:class:`FairLease` is the TPU-native replacement:
+:class:`SliceLease` is the TPU-native replacement:
 
 - **Pools** — each job class (``train``, ``tune``, ``evaluate``,
-  ``predict``, …) is a pool. Capacity ``n`` leases are granted to the
-  pool with the LOWEST served-time/weight among pools with waiters
-  (weighted fair queuing), FIFO within a pool. A pool that has used
-  the mesh least goes first, so a burst of tunes cannot starve a
-  train and vice versa.
+  ``predict``, …) is a pool. Grants go to the pool with the LOWEST
+  served-time/weight among pools with waiters (weighted fair
+  queuing), FIFO within a pool. A pool that has used the mesh least
+  goes first, so a burst of tunes cannot starve a train and vice
+  versa.
+- **Device slices** (``LO_MESH_LEASES > 1``) — instead of N abstract
+  leases timesharing the whole mesh, the scheduler packs concurrent
+  jobs onto **disjoint contiguous device blocks** of the default
+  mesh. A job declares a footprint (device count and/or HBM bytes,
+  estimated by preflight); the allocator grants the first free
+  contiguous block that fits (first-fit over the device index line —
+  deterministic, so identical repeat jobs land on identical slices
+  and executable/arena cache keys keep hitting). Jobs without a
+  footprint **gang-acquire** the full mesh.
+- **Aging anti-starvation** — a gang (or large) waiter blocked at the
+  head of its pool permits smaller jobs to backfill free devices
+  behind it, but only until it has waited ``aging_seconds``
+  (``LO_SLICE_AGING``); after that, backfill freezes so releases
+  drain devices toward the starved job. ``0`` disables the freeze.
 - **Epoch-boundary preemption** — a granted lease installs a
   thread-local yield point (:mod:`runtime.preempt`); the engine's
   epoch loops call it between epochs. If ANOTHER pool is waiting, the
   holder releases, the waiter runs, and the holder re-queues through
-  the same fair policy (same-pool waiters stay FIFO — no per-epoch
-  ping-pong between two trains). Per-epoch orbax checkpoints plus
+  the same fair policy, re-acquiring its EXACT device block (its
+  arrays still live there). Per-epoch orbax checkpoints plus
   in-process state make the hand-off safe and nearly free.
 - **Weights** — ``LO_POOL_WEIGHTS="train=2,tune=1"`` biases the
   fair-share ratio (fairscheduler.xml ``weight`` parity); unlisted
   pools weigh 1.
+
+With the default ``LO_MESH_LEASES=1`` the device plane is never
+resolved (no jax import) and the lease degrades to exactly the
+single-holder weighted-fair queue that predates slicing.
 
 Caveats (when preemption does NOT apply):
 
@@ -45,7 +63,7 @@ from __future__ import annotations
 import contextlib
 import threading
 import time
-from typing import Dict, Iterator, Optional
+from typing import Any, Dict, Iterator, Optional, Sequence, Tuple
 
 from learningorchestra_tpu.runtime import preempt
 
@@ -66,70 +84,269 @@ def parse_pool_weights(spec: str) -> Dict[str, float]:
     return weights
 
 
-class FairLease:
-    """Weighted-fair device lease (capacity ``leases`` holders)."""
+# _fit_locked sentinel: "this waiter cannot be granted right now"
+# (``None`` is a real grant value — the full mesh)
+_NOFIT = object()
+
+
+class Grant:
+    """A claimed (or reserved) allocation: ``devices`` is a tuple of
+    indices into the default mesh's flat device order, or ``None``
+    for the whole mesh (counting mode and gang grants)."""
+
+    __slots__ = ("seq", "pool", "devices", "wait_seconds")
+
+    def __init__(self, seq: int, pool: str,
+                 devices: Optional[Tuple[int, ...]]):
+        self.seq = seq
+        self.pool = pool
+        self.devices = devices
+        self.wait_seconds = 0.0
+
+
+class _Waiter:
+    __slots__ = ("seq", "pool", "want", "exact", "enqueued")
+
+    def __init__(self, seq: int, pool: str, want: Optional[int],
+                 exact: Optional[Tuple[int, ...]], enqueued: float):
+        self.seq = seq
+        self.pool = pool
+        self.want = want          # device count; None = full mesh
+        self.exact = exact        # exact indices (post-yield re-acquire)
+        self.enqueued = enqueued
+
+
+class SliceLease:
+    """Weighted-fair device lease: capacity ``leases`` concurrent
+    holders, packed onto disjoint device slices when ``leases > 1``."""
 
     def __init__(self, leases: int = 1,
-                 weights: Optional[Dict[str, float]] = None):
+                 weights: Optional[Dict[str, float]] = None,
+                 total_devices: Optional[int] = None,
+                 min_devices: int = 1,
+                 aging_seconds: float = 30.0,
+                 device_bytes: Optional[int] = None):
         self._capacity = max(1, int(leases))
         self._weights = dict(weights or {})
         self._cv = threading.Condition()
-        self._holders = 0
         self._served: Dict[str, float] = {}   # pool -> total held seconds
-        self._waiters: list = []              # [(seq, pool)] arrival order
-        self._granted: set = set()            # seqs granted, not yet claimed
+        self._waiters: list = []              # [_Waiter] arrival order
+        self._granted: Dict[int, Grant] = {}  # reserved, not yet claimed
+        self._holders: Dict[int, Grant] = {}  # claimed
         self._seq = 0
+        # device plane: injectable for tests; resolved lazily from the
+        # default mesh otherwise (and never at all in counting mode)
+        self._total = int(total_devices) if total_devices else None
+        self._free: Optional[set] = None
+        self._min_devices = max(1, int(min_devices or 1))
+        self._aging = max(0.0, float(aging_seconds or 0.0))
+        self._device_bytes = (int(device_bytes)
+                              if device_bytes is not None else None)
+        # observability (served by Api /metrics)
+        self._grants_by_pool: Dict[str, int] = {}
+        self._wait_sum = 0.0
+        self._wait_count = 0
+        self._wait_max = 0.0
 
     # -- policy --------------------------------------------------------
+    @property
+    def _sliced(self) -> bool:
+        return self._capacity > 1
+
     def _weight(self, pool: str) -> float:
         w = float(self._weights.get(pool, 1.0))
         return w if w > 0 else 1.0
 
+    def _ensure_devices_locked(self) -> None:
+        if self._total is None:
+            from learningorchestra_tpu.runtime import mesh as mesh_lib
+
+            self._total = max(1, int(mesh_lib.get_default_mesh().size))
+        if self._free is None:
+            self._free = set(range(self._total))
+
+    def _per_device_bytes(self) -> Optional[int]:
+        """HBM bytes per device, for footprints declared in bytes;
+        None (e.g. CPU backends without memory_stats) degrades the
+        bytes path to a conservative full-mesh request."""
+        if self._device_bytes is None:
+            try:
+                import jax
+
+                stats = jax.local_devices()[0].memory_stats() or {}
+                self._device_bytes = int(stats.get("bytes_limit") or 0)
+            except Exception:  # noqa: BLE001 — backend has no stats
+                self._device_bytes = 0
+        return self._device_bytes or None
+
+    def _requested_devices(self, footprint: Optional[Dict[str, Any]],
+                           ) -> Optional[int]:
+        """Footprint -> device count (None = full mesh). Explicit
+        ``devices`` wins; ``hbmBytes`` is converted through per-device
+        HBM; an unconvertible footprint gang-acquires (conservative:
+        never grant a slice the job may not fit on)."""
+        if not isinstance(footprint, dict):
+            return None
+        want = footprint.get("devices")
+        if want is None:
+            hbm = footprint.get("hbmBytes")
+            per = self._per_device_bytes() if hbm else None
+            if not hbm or not per:
+                return None
+            want = -(-int(hbm) // per)  # ceil
+        want = int(want)
+        if want >= self._total:
+            return None
+        return max(self._min_devices, min(want, self._total))
+
+    def _fit_locked(self, waiter: _Waiter):
+        """Devices for ``waiter`` right now, or ``_NOFIT``. Counting
+        mode always fits (capacity is the caller's guard). Slices are
+        the FIRST free contiguous run of the device index line that
+        holds the request — deterministic first-fit, so a repeated
+        arrival pattern reproduces identical placements."""
+        if not self._sliced:
+            return None
+        if waiter.exact is not None:
+            if self._free.issuperset(waiter.exact):
+                return waiter.exact
+            return _NOFIT
+        if waiter.want is None:
+            # gang: the whole mesh, exclusively
+            if len(self._free) == self._total:
+                return None
+            return _NOFIT
+        run = start = 0
+        for i in range(self._total):
+            if i in self._free:
+                if run == 0:
+                    start = i
+                run += 1
+                if run >= waiter.want:
+                    return tuple(range(start, start + waiter.want))
+            else:
+                run = 0
+        return _NOFIT
+
     def _grant_next(self) -> None:
-        """With the lock held: hand out free capacity to the waiter of
-        the most-deserving pool (min served/weight; FIFO inside)."""
-        while self._holders + len(self._granted) < self._capacity \
-                and self._waiters:
-            heads: Dict[str, int] = {}
-            for seq, pool in self._waiters:
-                if pool not in heads:
-                    heads[pool] = seq
-            best = min(heads, key=lambda p: (
-                self._served.get(p, 0.0) / self._weight(p), heads[p]))
-            self._waiters.remove((heads[best], best))
-            self._granted.add(heads[best])
-            self._cv.notify_all()
+        """With the lock held: hand out free capacity/devices to the
+        waiter of the most-deserving pool (min served/weight; FIFO
+        inside a pool). A pool head that doesn't FIT is skipped so
+        smaller jobs backfill around it — unless it has aged past
+        ``aging_seconds``, which freezes all further grants until
+        releases drain enough devices for it (anti-starvation)."""
+        while self._waiters and \
+                len(self._holders) + len(self._granted) < self._capacity:
+            now = time.monotonic()
+            aged = [w for w in self._waiters
+                    if self._aging and now - w.enqueued >= self._aging]
+            if aged:
+                # starvation freeze: once ANY waiter has aged past the
+                # bound, only the oldest aged waiter is eligible —
+                # fair-share order would let fitting small jobs keep
+                # leapfrogging it, so backfill stops until releases
+                # drain enough devices for it
+                heads = [min(aged, key=lambda w: w.seq)]
+            else:
+                heads = []
+                seen: set = set()
+                for w in self._waiters:
+                    if w.pool not in seen:
+                        seen.add(w.pool)
+                        heads.append(w)
+                heads.sort(key=lambda w: (
+                    self._served.get(w.pool, 0.0) / self._weight(w.pool),
+                    w.seq))
+            progressed = False
+            for w in heads:
+                devices = self._fit_locked(w)
+                if devices is not _NOFIT:
+                    self._waiters.remove(w)
+                    if self._sliced:
+                        # a gang grant (devices None = whole mesh)
+                        # reserves EVERY device — nothing may backfill
+                        # under it
+                        self._free.difference_update(
+                            range(self._total) if devices is None
+                            else devices)
+                    self._granted[w.seq] = Grant(w.seq, w.pool, devices)
+                    self._cv.notify_all()
+                    progressed = True
+                    break
+            if not progressed:
+                return
+
+    def _return_devices(self, grant: Grant) -> None:
+        if self._free is None:
+            return
+        self._free.update(range(self._total) if grant.devices is None
+                          else grant.devices)
 
     # -- mechanics -----------------------------------------------------
     def acquire(self, pool: str = "default",
-                cancel: Optional["preempt.CancelToken"] = None) -> None:
-        """Block until granted. With a ``cancel`` token the wait is
+                cancel: Optional["preempt.CancelToken"] = None,
+                footprint: Optional[Dict[str, Any]] = None,
+                exact: Optional[Sequence[int]] = None) -> Grant:
+        """Block until granted; returns the :class:`Grant` (``devices``
+        None = full mesh). With a ``cancel`` token the wait is
         cooperative: a cancelled/expired job raises
         :class:`preempt.JobCancelled` from the QUEUE — it never takes
-        a lease it can no longer use, and a grant that races the
-        cancellation is handed back to the next waiter."""
+        a lease it can no longer use, and a grant (with its device
+        reservation) that races the cancellation is handed back to the
+        next waiter. ``exact`` re-acquires a specific device block
+        (post-yield: the job's arrays still live on it)."""
+        t0 = time.monotonic()
         with self._cv:
+            if self._sliced:
+                self._ensure_devices_locked()
             seq = self._seq
             self._seq += 1
-            self._waiters.append((seq, pool))
+            if not self._sliced:
+                want, exact_t = None, None
+            elif exact is not None:
+                want, exact_t = None, tuple(int(i) for i in exact)
+            else:
+                want, exact_t = self._requested_devices(footprint), None
+            waiter = _Waiter(seq, pool, want, exact_t, t0)
+            self._waiters.append(waiter)
             self._grant_next()
             while seq not in self._granted:
                 self._cv.wait(0.1 if cancel is not None else None)
                 if cancel is not None and cancel.cancelled():
-                    if seq in self._granted:
-                        self._granted.discard(seq)
-                        self._grant_next()
-                    elif (seq, pool) in self._waiters:
-                        self._waiters.remove((seq, pool))
+                    grant = self._granted.pop(seq, None)
+                    if grant is not None:
+                        self._return_devices(grant)
+                    elif waiter in self._waiters:
+                        # releasing a blocked (possibly aged) waiter
+                        # can unfreeze backfill for everyone behind it
+                        self._waiters.remove(waiter)
+                    self._grant_next()
                     raise preempt.JobCancelled(
                         cancel.reason or "cancelled",
                         "cancelled while waiting for the mesh lease")
-            self._granted.discard(seq)
-            self._holders += 1
+            grant = self._granted.pop(seq)
+            self._holders[seq] = grant
+            grant.wait_seconds = time.monotonic() - t0
+            self._wait_sum += grant.wait_seconds
+            self._wait_count += 1
+            self._wait_max = max(self._wait_max, grant.wait_seconds)
+            self._grants_by_pool[pool] = \
+                self._grants_by_pool.get(pool, 0) + 1
+            return grant
 
-    def release(self, pool: str, held_seconds: float) -> None:
+    def release(self, pool: str, held_seconds: float,
+                grant: Optional[Grant] = None) -> None:
         with self._cv:
-            self._holders -= 1
+            if grant is not None:
+                self._holders.pop(grant.seq, None)
+                self._return_devices(grant)
+            elif self._holders:
+                # legacy (pool, seconds) surface: drop this pool's
+                # oldest holder (counting mode has no devices anyway)
+                seq = next((s for s in sorted(self._holders)
+                            if self._holders[s].pool == pool),
+                           min(self._holders))
+                self._return_devices(self._holders.pop(seq))
             self._served[pool] = self._served.get(pool, 0.0) \
                 + max(0.0, held_seconds)
             self._grant_next()
@@ -141,31 +358,61 @@ class FairLease:
     def contended_by_other(self, pool: str) -> bool:
         """A waiter from a DIFFERENT pool exists — the only condition
         under which a holder should yield (same-pool waiters are
-        served FIFO when the holder finishes)."""
+        served FIFO when the holder finishes). Waiters still queued
+        are exactly the currently-ungrantable ones: ``_grant_next``
+        runs at every state change."""
         with self._cv:
-            return any(p != pool for _, p in self._waiters)
+            return any(w.pool != pool for w in self._waiters)
 
     def served(self) -> Dict[str, float]:
         """Per-pool cumulative mesh seconds (observability)."""
         with self._cv:
             return dict(self._served)
 
+    def stats(self) -> Dict[str, Any]:
+        """Scheduler observability: device occupancy, grant counts and
+        lease-wait aggregates. In counting mode (``leases == 1``) the
+        device plane is never resolved, so ``devicesBusy`` counts busy
+        LEASES there (0 or 1) and ``devicesTotal`` is None."""
+        with self._cv:
+            busy = len(self._holders) + len(self._granted)
+            if self._sliced and self._free is not None:
+                busy = self._total - len(self._free)
+            return {
+                "sliced": self._sliced,
+                "capacity": self._capacity,
+                "devicesTotal": self._total,
+                "devicesBusy": busy,
+                "waiters": len(self._waiters),
+                "grantsByPool": dict(self._grants_by_pool),
+                "leaseWaitSum": self._wait_sum,
+                "leaseWaitCount": self._wait_count,
+                "leaseWaitMax": self._wait_max,
+            }
+
     # -- job-facing surface --------------------------------------------
     @contextlib.contextmanager
     def lease(self, pool: str = "default",
               cancel: Optional["preempt.CancelToken"] = None,
+              footprint: Optional[Dict[str, Any]] = None,
               ) -> Iterator["LeaseToken"]:
-        """Hold the mesh fairly; installs the epoch-boundary yield
-        point for the duration (so engine fits running on this thread
-        hand the device to waiting pools between epochs). Yields a
-        :class:`LeaseToken` whose ``preempted_seconds`` lets callers
-        subtract hand-off idle time from a job's own runtime. With a
-        ``cancel`` token, both the initial acquire and every
-        post-yield re-acquire abort with :class:`preempt.JobCancelled`
-        the moment the job is cancelled or past its deadline — a
-        preempted-then-cancelled job never reclaims the device."""
-        self.acquire(pool, cancel)
+        """Hold the mesh (or a footprint-sized slice of it) fairly;
+        installs the epoch-boundary yield point for the duration (so
+        engine fits running on this thread hand the device to waiting
+        pools between epochs). Yields a :class:`LeaseToken` whose
+        ``devices`` is the granted slice (None = full mesh), whose
+        ``wait_seconds`` is the queue wait, and whose
+        ``preempted_seconds`` lets callers subtract hand-off idle time
+        from a job's own runtime. With a ``cancel`` token, both the
+        initial acquire and every post-yield re-acquire abort with
+        :class:`preempt.JobCancelled` the moment the job is cancelled
+        or past its deadline — a preempted-then-cancelled job never
+        reclaims the device."""
+        grant = self.acquire(pool, cancel, footprint=footprint)
         token = LeaseToken()
+        token.devices = grant.devices
+        token.wait_seconds = grant.wait_seconds
+        current = [grant]
         start = [time.monotonic()]
         held = [True]
         can_yield = _yield_enabled()
@@ -173,10 +420,14 @@ class FairLease:
         def yield_point() -> None:
             if not can_yield or not self.contended_by_other(pool):
                 return
-            self.release(pool, time.monotonic() - start[0])
+            self.release(pool, time.monotonic() - start[0],
+                         grant=current[0])
             held[0] = False
             t_wait = time.monotonic()
-            self.acquire(pool, cancel)
+            # re-acquire the SAME device block: the preempted job's
+            # sharded arrays live on those devices
+            current[0] = self.acquire(pool, cancel,
+                                      exact=current[0].devices)
             held[0] = True
             start[0] = time.monotonic()
             token.preempted_seconds += start[0] - t_wait
@@ -192,16 +443,26 @@ class FairLease:
         finally:
             preempt.restore(previous)
             if held[0]:
-                self.release(pool, time.monotonic() - start[0])
+                self.release(pool, time.monotonic() - start[0],
+                             grant=current[0])
+
+
+# Backwards-compatible alias: the counting behavior of the historical
+# FairLease is exactly SliceLease at leases=1.
+FairLease = SliceLease
 
 
 class LeaseToken:
-    """Per-hold accounting: how long the holder sat preempted (lease
-    handed to another pool) and how many hand-offs happened."""
+    """Per-hold accounting: the granted device slice (None = full
+    mesh), how long the grant took (queue wait), how long the holder
+    sat preempted (lease handed to another pool) and how many
+    hand-offs happened."""
 
     def __init__(self) -> None:
         self.preempted_seconds = 0.0
         self.yields = 0
+        self.devices: Optional[Tuple[int, ...]] = None
+        self.wait_seconds = 0.0
 
 
 def _yield_enabled() -> bool:

@@ -49,6 +49,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Dict, Optional, Tuple
 from urllib.parse import parse_qs, urlparse
 
+from learningorchestra_tpu import analysis as A
 from learningorchestra_tpu.catalog import documents as D
 from learningorchestra_tpu.services import validators as V
 from learningorchestra_tpu.services.builder_service import BuilderService
@@ -199,7 +200,8 @@ class Api:
             meta.get(D.METHOD_PARAMETERS_FIELD) or {},
             meta.get(D.DESCRIPTION_FIELD, ""),
             only_if_idle=only_if_idle,
-            timeout=meta.get(V.TIMEOUT_FIELD))
+            timeout=meta.get(V.TIMEOUT_FIELD),
+            footprint=meta.get(A.FOOTPRINT_FIELD))
 
     def recover_worker_lost(self) -> list:
         """Elastic pod recovery (beyond the reference, whose node loss
@@ -308,6 +310,7 @@ class Api:
             pool: round(seconds, 3) for pool, seconds in
             sorted(self.ctx.jobs.mesh_served().items())}
         out["jobLifecycle"] = self.ctx.jobs.lifecycle_counters()
+        out["meshScheduler"] = self.ctx.jobs.scheduler_stats()
         # feature-plane cache tiers (docs/PERFORMANCE.md). Lazy
         # imports: arena/engine stats never initialize a backend.
         out["featureCache"] = self.ctx.features.stats()
@@ -397,6 +400,24 @@ class Api:
             "# TYPE lo_jobs_stalled gauge",
             f"lo_jobs_stalled {lifecycle.get('stalled', 0)}",
         ]
+        scheduler = m["meshScheduler"]
+        lines += [
+            "# TYPE lo_lease_wait_seconds summary",
+            f"lo_lease_wait_seconds_sum "
+            f"{scheduler.get('leaseWaitSum', 0.0)}",
+            f"lo_lease_wait_seconds_count "
+            f"{scheduler.get('leaseWaitCount', 0)}",
+            "# TYPE lo_lease_wait_seconds_max gauge",
+            f"lo_lease_wait_seconds_max "
+            f"{scheduler.get('leaseWaitMax', 0.0)}",
+            "# TYPE lo_mesh_devices_busy gauge",
+            f"lo_mesh_devices_busy {scheduler.get('devicesBusy', 0)}",
+            "# TYPE lo_slice_grants_total counter",
+        ]
+        for pool, n in sorted(
+                (scheduler.get("grantsByPool") or {}).items()):
+            lines.append(
+                f'lo_slice_grants_total{{pool="{esc(pool)}"}} {n}')
         return ("\n".join(lines) + "\n").encode()
 
     # ------------------------------------------------------------------

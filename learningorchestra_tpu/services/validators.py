@@ -69,6 +69,24 @@ def valid_timeout(value: Any) -> Optional[float]:
     return float(value)
 
 
+SLICE_DEVICES_FIELD = "sliceDevices"
+
+
+def valid_slice_devices(value: Any) -> Optional[int]:
+    """Optional explicit device-footprint request field: a positive
+    integer count of mesh devices this job needs (the slice scheduler
+    packs it onto a sub-mesh that size), or None (footprint comes from
+    the preflight estimate, else the job gang-acquires)."""
+    if value is None:
+        return None
+    if isinstance(value, bool) or not isinstance(value, int) or value <= 0:
+        raise HttpError(
+            HTTP_NOT_ACCEPTABLE,
+            f"{MESSAGE_INVALID_FIELD}: sliceDevices must be a positive "
+            f"integer device count, got {value!r}")
+    return int(value)
+
+
 def run_preflight(findings) -> list:
     """Gate a request on analyzer findings: raise a 406 carrying the
     full structured finding list if any error-severity finding fired,

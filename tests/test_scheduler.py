@@ -14,6 +14,7 @@ import pytest
 from learningorchestra_tpu.runtime import preempt
 from learningorchestra_tpu.services.scheduler import (
     FairLease,
+    SliceLease,
     parse_pool_weights,
 )
 
@@ -404,6 +405,201 @@ def test_sweep_progresses_under_sustained_contention(tmp_config):
     t1.join(10)
     t2.join(10)
     assert len(trains_run) >= 2  # contention was real, not idle
+
+
+# ----------------------------------------------------------------------
+# slice packing (LO_MESH_LEASES > 1): the allocator runs on an injected
+# 8-slot device line, no jax required
+# ----------------------------------------------------------------------
+
+def _slice_lease(**kw):
+    kw.setdefault("leases", 4)
+    kw.setdefault("total_devices", 8)
+    kw.setdefault("aging_seconds", 0.0)
+    return SliceLease(**kw)
+
+
+def test_concurrent_footprints_get_disjoint_slices():
+    """Two footprint-sized jobs held at once occupy non-overlapping
+    contiguous device blocks of the requested sizes."""
+    lease = _slice_lease()
+    g1 = lease.acquire("train", footprint={"devices": 4})
+    g2 = lease.acquire("train", footprint={"devices": 4})
+    assert len(g1.devices) == 4 and len(g2.devices) == 4
+    assert not set(g1.devices) & set(g2.devices)
+    assert lease.stats()["devicesBusy"] == 8
+    lease.release("train", 1.0, grant=g1)
+    lease.release("train", 1.0, grant=g2)
+    assert lease.stats()["devicesBusy"] == 0
+
+
+def test_packing_many_sizes_stays_disjoint():
+    """Property-style sweep: a stream of mixed-size requests, drained
+    by releases whenever one blocks, keeps live slices pairwise
+    disjoint and inside the device line."""
+    lease = _slice_lease()
+    held = []
+    results = {}
+
+    def take(i, size):
+        results[i] = lease.acquire("train", footprint={"devices": size})
+
+    sizes = [2, 3, 1, 2, 4, 1, 3, 2, 2, 1]
+    for i, size in enumerate(sizes):
+        t = threading.Thread(target=take, args=(i, size))
+        t.start()
+        t.join(0.3)
+        while t.is_alive():
+            # occupancy or fragmentation blocks the waiter: a release
+            # must eventually unblock it (no leaked reservations)
+            assert held, "acquire blocked with nothing held"
+            lease.release("train", 0.1, grant=held.pop(0))
+            t.join(2.0)
+        got = results[i]
+        assert len(got.devices) == size
+        assert all(0 <= d < 8 for d in got.devices)
+        for other in held:
+            assert not set(got.devices) & set(other.devices)
+        held.append(got)
+    for g in held:
+        lease.release("train", 0.1, grant=g)
+    assert lease.stats()["devicesBusy"] == 0
+
+
+def test_gang_job_is_exclusive():
+    """A job without a footprint gang-acquires: it waits for an empty
+    mesh, and while it holds, nothing else gets in."""
+    lease = _slice_lease()
+    small = lease.acquire("train", footprint={"devices": 2})
+    gang_grant = []
+    t = threading.Thread(
+        target=lambda: gang_grant.append(lease.acquire("train")))
+    t.start()
+    time.sleep(0.15)
+    assert not gang_grant          # blocked behind the small holder
+    lease.release("train", 1.0, grant=small)
+    t.join(5)
+    assert gang_grant[0].devices is None      # whole mesh
+    assert lease.stats()["devicesBusy"] == 8  # all reserved
+    # a small job cannot backfill under a gang hold
+    late = []
+    t2 = threading.Thread(target=lambda: late.append(
+        lease.acquire("tune", footprint={"devices": 1})))
+    t2.start()
+    time.sleep(0.15)
+    assert not late
+    lease.release("train", 1.0, grant=gang_grant[0])
+    t2.join(5)
+    assert len(late[0].devices) == 1
+    lease.release("tune", 1.0, grant=late[0])
+
+
+def test_aging_freezes_backfill_for_starved_gang():
+    """A gang waiter aged past ``aging_seconds`` stops further small
+    grants, so releases drain the mesh toward it (anti-starvation)."""
+    lease = _slice_lease(aging_seconds=0.2)
+    small = lease.acquire("train", footprint={"devices": 2})
+    gang = []
+    t = threading.Thread(
+        target=lambda: gang.append(lease.acquire("train")))
+    t.start()
+    time.sleep(0.35)  # the gang waiter is now aged
+    # backfill frozen: a 1-device request must NOT be granted even
+    # though 6 devices are free
+    blocked = []
+    t2 = threading.Thread(target=lambda: blocked.append(
+        lease.acquire("tune", footprint={"devices": 1})))
+    t2.start()
+    time.sleep(0.15)
+    assert not blocked and not gang
+    lease.release("train", 1.0, grant=small)
+    t.join(5)
+    assert gang and gang[0].devices is None   # starved job got the mesh
+    lease.release("train", 1.0, grant=gang[0])
+    t2.join(5)
+    assert blocked
+    lease.release("tune", 1.0, grant=blocked[0])
+
+
+def test_cancel_while_queued_releases_reservation():
+    """Cancelling a queued waiter raises JobCancelled and leaves the
+    device line fully reusable — no leaked reservation."""
+    lease = _slice_lease()
+    holder = lease.acquire("train", footprint={"devices": 8})
+    token = preempt.CancelToken()
+    errs = []
+
+    def waiter():
+        try:
+            lease.acquire("train", cancel=token,
+                          footprint={"devices": 4})
+        except preempt.JobCancelled as e:
+            errs.append(e)
+
+    t = threading.Thread(target=waiter)
+    t.start()
+    time.sleep(0.15)
+    token.cancel("test")
+    t.join(5)
+    assert errs
+    lease.release("train", 1.0, grant=holder)
+    # the full line must be available again
+    g = lease.acquire("train")       # gang needs ALL 8 devices free
+    assert g.devices is None
+    lease.release("train", 1.0, grant=g)
+
+
+def test_repeat_jobs_land_identical_slices():
+    """First-fit placement is deterministic: replaying the same
+    arrival pattern reproduces the same device blocks (this is what
+    keeps mesh-keyed executable/arena caches warm across reruns)."""
+    def play():
+        lease = _slice_lease()
+        g1 = lease.acquire("train", footprint={"devices": 4})
+        g2 = lease.acquire("tune", footprint={"devices": 2})
+        out = (g1.devices, g2.devices)
+        lease.release("train", 1.0, grant=g1)
+        lease.release("tune", 1.0, grant=g2)
+        return out
+
+    assert play() == play()
+
+
+def test_hbm_footprint_converts_to_devices():
+    """hbmBytes footprints size the slice via per-device HBM (ceil);
+    oversized or unconvertible footprints gang-acquire."""
+    lease = _slice_lease(device_bytes=100)
+    g = lease.acquire("train", footprint={"hbmBytes": 250})
+    assert len(g.devices) == 3  # ceil(250 / 100)
+    lease.release("train", 1.0, grant=g)
+    g = lease.acquire("train", footprint={"hbmBytes": 10_000})
+    assert g.devices is None    # bigger than the mesh: gang
+    lease.release("train", 1.0, grant=g)
+    # no per-device stats (device_bytes=0): conservative gang
+    lease2 = _slice_lease(device_bytes=0)
+    g = lease2.acquire("train", footprint={"hbmBytes": 1})
+    assert g.devices is None
+    lease2.release("train", 1.0, grant=g)
+
+
+def test_min_devices_floor_applies():
+    lease = _slice_lease(min_devices=2)
+    g = lease.acquire("train", footprint={"devices": 1})
+    assert len(g.devices) == 2
+    lease.release("train", 1.0, grant=g)
+
+
+def test_counting_mode_never_resolves_devices():
+    """leases=1 (the default config) must stay the pure counting
+    lease: no device plane, grants carry devices=None."""
+    lease = SliceLease(1)
+    g = lease.acquire("train", footprint={"devices": 4})
+    assert g.devices is None
+    s = lease.stats()
+    assert s["sliced"] is False and s["devicesTotal"] is None
+    assert s["devicesBusy"] == 1
+    lease.release("train", 1.0, grant=g)
+    assert lease.stats()["devicesBusy"] == 0
 
 
 def test_engine_fit_offers_yield_each_epoch(tmp_config):
