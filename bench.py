@@ -95,7 +95,8 @@ TLM_ATTENTION = os.environ.get("LO_BENCH_TLM_ATTENTION", "auto")
 PHASE_TIMEOUTS = {"cnn": 600, "lstm": 600, "tlm": 900, "proxy": 120,
                   "builder": 600, "builder_mesh": 600,
                   "warm_pipeline": 600, "concurrent_jobs": 600,
-                  "flash": 600, "ingest": 600, "gen": 900}
+                  "flash": 600, "ingest": 600, "gen": 900,
+                  "sentinel_overhead": 600, "sentinel_chaos": 600}
 
 # out-of-core Builder (reference config 4: 10M-row GBT via Spark)
 BUILDER_ROWS = int(os.environ.get("LO_BENCH_BUILDER_ROWS", "10000000"))
@@ -832,13 +833,126 @@ def phase_concurrent_jobs():
             "platform": jax.devices()[0].platform}
 
 
+def phase_sentinel_overhead():
+    """Cost of the armed health sentinel (docs/RELIABILITY.md): the
+    same MLP fit with the sentinel off vs ``skip`` (the most
+    instrumented variant — health word + on-device drop guard). One
+    model per arm keeps both executables warm; repeats interleave so
+    host drift taxes both arms equally; min-of-repeats is the
+    steady-state number CI gates at < 3% overhead."""
+    import jax
+    import numpy as np
+
+    from learningorchestra_tpu import config as config_mod
+    from learningorchestra_tpu.models.neural import NeuralModel
+
+    home = tempfile.mkdtemp(prefix="lo_bench_health_")
+    config_mod.set_config(config_mod.Config(home=home))
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(8192, 64)).astype(np.float32)
+    y = (x[:, 0] > 0).astype(np.int64)
+
+    def build():
+        return NeuralModel([
+            {"kind": "dense", "units": 128, "activation": "relu"},
+            {"kind": "dense", "units": 128, "activation": "relu"},
+            {"kind": "dense", "units": 2, "activation": "softmax"}])
+
+    arms = {"off": (build(), None), "skip": (build(), "skip")}
+    for model, policy in arms.values():  # compile warm-up, untimed
+        model.fit(x, y, epochs=1, batch_size=256, shuffle=False,
+                  health_policy=policy)
+    times = {name: [] for name in arms}
+    for _ in range(5):
+        for name, (model, policy) in arms.items():
+            t0 = time.perf_counter()
+            model.fit(x, y, epochs=3, batch_size=256, shuffle=False,
+                      health_policy=policy)
+            times[name].append(time.perf_counter() - t0)
+    best = {name: min(ts) for name, ts in times.items()}
+    return {"off_seconds": round(best["off"], 4),
+            "skip_seconds": round(best["skip"], 4),
+            "overhead_ratio": round(best["skip"] / best["off"], 4),
+            "platform": jax.devices()[0].platform}
+
+
+def phase_sentinel_chaos():
+    """NaN + bit-rot chaos through the full REST stack: an armed
+    ``engine_step`` NaN plus a corrupted checkpoint write, under
+    healthPolicy rollback. The job must FINISH (rollback-to-last-good,
+    quarantine-and-fallback restore), not dead-letter — CI gates on
+    exactly that."""
+    import jax
+
+    from learningorchestra_tpu import config as config_mod
+    from learningorchestra_tpu.runtime import health as health_lib
+    from learningorchestra_tpu.services import faults
+    from learningorchestra_tpu.services.server import Api
+
+    home = tempfile.mkdtemp(prefix="lo_bench_chaos_")
+    config_mod.set_config(config_mod.Config(
+        home=home,
+        fault_inject="engine_step:1:nan,ckpt_write:1:corrupt:64"))
+    faults.reset()
+    health_lib.reset_health_stats()
+    api = Api()
+    prefix = "/api/learningOrchestra/v1"
+    try:
+        status, body, _ = api.dispatch(
+            "POST", f"{prefix}/function/python", {}, {
+                "name": "chaos_data", "functionParameters": {},
+                "function": ("import numpy as np\n"
+                             "rng = np.random.default_rng(0)\n"
+                             "x = rng.normal(size=(2048, 32))"
+                             ".astype(np.float32)\n"
+                             "y = (x[:, 0] > 0).astype(np.int32)\n"
+                             "response = {'x': x, 'y': y}\n")})
+        _expect_created(status, body)
+        _wait(api, body["result"])
+        status, body, _ = api.dispatch(
+            "POST", f"{prefix}/model/tensorflow", {}, {
+                "modelName": "chaos_model",
+                "modulePath": "learningorchestra_tpu.models",
+                "class": "NeuralModel",
+                "classParameters": {"layer_configs": [
+                    {"kind": "dense", "units": 32,
+                     "activation": "relu"},
+                    {"kind": "dense", "units": 2,
+                     "activation": "softmax"}]}})
+        _expect_created(status, body)
+        _wait(api, body["result"])
+        status, body, _ = api.dispatch(
+            "POST", f"{prefix}/train/tensorflow", {}, {
+                "name": "chaos_train", "modelName": "chaos_model",
+                "method": "fit",
+                "healthPolicy": {"action": "rollback",
+                                 "maxRollbacks": 2},
+                "methodParameters": {
+                    "x": "$chaos_data.x", "y": "$chaos_data.y",
+                    "epochs": 4, "batch_size": 128,
+                    "shuffle": False, "checkpoint": True}})
+        _expect_created(status, body)
+        meta = _wait(api, body["result"])
+        stats = health_lib.health_stats()
+        return {"status": meta.get("status"),
+                "finished": bool(meta.get("finished")),
+                "rollbacks": int(meta.get("rollbacks", 0)),
+                "nonfinite_steps": int(meta.get("nonfiniteSteps", 0)),
+                "quarantined": stats["quarantined"],
+                "platform": jax.devices()[0].platform}
+    finally:
+        api.ctx.jobs.shutdown()
+
+
 PHASES = {"cnn": phase_cnn, "lstm": phase_lstm, "tlm": phase_tlm,
           "proxy": phase_proxy, "builder": phase_builder,
           "builder_mesh": phase_builder_mesh,
           "warm_pipeline": phase_warm_pipeline,
           "concurrent_jobs": phase_concurrent_jobs,
           "flash": phase_flash, "ingest": phase_ingest,
-          "gen": phase_gen}
+          "gen": phase_gen,
+          "sentinel_overhead": phase_sentinel_overhead,
+          "sentinel_chaos": phase_sentinel_chaos}
 
 _RESULT_MARK = "@@LO_BENCH_RESULT@@"
 

@@ -105,4 +105,66 @@ print(f"slice-smoke: OK (serialized {serialized}s, "
       f"concurrent {concurrent}s, ratio {ratio})")
 EOF
 
+echo "== sentinel-smoke: chaos train must finish via rollback =="
+# NaN'd train step + bit-rotted checkpoint write through the full REST
+# stack under healthPolicy rollback (bench.py sentinel_chaos): the job
+# must reach finished — not deadLettered — with at least one recorded
+# rollback (docs/RELIABILITY.md).
+SENTINEL_TIMEOUT="${LO_CI_SENTINEL_TIMEOUT:-600}"
+CHAOS_OUT="$(mktemp)"
+OVERHEAD_OUT="$(mktemp)"
+trap 'rm -rf "$PERF_CACHE" "$PERF_OUT" "$SLICE_OUT" "$CHAOS_OUT" "$OVERHEAD_OUT"' EXIT
+timeout -k 10 "$SENTINEL_TIMEOUT" env JAX_PLATFORMS=cpu \
+    JAX_COMPILATION_CACHE_DIR="$PERF_CACHE" \
+    LO_COMPUTE_DTYPE=float32 \
+    python bench.py --phase sentinel_chaos | tee "$CHAOS_OUT"
+python - "$CHAOS_OUT" <<'EOF'
+import json, sys
+
+mark = "@@LO_BENCH_RESULT@@"
+result = None
+for line in reversed(open(sys.argv[1]).read().splitlines()):
+    if line.startswith(mark):
+        result = json.loads(line[len(mark):])
+        break
+assert result is not None, "sentinel-smoke: no bench result line"
+assert "error" not in result, f"sentinel-smoke: phase failed: {result}"
+result = result.get("result", result)  # unwrap the ok-envelope
+assert result["finished"], f"sentinel-smoke: job did not finish: {result}"
+assert result["status"] == "finished", f"sentinel-smoke: {result}"
+assert result["rollbacks"] >= 1, (
+    f"sentinel-smoke: no rollback recorded: {result}")
+print(f"sentinel-smoke: OK (status {result['status']}, "
+      f"{result['rollbacks']} rollback(s), "
+      f"{result['nonfinite_steps']} nonfinite step(s))")
+EOF
+
+echo "== sentinel-overhead: armed sentinel must cost < 3% =="
+# The same MLP fit with the sentinel off vs skip (bench.py
+# sentinel_overhead); the armed health word + drop guard must stay
+# under a 3% steady-state slowdown.
+timeout -k 10 "$SENTINEL_TIMEOUT" env JAX_PLATFORMS=cpu \
+    JAX_COMPILATION_CACHE_DIR="$PERF_CACHE" \
+    LO_COMPUTE_DTYPE=float32 \
+    python bench.py --phase sentinel_overhead | tee "$OVERHEAD_OUT"
+python - "$OVERHEAD_OUT" <<'EOF'
+import json, sys
+
+mark = "@@LO_BENCH_RESULT@@"
+result = None
+for line in reversed(open(sys.argv[1]).read().splitlines()):
+    if line.startswith(mark):
+        result = json.loads(line[len(mark):])
+        break
+assert result is not None, "sentinel-overhead: no bench result line"
+assert "error" not in result, f"sentinel-overhead: phase failed: {result}"
+result = result.get("result", result)  # unwrap the ok-envelope
+ratio = result["overhead_ratio"]
+assert ratio < 1.03, (
+    f"sentinel-overhead: armed sentinel costs {ratio}x "
+    f"(gate < 1.03x): {result}")
+print(f"sentinel-overhead: OK (off {result['off_seconds']}s, "
+      f"skip {result['skip_seconds']}s, ratio {ratio})")
+EOF
+
 echo "== ci: OK =="

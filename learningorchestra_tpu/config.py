@@ -164,6 +164,35 @@ class Config:
     retry_backoff_max_seconds: float = dataclasses.field(
         default_factory=lambda: float(os.environ.get(
             "LO_RETRY_BACKOFF_MAX", "30")))
+    # Training health sentinel defaults (docs/RELIABILITY.md). A
+    # request's "healthPolicy" field overrides per job. Action "" /
+    # "off" disables the sentinel; "skip" drops non-finite steps
+    # on-device; "rollback" restores the last-good checkpoint;
+    # "fail" raises NumericalDivergence (the jobs layer's
+    # "numerical" error class).
+    health_action: str = dataclasses.field(
+        default_factory=lambda: os.environ.get("LO_HEALTH_ACTION", ""))
+    # epoch mean loss > factor * EMA(loss) counts as a spike
+    health_spike_factor: float = dataclasses.field(
+        default_factory=lambda: float(os.environ.get(
+            "LO_HEALTH_SPIKE_FACTOR", "4.0")))
+    health_ema_alpha: float = dataclasses.field(
+        default_factory=lambda: float(os.environ.get(
+            "LO_HEALTH_EMA_ALPHA", "0.3")))
+    # in-fit rollback budget before the fit fails numerically
+    health_max_rollbacks: int = dataclasses.field(
+        default_factory=lambda: int(os.environ.get(
+            "LO_HEALTH_MAX_ROLLBACKS", "2")))
+    # epochs after a rollback during which spike checks are suppressed
+    health_cooldown_epochs: int = dataclasses.field(
+        default_factory=lambda: int(os.environ.get(
+            "LO_HEALTH_COOLDOWN", "1")))
+    # job-level rollback-retries for the "numerical" error class (a
+    # re-run of a checkpointed fit IS a rollback to its latest step)
+    # before the job dead-letters
+    health_retries: int = dataclasses.field(
+        default_factory=lambda: int(os.environ.get(
+            "LO_HEALTH_RETRIES", "1")))
     # byte budget for the $name DataFrame resolution cache (0 disables)
     param_cache_bytes: int = dataclasses.field(
         default_factory=lambda: int(os.environ.get(

@@ -239,6 +239,7 @@ class NeuralModel:
             shuffle: bool = True, checkpointer=None,
             log_fn=None, grad_accum: Optional[int] = None,
             sample_weight=None, class_weight=None,
+            health_policy=None,
             **_: Any) -> "History":
         self._set_grad_accum(grad_accum)
         if class_weight is not None and y is None:
@@ -286,7 +287,8 @@ class NeuralModel:
         state = eng.init_state(self.params, self.model_state)
         state, history = eng.fit(state, batcher, epochs=epochs,
                                  seed=self.seed, checkpointer=checkpointer,
-                                 log_fn=log_fn)
+                                 log_fn=log_fn,
+                                 health_policy=health_policy)
         # history can be empty on a no-op resume (checkpoint budget
         # already consumed) — still evaluate, record as its own entry
         if validation_data is not None:

@@ -16,13 +16,27 @@ and once the cache is warm no amount of disabling-at-restore helps —
 the poisoned executable has already run during fit. Keeping
 tensorstore out of CPU processes entirely removes the conflict while
 the compilation cache stays on.
+
+Integrity (docs/RELIABILITY.md): each msgpack step dir carries a
+``manifest.json`` (per-file byte size + sha256, step, wall time) and
+is committed ATOMICALLY — payload and manifest are written and
+fsynced into ``<step>.tmp/`` which one ``os.replace`` renames into
+place, so a kill mid-save can never leave a half-written step that
+``latest_step()`` would pick (leftover ``*.tmp`` dirs are swept on
+init). ``restore()`` re-hashes the payload against the manifest;
+a torn or bit-flipped step dir is moved to ``<dir>/.quarantine/``
+and restore transparently falls back to the newest VERIFIED step.
+Orbax (TPU) keeps its own atomic-commit + metadata machinery.
 """
 
 from __future__ import annotations
 
+import hashlib
 import json
 import os
 import shutil
+import time
+import warnings
 from typing import Any, List, Optional
 
 import jax
@@ -30,11 +44,66 @@ import jax.numpy as jnp
 import numpy as np
 from flax import serialization
 
+from learningorchestra_tpu.runtime import health as health_lib
+
 _MSGPACK_NAME = "checkpoint.msgpack"
+_MANIFEST_NAME = "manifest.json"
+_QUARANTINE_DIR = ".quarantine"
+
+
+class CheckpointCorrupted(IOError):
+    """A step dir failed manifest verification (missing payload, size
+    mismatch, sha256 mismatch, unreadable manifest). IOError subclass:
+    if one ever escapes the fallback (explicit-step restore), the jobs
+    layer classifies it transient."""
 
 
 def _use_orbax() -> bool:
     return jax.default_backend() == "tpu"
+
+
+def _fsync_file(f) -> None:
+    f.flush()
+    os.fsync(f.fileno())
+
+
+def _fsync_dir(path: str) -> None:
+    # the rename itself must reach disk or a crash can forget a
+    # committed step (POSIX: fsync the parent directory)
+    try:
+        fd = os.open(path, os.O_RDONLY)
+    except OSError:  # platforms without directory fds
+        return
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
+
+
+def _chaos_corrupt(path: str) -> None:
+    """``ckpt_write:*:corrupt:<nbytes>`` chaos site: flip bytes of the
+    just-written payload AFTER its checksum was taken — simulated bit
+    rot that restore-side verification must catch. Lazy import: the
+    runtime layer only touches services.faults when armed chaos specs
+    are plausible, and never lets injection plumbing sink a save."""
+    try:
+        from learningorchestra_tpu.services import faults
+
+        nbytes = faults.corrupt_nbytes("ckpt_write")
+    except Exception:  # noqa: BLE001
+        return
+    if not nbytes:
+        return
+    size = os.path.getsize(path)
+    nbytes = min(nbytes, size)
+    with open(path, "r+b") as f:
+        f.seek(size - nbytes)
+        chunk = f.read(nbytes)
+        f.seek(size - nbytes)
+        f.write(bytes(b ^ 0xFF for b in chunk))
+        _fsync_file(f)
 
 
 def _place_like(restored: Any, target: Any) -> Any:
@@ -78,6 +147,12 @@ class Checkpointer:
             )
         else:
             self._mgr = _NullAsyncManager()
+            # a kill mid-save leaves a <step>.tmp dir that was never
+            # committed — it holds no verified state, sweep it
+            for name in os.listdir(self._dir):
+                if name.endswith(".tmp"):
+                    shutil.rmtree(os.path.join(self._dir, name),
+                                  ignore_errors=True)
 
     # -- msgpack layout helpers ----------------------------------------
     def _step_dirs(self) -> List[int]:
@@ -93,6 +168,89 @@ class Checkpointer:
     def _step_path(self, step: int) -> str:
         return os.path.join(self._dir, str(step), _MSGPACK_NAME)
 
+    def _manifest_path(self, step: int) -> str:
+        return os.path.join(self._dir, str(step), _MANIFEST_NAME)
+
+    def _load_manifest(self, step: int) -> Optional[dict]:
+        """The step's manifest dict, None for a legacy (pre-manifest)
+        dir, CheckpointCorrupted for an unreadable/malformed one."""
+        path = self._manifest_path(step)
+        if not os.path.exists(path):
+            return None
+        try:
+            with open(path) as f:
+                manifest = json.load(f)
+        except (OSError, ValueError) as exc:
+            raise CheckpointCorrupted(
+                f"step {step}: unreadable manifest: {exc}") from exc
+        if not isinstance(manifest, dict) or \
+                not isinstance(manifest.get("files"), dict):
+            raise CheckpointCorrupted(
+                f"step {step}: malformed manifest (no files map)")
+        return manifest
+
+    def _verify_sizes(self, step: int) -> None:
+        """Cheap (stat-only) verification against the manifest; legacy
+        dirs with a payload pass. Raises CheckpointCorrupted."""
+        manifest = self._load_manifest(step)
+        if manifest is None:
+            if not os.path.exists(self._step_path(step)):
+                raise CheckpointCorrupted(f"step {step}: missing payload")
+            return
+        for name, meta in manifest["files"].items():
+            path = os.path.join(self._dir, str(step), name)
+            if not os.path.exists(path):
+                raise CheckpointCorrupted(
+                    f"step {step}: manifest names missing file {name!r}")
+            size = os.path.getsize(path)
+            if size != meta.get("bytes"):
+                raise CheckpointCorrupted(
+                    f"step {step}: {name} is {size} bytes, manifest "
+                    f"says {meta.get('bytes')} (torn write?)")
+
+    def _read_verified(self, step: int) -> bytes:
+        """The step's payload bytes, re-hashed against the manifest.
+        Raises CheckpointCorrupted on any mismatch; a legacy dir with
+        no manifest is accepted as-is."""
+        manifest = self._load_manifest(step)
+        try:
+            with open(self._step_path(step), "rb") as f:
+                data = f.read()
+        except OSError as exc:
+            raise CheckpointCorrupted(
+                f"step {step}: unreadable payload: {exc}") from exc
+        if manifest is not None:
+            meta = manifest["files"].get(_MSGPACK_NAME, {})
+            if len(data) != meta.get("bytes"):
+                raise CheckpointCorrupted(
+                    f"step {step}: payload is {len(data)} bytes, "
+                    f"manifest says {meta.get('bytes')} (torn write?)")
+            digest = hashlib.sha256(data).hexdigest()
+            if digest != meta.get("sha256"):
+                raise CheckpointCorrupted(
+                    f"step {step}: payload sha256 {digest[:12]}… does "
+                    f"not match manifest {str(meta.get('sha256'))[:12]}… "
+                    f"(bit rot?)")
+        return data
+
+    def _quarantine(self, step: int, reason: str) -> None:
+        """Move a corrupt step dir aside (never delete evidence) so
+        latest_step()/restore() stop seeing it."""
+        src = os.path.join(self._dir, str(step))
+        qdir = os.path.join(self._dir, _QUARANTINE_DIR)
+        os.makedirs(qdir, exist_ok=True)
+        dst = os.path.join(qdir, f"{step}-{int(time.time() * 1000)}")
+        while os.path.exists(dst):
+            dst += "x"
+        try:
+            os.replace(src, dst)
+        except OSError:
+            shutil.rmtree(src, ignore_errors=True)
+        health_lib.record("quarantined")
+        warnings.warn(
+            f"quarantined checkpoint step {step} -> {dst}: {reason}",
+            RuntimeWarning, stacklevel=3)
+
     def save(self, step: int, tree: Any) -> None:
         if _use_orbax():
             import orbax.checkpoint as ocp
@@ -101,34 +259,84 @@ class Checkpointer:
             return
         host = jax.tree_util.tree_map(np.asarray, tree)
         data = serialization.to_bytes(host)
-        step_dir = os.path.join(self._dir, str(step))
-        os.makedirs(step_dir, exist_ok=True)
-        path = self._step_path(step)
-        with open(path + ".tmp", "wb") as f:
+        # stage the whole step dir, fsync contents, then one atomic
+        # rename commits it — a crash at any point leaves either the
+        # previous state or a .tmp dir the next init sweeps
+        final_dir = os.path.join(self._dir, str(step))
+        tmp_dir = final_dir + ".tmp"
+        shutil.rmtree(tmp_dir, ignore_errors=True)
+        os.makedirs(tmp_dir)
+        payload = os.path.join(tmp_dir, _MSGPACK_NAME)
+        with open(payload, "wb") as f:
             f.write(data)
-        os.replace(path + ".tmp", path)
+            _fsync_file(f)
+        manifest = {
+            "step": int(step),
+            "wallTime": time.time(),
+            "files": {_MSGPACK_NAME: {
+                "sha256": hashlib.sha256(data).hexdigest(),
+                "bytes": len(data),
+            }},
+        }
+        _chaos_corrupt(payload)
+        with open(os.path.join(tmp_dir, _MANIFEST_NAME), "w") as f:
+            json.dump(manifest, f)
+            _fsync_file(f)
+        if os.path.exists(final_dir):
+            shutil.rmtree(final_dir, ignore_errors=True)
+        os.replace(tmp_dir, final_dir)
+        _fsync_dir(self._dir)
         for old in self._step_dirs()[:-self._max_to_keep]:
             shutil.rmtree(os.path.join(self._dir, str(old)),
                           ignore_errors=True)
 
     def latest_step(self) -> Optional[int]:
+        """Newest step passing cheap (size) verification. Steps failing
+        it are skipped — not quarantined; only restore(), which does the
+        full re-hash, moves dirs aside."""
         if _use_orbax():
             return self._mgr.latest_step()
-        steps = self._step_dirs()
-        return steps[-1] if steps else None
+        for step in reversed(self._step_dirs()):
+            try:
+                self._verify_sizes(step)
+            except CheckpointCorrupted:
+                continue
+            return step
+        return None
 
     def restore(self, target: Any, step: Optional[int] = None) -> Any:
-        if step is None:
-            step = self.latest_step()
-        if step is None:
-            return None
         if _use_orbax():
+            if step is None:
+                step = self._mgr.latest_step()
+            if step is None:
+                return None
             import orbax.checkpoint as ocp
 
             return self._mgr.restore(
                 step, args=ocp.args.StandardRestore(target))
-        with open(self._step_path(step), "rb") as f:
-            data = f.read()
+        if step is not None:
+            try:
+                data = self._read_verified(step)
+            except CheckpointCorrupted as exc:
+                # an explicitly requested step has no substitute
+                self._quarantine(step, str(exc))
+                raise
+            return self._decode(data, target)
+        # newest VERIFIED step: quarantine corrupt/torn dirs and fall
+        # back until one passes (or none are left -> fresh start)
+        while True:
+            candidates = self._step_dirs()
+            if not candidates:
+                return None
+            step = candidates[-1]
+            try:
+                data = self._read_verified(step)
+            except CheckpointCorrupted as exc:
+                self._quarantine(step, str(exc))
+                continue
+            return self._decode(data, target)
+
+    def _decode(self, data: bytes, target: Any) -> Any:
         host_target = jax.tree_util.tree_map(np.asarray, target)
         # raises ValueError on structural drift (missing/extra keys) —
         # same contract the engine's migration fallback keys off
@@ -219,8 +427,14 @@ class Checkpointer:
         path = os.path.join(self._dir, "progress.json")
         if not os.path.exists(path):
             return None
-        with open(path) as f:
-            return json.load(f)
+        try:
+            with open(path) as f:
+                meta = json.load(f)
+        except (OSError, ValueError):
+            # a torn sidecar must not poison the restore path — step
+            # checkpoints carry the real state; progress is best-effort
+            return None
+        return meta if isinstance(meta, dict) else None
 
     def close(self) -> None:
         self._mgr.wait_until_finished()

@@ -30,8 +30,20 @@ from flax import struct
 
 from learningorchestra_tpu.runtime import arena as arena_lib
 from learningorchestra_tpu.runtime import data as data_lib
+from learningorchestra_tpu.runtime import health as health_lib
 from learningorchestra_tpu.runtime import mesh as mesh_lib
 from learningorchestra_tpu.runtime import preempt
+from learningorchestra_tpu.runtime.health import (HealthPolicy,
+                                                  NumericalDivergence)
+
+# "HELT": domain-separates the post-rollback rng stream from the
+# original, so a replayed epoch does not redraw the exact dropout/
+# shuffle sequence that diverged
+_HEALTH_TAG = 0x4845_4C54
+# added (x rollback count) to the data-shuffle epoch index after a
+# rollback: the replayed epoch sees a fresh permutation, not the one
+# that fed the poisoned batch
+_ROLLBACK_STRIDE = 100003
 
 
 class TrainState(struct.PyTreeNode):
@@ -161,6 +173,13 @@ class Engine:
         # equal keys share jitted steps via _EXEC_CACHE. None opts out
         # (custom callables with no stable identity).
         self._cache_key = cache_key
+        # training health sentinel (docs/RELIABILITY.md), set per-fit:
+        # the flags are read at TRACE time by _train_step_body, so
+        # _health_sig joins every executable cache key and a change
+        # drops this instance's cached steps
+        self._health_on = False
+        self._health_skip = False
+        self._health_sig: Optional[tuple] = None
 
     # ------------------------------------------------------------------
     def init_state(self, params, model_state=None) -> TrainState:
@@ -230,12 +249,41 @@ class Engine:
         else:
             grads, new_model_state, metrics = self._micro_grads(
                 state.params, state.model_state, batch, rng)
+        bad = None
+        if self._health_on:
+            # on-device health word (docs/RELIABILITY.md): folded into
+            # the metric sums the step already ships, so the sentinel
+            # adds no extra host sync — loss finiteness + global
+            # grad-norm finiteness, a couple of reductions against a
+            # full fwd+bwd
+            loss_sum, loss_cnt = metrics["loss"]
+            mean_loss = loss_sum.astype(jnp.float32) / \
+                jnp.maximum(loss_cnt.astype(jnp.float32), 1e-9)
+            bad = jnp.logical_or(~jnp.isfinite(mean_loss),
+                                 ~jnp.isfinite(optax.global_norm(grads)))
         updates, new_opt = self._optimizer.update(
             grads, state.opt_state, state.params)
         new_params = optax.apply_updates(state.params, updates)
         new_state = state.replace(step=state.step + 1, params=new_params,
                                   opt_state=new_opt,
                                   model_state=new_model_state)
+        if bad is not None:
+            if self._health_skip:
+                # drop the poisoned update wholesale (params, optimizer
+                # moments, batch stats) — the step counter still
+                # advances so the rng stream stays aligned — and zero
+                # the step's metric contributions so the epoch means
+                # the sentinel checks stay finite
+                kept = state.replace(step=state.step + 1)
+                new_state = jax.tree_util.tree_map(
+                    lambda old, new: jnp.where(bad, old, new),
+                    kept, new_state)
+                metrics = {
+                    k: (jnp.where(bad, 0.0, s.astype(jnp.float32)),
+                        jnp.where(bad, 0.0, c.astype(jnp.float32)))
+                    for k, (s, c) in metrics.items()}
+            metrics["_health_bad"] = (bad.astype(jnp.float32),
+                                      jnp.asarray(1.0, jnp.float32))
         return new_state, metrics
 
     def _accum_grads(self, state: TrainState, batch, rng):
@@ -289,7 +337,20 @@ class Engine:
             return None
         return (self._cache_key, kind, self._mesh, self._batch_sharding,
                 self._donate, str(self._compute_dtype), self._grad_accum,
-                extra)
+                self._health_sig, extra)
+
+    def _set_health(self, policy: Optional[HealthPolicy]) -> None:
+        """Arm/disarm sentinel instrumentation for this fit. The flags
+        feed trace-time branches, so a signature change invalidates the
+        per-instance jitted steps (the shared cache keys on the
+        signature and stays correct either way)."""
+        sig = policy.jit_signature() if policy is not None else None
+        if sig != self._health_sig:
+            self._health_sig = sig
+            self._train_step = None
+            self._epoch_steps = {}
+        self._health_on = policy is not None
+        self._health_skip = bool(policy) and policy.action == "skip"
 
     def _shared_step(self, kind: str, build: Callable[[], Callable],
                      extra: Tuple = ()) -> Callable:
@@ -469,6 +530,98 @@ class Engine:
         return limit > 0 and batcher.total_bytes() <= limit and \
             batcher.steps_per_epoch > 1
 
+    # -- health sentinel (docs/RELIABILITY.md) -------------------------
+    @staticmethod
+    def _new_sentinel() -> Dict[str, Any]:
+        """Host-side per-fit sentinel state: EMA of the epoch loss,
+        rollback budget used, spike-check cooldown remaining."""
+        return {"ema": None, "rollbacks": 0, "cooldown": 0}
+
+    def _health_epoch_end(self, policy: HealthPolicy, sent: Dict[str, Any],
+                          epoch: int, bad_steps: int, loss: float,
+                          state: TrainState, checkpointer, snapshot,
+                          log_fn) -> Tuple[bool, TrainState,
+                                           Optional[Dict[str, Any]]]:
+        """Epoch-boundary policy check. Returns ``(proceed, state,
+        event)``: proceed False means re-run the SAME epoch from the
+        rolled-back state; a verdict the policy cannot absorb raises
+        :class:`NumericalDivergence`. Runs BEFORE the epoch's
+        checkpoint save, so a bad epoch never becomes last-good."""
+        verdict = None
+        if bad_steps > 0 or not np.isfinite(loss):
+            verdict = "nonfinite"
+        elif sent["cooldown"] > 0:
+            # the EMA is stale relative to freshly-restored params;
+            # suppress the spike check while it re-warms
+            sent["cooldown"] -= 1
+        elif sent["ema"] is not None and \
+                loss > policy.spike_factor * max(sent["ema"], 1e-9):
+            verdict = "spike"
+        if verdict is None:
+            sent["ema"] = (loss if sent["ema"] is None else
+                           policy.ema_alpha * loss +
+                           (1.0 - policy.ema_alpha) * sent["ema"])
+            return True, state, None
+        if verdict == "nonfinite":
+            health_lib.record("nonfiniteSteps", max(bad_steps, 1))
+        else:
+            health_lib.record("lossSpikes")
+        event = {"kind": verdict, "epoch": epoch, "action": policy.action,
+                 "badSteps": bad_steps,
+                 "loss": loss if np.isfinite(loss) else None,
+                 "ema": sent["ema"], "rollbacks": sent["rollbacks"]}
+        rolled = None
+        if policy.action == "rollback" and \
+                sent["rollbacks"] < policy.max_rollbacks:
+            if checkpointer is not None and \
+                    checkpointer.latest_step() is not None:
+                # verified restore: a corrupt latest step quarantines
+                # and falls back inside the checkpointer; None means
+                # nothing on disk survived verification
+                rolled = checkpointer.restore(state)
+            if rolled is None and snapshot is not None:
+                from learningorchestra_tpu.runtime.checkpoint import \
+                    _place_like
+                rolled = _place_like(snapshot, state)
+            if rolled is not None:
+                sent["rollbacks"] += 1
+                sent["cooldown"] = policy.cooldown_epochs
+                health_lib.record("rollbacks")
+                event["rollbacks"] = sent["rollbacks"]
+                event["restoredStep"] = int(rolled.step)
+        if log_fn is not None:
+            try:
+                log_fn({"healthEvent": dict(event)})
+            except Exception:  # noqa: BLE001 — telemetry must not sink a fit
+                pass
+        if rolled is not None:
+            return False, rolled, event
+        if policy.action == "skip":
+            # updates were already dropped on-device; a spike cannot be
+            # skipped retroactively so it is counted and absorbed into
+            # the EMA (or the check would fire every epoch after a
+            # genuine level shift)
+            if np.isfinite(loss):
+                sent["ema"] = (loss if sent["ema"] is None else
+                               policy.ema_alpha * loss +
+                               (1.0 - policy.ema_alpha) * sent["ema"])
+            return True, state, event
+        suffix = (f" after {sent['rollbacks']} rollbacks"
+                  if policy.action == "rollback" else "")
+        raise NumericalDivergence(
+            f"epoch {epoch}: {verdict} (badSteps={bad_steps}, "
+            f"loss={loss}) under healthPolicy action "
+            f"{policy.action!r}{suffix}")
+
+    @staticmethod
+    def _pop_bad_steps(sums: Dict[str, Any],
+                       counts: Optional[Dict[str, Any]] = None) -> int:
+        bad = sums.pop("_health_bad", None)
+        if counts is not None:
+            counts.pop("_health_bad", None)
+        return int(float(bad[0] if isinstance(bad, tuple) else bad)) \
+            if bad is not None else 0
+
     def _save_checkpoint(self, checkpointer, state: TrainState,
                          epoch: int) -> None:
         step = int(state.step)
@@ -606,6 +759,7 @@ class Engine:
                      batcher: data_lib.ArrayBatcher, epochs: int,
                      seed: int, checkpointer, log_fn,
                      start_epoch: int = 0,
+                     policy: Optional[HealthPolicy] = None,
                      ) -> Tuple[TrainState, List[Dict[str, Any]]]:
         steps = batcher.steps_per_epoch
         bs = batcher.batch_size
@@ -645,28 +799,56 @@ class Engine:
         else:
             device_arrays = stage()
         history: List[Dict[str, Any]] = []
+        sent = self._new_sentinel()
+        # last-good fallback when no checkpoint step exists yet (or
+        # none survives verification): one host copy, refreshed after
+        # each healthy epoch only when there is no checkpointer
+        snapshot = (to_host(state)
+                    if policy is not None and policy.action == "rollback"
+                    else None)
         try:
-            for epoch in range(start_epoch, epochs):
+            epoch = start_epoch
+            while epoch < epochs:
                 # lifecycle boundary: honor a deadline/cancel before
                 # dispatching the next whole-epoch scan, and publish
                 # progress for the stall watchdog
                 preempt.check_cancel()
-                preempt.heartbeat(epoch=epoch)
+                preempt.heartbeat(epoch=epoch,
+                                  rollbacks=sent["rollbacks"])
                 t0 = time.perf_counter()
-                if epoch == start_epoch:
+                if epoch == start_epoch and sent["rollbacks"] == 0:
                     # sliced from the device copy so an arena hit never
                     # re-materializes the padded host arrays
                     one = {k: v[:bs] for k, v in device_arrays.items()}
                     self._measure_flops(
                         state, one, base_rng,
                         step_fn=jax.jit(self._train_step_body))
-                state, totals = epoch_step(state, device_arrays,
-                                           base_rng, shuffle_rng,
-                                           jnp.asarray(epoch))
+                arrays_in = device_arrays
+                if _armed_nan():
+                    arrays_in = _poison_rows(device_arrays, bs)
+                rb = sent["rollbacks"]
+                step_rng = (base_rng if rb == 0 else jax.random.fold_in(
+                    base_rng, _HEALTH_TAG + rb))
+                state, totals = epoch_step(
+                    state, arrays_in, step_rng, shuffle_rng,
+                    jnp.asarray(epoch + rb * _ROLLBACK_STRIDE))
                 jax.block_until_ready(state.params)
                 dt = time.perf_counter() - t0
+                bad_steps = self._pop_bad_steps(totals)
                 record = {k: float(s) / max(float(c), 1e-9)
                           for k, (s, c) in totals.items()}
+                if policy is not None:
+                    proceed, state, event = self._health_epoch_end(
+                        policy, sent, epoch, bad_steps,
+                        record.get("loss", float("nan")), state,
+                        checkpointer, snapshot, log_fn)
+                    if not proceed:
+                        continue  # re-run this epoch from last-good
+                    if event is not None and bad_steps:
+                        record["nonfiniteSteps"] = bad_steps
+                    if checkpointer is None and \
+                            policy.action == "rollback":
+                        snapshot = to_host(state)
                 record.update(epoch=epoch, epochSeconds=round(dt, 4),
                               samplesPerSecond=round(
                                   batcher.num_samples / dt, 2))
@@ -685,7 +867,8 @@ class Engine:
                 # is durable. Never after the last epoch — a finishing
                 # job must not block on re-acquiring a lease it has no
                 # more work for.
-                if epoch + 1 < epochs:
+                epoch += 1
+                if epoch < epochs:
                     preempt.maybe_yield()
         finally:
             # the pin must drop on EVERY exit — a JobCancelled /
@@ -700,7 +883,10 @@ class Engine:
             checkpointer=None,
             log_fn: Optional[Callable[[Dict[str, Any]], None]] = None,
             scan_batches: Optional[bool] = None,
+            health_policy=None,
             ) -> Tuple[TrainState, List[Dict[str, Any]]]:
+        policy = health_lib.coerce_policy(health_policy)
+        self._set_health(policy)
         state, restored = self._maybe_restore(state, checkpointer)
         # On a real resume the requested ``epochs`` is the TOTAL budget:
         # a PATCH re-run of a crashed job trains only the remainder and
@@ -726,38 +912,56 @@ class Engine:
         if use_scan:
             return self._fit_scanned(state, batcher, epochs, seed,
                                      checkpointer, log_fn,
-                                     start_epoch=start_epoch)
+                                     start_epoch=start_epoch,
+                                     policy=policy)
         if self._train_step is None:
             self._train_step = self._shared_step(
                 "train", self._build_train_step)
         base_rng = jax.random.PRNGKey(seed)
         history: List[Dict[str, Any]] = []
+        sent = self._new_sentinel()
+        snapshot = (to_host(state)
+                    if policy is not None and policy.action == "rollback"
+                    else None)
         # Host-side step counter for the dropout rng: reading
         # ``state.step`` here would sync the host on every step and
         # serialize the prefetch pipeline against device compute. It
         # continues from the restored step, so the per-step rng stream
         # does not replay draws consumed before a crash.
         host_step = int(state.step)
-        for epoch in range(start_epoch, epochs):
+        epoch = start_epoch
+        while epoch < epochs:
             t0 = time.perf_counter()
             # metric accumulation stays on-device (async); one sync at
             # epoch end
             sums: Dict[str, Any] = {}
             counts: Dict[str, Any] = {}
             steps = 0
+            rb = sent["rollbacks"]
+            # post-rollback the rng stream re-keys and the shuffle
+            # cursor jumps, so the replayed epoch does not replay the
+            # exact batch order / dropout draws that diverged
+            eff_rng = (base_rng if rb == 0 else jax.random.fold_in(
+                base_rng, _HEALTH_TAG + rb))
+            poison = _armed_nan()
             # MFU must reflect steady-state compute, not XLA compile:
             # on the compile epoch the roofline window starts after the
             # first step completes (one extra sync, once per fit)
             t_steady, steady_steps = t0, 0
-            for batch in self._device_feed(batcher, epoch):
+            for batch in self._device_feed(
+                    batcher, epoch + rb * _ROLLBACK_STRIDE):
                 # per-step lifecycle point (dispatch is async, so this
                 # is host-side and nearly free): a cancelled/expired
                 # job stops mid-epoch instead of finishing it out
                 preempt.check_cancel()
-                preempt.heartbeat(epoch=epoch, step=host_step)
-                rng = jax.random.fold_in(base_rng, host_step)
+                preempt.heartbeat(epoch=epoch, step=host_step,
+                                  rollbacks=rb)
+                if poison:
+                    batch = _poison_batch(batch)
+                    poison = False
+                rng = jax.random.fold_in(eff_rng, host_step)
                 host_step += 1
-                if steps == 0 and epoch == start_epoch:
+                if steps == 0 and epoch == start_epoch and rb == 0:
                     self._measure_flops(state, batch, rng)
                 state, metrics = self._train_step(state, batch, rng)
                 if steps == 0 and epoch == start_epoch:
@@ -770,8 +974,23 @@ class Engine:
             jax.block_until_ready(state.params)
             now = time.perf_counter()
             dt = now - t0
+            bad_steps = self._pop_bad_steps(sums, counts)
             record = {k: float(sums[k]) / max(float(counts[k]), 1e-9)
                       for k in sums}
+            if policy is not None:
+                proceed, state, event = self._health_epoch_end(
+                    policy, sent, epoch, bad_steps,
+                    record.get("loss", float("nan")), state,
+                    checkpointer, snapshot, log_fn)
+                if not proceed:
+                    # re-run this epoch from the rolled-back state; the
+                    # rng step counter rewinds with it
+                    host_step = int(state.step)
+                    continue
+                if event is not None and bad_steps:
+                    record["nonfiniteSteps"] = bad_steps
+                if checkpointer is None and policy.action == "rollback":
+                    snapshot = to_host(state)
             record.update(epoch=epoch, epochSeconds=round(dt, 4),
                           samplesPerSecond=round(batcher.num_samples / dt, 2))
             steady_steps += steps
@@ -781,7 +1000,8 @@ class Engine:
                 self._save_checkpoint(checkpointer, state, epoch)
             if log_fn is not None:
                 log_fn(record)
-            if epoch + 1 < epochs:  # fair scheduling (see _fit_scanned)
+            epoch += 1
+            if epoch < epochs:  # fair scheduling (see _fit_scanned)
                 preempt.maybe_yield()
         return state, history
 
@@ -872,6 +1092,52 @@ def _replicator(mesh):
         rep = NamedSharding(mesh, PartitionSpec())
         fn = _REPLICATORS[mesh] = jax.jit(lambda a: a, out_shardings=rep)
     return fn
+
+
+def _nan_key(arrays) -> Optional[str]:
+    """Which feed key an armed ``engine_step:nan`` fault poisons: the
+    feature array if present, else the first floating non-mask leaf."""
+    keys = [k for k, v in arrays.items()
+            if k != data_lib.MASK_KEY and hasattr(v, "dtype") and
+            jnp.issubdtype(v.dtype, jnp.floating)]
+    if "x" in keys:
+        return "x"
+    return keys[0] if keys else None
+
+
+def _poison_batch(batch):
+    """One whole batch to NaN (per-step path). Multiply-by-NaN keeps
+    the leaf's sharding/dtype — a device_put of a fresh array would
+    land uncommitted."""
+    key = _nan_key(batch)
+    if key is None:
+        return batch
+    out = dict(batch)
+    out[key] = out[key] * jnp.asarray(float("nan"), out[key].dtype)
+    return out
+
+
+def _poison_rows(arrays, rows: int):
+    """First ``rows`` samples to NaN (scanned path) — a NEW array, the
+    arena-cached staging entry is never mutated."""
+    key = _nan_key(arrays)
+    if key is None:
+        return arrays
+    out = dict(arrays)
+    out[key] = out[key].at[:rows].mul(
+        jnp.asarray(float("nan"), out[key].dtype))
+    return out
+
+
+def _armed_nan() -> bool:
+    """Armed ``engine_step:*:nan`` chaos fault? (services/faults.py;
+    lazy import keeps runtime free of service-layer module deps)."""
+    try:
+        from learningorchestra_tpu.services import faults
+
+        return faults.maybe_nan("engine_step")
+    except Exception:  # noqa: BLE001
+        return False
 
 
 _SHUFFLE_TAG = 0x5348_5546  # "SHUF": domain-separates permutation keys

@@ -51,7 +51,9 @@ class ServiceContext:
                                slice_min_devices=self.config
                                .slice_min_devices,
                                slice_aging_seconds=self.config
-                               .slice_aging_seconds)
+                               .slice_aging_seconds,
+                               numerical_retries=self.config
+                               .health_retries)
         # feature-plane cache (docs/PERFORMANCE.md): the host tier all
         # dataset reads route through; shares the $name-cache budget
         self.features = FeatureCache(

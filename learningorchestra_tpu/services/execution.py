@@ -111,6 +111,8 @@ class ExecutionService:
         timeout = V.valid_timeout(body.get(V.TIMEOUT_FIELD))
         slice_devices = V.valid_slice_devices(
             body.get(V.SLICE_DEVICES_FIELD))
+        health_policy = V.valid_health_policy(
+            body.get(V.HEALTH_POLICY_FIELD))
         self._validator.not_duplicate(name)
         self._validator.existing_finished(parent_name)
         root_meta = self.root_model_metadata(parent_name)
@@ -129,6 +131,9 @@ class ExecutionService:
             # stored in metadata so boot/elastic requeues replay the
             # same deadline (server._requeue_execution)
             extra[V.TIMEOUT_FIELD] = timeout
+        if health_policy is not None:
+            # same boot-replay contract as timeout
+            extra[V.HEALTH_POLICY_FIELD] = health_policy
         if analysis:
             extra[ANALYSIS_FIELD] = analysis
         if footprint:
@@ -138,7 +143,7 @@ class ExecutionService:
         self._ctx.catalog.create_collection(name, type_string, extra)
         self._submit(name, type_string, parent_name, method,
                      method_parameters, description, timeout=timeout,
-                     footprint=footprint)
+                     footprint=footprint, health_policy=health_policy)
         return V.HTTP_CREATED, {
             "result": f"/api/learningOrchestra/v1/{verb}/{tool}/{name}"}
 
@@ -155,6 +160,9 @@ class ExecutionService:
         slice_devices = V.valid_slice_devices(
             body.get(V.SLICE_DEVICES_FIELD,
                      (meta.get(A.FOOTPRINT_FIELD) or {}).get("devices")))
+        health_policy = V.valid_health_policy(
+            body.get(V.HEALTH_POLICY_FIELD,
+                     meta.get(V.HEALTH_POLICY_FIELD)))
         parent_name = meta[D.PARENT_NAME_FIELD]
         root_meta = self.root_model_metadata(parent_name)
         self._validate_method(root_meta, method, method_parameters)
@@ -166,10 +174,11 @@ class ExecutionService:
                    ANALYSIS_FIELD: analysis,
                    A.FOOTPRINT_FIELD: footprint,
                    V.TIMEOUT_FIELD: timeout,
+                   V.HEALTH_POLICY_FIELD: health_policy,
                    D.FINISHED_FIELD: False})
         self._submit(name, meta[D.TYPE_FIELD], parent_name, method,
                      method_parameters, description, timeout=timeout,
-                     footprint=footprint)
+                     footprint=footprint, health_policy=health_policy)
         return V.HTTP_SUCCESS, {
             "result": f"/api/learningOrchestra/v1/{verb}/{tool}/{name}"}
 
@@ -222,16 +231,19 @@ class ExecutionService:
                 method: str, method_parameters: Dict[str, Any],
                 description: str, only_if_idle: bool = False,
                 timeout: Optional[float] = None,
-                footprint: Optional[Dict[str, Any]] = None) -> None:
+                footprint: Optional[Dict[str, Any]] = None,
+                health_policy: Optional[Any] = None) -> None:
         def run():
             _broadcast_to_workers(name, type_string, parent_name, method,
-                                  method_parameters)
+                                  method_parameters, health_policy)
             parent_type = self._ctx.params.artifact_type(parent_name)
             instance = self._ctx.artifacts.load(parent_name, parent_type)
             treated = self._ctx.params.treat(method_parameters)
             ckpt = _prepare_checkpointer(self._ctx, name, type_string,
                                          treated)
             _inject_epoch_log(self._ctx, name, instance, method, treated)
+            _inject_health_policy(self._ctx, instance, method, treated,
+                                  health_policy)
             try:
                 result = getattr(instance, method)(**treated)
             finally:
@@ -293,8 +305,35 @@ def _inject_epoch_log(ctx, name: str, instance: Any, method: str,
         return
 
     seen = {"n": 0}
+    health = {"rollbacks": 0, "nonfiniteSteps": 0, "lossSpikes": 0,
+              "events": []}
 
     def log_record(record: Dict[str, Any]) -> None:
+        event = record.get("healthEvent")
+        if event is not None:
+            # sentinel events (runtime/health.py) bypass the throttle —
+            # they are rare by construction (bounded by the rollback
+            # budget) and the acceptance contract is their presence on
+            # the job's metadata document
+            health["events"].append(event)
+            del health["events"][:-32]
+            if "restoredStep" in event:
+                health["rollbacks"] += 1
+            if event.get("kind") == "spike":
+                health["lossSpikes"] += 1
+            else:
+                health["nonfiniteSteps"] += max(
+                    int(event.get("badSteps") or 0), 1)
+            try:
+                ctx.catalog.append_document(name, {"healthEvent": event})
+                ctx.catalog.update_metadata(name, {
+                    "rollbacks": health["rollbacks"],
+                    "nonfiniteSteps": health["nonfiniteSteps"],
+                    "lossSpikes": health["lossSpikes"],
+                    "healthEvents": list(health["events"])})
+            except Exception:  # noqa: BLE001 — must never sink a fit
+                pass
+            return
         # bounded stream: every epoch up to 512, then every 16th — a
         # 10k-epoch fit appends ~1.1k docs, not 10k (job-history DoS cap)
         i = seen["n"]
@@ -307,6 +346,31 @@ def _inject_epoch_log(ctx, name: str, instance: Any, method: str,
             pass
 
     treated["log_fn"] = log_record
+
+
+def _inject_health_policy(ctx, instance: Any, method: str,
+                          treated: Dict[str, Any],
+                          requested: Optional[Any]) -> None:
+    """Arm the engine's training-health sentinel
+    (docs/RELIABILITY.md) when the target method takes a
+    ``health_policy`` kwarg (engine-backed fits do; sklearn methods
+    don't): the request's validated ``healthPolicy`` field merged over
+    the ``LO_HEALTH_*`` defaults. No-op when both are off."""
+    import inspect
+
+    if "health_policy" in treated:
+        return
+    try:
+        params = inspect.signature(getattr(instance, method)).parameters
+    except (TypeError, ValueError):
+        return
+    if "health_policy" not in params:
+        return
+    from learningorchestra_tpu.runtime import health as health_lib
+
+    policy = health_lib.resolve_policy(requested, ctx.config)
+    if policy is not None:
+        treated["health_policy"] = policy
 
 
 def checkpoint_dir_for(ctx, name: str) -> str:
@@ -341,12 +405,16 @@ def _prepare_checkpointer(ctx, name: str, type_string: str,
 # ----------------------------------------------------------------------
 def _broadcast_to_workers(name: str, type_string: str, parent_name: str,
                           method: str,
-                          method_parameters: Dict[str, Any]) -> None:
+                          method_parameters: Dict[str, Any],
+                          health_policy: Optional[Any] = None) -> None:
     """On a multi-host pod the coordinator publishes every mesh job
     before entering it: the jitted train/eval/predict step runs over
     the GLOBAL mesh, whose collectives need all processes to execute
     the same program. Workers replay the identical method call from
-    the shared artifact store (see :func:`replay_method_call`)."""
+    the shared artifact store (see :func:`replay_method_call`). The
+    health policy rides along because sentinel instrumentation changes
+    the traced program — a coordinator-only policy would diverge the
+    SPMD replay."""
     import jax
 
     from learningorchestra_tpu.runtime import distributed as dist
@@ -359,7 +427,8 @@ def _broadcast_to_workers(name: str, type_string: str, parent_name: str,
                   "replay_method_call",
         "kwargs": {"name": name, "type_string": type_string,
                    "parent_name": parent_name, "method": method,
-                   "method_parameters": method_parameters}})
+                   "method_parameters": method_parameters,
+                   "health_policy": health_policy}})
 
 
 _worker_ctx = None
@@ -367,7 +436,8 @@ _worker_ctx = None
 
 def replay_method_call(name: str, type_string: str, parent_name: str,
                        method: str,
-                       method_parameters: Dict[str, Any]) -> None:
+                       method_parameters: Dict[str, Any],
+                       health_policy: Optional[Any] = None) -> None:
     """Worker-side twin of the coordinator's pipeline: load the same
     artifact from the shared store, resolve the same parameters, call
     the same method — so every host participates in the global-mesh
@@ -384,6 +454,7 @@ def replay_method_call(name: str, type_string: str, parent_name: str,
     instance = ctx.artifacts.load(parent_name, parent_type)
     treated = ctx.params.treat(method_parameters)
     ckpt = _prepare_checkpointer(ctx, name, type_string, treated)
+    _inject_health_policy(ctx, instance, method, treated, health_policy)
     try:
         getattr(instance, method)(**treated)
     finally:

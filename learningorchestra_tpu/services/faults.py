@@ -10,14 +10,21 @@ injection sites with a budget, mode and argument —
   (checking the job's cancel token, so deadlines/DELETE still fire)
   until cancelled or ``arg`` seconds pass (default 3600);
 - ``"job_run:3:latency:0.5"`` — the first three attempts sleep 0.5 s
-  and then proceed normally.
+  and then proceed normally;
+- ``"engine_step:1:nan"`` — the engine poisons one train batch to NaN
+  (exercises the health sentinel, docs/RELIABILITY.md);
+- ``"ckpt_write:1:corrupt:64"`` — the checkpointer flips ``arg``
+  bytes (default 8) of one written payload AFTER its manifest sha256
+  was taken — simulated bit rot the verified restore must catch.
 
 So failure-handling paths (classified retries, deadlines, stall
-watchdog, failure execution documents, boot requeue) are testable
-end-to-end through the real REST/job stack instead of only with
-hand-made flaky callables. Known sites: ``artifact_save``
-(catalog/artifacts.py) and ``job_run`` (services/jobs.py, fired while
-the mesh lease is held)."""
+watchdog, failure execution documents, boot requeue, health
+rollback, quarantine-and-fallback restore) are testable end-to-end
+through the real REST/job stack instead of only with hand-made flaky
+callables. Known sites: ``artifact_save`` (catalog/artifacts.py),
+``job_run`` (services/jobs.py, fired while the mesh lease is held),
+``engine_step`` (runtime/engine.py, ``nan`` mode only) and
+``ckpt_write`` (runtime/checkpoint.py, ``corrupt`` mode only)."""
 
 from __future__ import annotations
 
@@ -30,9 +37,14 @@ _lock = threading.Lock()
 _used: Dict[str, int] = {}
 _parsed: Dict[str, Dict[str, "FaultSpec"]] = {}
 
-_MODES = ("raise", "hang", "latency")
+_MODES = ("raise", "hang", "latency", "nan", "corrupt")
+# modes maybe_inject() fires itself; "nan"/"corrupt" are DATA faults
+# consumed by their typed helpers (maybe_nan / corrupt_nbytes) at the
+# sites that know how to poison a batch / a written payload
+_INJECT_MODES = ("raise", "hang", "latency")
 _DEFAULT_HANG_SECONDS = 3600.0
 _DEFAULT_LATENCY_SECONDS = 0.1
+_DEFAULT_CORRUPT_BYTES = 8
 
 
 class InjectedFault(IOError):
@@ -87,12 +99,20 @@ def parse_spec(spec: str) -> Dict[str, FaultSpec]:
                     f"bad fault mode in {part!r}: {mode!r} (one of "
                     f"{_MODES})")
         if len(fields) > 3 and fields[3].strip():
+            if mode == "nan":
+                raise ValueError(
+                    f"bad fault arg in {part!r}: mode 'nan' takes no "
+                    f"arg, got {fields[3]!r}")
             try:
                 arg = float(fields[3])
             except ValueError:
                 raise ValueError(
                     f"bad fault arg in {part!r}: {fields[3]!r} is not a "
                     f"number") from None
+            if mode == "corrupt" and (arg != int(arg) or arg <= 0):
+                raise ValueError(
+                    f"bad fault arg in {part!r}: mode 'corrupt' takes "
+                    f"a positive integer byte count, got {fields[3]!r}")
         entries[site] = FaultSpec(site, count, mode, arg)
     return entries
 
@@ -122,19 +142,52 @@ def _cooperative_hang(site: str, seconds: float) -> None:
         time.sleep(0.05)
 
 
-def maybe_inject(site: str) -> None:
-    """Fire ``site``'s configured fault if it still has budget in
-    ``Config.fault_inject``: raise :class:`InjectedFault`, hang
-    cooperatively, or add latency (see module docstring)."""
+def _consume(site: str, modes) -> FaultSpec | None:
+    """The armed spec for ``site`` if its mode is one of ``modes`` and
+    budget remains — consuming one firing. Mode filtering happens
+    BEFORE the budget is touched, so a ``nan`` spec is never burned by
+    a plain maybe_inject() call at the same site (and vice versa)."""
     entry = _spec_for(site)
-    if entry is None:
-        return
+    if entry is None or entry.mode not in modes:
+        return None
     with _lock:
         used = _used.get(site, 0)
         if used >= entry.count:
-            return
+            return None
         _used[site] = used + 1
-        fired = used + 1
+    return entry
+
+
+def maybe_nan(site: str) -> bool:
+    """True when ``site`` carries an armed ``nan``-mode fault: the
+    caller (runtime/engine.py's train loop) poisons the next batch to
+    NaN so the health sentinel's detection paths run for real."""
+    return _consume(site, ("nan",)) is not None
+
+
+def corrupt_nbytes(site: str) -> int:
+    """The byte count to corrupt when ``site`` carries an armed
+    ``corrupt``-mode fault, else 0. The caller (runtime/checkpoint.py)
+    flips that many bytes of the payload it just wrote — after the
+    manifest checksum was taken, so restore-side verification is what
+    gets exercised."""
+    entry = _consume(site, ("corrupt",))
+    if entry is None:
+        return 0
+    return int(entry.arg) if entry.arg else _DEFAULT_CORRUPT_BYTES
+
+
+def maybe_inject(site: str) -> None:
+    """Fire ``site``'s configured fault if it still has budget in
+    ``Config.fault_inject``: raise :class:`InjectedFault`, hang
+    cooperatively, or add latency (see module docstring). Data-fault
+    modes (``nan``/``corrupt``) are ignored here — their budget belongs
+    to :func:`maybe_nan` / :func:`corrupt_nbytes`."""
+    entry = _consume(site, _INJECT_MODES)
+    if entry is None:
+        return
+    with _lock:
+        fired = _used.get(site, 0)
     if entry.mode == "raise":
         raise InjectedFault(
             f"injected fault at {site} ({fired}/{entry.count})")

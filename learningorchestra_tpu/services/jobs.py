@@ -33,8 +33,12 @@ README.md:194-198):
   only for TRANSIENT errors (I/O, OOM/RESOURCE_EXHAUSTED, injected
   faults), with exponential backoff + jitter between attempts;
   permanent errors (validation, user-code bugs) dead-letter
-  immediately, and an exhausted budget dead-letters too. Each attempt
-  appends its own execution document.
+  immediately, and an exhausted budget dead-letters too. NUMERICAL
+  errors (health-sentinel divergence, runtime/health.py) carry their
+  own ``LO_HEALTH_RETRIES`` budget — a retried checkpointed fit
+  resumes from its last-good step instead of replaying the
+  divergence (docs/RELIABILITY.md). Each attempt appends its own
+  execution document.
 - **Timing.** Every execution document records ``elapsedSeconds``
   (superset of the reference's builder-only ``fitTime``,
   builder.py:117-122) plus queue wait time for lease contention.
@@ -53,10 +57,16 @@ from typing import Any, Callable, Dict, Optional
 from learningorchestra_tpu.catalog import documents as D
 from learningorchestra_tpu.catalog.store import Catalog
 from learningorchestra_tpu.runtime import preempt
+from learningorchestra_tpu.runtime.health import NumericalDivergence
 from learningorchestra_tpu.services import faults
 
 TRANSIENT = "transient"
 PERMANENT = "permanent"
+# training diverged past its health policy (runtime/health.py): its own
+# class because the right response is neither a plain re-run (the same
+# divergence replays) nor dead-lettering — a bounded number of
+# rollback-retries, each resuming from the last-good checkpoint
+NUMERICAL = "numerical"
 
 # message substrings that mark an otherwise-unclassified exception as
 # retryable (XLA surfaces HBM OOM as XlaRuntimeError RESOURCE_EXHAUSTED,
@@ -69,9 +79,13 @@ _TRANSIENT_MARKERS = ("RESOURCE_EXHAUSTED", "OUT OF MEMORY",
 
 def classify_error(exception: BaseException) -> str:
     """``transient`` (worth a retry: the same code may succeed on a
-    re-run) vs ``permanent`` (validation/user-code errors a retry
-    would only repeat). :class:`faults.InjectedFault` is an IOError
-    subclass, so injected faults exercise the transient path."""
+    re-run) vs ``numerical`` (training diverged: retry resumes from
+    the last-good checkpoint, budgeted separately) vs ``permanent``
+    (validation/user-code errors a retry would only repeat).
+    :class:`faults.InjectedFault` is an IOError subclass, so injected
+    faults exercise the transient path."""
+    if isinstance(exception, NumericalDivergence):
+        return NUMERICAL
     if isinstance(exception, (OSError, MemoryError, InterruptedError,
                               TimeoutError, ConnectionError)):
         return TRANSIENT
@@ -109,7 +123,8 @@ class JobManager:
                  retry_backoff: float = 0.5,
                  retry_backoff_max: float = 30.0,
                  slice_min_devices: int = 1,
-                 slice_aging_seconds: float = 30.0):
+                 slice_aging_seconds: float = 30.0,
+                 numerical_retries: int = 1):
         from learningorchestra_tpu.services.scheduler import SliceLease
 
         self._catalog = catalog
@@ -134,8 +149,14 @@ class JobManager:
         self._retry_backoff = max(0.0, float(retry_backoff))
         self._retry_backoff_max = max(self._retry_backoff,
                                       float(retry_backoff_max))
+        # rollback-retry budget for the NUMERICAL error class
+        # (LO_HEALTH_RETRIES): a checkpointed fit resumes from its
+        # last-good step on each of these, so they are budgeted apart
+        # from the transient max_retries
+        self._numerical_retries = max(0, int(numerical_retries))
         self._counters: Dict[str, int] = {"retries": 0, "cancelled": 0,
-                                          "timedOut": 0}
+                                          "timedOut": 0,
+                                          "numericalRetries": 0}
         self._stalled: set = set()
         self._watchdog_stop = threading.Event()
         if self._stall_seconds > 0:
@@ -259,9 +280,18 @@ class JobManager:
             submitted = time.monotonic()
             token.started = submitted
             attempts = max_retries + 1
+            # attempt_no counts every try (documents/diagnostics);
+            # transient failures burn the max_retries budget while
+            # numerical (divergence) failures burn their own, so a
+            # rollback-retry never eats the slot reserved for an
+            # infra blip and vice versa
+            attempt_no = 0
+            transient_failures = 0
+            numerical_used = 0
             preempt.install_cancel(token)
             try:
-                for attempt in range(attempts):
+                while True:
+                    attempt_no += 1
                     if needs_mesh:
                         failure = self._pod_failure_fn()
                         if failure:
@@ -273,7 +303,7 @@ class JobManager:
                                 description, parameters,
                                 exception=f"WorkerLost({failure!r})",
                                 extra={"workerLost": True,
-                                       "attempt": attempt + 1}))
+                                       "attempt": attempt_no}))
                             return None
                     try:
                         # cancelled/expired while queued in the thread
@@ -364,7 +394,7 @@ class JobManager:
                                         extra=timing(
                                             {"queueWaitSeconds": round(
                                                 queue_wait, 6),
-                                             "attempt": attempt + 1})))
+                                             "attempt": attempt_no})))
                                 return result
                             except preempt.JobCancelled as exc:
                                 # deadline / DELETE / stall escalation
@@ -374,17 +404,26 @@ class JobManager:
                                 # checkpointed fit stays resumable — a
                                 # PATCH re-run picks up at the latest
                                 # orbax step.
-                                record_cancel(exc, attempt + 1, timing(
+                                record_cancel(exc, attempt_no, timing(
                                     {"queueWaitSeconds": round(
                                         queue_wait, 6)}))
                                 return None
                             except Exception as exception:  # noqa: BLE001
                                 traceback.print_exc()
                                 kind = classify_error(exception)
-                                terminal = (kind == PERMANENT or
-                                            attempt + 1 >= attempts)
-                                extra = timing({"attempt": attempt + 1,
+                                if kind == PERMANENT:
+                                    terminal = True
+                                elif kind == NUMERICAL:
+                                    terminal = (numerical_used >=
+                                                self._numerical_retries)
+                                else:
+                                    terminal = (transient_failures + 1
+                                                >= attempts)
+                                extra = timing({"attempt": attempt_no,
                                                 "errorKind": kind})
+                                if kind == NUMERICAL:
+                                    extra["numericalRetriesUsed"] = \
+                                        numerical_used
                                 if needs_mesh and self._pod_failure_fn():
                                     # a mesh job failing WHILE the pod
                                     # is degraded is a worker-loss
@@ -406,6 +445,11 @@ class JobManager:
                                                 max_retries > 0:
                                             extra["retriesSkipped"] = \
                                                 "permanent error class"
+                                        elif kind == NUMERICAL:
+                                            extra["retriesSkipped"] = \
+                                                ("numerical rollback-"
+                                                 "retry budget "
+                                                 "exhausted")
                                     doc = D.execution_document(
                                         description, parameters,
                                         exception=repr(exception),
@@ -418,7 +462,8 @@ class JobManager:
                                     # finished stays False (reference
                                     # parity)
                                     return None
-                                backoff = self._backoff_seconds(attempt)
+                                backoff = self._backoff_seconds(
+                                    attempt_no - 1)
                                 extra["nextRetryInSeconds"] = round(
                                     backoff, 3)
                                 self._catalog.append_document(
@@ -426,7 +471,12 @@ class JobManager:
                                         description, parameters,
                                         exception=repr(exception),
                                         extra=extra))
-                                self._count("retries")
+                                if kind == NUMERICAL:
+                                    numerical_used += 1
+                                    self._count("numericalRetries")
+                                else:
+                                    transient_failures += 1
+                                    self._count("retries")
                                 self._set_status(name, D.STATUS_QUEUED)
                                 # cancel-aware sleep: a DELETE or the
                                 # deadline interrupts the backoff and
@@ -436,7 +486,7 @@ class JobManager:
                     except preempt.JobCancelled as exc:
                         # cancelled before holding the lease (thread-
                         # pool queue, fair-queue wait, retry backoff)
-                        record_cancel(exc, attempt + 1, {
+                        record_cancel(exc, attempt_no, {
                             "elapsedSeconds": round(
                                 time.monotonic() - submitted, 6),
                             "queuedOnly": True})

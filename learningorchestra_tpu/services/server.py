@@ -201,7 +201,8 @@ class Api:
             meta.get(D.DESCRIPTION_FIELD, ""),
             only_if_idle=only_if_idle,
             timeout=meta.get(V.TIMEOUT_FIELD),
-            footprint=meta.get(A.FOOTPRINT_FIELD))
+            footprint=meta.get(A.FOOTPRINT_FIELD),
+            health_policy=meta.get(V.HEALTH_POLICY_FIELD))
 
     def recover_worker_lost(self) -> list:
         """Elastic pod recovery (beyond the reference, whose node loss
@@ -318,6 +319,11 @@ class Api:
         from learningorchestra_tpu.runtime import engine as engine_lib
         out["arena"] = arena_lib.get_default_arena().stats()
         out["executableCache"] = engine_lib.executable_cache_stats()
+        # training-health sentinel + checkpoint-integrity counters
+        # (docs/RELIABILITY.md); health.py is jax-free so this import
+        # is always cheap
+        from learningorchestra_tpu.runtime import health as health_lib
+        out["trainingHealth"] = health_lib.health_stats()
         return out
 
     def metrics_prometheus(self) -> bytes:
@@ -418,6 +424,25 @@ class Api:
                 (scheduler.get("grantsByPool") or {}).items()):
             lines.append(
                 f'lo_slice_grants_total{{pool="{esc(pool)}"}} {n}')
+        lines += [
+            "# TYPE lo_job_numerical_retries_total counter",
+            f"lo_job_numerical_retries_total "
+            f"{lifecycle.get('numericalRetries', 0)}",
+        ]
+        training_health = m["trainingHealth"]
+        lines += [
+            "# TYPE lo_nonfinite_steps_total counter",
+            f"lo_nonfinite_steps_total "
+            f"{training_health.get('nonfiniteSteps', 0)}",
+            "# TYPE lo_rollbacks_total counter",
+            f"lo_rollbacks_total {training_health.get('rollbacks', 0)}",
+            "# TYPE lo_loss_spikes_total counter",
+            f"lo_loss_spikes_total "
+            f"{training_health.get('lossSpikes', 0)}",
+            "# TYPE lo_checkpoints_quarantined_total counter",
+            f"lo_checkpoints_quarantined_total "
+            f"{training_health.get('quarantined', 0)}",
+        ]
         return ("\n".join(lines) + "\n").encode()
 
     # ------------------------------------------------------------------

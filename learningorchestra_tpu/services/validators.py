@@ -87,6 +87,45 @@ def valid_slice_devices(value: Any) -> Optional[int]:
     return int(value)
 
 
+HEALTH_POLICY_FIELD = "healthPolicy"
+
+
+def valid_health_policy(value: Any) -> Optional[Any]:
+    """Optional training-health request field (docs/RELIABILITY.md):
+    an action string (``"skip"``/``"rollback"``/``"fail"``/``"off"``)
+    or an object ``{"action", "spikeFactor", "emaAlpha",
+    "maxRollbacks", "cooldownEpochs"}``. Returns the normalized value
+    (stored on job metadata for boot replay); None when absent —
+    ``LO_HEALTH_*`` defaults then decide."""
+    if value is None:
+        return None
+    if not isinstance(value, (str, dict)):
+        raise HttpError(
+            HTTP_NOT_ACCEPTABLE,
+            f"{MESSAGE_INVALID_FIELD}: healthPolicy must be an action "
+            f"string or object, got {value!r}")
+    if isinstance(value, dict):
+        unknown = set(value) - {"action", "spikeFactor", "emaAlpha",
+                                "maxRollbacks", "cooldownEpochs"}
+        if unknown:
+            raise HttpError(
+                HTTP_NOT_ACCEPTABLE,
+                f"{MESSAGE_INVALID_FIELD}: healthPolicy has unknown "
+                f"key(s) {sorted(unknown)}")
+    from learningorchestra_tpu.runtime import health as health_lib
+
+    try:
+        # full range/type validation — the same coercion the engine
+        # applies, so a request that validates here never blows up at
+        # fit time
+        health_lib.coerce_policy(value)
+    except (ValueError, TypeError) as exc:
+        raise HttpError(
+            HTTP_NOT_ACCEPTABLE,
+            f"{MESSAGE_INVALID_FIELD}: {exc}") from None
+    return value
+
+
 def run_preflight(findings) -> list:
     """Gate a request on analyzer findings: raise a 406 carrying the
     full structured finding list if any error-severity finding fired,

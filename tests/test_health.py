@@ -1,0 +1,628 @@
+"""Training health sentinel + checksummed checkpoint integrity
+(docs/RELIABILITY.md). The reference has neither: a NaN'd training run
+writes NaN weights as its final artifact, and a torn/bit-rotted file
+is discovered only when a dependent job crashes on it (SURVEY §5).
+Here the engine detects non-finite steps and loss spikes per
+``healthPolicy`` (skip / rollback-to-last-good / fail), and msgpack
+step checkpoints carry a sha256 manifest that restore verifies —
+corrupt dirs are quarantined and restore falls back to the newest
+verified step."""
+
+import dataclasses
+import json
+import os
+import time
+
+import numpy as np
+import pytest
+
+from learningorchestra_tpu.catalog import documents as D
+from learningorchestra_tpu.runtime import health as health_lib
+from learningorchestra_tpu.runtime.checkpoint import (CheckpointCorrupted,
+                                                      Checkpointer)
+from learningorchestra_tpu.services import faults
+
+
+def _ctx(tmp_config, **overrides):
+    """Install the overridden config GLOBALLY (faults helpers and the
+    engine read get_config()) and build a context on it."""
+    from learningorchestra_tpu import config as config_mod
+    from learningorchestra_tpu.services.context import ServiceContext
+
+    cfg = dataclasses.replace(tmp_config, **overrides)
+    config_mod.set_config(cfg)
+    return ServiceContext(cfg)
+
+
+@pytest.fixture(autouse=True)
+def _fresh_health_state():
+    faults.reset()
+    health_lib.reset_health_stats()
+    yield
+    faults.reset()
+    health_lib.reset_health_stats()
+
+
+# ----------------------------------------------------------------------
+# policy coercion / resolution
+# ----------------------------------------------------------------------
+def test_coerce_policy_forms():
+    p = health_lib.coerce_policy("rollback")
+    assert p.action == "rollback"
+    p = health_lib.coerce_policy({"action": "skip", "spikeFactor": 8,
+                                  "maxRollbacks": 5})
+    assert (p.action, p.spike_factor, p.max_rollbacks) == ("skip", 8.0, 5)
+    assert health_lib.coerce_policy(None) is None
+    assert health_lib.coerce_policy(p) is p
+
+
+def test_coerce_policy_rejects_bad_fields():
+    with pytest.raises(ValueError, match="action"):
+        health_lib.coerce_policy("explode")
+    with pytest.raises(ValueError, match="spikeFactor"):
+        health_lib.coerce_policy({"action": "skip", "spikeFactor": 0})
+    with pytest.raises(ValueError, match="emaAlpha"):
+        health_lib.coerce_policy({"action": "skip", "emaAlpha": 1.5})
+    with pytest.raises(ValueError, match="maxRollbacks"):
+        health_lib.coerce_policy({"action": "rollback",
+                                  "maxRollbacks": -1})
+
+
+def test_resolve_policy_request_overrides_config(tmp_config):
+    cfg = dataclasses.replace(tmp_config, health_action="skip",
+                              health_spike_factor=9.0)
+    # no request -> LO_HEALTH_* defaults decide
+    p = health_lib.resolve_policy(None, cfg)
+    assert p is not None and p.action == "skip"
+    assert p.spike_factor == 9.0
+    # request wins over config
+    p = health_lib.resolve_policy("rollback", cfg)
+    assert p.action == "rollback"
+    # neither -> sentinel off
+    off = dataclasses.replace(tmp_config, health_action="")
+    assert health_lib.resolve_policy(None, off) is None
+
+
+# ----------------------------------------------------------------------
+# checkpoint integrity: manifest, atomic commit, quarantine, fallback
+# ----------------------------------------------------------------------
+def _tree(seed: int):
+    rng = np.random.default_rng(seed)
+    return {"w": rng.normal(size=(8, 4)).astype(np.float32),
+            "b": rng.normal(size=(4,)).astype(np.float32)}
+
+
+def test_manifest_written_and_round_trip(tmp_path):
+    ck = Checkpointer(str(tmp_path), max_to_keep=3)
+    tree = _tree(0)
+    ck.save(1, tree)
+    man_path = tmp_path / "1" / "manifest.json"
+    assert man_path.exists()
+    manifest = json.loads(man_path.read_text())
+    assert manifest["step"] == 1
+    entry = manifest["files"]["checkpoint.msgpack"]
+    assert len(entry["sha256"]) == 64
+    assert entry["bytes"] == os.path.getsize(
+        tmp_path / "1" / "checkpoint.msgpack")
+    out = ck.restore(_tree(99))  # target: same structure, other values
+    np.testing.assert_array_equal(out["w"], tree["w"])
+    np.testing.assert_array_equal(out["b"], tree["b"])
+    ck.close()
+
+
+def test_bitflip_quarantines_and_falls_back(tmp_path):
+    ck = Checkpointer(str(tmp_path), max_to_keep=3)
+    ck.save(1, _tree(1))
+    ck.save(2, _tree(2))
+    payload = tmp_path / "2" / "checkpoint.msgpack"
+    raw = bytearray(payload.read_bytes())
+    raw[len(raw) // 2] ^= 0xFF  # single flipped bit-pattern, same size
+    payload.write_bytes(bytes(raw))
+    # size unchanged -> the cheap check still reports step 2 ...
+    assert ck.latest_step() == 2
+    # ... but restore re-hashes, quarantines it, falls back to step 1
+    with pytest.warns(RuntimeWarning, match="quarantined"):
+        out = ck.restore(_tree(99))
+    np.testing.assert_array_equal(out["w"], _tree(1)["w"])
+    assert ck.latest_step() == 1
+    qdir = tmp_path / ".quarantine"
+    assert qdir.is_dir() and any(
+        name.startswith("2-") for name in os.listdir(qdir))
+    assert health_lib.health_stats()["quarantined"] == 1
+    ck.close()
+
+
+def test_truncation_detected_by_cheap_check(tmp_path):
+    ck = Checkpointer(str(tmp_path), max_to_keep=3)
+    ck.save(1, _tree(1))
+    ck.save(2, _tree(2))
+    payload = tmp_path / "2" / "checkpoint.msgpack"
+    payload.write_bytes(payload.read_bytes()[:-16])  # torn write
+    # size mismatch: even the stat-only check skips step 2
+    assert ck.latest_step() == 1
+    with pytest.warns(RuntimeWarning, match="quarantined"):
+        out = ck.restore(_tree(99))
+    np.testing.assert_array_equal(out["b"], _tree(1)["b"])
+    ck.close()
+
+
+def test_all_steps_corrupt_restores_none(tmp_path):
+    ck = Checkpointer(str(tmp_path), max_to_keep=3)
+    ck.save(1, _tree(1))
+    payload = tmp_path / "1" / "checkpoint.msgpack"
+    raw = bytearray(payload.read_bytes())
+    raw[0] ^= 0xFF
+    payload.write_bytes(bytes(raw))
+    with pytest.warns(RuntimeWarning, match="quarantined"):
+        assert ck.restore(_tree(99)) is None  # fresh start, no crash
+    assert health_lib.health_stats()["quarantined"] == 1
+    ck.close()
+
+
+def test_explicit_step_restore_raises_on_corruption(tmp_path):
+    ck = Checkpointer(str(tmp_path), max_to_keep=3)
+    ck.save(1, _tree(1))
+    ck.save(2, _tree(2))
+    payload = tmp_path / "2" / "checkpoint.msgpack"
+    raw = bytearray(payload.read_bytes())
+    raw[-1] ^= 0xFF
+    payload.write_bytes(bytes(raw))
+    # an explicitly requested step has no substitute: quarantine + raise
+    with pytest.warns(RuntimeWarning, match="quarantined"):
+        with pytest.raises(CheckpointCorrupted, match="sha256"):
+            ck.restore(_tree(99), step=2)
+    out = ck.restore(_tree(99), step=1)
+    np.testing.assert_array_equal(out["w"], _tree(1)["w"])
+    ck.close()
+
+
+def test_leftover_tmp_dir_swept_on_init(tmp_path):
+    ck = Checkpointer(str(tmp_path), max_to_keep=3)
+    ck.save(1, _tree(1))
+    ck.close()
+    stranded = tmp_path / "7.tmp"
+    stranded.mkdir()
+    (stranded / "checkpoint.msgpack").write_bytes(b"half-written")
+    ck2 = Checkpointer(str(tmp_path), max_to_keep=3)
+    assert not stranded.exists()  # a kill mid-save leaves no debris
+    assert ck2.latest_step() == 1
+    ck2.close()
+
+
+def test_legacy_dir_without_manifest_still_restores(tmp_path):
+    ck = Checkpointer(str(tmp_path), max_to_keep=3)
+    ck.save(1, _tree(1))
+    os.remove(tmp_path / "1" / "manifest.json")  # pre-manifest layout
+    assert ck.latest_step() == 1
+    out = ck.restore(_tree(99))
+    np.testing.assert_array_equal(out["w"], _tree(1)["w"])
+    ck.close()
+
+
+def test_chaos_corrupt_site_exercises_fallback(tmp_config, tmp_path):
+    """LO_FAULT_INJECT=ckpt_write:1:corrupt:4 — the save-side chaos
+    hook flips trailing bytes AFTER the manifest sha was taken, so the
+    NEXT restore must catch it and fall back."""
+    from learningorchestra_tpu import config as config_mod
+
+    ck = Checkpointer(str(tmp_path), max_to_keep=3)
+    ck.save(1, _tree(1))   # clean last-good
+    config_mod.set_config(dataclasses.replace(
+        tmp_config, fault_inject="ckpt_write:1:corrupt:4"))
+    ck.save(2, _tree(2))   # chaos budget fires here: payload corrupted
+    assert ck.latest_step() == 2  # size unchanged: cheap check passes
+    with pytest.warns(RuntimeWarning, match="quarantined"):
+        out = ck.restore(_tree(99))
+    np.testing.assert_array_equal(out["w"], _tree(1)["w"])
+    assert health_lib.health_stats()["quarantined"] == 1
+    ck.close()
+
+
+# ----------------------------------------------------------------------
+# fault grammar: nan / corrupt data-fault modes
+# ----------------------------------------------------------------------
+def test_parse_spec_nan_and_corrupt_modes():
+    entries = faults.parse_spec("engine_step:2:nan, ckpt_write:1:corrupt:64")
+    assert entries["engine_step"].mode == "nan"
+    assert entries["engine_step"].count == 2
+    assert entries["ckpt_write"].mode == "corrupt"
+    assert entries["ckpt_write"].arg == 64
+    # corrupt byte count is optional (defaults at the consuming site)
+    assert faults.parse_spec("s:1:corrupt")["s"].arg is None
+
+
+def test_parse_spec_rejects_bad_data_fault_args():
+    with pytest.raises(ValueError, match="nan"):
+        faults.parse_spec("s:1:nan:5")       # nan takes no argument
+    with pytest.raises(ValueError, match="corrupt"):
+        faults.parse_spec("s:1:corrupt:0")   # byte count must be > 0
+    with pytest.raises(ValueError, match="corrupt"):
+        faults.parse_spec("s:1:corrupt:2.5")  # ... and an integer
+
+
+def test_data_fault_budget_isolated_from_maybe_inject(tmp_config):
+    """A nan spec at a site must never be burned by maybe_inject() at
+    the same site (and vice versa) — mode filtering happens before the
+    budget is consumed."""
+    from learningorchestra_tpu import config as config_mod
+
+    config_mod.set_config(dataclasses.replace(
+        tmp_config, fault_inject="engine_step:1:nan"))
+    faults.maybe_inject("engine_step")       # wrong mode: no-op, no burn
+    assert faults.maybe_nan("engine_step") is True
+    assert faults.maybe_nan("engine_step") is False  # budget spent
+    assert faults.corrupt_nbytes("engine_step") == 0  # wrong mode
+
+    config_mod.set_config(dataclasses.replace(
+        tmp_config, fault_inject="ckpt_write:1:corrupt"))
+    faults.reset()
+    assert faults.maybe_nan("ckpt_write") is False
+    assert faults.corrupt_nbytes("ckpt_write") == 8  # default byte count
+    assert faults.corrupt_nbytes("ckpt_write") == 0
+
+
+# ----------------------------------------------------------------------
+# engine sentinel: skip / rollback / fail
+# ----------------------------------------------------------------------
+def _toy(n=256, features=8):
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(n, features)).astype(np.float32)
+    y = (x.sum(axis=1) > 0).astype(np.int64)
+    return x, y
+
+
+def _mlp():
+    from learningorchestra_tpu.models.neural import NeuralModel
+
+    return NeuralModel([
+        {"kind": "dense", "units": 16, "activation": "relu"},
+        {"kind": "dense", "units": 2, "activation": "softmax"}])
+
+
+def _arm(tmp_config, spec, **overrides):
+    from learningorchestra_tpu import config as config_mod
+
+    cfg = dataclasses.replace(tmp_config, fault_inject=spec, **overrides)
+    config_mod.set_config(cfg)
+    return cfg
+
+
+def test_skip_drops_bad_step_and_keeps_history_finite(tmp_config):
+    _arm(tmp_config, "engine_step:1:nan")
+    x, y = _toy()
+    events = []
+    hist = _mlp().fit(x, y, epochs=3, batch_size=32, shuffle=False,
+                      health_policy="skip",
+                      log_fn=lambda r: events.append(r))
+    assert all(np.isfinite(v) for v in hist.history["loss"])
+    stats = health_lib.health_stats()
+    assert stats["nonfiniteSteps"] >= 1
+    assert stats["rollbacks"] == 0
+    hev = [e["healthEvent"] for e in events if "healthEvent" in e]
+    assert hev and hev[0]["kind"] == "nonfinite"
+    assert hev[0]["action"] == "skip"
+    assert hev[0]["badSteps"] >= 1
+
+
+def test_rollback_restores_last_good_and_finishes(tmp_config, tmp_path):
+    _arm(tmp_config, "engine_step:1:nan")
+    x, y = _toy()
+    ck = Checkpointer(str(tmp_path / "ck"), max_to_keep=3)
+    events = []
+    try:
+        hist = _mlp().fit(x, y, epochs=4, batch_size=32, shuffle=False,
+                          checkpointer=ck,
+                          health_policy={"action": "rollback",
+                                         "maxRollbacks": 2},
+                          log_fn=lambda r: events.append(r))
+    finally:
+        ck.close()
+    # the poisoned epoch was replayed: full budget, all finite
+    assert len(hist.history["loss"]) == 4
+    assert all(np.isfinite(v) for v in hist.history["loss"])
+    assert health_lib.health_stats()["rollbacks"] == 1
+    hev = [e["healthEvent"] for e in events if "healthEvent" in e]
+    rb = [e for e in hev if "restoredStep" in e]
+    assert rb and rb[0]["action"] == "rollback"
+    assert rb[0]["rollbacks"] == 1
+
+
+def test_rollback_is_bit_identical_to_clean_run(tmp_config, tmp_path):
+    """Replaying the poisoned epoch from last-good must converge to the
+    SAME final parameters a never-faulted run reaches: same policy
+    (identical traced program), shuffle off, rng-free model — the
+    rollback's re-seeded replay has no numerical side channel."""
+    x, y = _toy(n=128)
+    policy = {"action": "rollback", "maxRollbacks": 2}
+
+    _arm(tmp_config, "")  # clean reference run, sentinel armed
+    m_clean = _mlp()
+    m_clean.fit(x, y, epochs=3, batch_size=32, shuffle=False,
+                health_policy=policy)
+
+    _arm(tmp_config, "engine_step:1:nan")
+    faults.reset()
+    ck = Checkpointer(str(tmp_path / "ck2"), max_to_keep=3)
+    m_fault = _mlp()
+    try:
+        m_fault.fit(x, y, epochs=3, batch_size=32, shuffle=False,
+                    checkpointer=ck, health_policy=policy)
+    finally:
+        ck.close()
+    assert health_lib.health_stats()["rollbacks"] == 1
+    clean_leaves = jax_leaves(m_clean.params)
+    fault_leaves = jax_leaves(m_fault.params)
+    assert len(clean_leaves) == len(fault_leaves) > 0
+    for a, b in zip(clean_leaves, fault_leaves):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def jax_leaves(tree):
+    import jax
+
+    return jax.tree_util.tree_leaves(tree)
+
+
+def test_fail_policy_raises_numerical_divergence(tmp_config):
+    _arm(tmp_config, "engine_step:1:nan")
+    x, y = _toy()
+    with pytest.raises(health_lib.NumericalDivergence,
+                       match="nonfinite"):
+        _mlp().fit(x, y, epochs=3, batch_size=32, shuffle=False,
+                   health_policy="fail")
+
+
+def test_rollback_budget_exhaustion_escalates(tmp_config, tmp_path):
+    """Every epoch poisoned: maxRollbacks=1 re-runs once, then the
+    sentinel escalates to NumericalDivergence instead of looping."""
+    _arm(tmp_config, "engine_step:99:nan")
+    x, y = _toy()
+    ck = Checkpointer(str(tmp_path / "ck3"), max_to_keep=3)
+    try:
+        with pytest.raises(health_lib.NumericalDivergence,
+                           match="after 1 rollbacks"):
+            _mlp().fit(x, y, epochs=4, batch_size=32, shuffle=False,
+                       checkpointer=ck,
+                       health_policy={"action": "rollback",
+                                      "maxRollbacks": 1})
+    finally:
+        ck.close()
+    assert health_lib.health_stats()["rollbacks"] == 1
+
+
+def test_spike_verdict_fires_after_ema_warms(tmp_config):
+    """Loss-spike detection is an epoch-boundary EMA test — unit-level
+    through ``_health_epoch_end`` (which never touches engine state):
+    healthy epochs warm the EMA, then a jump past spikeFactor×EMA
+    raises under the fail policy."""
+    from learningorchestra_tpu.runtime.engine import Engine
+
+    eng = object.__new__(Engine)
+    policy = health_lib.coerce_policy(
+        {"action": "fail", "spikeFactor": 4.0, "emaAlpha": 0.5})
+    sent = Engine._new_sentinel()
+    proceed, _, event = eng._health_epoch_end(
+        policy, sent, 0, 0, 1.0, None, None, None, None)
+    assert proceed and event is None and sent["ema"] == 1.0
+    eng._health_epoch_end(policy, sent, 1, 0, 1.0, None, None, None, None)
+    # 3x the EMA: under the 4x threshold, absorbed
+    proceed, _, event = eng._health_epoch_end(
+        policy, sent, 2, 0, 3.0, None, None, None, None)
+    assert proceed and event is None
+    with pytest.raises(health_lib.NumericalDivergence, match="spike"):
+        eng._health_epoch_end(policy, sent, 3, 0, 50.0,
+                              None, None, None, None)
+    assert health_lib.health_stats()["lossSpikes"] == 1
+
+
+def test_spike_rollback_restores_snapshot_and_cools_down(tmp_config):
+    """A spike under rollback restores the host snapshot (no
+    checkpointer attached) and arms the cooldown, which suppresses the
+    spike check on the replayed epoch."""
+    from types import SimpleNamespace
+
+    from learningorchestra_tpu.runtime.engine import Engine
+
+    eng = object.__new__(Engine)
+    policy = health_lib.coerce_policy(
+        {"action": "rollback", "spikeFactor": 2.0, "emaAlpha": 0.5,
+         "cooldownEpochs": 1})
+    sent = Engine._new_sentinel()
+    last_good = SimpleNamespace(step=7)
+    eng._health_epoch_end(policy, sent, 0, 0, 1.0, None, None,
+                          last_good, None)
+    events = []
+    proceed, state, event = eng._health_epoch_end(
+        policy, sent, 1, 0, 9.0, SimpleNamespace(step=11), None,
+        last_good, lambda r: events.append(r))
+    assert proceed is False          # replay the epoch ...
+    assert state is last_good        # ... from the restored snapshot
+    assert event["kind"] == "spike"
+    assert event["restoredStep"] == 7
+    assert sent["cooldown"] == 1
+    assert events and events[0]["healthEvent"]["kind"] == "spike"
+    # replayed epoch still spiky: cooldown absorbs it, no verdict
+    proceed, _, event = eng._health_epoch_end(
+        policy, sent, 1, 0, 9.0, SimpleNamespace(step=11), None,
+        last_good, None)
+    assert proceed and event is None and sent["cooldown"] == 0
+    assert health_lib.health_stats()["rollbacks"] == 1
+
+
+# ----------------------------------------------------------------------
+# jobs layer: the numerical error class
+# ----------------------------------------------------------------------
+def test_classify_numerical_divergence():
+    from learningorchestra_tpu.services.jobs import (NUMERICAL,
+                                                     classify_error)
+
+    assert classify_error(
+        health_lib.NumericalDivergence("diverged")) == NUMERICAL
+    # stays distinct from the transient/permanent classes
+    assert classify_error(IOError("disk")) == "transient"
+    assert classify_error(ValueError("bad")) == "permanent"
+
+
+def test_numerical_retries_then_dead_letters(tmp_config, catalog):
+    """A job that keeps diverging gets its own bounded retry budget
+    (numerical_retries), separate from the transient budget, then dead-
+    letters with the numerical error kind."""
+    from learningorchestra_tpu.services.jobs import JobManager
+
+    jobs = JobManager(catalog, max_workers=2, retry_backoff=0.02,
+                      numerical_retries=1)
+    try:
+        catalog.create_collection("nd1", "train/tensorflow")
+        calls = []
+
+        def diverges():
+            calls.append(1)
+            raise health_lib.NumericalDivergence("loss went to NaN")
+
+        jobs.submit("nd1", diverges, max_retries=5)
+        jobs.wait("nd1", timeout=30)
+        assert calls == [1, 1]  # initial + 1 numerical retry, NOT 5
+        meta = catalog.get_metadata("nd1")
+        assert meta[D.STATUS_FIELD] == D.STATUS_DEAD_LETTERED
+        doc = catalog.get_documents("nd1")[-1]
+        assert doc["deadLettered"] is True
+        assert doc["errorKind"] == "numerical"
+        assert doc["retriesSkipped"] == \
+            "numerical rollback-retry budget exhausted"
+        assert jobs.lifecycle_counters()["numericalRetries"] == 1
+        assert jobs.lifecycle_counters()["retries"] == 0
+    finally:
+        jobs.shutdown()
+
+
+def test_numerical_retry_succeeds_on_replay(tmp_config, catalog):
+    from learningorchestra_tpu.services.jobs import JobManager
+
+    jobs = JobManager(catalog, max_workers=2, retry_backoff=0.02,
+                      numerical_retries=2)
+    try:
+        catalog.create_collection("nd2", "train/tensorflow")
+        calls = []
+
+        def diverges_once():
+            calls.append(1)
+            if len(calls) == 1:
+                raise health_lib.NumericalDivergence("spike")
+            return "ok"
+
+        jobs.submit("nd2", diverges_once, max_retries=0)
+        assert jobs.wait("nd2", timeout=30) == "ok"
+        meta = catalog.get_metadata("nd2")
+        assert meta[D.STATUS_FIELD] == D.STATUS_FINISHED
+        assert jobs.lifecycle_counters()["numericalRetries"] == 1
+    finally:
+        jobs.shutdown()
+
+
+# ----------------------------------------------------------------------
+# REST: healthPolicy validation + end-to-end rollback through the Api
+# ----------------------------------------------------------------------
+def test_health_policy_field_validation():
+    from learningorchestra_tpu.services import validators as V
+
+    assert V.valid_health_policy(None) is None
+    assert V.valid_health_policy("rollback") == "rollback"
+    spec = {"action": "skip", "spikeFactor": 6.0}
+    assert V.valid_health_policy(spec) == spec
+    for bad in (17, ["skip"], "explode",
+                {"action": "skip", "unknownKey": 1},
+                {"action": "rollback", "maxRollbacks": -2}):
+        with pytest.raises(V.HttpError) as err:
+            V.valid_health_policy(bad)
+        assert err.value.status == 406
+
+
+_P = "/api/learningOrchestra/v1"
+
+
+def test_e2e_rollback_job_finishes_with_health_metadata(tmp_config):
+    """The acceptance path: POST a train with healthPolicy rollback +
+    an armed engine_step:1:nan fault; the job must reach ``finished``
+    (no dead-letter) with rollbacks >= 1 on its metadata and a
+    healthEvent execution document."""
+    from learningorchestra_tpu.services.server import Api
+
+    _arm(tmp_config, "engine_step:1:nan")
+    api = Api()
+    try:
+        s, b, _ = api.dispatch("POST", _P + "/function/python", {}, {
+            "name": "h_data", "functionParameters": {},
+            "function": ("import numpy as np\n"
+                         "rng = np.random.default_rng(0)\n"
+                         "x = rng.normal(size=(128, 8))"
+                         ".astype(np.float32)\n"
+                         "y = (x[:, 0] > 0).astype(np.int32)\n"
+                         "response = {'x': x, 'y': y}\n")})
+        assert s == 201, b
+        api.ctx.jobs.wait("h_data", timeout=120)
+        s, b, _ = api.dispatch("POST", _P + "/model/tensorflow", {}, {
+            "modelName": "h_model",
+            "modulePath": "learningorchestra_tpu.models",
+            "class": "NeuralModel",
+            "classParameters": {"layer_configs": [
+                {"kind": "dense", "units": 8, "activation": "relu"},
+                {"kind": "dense", "units": 2,
+                 "activation": "softmax"}]}})
+        assert s == 201, b
+        api.ctx.jobs.wait("h_model", timeout=120)
+        s, b, _ = api.dispatch("POST", _P + "/train/tensorflow", {}, {
+            "name": "h_train", "modelName": "h_model", "method": "fit",
+            "healthPolicy": {"action": "rollback", "maxRollbacks": 2},
+            "methodParameters": {"x": "$h_data.x", "y": "$h_data.y",
+                                 "epochs": 4, "batch_size": 32,
+                                 "shuffle": False,
+                                 "checkpoint": True}})
+        assert s == 201, b
+        api.ctx.jobs.wait("h_train", timeout=240)
+        meta = api.ctx.catalog.get_metadata("h_train")
+        assert meta["finished"] is True, meta
+        assert meta[D.STATUS_FIELD] == D.STATUS_FINISHED
+        # the sentinel's story is on the job: counters + event trail
+        assert meta["rollbacks"] >= 1
+        assert meta["healthPolicy"] == {"action": "rollback",
+                                        "maxRollbacks": 2}
+        assert meta["healthEvents"], meta
+        assert any("restoredStep" in e for e in meta["healthEvents"])
+        docs = api.ctx.catalog.get_documents("h_train")
+        assert any(d.get("healthEvent") for d in docs)
+        # /metrics surfaces the fleet-wide counters
+        m = api.metrics()
+        assert m["trainingHealth"]["rollbacks"] >= 1
+        prom = api.metrics_prometheus()
+        prom = prom.decode() if isinstance(prom, bytes) else prom
+        assert "lo_rollbacks_total" in prom
+        assert "lo_nonfinite_steps_total" in prom
+    finally:
+        api.ctx.close()
+
+
+def test_invalid_health_policy_rejected_via_rest(tmp_config):
+    from learningorchestra_tpu.services.server import Api
+
+    _arm(tmp_config, "")
+    api = Api()
+    try:
+        s, b, _ = api.dispatch("POST", _P + "/model/tensorflow", {}, {
+            "modelName": "h_model2",
+            "modulePath": "learningorchestra_tpu.models",
+            "class": "NeuralModel",
+            "classParameters": {"layer_configs": [
+                {"kind": "dense", "units": 2,
+                 "activation": "softmax"}]}})
+        assert s == 201, b
+        api.ctx.jobs.wait("h_model2", timeout=120)
+        s, b, _ = api.dispatch("POST", _P + "/train/tensorflow", {}, {
+            "name": "h_bad", "modelName": "h_model2", "method": "fit",
+            "healthPolicy": "explode",
+            "methodParameters": {"x": [[1.0, 2.0]], "y": [0],
+                                 "epochs": 1}})
+        assert s == 406
+        assert "healthPolicy" in b["result"] or "action" in b["result"]
+        assert api.ctx.catalog.get_metadata("h_bad") is None
+    finally:
+        api.ctx.close()
