@@ -96,6 +96,7 @@ PHASE_TIMEOUTS = {"cnn": 600, "lstm": 600, "tlm": 900, "proxy": 120,
                   "builder": 600, "builder_mesh": 600,
                   "warm_pipeline": 600, "concurrent_jobs": 600,
                   "flash": 600, "ingest": 600, "gen": 900,
+                  "serving": 900,
                   "sentinel_overhead": 600, "sentinel_chaos": 600}
 
 # out-of-core Builder (reference config 4: 10M-row GBT via Spark)
@@ -356,6 +357,180 @@ def phase_gen():
         "n_kv_heads": kv or cfg["n_heads"],
         "platform": jax.devices()[0].platform,
     }
+
+
+def serve_clf_code() -> str:
+    return """
+import numpy as np
+rng = np.random.default_rng(7)
+n, d = 4096, 8
+x = rng.normal(size=(n, d)).astype(np.float32)
+w = rng.normal(size=(d,))
+y = (x @ w > 0).astype(np.int32)
+response = {"x": x, "y": y, "xq": x[:8]}
+"""
+
+
+def phase_serving():
+    """Resident serving plane (docs/SERVING.md) vs the batch path it
+    replaces. LM half: sustained mixed traffic — >= 8 concurrent
+    request streams through ONE continuous-batched session — gated
+    against the in-phase solo decode baseline measured with the
+    lm_decode protocol (batch 2, same model, same process). Classifier
+    half: warm shape-bucketed predict p50 vs the full submit->poll job
+    path on the same fitted artifact (catalog writes + scheduling +
+    artifact load per request vs a resident instance)."""
+    import concurrent.futures
+
+    import jax
+    import numpy as np
+
+    from learningorchestra_tpu.models.transformer import LanguageModel
+
+    new = int(os.environ.get("LO_BENCH_SERVE_TOKENS", "64"))
+    prompt_len = int(os.environ.get("LO_BENCH_SERVE_PROMPT", "32"))
+    streams = int(os.environ.get("LO_BENCH_SERVE_STREAMS", "8"))
+    reqs = int(os.environ.get("LO_BENCH_SERVE_REQS", "3"))
+    api, prefix = _make_api()
+    out = {"platform": jax.devices()[0].platform,
+           "streams": streams, "requests_per_stream": reqs,
+           "prompt_len": prompt_len, "new_tokens": new}
+    try:
+        # ---- LM solo baseline: the lm_decode protocol (batch 2, whole
+        # continuation in one jitted fori_loop) on a serving-sized model
+        cfg = dict(TLM_CFG)
+        cfg["max_len"] = prompt_len + new
+        lm = LanguageModel(**cfg)
+        rng = np.random.default_rng(0)
+        seed_tokens = rng.integers(
+            1, cfg["vocab_size"], size=(4, 128)).astype(np.int32)
+        lm.fit(seed_tokens, batch_size=4, epochs=1)
+        solo_prompt = rng.integers(
+            1, cfg["vocab_size"], size=(2, prompt_len)).astype(np.int32)
+        lm.generate(solo_prompt, max_new_tokens=new, temperature=0.8,
+                    top_k=50, seed=0)  # pays the compile
+        t0 = time.perf_counter()
+        for i in range(3):
+            lm.generate(solo_prompt, max_new_tokens=new, temperature=0.8,
+                        top_k=50, seed=i + 1)
+        solo_dt = (time.perf_counter() - t0) / 3
+        solo_tps = 2 * new / solo_dt
+        out["solo_decode_tokens_per_sec"] = round(solo_tps, 1)
+
+        # ---- LM serving: one session, `streams` concurrent clients
+        api.ctx.artifacts.save(lm, "serve_lm", "train/tensorflow")
+        status, body, _ = api.dispatch(
+            "POST", f"{prefix}/serve/serve_lm", {}, {
+                "maxSlots": streams, "cacheLen": prompt_len + new,
+                "temperature": 0.8, "topK": 50})
+        _expect_created(status, body)
+        base_prompt = [int(t) for t in rng.integers(
+            1, cfg["vocab_size"], size=prompt_len)]
+        status, body, _ = api.dispatch(
+            "POST", f"{prefix}/serve/serve_lm/predict", {}, {
+                "prompt": base_prompt, "maxNewTokens": new, "seed": 0})
+        if status != 200:
+            raise RuntimeError(f"serve warmup failed: {status} {body}")
+
+        def _stream(k):
+            times = []
+            for j in range(reqs):
+                t = time.perf_counter()
+                s2, b2, _ = api.dispatch(
+                    "POST", f"{prefix}/serve/serve_lm/predict", {}, {
+                        "prompt": base_prompt, "maxNewTokens": new,
+                        "seed": k * 100 + j + 1})
+                if s2 != 200:
+                    raise RuntimeError(
+                        f"serve predict failed: {s2} {b2}")
+                times.append(time.perf_counter() - t)
+            return times
+
+        lat = []
+        t0 = time.perf_counter()
+        with concurrent.futures.ThreadPoolExecutor(streams) as pool:
+            for times in pool.map(_stream, range(streams)):
+                lat.extend(times)
+        serve_dt = time.perf_counter() - t0
+        serve_tps = streams * reqs * new / serve_dt
+        lat.sort()
+        _, lm_stats, _ = api.dispatch(
+            "GET", f"{prefix}/serve/serve_lm", {}, None)
+        out.update({
+            "decode_tokens_per_sec": round(serve_tps, 1),
+            "speedup_vs_solo": round(serve_tps / solo_tps, 2),
+            "request_p50_ms": round(
+                lat[int(0.50 * (len(lat) - 1))] * 1e3, 1),
+            "p99_ms": round(lat[int(0.99 * (len(lat) - 1))] * 1e3, 1),
+            "lease_yields": lm_stats["lease"].get("yields", 0),
+        })
+        api.dispatch("DELETE", f"{prefix}/serve/serve_lm", {}, None)
+
+        # ---- classifier: submit->poll job path vs warm serving
+        status, body, _ = api.dispatch(
+            "POST", f"{prefix}/function/python", {}, {
+                "name": "sv_data", "function": serve_clf_code(),
+                "functionParameters": {}})
+        _expect_created(status, body)
+        _wait(api, body["result"])
+        status, body, _ = api.dispatch(
+            "POST", f"{prefix}/model/tensorflow", {}, {
+                "modelName": "sv_model",
+                "modulePath": "learningorchestra_tpu.models.estimators",
+                "class": "LogisticRegressionJAX",
+                "classParameters": {"epochs": 4, "batch_size": 512}})
+        _expect_created(status, body)
+        _wait(api, body["result"])
+        status, body, _ = api.dispatch(
+            "POST", f"{prefix}/train/tensorflow", {}, {
+                "name": "sv_clf", "modelName": "sv_model",
+                "method": "fit",
+                "methodParameters": {"x": "$sv_data.x",
+                                     "y": "$sv_data.y"}})
+        _expect_created(status, body)
+        _wait(api, body["result"])
+
+        poll_times = []
+        for i in range(5):
+            t = time.perf_counter()
+            status, body, _ = api.dispatch(
+                "POST", f"{prefix}/predict/tensorflow", {}, {
+                    "name": f"sv_p{i}", "modelName": "sv_clf",
+                    "method": "predict",
+                    "methodParameters": {"x": "$sv_data.xq"}})
+            _expect_created(status, body)
+            _wait(api, body["result"])
+            poll_times.append(time.perf_counter() - t)
+
+        status, body, _ = api.dispatch(
+            "POST", f"{prefix}/serve/sv_clf", {}, {})
+        _expect_created(status, body)
+        rows = [[float(v) for v in r]
+                for r in rng.normal(size=(8, 8))]
+        api.dispatch("POST", f"{prefix}/serve/sv_clf/predict", {},
+                     {"x": rows})  # warm
+        serve_times = []
+        for _ in range(20):
+            t = time.perf_counter()
+            s2, b2, _ = api.dispatch(
+                "POST", f"{prefix}/serve/sv_clf/predict", {},
+                {"x": rows})
+            if s2 != 200:
+                raise RuntimeError(f"clf serve failed: {s2} {b2}")
+            serve_times.append(time.perf_counter() - t)
+        poll_times.sort()
+        serve_times.sort()
+        poll_p50 = poll_times[len(poll_times) // 2]
+        serve_p50 = serve_times[len(serve_times) // 2]
+        out.update({
+            "predict_submit_poll_p50_ms": round(poll_p50 * 1e3, 1),
+            "predict_serving_p50_ms": round(serve_p50 * 1e3, 2),
+            "predict_speedup": round(poll_p50 / serve_p50, 1),
+        })
+    finally:
+        api.ctx.serving.close()
+        api.ctx.jobs.shutdown()
+    return out
 
 
 def _scrub_exc(exc) -> str:
@@ -950,7 +1125,7 @@ PHASES = {"cnn": phase_cnn, "lstm": phase_lstm, "tlm": phase_tlm,
           "warm_pipeline": phase_warm_pipeline,
           "concurrent_jobs": phase_concurrent_jobs,
           "flash": phase_flash, "ingest": phase_ingest,
-          "gen": phase_gen,
+          "gen": phase_gen, "serving": phase_serving,
           "sentinel_overhead": phase_sentinel_overhead,
           "sentinel_chaos": phase_sentinel_chaos}
 
@@ -1059,6 +1234,41 @@ def _run_phase(phase: str, extra_env=None):
                      f"without a result; tail: {' | '.join(tail)}"}
 
 
+def _median_iqr(vals):
+    import statistics
+
+    med = statistics.median(vals)
+    if len(vals) >= 2:
+        q = statistics.quantiles(vals, n=4, method="inclusive")
+        iqr = q[2] - q[0]
+    else:
+        iqr = 0.0
+    return round(med, 3), round(iqr, 3)
+
+
+def _run_phase_repeated(phase: str, extra_env=None, metrics=()):
+    """Run a phase LO_BENCH_REPEATS times (default 3); report the last
+    successful run plus a ``repeats`` block carrying median + IQR per
+    headline metric. Single-shot numbers on a shared host are
+    noise-bound — the spread is the evidence the number is real."""
+    n = max(1, int(os.environ.get("LO_BENCH_REPEATS", "3")))
+    runs = [_run_phase(phase, extra_env) for _ in range(n)]
+    good = [r for r in runs if "error" not in r]
+    if not good:
+        return runs[-1]
+    out = dict(good[-1])
+    agg = {}
+    for metric in metrics:
+        vals = [float(r[metric]) for r in good
+                if isinstance(r.get(metric), (int, float))]
+        if vals:
+            med, iqr = _median_iqr(vals)
+            agg[metric] = {"median": med, "iqr": iqr, "n": len(vals),
+                           "values": [round(v, 3) for v in vals]}
+    out["repeats"] = {"n": n, "successful": len(good), "metrics": agg}
+    return out
+
+
 def _prior_tpu_numbers():
     """TPU rows parsed out of the committed BENCHMARKS.md at report
     time (never hardcoded — the file is the single source, so the
@@ -1145,7 +1355,9 @@ def main(argv=None):
         if "error" not in retry:
             retry["flash_error"] = models["transformer_lm"]["error"]
             models["transformer_lm"] = retry
-    models["builder_10m_streaming"] = _run_phase("builder", env)
+    models["builder_10m_streaming"] = _run_phase_repeated(
+        "builder", env,
+        metrics=("train_rows_per_sec", "pipeline_seconds"))
     models["builder_mesh_2m"] = _run_phase("builder_mesh", env)
     models["warm_pipeline"] = _run_phase("warm_pipeline", env)
     models["csv_ingest"] = _run_phase("ingest", env)
@@ -1153,6 +1365,14 @@ def main(argv=None):
                        LO_BENCH_GEN_PROMPT="16", LO_BENCH_GEN_BATCH="2")
     models["lm_decode"] = _run_phase("gen", None if tpu_ok
                                      else gen_cpu_env)
+    serve_cpu_env = dict(cpu_env, LO_BENCH_SERVE_TOKENS="32",
+                         LO_BENCH_SERVE_PROMPT="16",
+                         LO_BENCH_SERVE_STREAMS="8",
+                         LO_BENCH_SERVE_REQS="2")
+    models["serving"] = _run_phase_repeated(
+        "serving", None if tpu_ok else serve_cpu_env,
+        metrics=("decode_tokens_per_sec", "speedup_vs_solo", "p99_ms",
+                 "predict_speedup"))
     # interpret-mode kernel timing is meaningless — flash runs on TPU only
     flash = _run_phase("flash") if tpu_ok else {
         "skipped": "TPU unreachable; interpret-mode timing is not "
@@ -1226,6 +1446,8 @@ def main(argv=None):
         "transformer_lm_mfu": tlm.get("mfu"),
         "transformer_lm_tflops_per_sec_per_chip":
             tlm.get("tflops_per_sec_per_chip"),
+        "serving_speedup_vs_solo":
+            models.get("serving", {}).get("speedup_vs_solo"),
         "full_report": report_path,
     }
     print(json.dumps(compact))
@@ -1274,6 +1496,19 @@ def _write_md(path, report):
                 f"{mesh.get('lr', {}).get('fitTime')}s vs sklearn "
                 f"{host.get('lr', {}).get('fitTime')}s, slices="
                 f"{mesh.get('lr', {}).get('meshDevices')}dev |")
+            continue
+        if name == "serving":
+            lines.append(
+                f"| {name} (resident plane) "
+                f"| {stats.get('platform', '?')} "
+                f"| {stats.get('decode_tokens_per_sec', '—')} tok/s "
+                f"({stats.get('speedup_vs_solo', '—')}× solo decode) "
+                f"| — | — | — | — "
+                f"| streams={stats.get('streams')}, "
+                f"p99={stats.get('p99_ms')}ms, clf predict p50 "
+                f"{stats.get('predict_serving_p50_ms')}ms "
+                f"({stats.get('predict_speedup', '—')}× vs "
+                f"submit→poll) |")
             continue
         if name == "csv_ingest":
             lines.append(

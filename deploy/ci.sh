@@ -113,7 +113,8 @@ echo "== sentinel-smoke: chaos train must finish via rollback =="
 SENTINEL_TIMEOUT="${LO_CI_SENTINEL_TIMEOUT:-600}"
 CHAOS_OUT="$(mktemp)"
 OVERHEAD_OUT="$(mktemp)"
-trap 'rm -rf "$PERF_CACHE" "$PERF_OUT" "$SLICE_OUT" "$CHAOS_OUT" "$OVERHEAD_OUT"' EXIT
+SERVE_OUT="$(mktemp)"
+trap 'rm -rf "$PERF_CACHE" "$PERF_OUT" "$SLICE_OUT" "$CHAOS_OUT" "$OVERHEAD_OUT" "$SERVE_OUT"' EXIT
 timeout -k 10 "$SENTINEL_TIMEOUT" env JAX_PLATFORMS=cpu \
     JAX_COMPILATION_CACHE_DIR="$PERF_CACHE" \
     LO_COMPUTE_DTYPE=float32 \
@@ -165,6 +166,58 @@ assert ratio < 1.03, (
     f"(gate < 1.03x): {result}")
 print(f"sentinel-overhead: OK (off {result['off_seconds']}s, "
       f"skip {result['skip_seconds']}s, ratio {ratio})")
+EOF
+
+echo "== serving-smoke: resident plane must beat the batch path =="
+# One continuous-batched LM session under 8 concurrent streams plus a
+# shape-bucketed classifier session (bench.py serving;
+# docs/SERVING.md). Gates:
+#  - warm serving predict p50 >= 5x lower than the submit->poll job
+#    path on the same fitted artifact, and an absolute sustained floor
+#    (p50 <= 100ms -> >= 10 req/s warm)
+#  - sustained decode tokens/s vs the in-phase solo (batch-2) decode
+#    baseline: >= 3x on an accelerator, where decode is HBM-bound and
+#    slot batching is nearly free; >= 0.8x (parity floor) on the CPU
+#    backend, where the vocab projection is compute-bound and scales
+#    linearly with batch. Override with LO_SMOKE_SERVE_DECODE_FLOOR.
+SERVE_TIMEOUT="${LO_CI_SERVE_TIMEOUT:-900}"
+timeout -k 10 "$SERVE_TIMEOUT" env JAX_PLATFORMS=cpu \
+    JAX_COMPILATION_CACHE_DIR="$PERF_CACHE" \
+    LO_COMPUTE_DTYPE=float32 \
+    LO_BENCH_TLM_D=128 LO_BENCH_TLM_LAYERS=2 LO_BENCH_TLM_SEQ=128 \
+    LO_BENCH_SERVE_TOKENS=32 LO_BENCH_SERVE_PROMPT=16 \
+    LO_BENCH_SERVE_STREAMS=8 LO_BENCH_SERVE_REQS=2 \
+    python bench.py --phase serving | tee "$SERVE_OUT"
+python - "$SERVE_OUT" <<'EOF'
+import json, os, sys
+
+mark = "@@LO_BENCH_RESULT@@"
+result = None
+for line in reversed(open(sys.argv[1]).read().splitlines()):
+    if line.startswith(mark):
+        result = json.loads(line[len(mark):])
+        break
+assert result is not None, "serving-smoke: no bench result line"
+assert "error" not in result, f"serving-smoke: phase failed: {result}"
+result = result.get("result", result)  # unwrap the ok-envelope
+floor = os.environ.get("LO_SMOKE_SERVE_DECODE_FLOOR")
+floor = float(floor) if floor else (
+    0.8 if result["platform"] == "cpu" else 3.0)
+decode = result["speedup_vs_solo"]
+assert decode >= floor, (
+    f"serving-smoke: sustained decode {decode}x solo baseline "
+    f"(gate >= {floor}x on {result['platform']}): {result}")
+pspeed = result["predict_speedup"]
+assert pspeed >= 5, (
+    f"serving-smoke: warm predict only {pspeed}x faster than "
+    f"submit->poll (gate >= 5x): {result}")
+p50 = result["predict_serving_p50_ms"]
+assert p50 <= 100, (
+    f"serving-smoke: warm predict p50 {p50}ms (floor <= 100ms): "
+    f"{result}")
+print(f"serving-smoke: OK (decode {decode}x solo, "
+      f"p99 {result['p99_ms']}ms over {result['streams']} streams, "
+      f"clf predict {pspeed}x vs submit->poll, p50 {p50}ms)")
 EOF
 
 echo "== ci: OK =="

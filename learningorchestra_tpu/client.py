@@ -229,6 +229,60 @@ class Tool:
             "classifiersList": classifiers, **extra})
 
 
+class Serve:
+    """Handle for the resident serving plane (``/serve`` routes,
+    docs/SERVING.md). Unlike :class:`Tool` verbs, ``predict`` here is
+    SYNCHRONOUS — the response carries the tokens/predictions, no
+    submit-then-poll. 429 (queue full) and 503 (session unavailable)
+    surface as :class:`ApiError` with the matching status."""
+
+    def __init__(self, http: _Http):
+        self._http = http
+        self._base = f"{API_PREFIX}/serve"
+
+    def create(self, model_name: str, **options: Any) -> Dict[str, Any]:
+        """Start a serving session for a fitted model. LM options:
+        ``maxSlots``, ``cacheLen``, ``temperature``, ``topK``,
+        ``topP``; both kinds: ``type`` ("lm"/"predict"),
+        ``sliceDevices``."""
+        _, payload = self._http.request(
+            "POST", f"{self._base}/{model_name}", options)
+        return payload
+
+    def generate(self, model_name: str, prompt: List[int],
+                 max_new_tokens: int = 32, seed: int = 0,
+                 timeout: Optional[float] = None) -> Dict[str, Any]:
+        body: Dict[str, Any] = {"prompt": list(prompt),
+                                "maxNewTokens": max_new_tokens,
+                                "seed": seed}
+        if timeout is not None:
+            body["timeout"] = timeout
+        _, payload = self._http.request(
+            "POST", f"{self._base}/{model_name}/predict", body)
+        return payload
+
+    def predict(self, model_name: str, x: Any,
+                timeout: Optional[float] = None) -> List[Any]:
+        body: Dict[str, Any] = {
+            "x": x.tolist() if hasattr(x, "tolist") else list(x)}
+        if timeout is not None:
+            body["timeout"] = timeout
+        _, payload = self._http.request(
+            "POST", f"{self._base}/{model_name}/predict", body)
+        return payload["predictions"]
+
+    def stats(self, model_name: Optional[str] = None) -> Any:
+        path = self._base if model_name is None \
+            else f"{self._base}/{model_name}"
+        _, payload = self._http.request("GET", path)
+        return payload["result"] if model_name is None else payload
+
+    def delete(self, model_name: str) -> Dict[str, Any]:
+        _, payload = self._http.request(
+            "DELETE", f"{self._base}/{model_name}")
+        return payload
+
+
 _TOOL_ROUTES = {
     "dataset_csv": ("dataset", "csv"),
     "dataset_generic": ("dataset", "generic"),
@@ -271,6 +325,7 @@ class Context:
         self._http = _Http(cluster, timeout=timeout)
         for attr, (service, tool) in _TOOL_ROUTES.items():
             setattr(self, attr, Tool(self._http, service, tool))
+        self.serve = Serve(self._http)
 
     def tool(self, service: str, tool: str) -> Tool:
         return Tool(self._http, service, tool)

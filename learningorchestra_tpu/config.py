@@ -210,6 +210,36 @@ class Config:
     fault_inject: str = dataclasses.field(
         default_factory=lambda: os.environ.get("LO_FAULT_INJECT", ""))
 
+    # Resident serving plane (docs/SERVING.md). Sessions pin a model
+    # in the HBM arena and micro-batch concurrent predict requests.
+    # Max in-flight decode slots per LM serving session (the
+    # continuous batcher's compiled batch width).
+    serve_max_batch: int = dataclasses.field(
+        default_factory=lambda: int(os.environ.get(
+            "LO_SERVE_MAX_BATCH", "8")))
+    # Precompiled batch-size buckets for classifier/estimator predict
+    # micro-batching ("1,2,4,8,..."): a request burst of n rows pads
+    # to the smallest bucket >= n so warm predicts never retrace.
+    serve_buckets: str = dataclasses.field(
+        default_factory=lambda: os.environ.get(
+            "LO_SERVE_BUCKETS", "1,2,4,8,16,32,64"))
+    # Admission control: requests queued beyond this bound are
+    # rejected with 429 (bounded queue per session).
+    serve_queue_depth: int = dataclasses.field(
+        default_factory=lambda: int(os.environ.get(
+            "LO_SERVE_QUEUE", "64")))
+    # How long a request may wait for batch aggregation before the
+    # batcher dispatches a partial batch (milliseconds).
+    serve_max_wait_ms: float = dataclasses.field(
+        default_factory=lambda: float(os.environ.get(
+            "LO_SERVE_MAX_WAIT_MS", "2")))
+    # Serving-lease policy: "preempt" (the session periodically yields
+    # its slice when batch gang jobs wait — never deadlocks them) or
+    # "hold" (the session keeps its slice until deleted).
+    serve_lease_policy: str = dataclasses.field(
+        default_factory=lambda: os.environ.get(
+            "LO_SERVE_LEASE_POLICY", "preempt"))
+
     # Gateway behaviors (KrakenD parity, krakend.json:1769-1770):
     # version-revalidated response cache for universal GETs (TTL is a
     # lifetime bound, never a staleness window; 0 disables) and an

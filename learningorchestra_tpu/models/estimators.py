@@ -12,7 +12,7 @@ to work per classifier slice. Tree families stay on host sklearn
 
 Both classes speak the sklearn surface the Builder consumes
 (``fit(X, y)`` / ``predict`` / ``predict_proba``) plus ``set_mesh``
-for sub-slice placement (models/sweep.py ``sub_meshes``).
+for sub-slice placement (runtime/mesh.py ``sub_meshes``).
 """
 
 from __future__ import annotations

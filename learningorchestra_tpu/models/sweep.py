@@ -40,8 +40,20 @@ _FIT_KEYS = {"batch_size", "epochs"}
 
 # Deprecated re-export: sub-mesh construction is a runtime concern
 # now that the slice scheduler packs jobs onto device subsets — the
-# implementation lives in runtime.mesh. Import from there.
-sub_meshes = mesh_lib.sub_meshes
+# implementation lives in runtime.mesh. Import from there. The module
+# __getattr__ (PEP 562) keeps `from models.sweep import sub_meshes`
+# working one more release, with a DeprecationWarning at use site.
+def __getattr__(name: str):
+    if name == "sub_meshes":
+        import warnings
+
+        warnings.warn(
+            "models.sweep.sub_meshes is deprecated; import it from "
+            "learningorchestra_tpu.runtime.mesh instead",
+            DeprecationWarning, stacklevel=2)
+        return mesh_lib.sub_meshes
+    raise AttributeError(
+        f"module {__name__!r} has no attribute {name!r}")
 
 
 def _clone(estimator):
@@ -221,7 +233,7 @@ class GridSearch:
             slices = [mesh]
         else:
             k = min(len(combos), self.max_parallel or mesh.size)
-            slices = sub_meshes(mesh, k)
+            slices = mesh_lib.sub_meshes(mesh, k)
             k = min(k, len(slices))  # never more workers than slices
         # free pool, not idx % k: a fast trial returns its slice for
         # the next combo instead of contending with a slow neighbour

@@ -173,7 +173,8 @@ class _Attention(nn.Module):
         return ck, cv
 
     @nn.compact
-    def __call__(self, x, train: bool, decode_pos=None, cache_len: int = 0):
+    def __call__(self, x, train: bool, decode_pos=None, cache_len: int = 0,
+                 pad_offset=None, kv_len=None):
         d_model = x.shape[-1]
         kv = self.kv_heads
         if self.n_heads % kv:
@@ -196,7 +197,8 @@ class _Attention(nn.Module):
             k = dense("k_proj", kv * self.head_dim)(x).reshape(kv_shape4)
             v = dense("v_proj", kv * self.head_dim)(x).reshape(kv_shape4)
 
-        if decode_pos is not None:
+        if decode_pos is not None and jnp.ndim(decode_pos) == 0 \
+                and pad_offset is None:
             # single-token step at absolute position decode_pos: rope
             # from the scalar position, attend over the KV cache
             half = self.head_dim // 2
@@ -231,10 +233,74 @@ class _Attention(nn.Module):
             o = jnp.einsum("bqhgk,bkhd->bqhgd", p,
                            cv.value.astype(jnp.float32)
                            ).reshape(shape4).astype(x.dtype)
+        elif decode_pos is not None:
+            # per-row decode step: continuous-batched serving (every
+            # slot sits at its OWN cache position) or a left-padded
+            # batch decoding one shared column. The math is the scalar
+            # branch's, elementwise per row — rope angle, cache write,
+            # grouped scores, visibility mask — so a slot's output
+            # bits match a solo batch-1 decode of the same request
+            # (docs/SERVING.md bit-identity contract).
+            pos = decode_pos if jnp.ndim(decode_pos) else \
+                jnp.full((b,), decode_pos, jnp.int32)
+            rel = pos if pad_offset is None else pos - pad_offset
+            half = self.head_dim // 2
+            freqs = 1.0 / (self.rope_base ** (
+                jnp.arange(half, dtype=jnp.float32) / half))
+            ang = rel.astype(jnp.float32)[:, None] * freqs[None, :]
+            cos = jnp.cos(ang)[:, None, None, :]       # (b, 1, 1, half)
+            sin = jnp.sin(ang)[:, None, None, :]
+
+            def rot(t):
+                t1, t2 = jnp.split(t, 2, axis=-1)
+                c, si = cos.astype(t.dtype), sin.astype(t.dtype)
+                return jnp.concatenate(
+                    [t1 * c - t2 * si, t1 * si + t2 * c], axis=-1)
+
+            q, k = rot(q), rot(k)
+            ck, cv = self._cache_vars(b, cache_len, x.dtype)
+            rows = jnp.arange(b)
+            ck.value = ck.value.at[rows, pos].set(
+                k[:, 0].astype(x.dtype))
+            cv.value = cv.value.at[rows, pos].set(
+                v[:, 0].astype(x.dtype))
+            o = attn_ops.decode_attention(
+                q, ck.value, cv.value, pos, pad_offset=pad_offset,
+                window=self.window).reshape(shape4)
         else:
-            cos, sin = rope_tables(s, self.head_dim,
-                                   base=self.rope_base)
-            q, k = apply_rope(q, cos, sin), apply_rope(k, cos, sin)
+            if pad_offset is None:
+                cos, sin = rope_tables(s, self.head_dim,
+                                       base=self.rope_base)
+                q, k = apply_rope(q, cos, sin), apply_rope(k, cos, sin)
+            else:
+                # left-padded batch prefill: each row's rope position
+                # is its content-relative index (negative over the pad
+                # columns — masked below, never read)
+                half = self.head_dim // 2
+                freqs = 1.0 / (self.rope_base ** (
+                    jnp.arange(half, dtype=jnp.float32) / half))
+                rel = (jnp.arange(s)[None, :]
+                       - pad_offset[:, None]).astype(jnp.float32)
+                ang = rel[:, :, None] * freqs[None, None, :]
+                cos = jnp.cos(ang)[:, :, None, :]   # (b, s, 1, half)
+                sin = jnp.sin(ang)[:, :, None, :]
+
+                def rot(t):
+                    t1, t2 = jnp.split(t, 2, axis=-1)
+                    c, si = cos.astype(t.dtype), sin.astype(t.dtype)
+                    return jnp.concatenate(
+                        [t1 * c - t2 * si, t1 * si + t2 * c], axis=-1)
+
+                q, k = rot(q), rot(k)
+            kv_valid = None
+            if pad_offset is not None:
+                kv_valid = jnp.arange(s)[None, :] >= pad_offset[:, None]
+            elif kv_len is not None:
+                # right-padded serving prefill: rows past a request's
+                # true length hold garbage keys — masked here; the
+                # decode loop overwrites their cache rows column by
+                # column before they ever become visible
+                kv_valid = jnp.arange(s)[None, :] < kv_len[:, None]
             if cache_len:
                 # prefill: stash the prompt's K/V so decode steps can
                 # continue from position s without recomputing them
@@ -243,13 +309,14 @@ class _Attention(nn.Module):
                 cv.value = cv.value.at[:, :s].set(v.astype(x.dtype))
             o = _dispatch_attention(q, k, v, impl=self.impl,
                                     causal=self.causal, mesh=self.mesh,
-                                    window=self.window)
+                                    window=self.window,
+                                    kv_valid=kv_valid)
         o = o.reshape(b, s, proj)
         return dense("o_proj", d_model)(o)
 
 
 def _dispatch_attention(q, k, v, *, impl: str, causal: bool, mesh=None,
-                        window: int = 0):
+                        window: int = 0, kv_valid=None):
     """q: (b, s, h, d); k/v may carry FEWER (kv) heads under GQA.
     The single-chip flash path consumes them natively (the kernel
     folds the query group — K/V never materialize at h heads); every
@@ -257,7 +324,10 @@ def _dispatch_attention(q, k, v, *, impl: str, causal: bool, mesh=None,
     consuming matmul on the dot path. ``window`` composes with every
     impl: ring hops apply the exact banded mask at static cross-shard
     offsets (hops wholly below the band skip), Ulysses windows its
-    local full-sequence attention."""
+    local full-sequence attention. ``kv_valid`` (``(b, s)`` bool,
+    padded-batch prefill) always routes to the dense reference path —
+    the sharded/flash kernels take no per-row mask, a documented cost
+    of unequal-length batches (docs/SERVING.md)."""
     mesh = mesh or mesh_lib.current_mesh()
     b, s, h, _ = q.shape
     kvh = k.shape[2]
@@ -268,6 +338,10 @@ def _dispatch_attention(q, k, v, *, impl: str, causal: bool, mesh=None,
             return k, v
         return (jnp.repeat(k, group, axis=2),
                 jnp.repeat(v, group, axis=2))
+    if kv_valid is not None:
+        kr, vr = repeated()
+        return ring_lib.full_attention_reference(
+            q, kr, vr, causal=causal, window=window, kv_valid=kv_valid)
     data_size = mesh_lib.data_parallel_size(mesh)
     sp = mesh.shape.get(mesh_lib.SP, 1)
     tp = mesh.shape.get(mesh_lib.TP, 1)
@@ -381,7 +455,8 @@ class _Block(nn.Module):
     rope_base: float = 10000.0
 
     @nn.compact
-    def __call__(self, x, train: bool, decode_pos=None, cache_len: int = 0):
+    def __call__(self, x, train: bool, decode_pos=None, cache_len: int = 0,
+                 pad_offset=None, kv_len=None):
         h = nn.RMSNorm(name="attn_norm")(x)
         h = _Attention(self.n_heads, self.head_dim, self.attention,
                        self.causal, self.mesh,
@@ -391,7 +466,8 @@ class _Block(nn.Module):
                        lora_alpha=self.lora_alpha,
                        window=self.window,
                        rope_base=self.rope_base, name="attn")(
-            h, train, decode_pos=decode_pos, cache_len=cache_len)
+            h, train, decode_pos=decode_pos, cache_len=cache_len,
+            pad_offset=pad_offset, kv_len=kv_len)
         if self.dropout and train:
             h = nn.Dropout(self.dropout, deterministic=False)(h)
         x = x + h
@@ -485,7 +561,7 @@ class TransformerLM(nn.Module):
 
     @nn.compact
     def __call__(self, tokens, train: bool = False, decode_pos=None,
-                 cache_len: int = 0):
+                 cache_len: int = 0, pad_offset=None, kv_len=None):
         if self.attention not in ATTENTION_IMPLS:
             raise ValueError(f"unknown attention impl: {self.attention!r}")
         d_ff = self.d_ff or 4 * self.d_model
@@ -529,7 +605,7 @@ class TransformerLM(nn.Module):
                                self.lora_rank, self.lora_alpha,
                                self.sliding_window, self.rope_base,
                                name=f"layer_{i}")(
-                x, train, decode_pos, cache_len)
+                x, train, decode_pos, cache_len, pad_offset, kv_len)
             aux_total = aux_total + aux
         x = nn.RMSNorm(name="final_norm")(x)
         head = _LMHead(self.vocab_size, name="lm_head")
@@ -1160,6 +1236,7 @@ class LanguageModel:
         must drop them or a stale compile serves the old config."""
         self._gen_cache_fns = {}
         self._beam_cache_fns = {}
+        self._serve_cache_fns = {}
 
     def _mesh(self):
         return self._mesh_override or mesh_lib.current_mesh()
@@ -1441,6 +1518,15 @@ class LanguageModel:
         Prompts longer than ``max_len`` keep their last ``max_len - 1``
         tokens (sliding-window truncation). Token id 0 is reserved as
         padding by ``next_token_loss`` and is masked out of sampling.
+
+        Unequal-length prompts are accepted (list of lists): rows are
+        left-padded with id 0 so the last prompt tokens align, and the
+        attention mask hides pad columns — each row's continuation is
+        the same tokens a solo ``generate([row])`` call would produce
+        (greedy; sampled runs draw per-position keys from the shared
+        buffer layout). The returned array keeps the leading pad zeros
+        so rows stay rectangular; slice ``row[pad:]`` to recover the
+        solo-shaped sequence.
         """
         self._require_built()
         if num_beams > 1:
@@ -1476,7 +1562,8 @@ class LanguageModel:
                 raise ValueError(f"top_p must be in (0, 1], got {top_p}")
             if top_p == 1.0:
                 top_p = None  # keeps everything — same compile as None
-        prompt, b, s, total = self._prep_prompt(prompt, max_new_tokens)
+        prompt, b, s, total, pad = self._prep_prompt(prompt,
+                                                     max_new_tokens)
         if total <= s:
             # nothing to generate — prefill would clamp buf[:, s] onto
             # the last prompt column and corrupt it
@@ -1485,27 +1572,59 @@ class LanguageModel:
         buf[:, :s] = prompt
         buf = jnp.asarray(buf)
         prefill, decode = self._gen_fns(
-            b, s, total, float(temperature), top_k, top_p)
+            b, s, total, float(temperature), top_k, top_p,
+            padded=pad is not None)
         params = self.params
         key = jax.random.PRNGKey(seed)
         key, sub = jax.random.split(key)
-        buf, cache = prefill(params, buf, sub)
-        if total > s + 1:
-            key, sub = jax.random.split(key)
-            buf, cache = decode(params, cache, buf, sub)
+        if pad is None:
+            buf, cache = prefill(params, buf, sub)
+            if total > s + 1:
+                key, sub = jax.random.split(key)
+                buf, cache = decode(params, cache, buf, sub)
+        else:
+            # unequal-length prompts: left-pad aligned the rows' last
+            # tokens, so the whole batch prefills and decodes in
+            # lockstep — pad rows are hidden by the attention mask
+            pad_j = jnp.asarray(pad)
+            buf, cache = prefill(params, buf, sub, pad_j)
+            if total > s + 1:
+                key, sub = jax.random.split(key)
+                buf, cache = decode(params, cache, buf, sub, pad_j)
         return np.asarray(buf)
 
     def _prep_prompt(self, prompt, max_new_tokens: int):
         """Shared generate/beam preprocessing: 2-D int32 prompt,
         sliding-window truncation of prompts at/over max_len, and the
-        clamped total length."""
+        clamped total length. A list of UNEQUAL-length prompts is
+        left-padded (with the reserved pad id 0) so every row's last
+        prompt token lands in the same column and the batch decodes in
+        lockstep; the returned ``pad`` (``(b,)`` int32, None for
+        rectangular input) carries each row's pad width into the
+        attention masks."""
+        pad = None
+        if isinstance(prompt, (list, tuple)) and len(prompt) > 1 and \
+                all(hasattr(p, "__len__") for p in prompt) and \
+                len({len(p) for p in prompt}) > 1:
+            s = max(len(p) for p in prompt)
+            rows = np.zeros((len(prompt), s), np.int32)
+            pad = np.zeros(len(prompt), np.int32)
+            for i, p in enumerate(prompt):
+                arr = np.asarray(p, dtype=np.int32).reshape(-1)
+                pad[i] = s - arr.shape[0]
+                rows[i, pad[i]:] = arr
+            prompt = rows
         prompt = np.atleast_2d(np.asarray(prompt)).astype(np.int32)
         b, s = prompt.shape
         if s >= self.max_len:
-            prompt = prompt[:, -(self.max_len - 1):]
+            keep = self.max_len - 1
+            prompt = prompt[:, -keep:]
+            if pad is not None:
+                pad = np.minimum(pad - (s - keep), keep).clip(0) \
+                    .astype(np.int32)
             s = prompt.shape[1]
         total = min(self.max_len, s + max_new_tokens)
-        return prompt, b, s, total
+        return prompt, b, s, total, pad
 
     # ------------------------------------------------------------------
     # beam search
@@ -1520,7 +1639,13 @@ class LanguageModel:
         inside the loop). All beams share one fixed length, so raw
         summed log-prob is the ranking (no length penalty needed);
         returns the best beam per sample, shape (b, s+new)."""
-        prompt, b, s, total = self._prep_prompt(prompt, max_new_tokens)
+        prompt, b, s, total, pad = self._prep_prompt(prompt,
+                                                     max_new_tokens)
+        if pad is not None:
+            raise ValueError(
+                "beam search requires equal-length prompts (pass one "
+                "prompt at a time, or use num_beams=1 which "
+                "left-pads)")
         if total <= s:
             return prompt
         fns = self._beam_cache_fns
@@ -1610,22 +1735,62 @@ class LanguageModel:
 
     def _gen_fns(self, b: int, s: int, total: int, temperature: float,
                  top_k: Optional[int] = None,
-                 top_p: Optional[float] = None):
+                 top_p: Optional[float] = None,
+                 padded: bool = False):
         """Jitted (prefill, decode) per (batch, prompt_len, total,
         temperature) — params/cache are arguments, not closures, so
         weights stay device-resident and repeated generate() calls
         reuse the compile. ``decode`` runs the WHOLE continuation in
         one fori_loop program (buf and cache donated into it, updated
-        in place across iterations — no per-token host round trip)."""
+        in place across iterations — no per-token host round trip).
+        ``padded=True`` compiles the left-padded variant: prefill and
+        decode take a per-row ``pad`` width and mask pad rows out of
+        attention (unequal-length prompt batches)."""
         fns = self._gen_cache_fns
         # resolve flash-vs-dot from the PREFILL length, not max_len: a
         # max_len>=2048 model generating from a short prompt attends
         # over only s tokens, below the measured flash crossover
         sig = (b, s, total, temperature, top_k, top_p,
-               self._resolved_attention(s))
+               self._resolved_attention(s), padded)
         if sig in fns:
             return fns[sig]
         module = self._module_for(s)
+
+        if padded:
+            @jax.jit
+            def prefill(params, buf, key, pad):
+                (logits, _), mut = module.apply(
+                    {"params": params}, buf[:, :s], train=False,
+                    cache_len=total, pad_offset=pad,
+                    mutable=["cache"])
+                nxt = self._sample(logits[:, -1], temperature, key,
+                                   top_k, top_p)
+                buf = buf.at[:, s].set(nxt.astype(jnp.int32))
+                return buf, mut["cache"]
+
+            @functools.partial(jax.jit, donate_argnums=(1, 2))
+            def decode(params, cache, buf, key, pad):
+                def body(pos, carry):
+                    buf, cache = carry
+                    tok = jax.lax.dynamic_slice(buf, (0, pos - 1),
+                                                (b, 1))
+                    (logits, _), mut = module.apply(
+                        {"params": params, "cache": cache}, tok,
+                        train=False, decode_pos=pos - 1,
+                        cache_len=total, pad_offset=pad,
+                        mutable=["cache"])
+                    nxt = self._sample(logits[:, 0], temperature,
+                                       jax.random.fold_in(key, pos),
+                                       top_k, top_p)
+                    buf = jax.lax.dynamic_update_slice(
+                        buf, nxt[:, None].astype(jnp.int32), (0, pos))
+                    return buf, mut["cache"]
+
+                return jax.lax.fori_loop(s + 1, total, body,
+                                         (buf, cache))
+
+            fns[sig] = (prefill, decode)
+            return fns[sig]
 
         @jax.jit
         def prefill(params, buf, key):
@@ -1661,6 +1826,97 @@ class LanguageModel:
 
         fns[sig] = (prefill, decode)
         return fns[sig]
+
+    # ------------------------------------------------------------------
+    # resident serving (services/serving.py)
+    # ------------------------------------------------------------------
+    def serve_fns(self, slots: int, cache_len: int, temperature: float,
+                  top_k: Optional[int] = None,
+                  top_p: Optional[float] = None):
+        """Jitted continuous-batching kernel set for a serving session
+        (docs/SERVING.md): ``(step, prefill_for, join)``.
+
+        - ``step(params, cache, tok (slots,1), col (slots,), keys
+          (slots,2))`` advances EVERY slot one token: each row attends
+          its own cache prefix at its own position ``col[i]`` and
+          samples with its own fold_in(key_i, col_i+1) — exactly the
+          key/position schedule a solo ``generate()`` row follows, so
+          a slot's token stream is bit-identical to decoding that
+          request alone. Idle slots compute garbage (finite — their
+          mask sees a valid self position) that the caller discards.
+        - ``prefill_for(s)`` returns the jitted batch-1 prompt prefill
+          for prompt length ``s`` (cached per length): fills a
+          (1, cache_len) layer cache and samples the first token.
+        - ``join(cache, pcache, slot)`` scatters a prefill cache into
+          the session cache at ``slot`` (traced index — one compile
+          covers every slot, so slot reuse never recompiles).
+        """
+        fns = self._serve_cache_fns
+        sig = (slots, cache_len, temperature, top_k, top_p)
+        if sig not in fns:
+            fns[sig] = self._build_serve_fns(slots, cache_len,
+                                             temperature, top_k, top_p)
+        return fns[sig]
+
+    def _build_serve_fns(self, slots: int, cache_len: int,
+                         temperature: float, top_k: Optional[int],
+                         top_p: Optional[float]):
+        module = self._module_for(1)
+        sample = self._sample
+
+        @functools.partial(jax.jit, donate_argnums=(1,))
+        def step(params, cache, tok, col, keys):
+            (logits, _), mut = module.apply(
+                {"params": params, "cache": cache}, tok, train=False,
+                decode_pos=col, cache_len=cache_len,
+                mutable=["cache"])
+            # per-row key schedule: fold_in(row_key, buffer_position)
+            # where the position being WRITTEN is col+1 — matching the
+            # solo decode loop's fold_in(key, pos) at pos = col + 1
+            ks = jax.vmap(jax.random.fold_in)(keys, col + 1)
+            nxt = jax.vmap(
+                lambda lg, k: sample(lg[None], temperature, k,
+                                     top_k, top_p)[0])(logits[:, 0], ks)
+            return nxt.astype(jnp.int32), mut["cache"]
+
+        prefill_cache: Dict[int, Any] = {}
+
+        def prefill_for(s: int):
+            if s in prefill_cache:
+                return prefill_cache[s]
+            pmod = self._module_for(s)
+
+            @jax.jit
+            def prefill(params, tokens, key):
+                (logits, _), mut = pmod.apply(
+                    {"params": params}, tokens, train=False,
+                    cache_len=cache_len, mutable=["cache"])
+                nxt = sample(logits[:, -1], temperature, key,
+                             top_k, top_p)
+                return nxt.astype(jnp.int32), mut["cache"]
+
+            prefill_cache[s] = prefill
+            return prefill
+
+        @jax.jit
+        def join(cache, pcache, slot):
+            return jax.tree_util.tree_map(
+                lambda sc, pc: sc.at[slot].set(pc[0]), cache, pcache)
+
+        return step, prefill_for, join
+
+    def serve_cache(self, slots: int, cache_len: int):
+        """Zero-initialized per-layer KV cache for a serving session
+        (the shape ``init`` would produce for a (slots, ·) decode)."""
+        module = self._module_for(1)
+        shapes = jax.eval_shape(
+            lambda: module.init(
+                jax.random.PRNGKey(0),
+                jnp.zeros((slots, 1), jnp.int32), train=False,
+                decode_pos=jnp.zeros((slots,), jnp.int32),
+                cache_len=cache_len)["cache"])
+        return jax.tree_util.tree_map(
+            lambda sd: jnp.zeros(sd.shape, sd.dtype), shapes)
 
     def _require_built(self) -> None:
         if self.params is None:

@@ -788,3 +788,87 @@ def reference_attention(q, k, v, causal: bool = False,
     from learningorchestra_tpu.parallel.ring import full_attention_reference
 
     return full_attention_reference(q, k, v, causal=causal, scale=scale)
+
+
+# ---------------------------------------------------------------------------
+# Single-token decode attention (the serving plane's hot op).
+#
+# These are deliberately NOT pallas kernels: the serving bit-identity
+# contract (docs/SERVING.md) requires the continuous batcher to
+# reproduce the solo decode loop's float32 reduction order exactly,
+# so the math below mirrors models/transformer.py's decode branch
+# einsum-for-einsum. A fused single-token kernel saves little anyway —
+# q is one row, the op is bandwidth-bound on the KV cache read.
+
+
+def decode_attention(q: jax.Array, k_cache: jax.Array,
+                     v_cache: jax.Array, col: jax.Array, *,
+                     pad_offset: Optional[jax.Array] = None,
+                     window: int = 0,
+                     scale: Optional[float] = None) -> jax.Array:
+    """One-token GQA attention against a per-row cache position.
+
+    ``q`` is ``(b, 1, n_heads, d)``, ``k_cache``/``v_cache`` are
+    ``(b, L, kv_heads, d)``, ``col`` is ``(b,)`` — each batch row
+    attends its own prefix ``[pad_offset[i], col[i]]`` of the cache
+    (continuous batching: rows sit at different decode positions).
+    ``pad_offset`` (``(b,)``, optional) hides left-pad rows;
+    ``window > 0`` restricts to the last ``window`` positions. Masked
+    scores take ``NEG_INF`` whose softmax term underflows to exact
+    zero, so a row's output bits match a solo batch-1 decode."""
+    b, s, h, d = q.shape
+    kv = k_cache.shape[2]
+    group = h // kv
+    qg = q.astype(jnp.float32).reshape(b, s, kv, group, d)
+    scores = jnp.einsum(
+        "bqhgd,bkhd->bqhgk", qg, k_cache.astype(jnp.float32),
+        preferred_element_type=jnp.float32)
+    # DIVIDE by sqrt(d) (not multiply by the reciprocal): x/s and
+    # x*(1/s) round differently, and the solo decode branch in
+    # models/transformer.py divides — the bit-identity contract hangs
+    # on matching it exactly
+    scores = scores * scale if scale is not None \
+        else scores / (d ** 0.5)
+    length = k_cache.shape[1]
+    positions = jnp.arange(length)
+    visible = positions[None, :] <= col[:, None]
+    if pad_offset is not None:
+        visible = visible & (positions[None, :] >= pad_offset[:, None])
+    if window > 0:
+        visible = visible & (positions[None, :] >
+                             (col - window)[:, None])
+    scores = jnp.where(visible[:, None, None, None, :], scores,
+                       NEG_INF)
+    p = jax.nn.softmax(scores, axis=-1)
+    o = jnp.einsum("bqhgk,bkhd->bqhgd", p,
+                   v_cache.astype(jnp.float32))
+    return o.reshape(b, s, h, d).astype(q.dtype)
+
+
+def paged_decode_attention(q: jax.Array, k_pool: jax.Array,
+                           v_pool: jax.Array,
+                           block_tables: jax.Array,
+                           col: jax.Array, *,
+                           pad_offset: Optional[jax.Array] = None,
+                           window: int = 0,
+                           scale: Optional[float] = None) -> jax.Array:
+    """:func:`decode_attention` over a paged KV pool (vLLM layout).
+
+    ``k_pool``/``v_pool`` are ``(pages, page_len, kv_heads, d)``;
+    ``block_tables`` (``(b, n_pages)`` int) maps each request's
+    logical cache to physical pages, so a request joining a serving
+    slot reuses whatever pages are free — no recompile, no copy of
+    other requests' state. Pages are gathered into the contiguous
+    ``(b, n_pages * page_len, kv, d)`` layout and fed through the
+    SAME reduction as :func:`decode_attention`, keeping the gathered
+    path bit-identical to the contiguous one (tested in
+    tests/test_ops.py / test_serving.py)."""
+    b = block_tables.shape[0]
+    n_pages = block_tables.shape[1]
+    page_len, kv, d = k_pool.shape[1], k_pool.shape[2], k_pool.shape[3]
+    k = jnp.take(k_pool, block_tables, axis=0).reshape(
+        b, n_pages * page_len, kv, d)
+    v = jnp.take(v_pool, block_tables, axis=0).reshape(
+        b, n_pages * page_len, kv, d)
+    return decode_attention(q, k, v, col, pad_offset=pad_offset,
+                            window=window, scale=scale)

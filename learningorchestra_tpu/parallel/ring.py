@@ -264,11 +264,16 @@ def ring_attention_sharded(q: jax.Array, k: jax.Array, v: jax.Array,
 
 def full_attention_reference(q, k, v, causal: bool = False,
                              scale: Optional[float] = None,
-                             window: int = 0) -> jax.Array:
+                             window: int = 0,
+                             kv_valid=None) -> jax.Array:
     """Plain full-softmax attention (the oracle ring_attention must
     match; also the single-device fallback). ``window=W`` with
     ``causal`` restricts query p to keys in [p-W+1, p] (sliding
-    window)."""
+    window). ``kv_valid`` (bool, ``(b, sk)``) additionally masks
+    per-batch-row key positions — padded prompt slots in batched
+    prefill (left-pad generate, serving bucket prefill). NEG_INF
+    scores underflow to exact zero under softmax, so a masked key
+    never perturbs the unmasked rows' bits."""
     d = q.shape[-1]
     if window < 0:
         raise ValueError(f"window must be >= 0, got {window}")
@@ -286,6 +291,8 @@ def full_attention_reference(q, k, v, causal: bool = False,
             mask = mask & (jnp.arange(sk)[None, :] >
                            jnp.arange(sq)[:, None] - window)
         scores = jnp.where(mask[None, :, None, :], scores, NEG_INF)
+    if kv_valid is not None:
+        scores = jnp.where(kv_valid[:, None, None, :], scores, NEG_INF)
     p = jax.nn.softmax(scores, axis=-1)
     return jnp.einsum("bqhk,bkhd->bqhd", p,
                       v.astype(jnp.float32)).astype(q.dtype)

@@ -59,6 +59,10 @@ class ServiceContext:
         self.features = FeatureCache(
             self.catalog, host_bytes=self.config.param_cache_bytes)
         self.params = ParameterResolver(self)
+        # resident serving plane (docs/SERVING.md): sessions share the
+        # JobManager's slice allocator via ServingLease handles
+        from learningorchestra_tpu.services.serving import ServingManager
+        self.serving = ServingManager(self)
         _wire_xla_cache(self.config)
         # callbacks fired by the pod guard when a degraded pod's
         # heartbeats resume (the Api registers worker-lost requeue)
@@ -77,6 +81,9 @@ class ServiceContext:
     def close(self) -> None:
         if self._pod_guard is not None:
             self._pod_guard.set()
+        # serving sessions first: they hold leases on the mesh the job
+        # manager's shutdown may want to drain
+        self.serving.close()
         self.jobs.shutdown()
         self.catalog.close()
 

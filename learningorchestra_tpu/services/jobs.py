@@ -172,6 +172,14 @@ class JobManager:
         slicing is enabled."""
         return self._mesh.lease(pool, cancel=cancel, footprint=footprint)
 
+    @property
+    def slice_lease(self):
+        """The shared SliceLease allocator — serving sessions wrap it
+        in a ``ServingLease`` so resident sessions and batch gang jobs
+        contend through ONE fair queue (a separate allocator would let
+        both sides believe they own the whole mesh)."""
+        return self._mesh
+
     def mesh_served(self) -> Dict[str, float]:
         """Cumulative mesh seconds per pool (observability)."""
         return self._mesh.served()

@@ -364,6 +364,17 @@ class SliceLease:
         with self._cv:
             return any(w.pool != pool for w in self._waiters)
 
+    def contended(self) -> bool:
+        """ANY waiter is queued (waiters still queued are exactly the
+        currently-ungrantable ones — ``_grant_next`` runs at every
+        state change). Long-lived holders (serving sessions) yield on
+        this broader condition: unlike a batch job, a serving session
+        never finishes, so a same-pool waiter behind it — another
+        serving session — would starve forever under the
+        same-pool-FIFO rule of :meth:`contended_by_other`."""
+        with self._cv:
+            return bool(self._waiters)
+
     def served(self) -> Dict[str, float]:
         """Per-pool cumulative mesh seconds (observability)."""
         with self._cv:
@@ -445,6 +456,138 @@ class SliceLease:
             if held[0]:
                 self.release(pool, time.monotonic() - start[0],
                              grant=current[0])
+
+
+class ServingLease:
+    """Long-lived slice grant for a resident serving session
+    (docs/SERVING.md). Batch jobs hold the mesh for the span of one
+    ``lease()`` context; a serving session holds its slice for the
+    session's LIFETIME — so it goes through the same
+    :class:`SliceLease` allocator (pool ``"serving"``) and, under the
+    default ``"preempt"`` policy, periodically offers the slice back:
+
+    - between decode/micro-batch iterations (and on an idle tick) the
+      session calls :meth:`maybe_yield`; if ANY other waiter exists —
+      a batch job from another pool or another serving session — the
+      session releases its grant and blockingly re-queues through the
+      fair policy. Gang jobs need EVERY device free, so this is what
+      guarantees a resident session can never deadlock a full-mesh
+      batch job; yielding to same-pool waiters too is what lets
+      multiple sessions time-share an oversubscribed mesh instead of
+      the second ``create`` hanging forever behind a holder that
+      never finishes.
+    - the re-acquire is NOT ``exact=``: the session may come back on a
+      different device block, so :meth:`maybe_yield` returns True and
+      the session re-pins its params/caches for the new slice.
+
+    ``"hold"`` disables yielding (a latency-critical session keeps its
+    slice until deleted — operator opt-in, documented as able to
+    starve gang jobs until teardown).
+    """
+
+    def __init__(self, slices: SliceLease, pool: str = "serving",
+                 policy: str = "preempt",
+                 footprint: Optional[Dict[str, Any]] = None):
+        self._slices = slices
+        self._pool = pool
+        self._policy = policy if policy in ("preempt", "hold") \
+            else "preempt"
+        self._footprint = dict(footprint) if footprint else None
+        self._grant: Optional[Grant] = None
+        self._acquired = 0.0
+        self._lock = threading.Lock()
+        self.yields = 0
+        self.wait_seconds = 0.0
+
+    @property
+    def pool(self) -> str:
+        return self._pool
+
+    @property
+    def policy(self) -> str:
+        return self._policy
+
+    @property
+    def devices(self) -> Optional[Tuple[int, ...]]:
+        """The currently-granted device slice (None = full mesh /
+        counting mode), or None while yielded."""
+        with self._lock:
+            return self._grant.devices if self._grant else None
+
+    def held(self) -> bool:
+        with self._lock:
+            return self._grant is not None
+
+    def acquire(self, cancel: Optional["preempt.CancelToken"] = None,
+                ) -> Optional[Tuple[int, ...]]:
+        """Blockingly acquire the session's slice through the fair
+        queue. Returns the granted device indices (None = full mesh)."""
+        grant = self._slices.acquire(self._pool, cancel,
+                                     footprint=self._footprint)
+        with self._lock:
+            self._grant = grant
+            self._acquired = time.monotonic()
+            self.wait_seconds += grant.wait_seconds
+        return grant.devices
+
+    def contended(self) -> bool:
+        """Some other waiter wants devices this session is sitting
+        on (any pool — including another serving session's)."""
+        return self._slices.contended()
+
+    def maybe_yield(self,
+                    cancel: Optional["preempt.CancelToken"] = None,
+                    ) -> bool:
+        """Yield the slice to waiting batch jobs and re-acquire
+        (``"preempt"`` policy only). Returns True when a hand-off
+        actually happened — the caller must then treat its device
+        placement as invalid and re-pin on :attr:`devices`."""
+        if self._policy != "preempt":
+            return False
+        if not self._slices.contended():
+            return False
+        with self._lock:
+            grant = self._grant
+            if grant is None:
+                return False
+            self._slices.release(
+                self._pool, time.monotonic() - self._acquired,
+                grant=grant)
+            self._grant = None
+        # re-queue OUTSIDE the lock: the wait can be long (the batch
+        # job runs to completion) and stats()/devices must stay
+        # readable meanwhile
+        grant = self._slices.acquire(self._pool, cancel,
+                                     footprint=self._footprint)
+        with self._lock:
+            self._grant = grant
+            self._acquired = time.monotonic()
+            self.wait_seconds += grant.wait_seconds
+            self.yields += 1
+        return True
+
+    def release(self) -> None:
+        """Give the slice back for good (session teardown)."""
+        with self._lock:
+            grant = self._grant
+            if grant is None:
+                return
+            self._grant = None
+            held = time.monotonic() - self._acquired
+        self._slices.release(self._pool, held, grant=grant)
+
+    def stats(self) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                "pool": self._pool,
+                "policy": self._policy,
+                "held": self._grant is not None,
+                "devices": list(self._grant.devices)
+                if self._grant is not None and
+                self._grant.devices is not None else None,
+                "yields": self.yields,
+                "waitSeconds": round(self.wait_seconds, 6),
+            }
 
 
 # Backwards-compatible alias: the counting behavior of the historical

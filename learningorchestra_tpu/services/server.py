@@ -67,7 +67,7 @@ from learningorchestra_tpu.services.model_service import ModelService
 
 EXECUTION_VERBS = ("train", "tune", "evaluate", "predict")
 SERVICES = ("dataset", "model", "transform", "explore", "tune", "train",
-            "evaluate", "predict", "builder", "function")
+            "evaluate", "predict", "builder", "function", "serve")
 
 
 class Api:
@@ -324,6 +324,9 @@ class Api:
         # is always cheap
         from learningorchestra_tpu.runtime import health as health_lib
         out["trainingHealth"] = health_lib.health_stats()
+        # resident serving plane (docs/SERVING.md): session counts,
+        # admission rejects, decode throughput and p50/p99 latency
+        out["serving"] = self.ctx.serving.stats()
         return out
 
     def metrics_prometheus(self) -> bytes:
@@ -443,6 +446,31 @@ class Api:
             f"lo_checkpoints_quarantined_total "
             f"{training_health.get('quarantined', 0)}",
         ]
+        serving = m["serving"]
+        lines += [
+            "# TYPE lo_serving_sessions gauge",
+            f"lo_serving_sessions {serving['sessions']}",
+            "# TYPE lo_serving_requests_total counter",
+            f"lo_serving_requests_total {serving['requestsTotal']}",
+            "# TYPE lo_serving_rejected_total counter",
+            f"lo_serving_rejected_total {serving['rejectedTotal']}",
+            "# TYPE lo_serving_tokens_total counter",
+            f"lo_serving_tokens_total {serving['tokensTotal']}",
+            "# TYPE lo_serving_lease_yields_total counter",
+            f"lo_serving_lease_yields_total {serving['leaseYields']}",
+        ]
+        for metric, value_of in (
+                ("lo_serving_latency_p50_ms",
+                 lambda s: s["latency"]["p50Ms"]),
+                ("lo_serving_latency_p99_ms",
+                 lambda s: s["latency"]["p99Ms"]),
+                ("lo_serving_queue_depth",
+                 lambda s: s["queueDepth"])):
+            lines.append(f"# TYPE {metric} gauge")
+            for sess in serving["bySession"]:
+                lines.append(
+                    f'{metric}{{model="{esc(sess["model"])}"}} '
+                    f'{value_of(sess)}')
         return ("\n".join(lines) + "\n").encode()
 
     # ------------------------------------------------------------------
@@ -464,6 +492,11 @@ class Api:
             return self._observe(parts, params)
         if parts and parts[0] == "profile":
             return self._profile(method, body or {})
+        if parts and parts[0] == "serve":
+            # serving sessions address the MODEL in the path (the
+            # session IS the resource), so the generic
+            # /{service}/{tool}/{name} dispatch doesn't fit
+            return self._serve(method, parts, body or {})
         if len(parts) < 2 or parts[0] not in SERVICES:
             return 404, {"result": "unknown route"}, "application/json"
         service, tool = parts[0], parts[1]
@@ -485,6 +518,40 @@ class Api:
                 raise V.HttpError(V.HTTP_NOT_ACCEPTABLE, "missing name")
             return self._delete(service, tool, name)
         return 405, {"result": "unsupported method"}, "application/json"
+
+    # ------------------------------------------------------------------
+    def _serve(self, method: str, parts: list,
+               body: Dict[str, Any]) -> Tuple[int, Any, str]:
+        """Resident serving plane (docs/SERVING.md):
+
+        - ``POST /serve/{model}``            create a session (201)
+        - ``POST /serve/{model}/predict``    synchronous inference
+        - ``GET  /serve`` / ``/serve/{model}``  stats
+        - ``DELETE /serve/{model}``          teardown
+        """
+        serving = self.ctx.serving
+        if method == "GET":
+            if len(parts) == 1:
+                return (200, {"result": serving.list_sessions()},
+                        "application/json")
+            if len(parts) == 2:
+                return (200, serving.session_stats(parts[1]),
+                        "application/json")
+        elif method == "POST":
+            if len(parts) == 2:
+                return (V.HTTP_CREATED, serving.create(parts[1], body),
+                        "application/json")
+            if len(parts) == 3 and parts[2] == "predict":
+                return (200, serving.predict(parts[1], body),
+                        "application/json")
+        elif method == "DELETE":
+            if len(parts) == 2:
+                return (200, serving.delete(parts[1]),
+                        "application/json")
+        else:
+            return (405, {"result": "unsupported method"},
+                    "application/json")
+        return 404, {"result": "unknown route"}, "application/json"
 
     # ------------------------------------------------------------------
     def _health(self) -> Dict[str, Any]:

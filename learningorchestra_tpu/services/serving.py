@@ -1,0 +1,664 @@
+"""Resident serving plane: continuous-batched LM decode and
+shape-bucketed predict behind long-lived serving leases.
+
+The batch path (``POST /model/train`` then poll) pays catalog writes,
+job scheduling, artifact (re)loads and a mesh gang-acquire on EVERY
+request. A serving session pays them ONCE: the fitted model stays
+resident (params pinned in the HBM arena), the slice is held under a
+``ServingLease`` (services/scheduler.py) that periodically yields to
+batch gang jobs, and requests flow through an admission-controlled
+bounded queue straight into compiled kernels.
+
+Two session kinds (docs/SERVING.md):
+
+- :class:`LMServingSession` — iteration-level continuous batching
+  (Orca-style): a fixed-width slot cache decodes every in-flight
+  request one token per step; requests join at any token boundary via
+  a per-length prefill scattered into their slot and leave the moment
+  they finish. Slot reuse never recompiles (the slot index is a traced
+  argument), and each slot's token stream is bit-identical to decoding
+  that request alone through ``LanguageModel.generate`` (tested).
+- :class:`BucketServingSession` — shape-bucketed micro-batching for
+  classifiers/estimators: a burst of n queued requests pads to the
+  smallest precompiled bucket >= n and runs ONE ``predict`` call, so
+  warm predicts never retrace and per-request latency is amortized.
+
+Admission control: a full queue rejects with 429 (back off + retry), a
+closed/tearing-down session with 503. p50/p99 latency per session is
+exported through ``/metrics``.
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+import time
+from typing import Any, Deque, Dict, List, Optional
+
+import numpy as np
+
+from learningorchestra_tpu.services import validators as V
+from learningorchestra_tpu.services.scheduler import ServingLease
+
+_IDLE_TICK_SECONDS = 0.05  # lease-yield poll cadence when no traffic
+
+
+class LatencyTracker:
+    """Ring buffer of request latencies -> p50/p99 snapshot. Bounded
+    (last 2048 requests) so a long-lived session's metrics reflect
+    current behavior, not its lifetime average."""
+
+    def __init__(self, maxlen: int = 2048):
+        self._lat: Deque[float] = collections.deque(maxlen=maxlen)
+        self._lock = threading.Lock()
+        self.count = 0
+
+    def record(self, seconds: float) -> None:
+        with self._lock:
+            self._lat.append(seconds)
+            self.count += 1
+
+    def snapshot(self) -> Dict[str, float]:
+        with self._lock:
+            lat = sorted(self._lat)
+            count = self.count
+        if not lat:
+            return {"count": 0, "p50Ms": 0.0, "p99Ms": 0.0}
+        p50 = lat[int(0.50 * (len(lat) - 1))]
+        p99 = lat[int(0.99 * (len(lat) - 1))]
+        return {"count": count, "p50Ms": round(p50 * 1e3, 3),
+                "p99Ms": round(p99 * 1e3, 3)}
+
+
+class _Request:
+    __slots__ = ("payload", "event", "result", "error", "queued_at")
+
+    def __init__(self, payload: Dict[str, Any]):
+        self.payload = payload
+        self.event = threading.Event()
+        self.result: Optional[Dict[str, Any]] = None
+        self.error: Optional[V.HttpError] = None
+        self.queued_at = time.monotonic()
+
+    def finish(self, result: Dict[str, Any]) -> None:
+        self.result = result
+        self.event.set()
+
+    def fail(self, error: V.HttpError) -> None:
+        self.error = error
+        self.event.set()
+
+
+class _SessionBase:
+    """Queue + worker-thread + lease skeleton shared by both session
+    kinds. Subclasses implement :meth:`_serve_once` (drain some queued
+    work, return True if anything was done)."""
+
+    kind = "base"
+
+    def __init__(self, name: str, ctx, lease: ServingLease):
+        self.name = name
+        self._ctx = ctx
+        self._lease = lease
+        self._queue: Deque[_Request] = collections.deque()
+        self._depth = int(ctx.config.serve_queue_depth)
+        self._cv = threading.Condition()
+        self._closed = False
+        self.latency = LatencyTracker()
+        self.requests_total = 0
+        self.rejected_total = 0
+        self.created_at = time.time()
+        self._thread = threading.Thread(
+            target=self._run, name=f"serving-{name}", daemon=True)
+
+    def start(self) -> None:
+        self._thread.start()
+
+    # -- request side --------------------------------------------------
+    def submit(self, payload: Dict[str, Any],
+               timeout: Optional[float] = None) -> Dict[str, Any]:
+        req = _Request(payload)
+        with self._cv:
+            if self._closed:
+                raise V.HttpError(V.HTTP_UNAVAILABLE,
+                                  f"serving session {self.name} is "
+                                  f"shutting down")
+            if len(self._queue) >= self._depth:
+                self.rejected_total += 1
+                raise V.HttpError(
+                    V.HTTP_TOO_MANY_REQUESTS,
+                    f"serving queue full ({self._depth} requests "
+                    f"queued) — retry with backoff")
+            self.requests_total += 1
+            self._queue.append(req)
+            self._cv.notify_all()
+        if timeout is None:
+            # 0 = no gateway deadline configured -> wait indefinitely
+            # (the client's socket timeout still bounds the call)
+            timeout = self._ctx.config.request_timeout_seconds or None
+        if not req.event.wait(timeout):
+            raise V.HttpError(V.HTTP_UNAVAILABLE,
+                              f"request timed out after {timeout}s "
+                              f"(session overloaded or preempted)")
+        if req.error is not None:
+            raise req.error
+        self.latency.record(time.monotonic() - req.queued_at)
+        assert req.result is not None
+        return req.result
+
+    # -- worker side ---------------------------------------------------
+    def _run(self) -> None:
+        while True:
+            with self._cv:
+                if self._closed:
+                    break
+                if not self._have_work():
+                    self._cv.wait(timeout=_IDLE_TICK_SECONDS)
+                    if self._closed:
+                        break
+            try:
+                # yield the slice to waiting batch gang jobs between
+                # iterations (and on every idle tick) — this is the
+                # no-deadlock guarantee: a gang acquire needs EVERY
+                # device free, and a preempt-policy session never
+                # holds its grant across a contended boundary
+                if self._lease.maybe_yield():
+                    self._on_reacquired()
+                self._serve_once()
+            except Exception as exc:  # noqa: BLE001 — fail requests, not the thread
+                self._fail_all(V.HttpError(
+                    V.HTTP_UNAVAILABLE, f"serving step failed: {exc}"))
+
+    def _have_work(self) -> bool:
+        return bool(self._queue)
+
+    def _serve_once(self) -> bool:
+        raise NotImplementedError
+
+    def _on_reacquired(self) -> None:
+        """Hook after a lease yield/re-acquire cycle (re-pin params)."""
+
+    def _fail_all(self, error: V.HttpError) -> None:
+        with self._cv:
+            pending = list(self._queue)
+            self._queue.clear()
+        for req in pending:
+            req.fail(error)
+
+    def close(self) -> None:
+        with self._cv:
+            if self._closed:
+                return
+            self._closed = True
+            self._cv.notify_all()
+        self._thread.join(timeout=30.0)
+        self._fail_all(V.HttpError(
+            V.HTTP_UNAVAILABLE,
+            f"serving session {self.name} was deleted"))
+        self._lease.release()
+
+    def stats(self) -> Dict[str, Any]:
+        with self._cv:
+            depth = len(self._queue)
+        out = {
+            "model": self.name,
+            "kind": self.kind,
+            "queueDepth": depth,
+            "queueBound": self._depth,
+            "requestsTotal": self.requests_total,
+            "rejectedTotal": self.rejected_total,
+            "uptimeSeconds": round(time.time() - self.created_at, 3),
+            "latency": self.latency.snapshot(),
+            "lease": self._lease.stats(),
+        }
+        return out
+
+
+class LMServingSession(_SessionBase):
+    """Iteration-level continuous batcher over a fixed slot cache.
+
+    Every worker iteration: (1) admit queued requests into free slots
+    (per-length prefill, cache scattered into the slot by a traced
+    index — no recompile per slot), (2) run ONE compiled ``step`` that
+    advances every active slot a token, (3) retire finished requests.
+    Per-slot key/position bookkeeping replays the exact schedule
+    ``LanguageModel.generate`` uses, so the emitted tokens are
+    bit-identical to a solo decode of the same request (tested in
+    tests/test_serving.py)."""
+
+    kind = "lm"
+
+    def __init__(self, name: str, ctx, lease: ServingLease, model,
+                 slots: int, cache_len: int, temperature: float,
+                 top_k: Optional[int], top_p: Optional[float]):
+        super().__init__(name, ctx, lease)
+        self._model = model
+        self.slots = int(slots)
+        self.cache_len = int(cache_len)
+        self.temperature = float(temperature)
+        self.top_k = top_k
+        self.top_p = top_p
+        self._step, self._prefill_for, self._join = model.serve_fns(
+            self.slots, self.cache_len, self.temperature, top_k, top_p)
+        self._cache = model.serve_cache(self.slots, self.cache_len)
+        self.tokens_total = 0
+        # host-side slot state (device state is the KV cache)
+        self._tok = np.zeros((self.slots, 1), np.int32)
+        self._col = np.zeros((self.slots,), np.int32)
+        self._keys = np.zeros((self.slots, 2), np.uint32)
+        self._slot_req: List[Optional[_Request]] = [None] * self.slots
+        self._slot_out: List[List[int]] = [[] for _ in range(self.slots)]
+        self._slot_left = np.zeros((self.slots,), np.int64)
+        self._slot_t0 = [0.0] * self.slots
+        # pin params in the HBM arena for the session's lifetime —
+        # tagged with the model name so a retrain invalidates the pin
+        self._params_entry = self._pin_params()
+
+    def _pin_params(self):
+        import jax
+
+        from learningorchestra_tpu.runtime import arena as arena_lib
+
+        leaves = jax.tree_util.tree_leaves(self._model.params)
+        flat = {f"leaf{i}": a for i, a in enumerate(leaves)}
+        return arena_lib.get_default_arena().get_or_put(
+            ("serving", self.name, id(self)), lambda: flat,
+            tags=(self.name,))
+
+    def _on_reacquired(self) -> None:
+        # the slice changed hands while we were yielded: re-pin so
+        # arena residency accounting follows the live grant
+        self._params_entry.release()
+        self._params_entry = self._pin_params()
+
+    def _have_work(self) -> bool:
+        return bool(self._queue) or any(
+            r is not None for r in self._slot_req)
+
+    def validate_request(self, payload: Dict[str, Any]) -> None:
+        prompt = payload.get("prompt")
+        if not isinstance(prompt, (list, tuple)) or not prompt or \
+                not all(isinstance(t, int) and not isinstance(t, bool)
+                        for t in prompt):
+            raise V.HttpError(
+                V.HTTP_NOT_ACCEPTABLE,
+                f"{V.MESSAGE_INVALID_FIELD}: prompt must be a non-empty "
+                f"list of token ids")
+        new = V.valid_positive_int(payload.get("maxNewTokens"),
+                                   "maxNewTokens", default=32)
+        if new >= self.cache_len:
+            raise V.HttpError(
+                V.HTTP_NOT_ACCEPTABLE,
+                f"{V.MESSAGE_INVALID_FIELD}: maxNewTokens={new} leaves "
+                f"no prompt room in cacheLen={self.cache_len}")
+        seed = payload.get("seed", 0)
+        if isinstance(seed, bool) or not isinstance(seed, int):
+            raise V.HttpError(
+                V.HTTP_NOT_ACCEPTABLE,
+                f"{V.MESSAGE_INVALID_FIELD}: seed must be an integer, "
+                f"got {seed!r}")
+
+    def _admit(self, slot: int, req: _Request) -> None:
+        import jax.numpy as jnp
+        import jax.random as jr
+
+        payload = req.payload
+        prompt = list(payload["prompt"])
+        new = int(payload.get("maxNewTokens") or 32)
+        seed = int(payload.get("seed", 0))
+        # same sliding-window truncation generate() applies, bounded
+        # by the session cache instead of max_len
+        keep = self.cache_len - new
+        if len(prompt) > keep:
+            prompt = prompt[-keep:]
+        s = len(prompt)
+        # generate()'s key schedule: split once for the prefill sample,
+        # split again for the decode loop's fold_in base
+        key = jr.PRNGKey(seed)
+        key, sub_prefill = jr.split(key)
+        key, sub_decode = jr.split(key)
+        prefill = self._prefill_for(s)
+        tokens = jnp.asarray(np.asarray(prompt, np.int32)[None, :])
+        nxt, pcache = prefill(self._model.params, tokens, sub_prefill)
+        self._cache = self._join(self._cache, pcache, slot)
+        first = int(nxt[0])
+        self._slot_req[slot] = req
+        self._slot_out[slot] = [first]
+        self._slot_left[slot] = new - 1
+        self._slot_t0[slot] = time.monotonic()
+        self._tok[slot, 0] = first
+        self._col[slot] = s  # next step attends positions <= s
+        self._keys[slot] = np.asarray(sub_decode)
+        self.tokens_total += 1
+        if self._slot_left[slot] <= 0:
+            self._retire(slot)
+
+    def _retire(self, slot: int) -> None:
+        req = self._slot_req[slot]
+        self._slot_req[slot] = None
+        if req is None:
+            return
+        req.finish({
+            "tokens": [int(t) for t in self._slot_out[slot]],
+            "decodeSeconds": round(
+                time.monotonic() - self._slot_t0[slot], 6),
+        })
+        self._slot_out[slot] = []
+
+    def _serve_once(self) -> bool:
+        import jax.numpy as jnp
+
+        # (1) admit — join at the token boundary, one slot per request
+        admitted = False
+        while True:
+            with self._cv:
+                free = [i for i, r in enumerate(self._slot_req)
+                        if r is None]
+                if not free or not self._queue:
+                    break
+                req = self._queue.popleft()
+            try:
+                self._admit(free[0], req)
+                admitted = True
+            except V.HttpError as exc:
+                req.fail(exc)
+            except Exception as exc:  # noqa: BLE001
+                req.fail(V.HttpError(V.HTTP_UNAVAILABLE,
+                                     f"prefill failed: {exc}"))
+        active = [i for i, r in enumerate(self._slot_req)
+                  if r is not None]
+        if not active:
+            return admitted
+        # (2) one continuous-batch step: every active slot advances a
+        # token; idle slots compute masked garbage that is discarded
+        nxt, self._cache = self._step(
+            self._model.params, self._cache, jnp.asarray(self._tok),
+            jnp.asarray(self._col), jnp.asarray(self._keys))
+        nxt = np.asarray(nxt)
+        # (3) harvest + retire
+        for slot in active:
+            tok = int(nxt[slot])
+            self._slot_out[slot].append(tok)
+            self._slot_left[slot] -= 1
+            self.tokens_total += 1
+            self._tok[slot, 0] = tok
+            self._col[slot] += 1
+            if self._slot_left[slot] <= 0 or \
+                    self._col[slot] >= self.cache_len - 1:
+                self._retire(slot)
+        return True
+
+    def close(self) -> None:
+        super().close()
+        self._params_entry.release()
+
+    def stats(self) -> Dict[str, Any]:
+        out = super().stats()
+        out.update({
+            "slots": self.slots,
+            "activeSlots": sum(1 for r in self._slot_req
+                               if r is not None),
+            "cacheLen": self.cache_len,
+            "tokensTotal": self.tokens_total,
+            "temperature": self.temperature,
+        })
+        return out
+
+
+class BucketServingSession(_SessionBase):
+    """Shape-bucketed micro-batcher for ``predict``-style models.
+
+    Queued requests aggregate for up to ``LO_SERVE_MAX_WAIT_MS`` (or
+    until the largest bucket fills), the stacked rows pad to the
+    smallest precompiled bucket >= n, and ONE ``predict`` call serves
+    the whole burst through the PR-3 executable cache — so a warm
+    request never traces, never touches the catalog, and never waits
+    on the job queue."""
+
+    kind = "predict"
+
+    def __init__(self, name: str, ctx, lease: ServingLease, instance):
+        super().__init__(name, ctx, lease)
+        self._instance = instance
+        buckets = sorted({int(b) for b in
+                          str(ctx.config.serve_buckets).split(",") if b})
+        self.buckets = [b for b in buckets if b > 0] or [1]
+        self._max_wait = float(ctx.config.serve_max_wait_ms) / 1e3
+        self.predicts_total = 0
+        self.rows_total = 0
+
+    def validate_request(self, payload: Dict[str, Any]) -> None:
+        x = payload.get("x")
+        if not isinstance(x, (list, tuple)) or not x:
+            raise V.HttpError(
+                V.HTTP_NOT_ACCEPTABLE,
+                f"{V.MESSAGE_INVALID_FIELD}: x must be a non-empty "
+                f"list of feature rows")
+
+    def _bucket_for(self, n: int) -> int:
+        for b in self.buckets:
+            if b >= n:
+                return b
+        return self.buckets[-1]
+
+    def _serve_once(self) -> bool:
+        # gather a burst: first request opens the window, then wait up
+        # to max_wait for co-travelers (bounded by the largest bucket)
+        limit = self.buckets[-1]
+        batch: List[_Request] = []
+        rows = 0
+        deadline = None
+        while True:
+            with self._cv:
+                while self._queue and rows < limit:
+                    req = self._queue.popleft()
+                    n = len(req.payload["x"])
+                    batch.append(req)
+                    rows += n
+                if not batch:
+                    return False
+                if rows >= limit:
+                    break
+                if deadline is None:
+                    deadline = time.monotonic() + self._max_wait
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    break
+                self._cv.wait(timeout=remaining)
+                if not self._queue:
+                    break
+        try:
+            stacked = np.concatenate(
+                [np.asarray(r.payload["x"]) for r in batch], axis=0)
+        except ValueError as exc:
+            for req in batch:
+                req.fail(V.HttpError(
+                    V.HTTP_NOT_ACCEPTABLE,
+                    f"{V.MESSAGE_INVALID_FIELD}: rows do not stack "
+                    f"({exc})"))
+            return True
+        n = stacked.shape[0]
+        bucket = self._bucket_for(n)
+        if bucket > n:
+            # pad the batch dim with row 0 so the compiled bucket shape
+            # is hit exactly; padded rows are sliced off below
+            pad = np.repeat(stacked[:1], bucket - n, axis=0)
+            stacked = np.concatenate([stacked, pad], axis=0)
+        try:
+            out = np.asarray(self._instance.predict(stacked))
+        except Exception as exc:  # noqa: BLE001
+            for req in batch:
+                req.fail(V.HttpError(V.HTTP_UNAVAILABLE,
+                                     f"predict failed: {exc}"))
+            return True
+        self.predicts_total += 1
+        self.rows_total += n
+        offset = 0
+        for req in batch:
+            k = len(req.payload["x"])
+            req.finish({"predictions": out[offset:offset + k].tolist(),
+                        "bucket": bucket})
+            offset += k
+        return True
+
+    def stats(self) -> Dict[str, Any]:
+        out = super().stats()
+        out.update({
+            "buckets": self.buckets,
+            "predictsTotal": self.predicts_total,
+            "rowsTotal": self.rows_total,
+        })
+        return out
+
+
+class ServingManager:
+    """Session registry + REST verbs (create/predict/stats/delete).
+
+    One session per model name; sessions share the JobManager's
+    SliceLease allocator through ``ServingLease`` handles so resident
+    serving and batch gang jobs contend in one fair queue."""
+
+    def __init__(self, ctx):
+        self._ctx = ctx
+        self._sessions: Dict[str, _SessionBase] = {}
+        self._lock = threading.Lock()
+
+    # -- verbs ---------------------------------------------------------
+    def create(self, model_name: str, body: Dict[str, Any]) -> Dict[str, Any]:
+        body = body or {}
+        with self._lock:
+            if model_name in self._sessions:
+                raise V.HttpError(
+                    V.HTTP_CONFLICT,
+                    f"{V.MESSAGE_DUPLICATE_FILE}: serving session for "
+                    f"{model_name} already exists")
+        type_string = self._ctx.params.artifact_type(model_name)
+        if type_string is None:
+            raise V.HttpError(V.HTTP_NOT_FOUND,
+                              f"{V.MESSAGE_NONEXISTENT_FILE}: "
+                              f"{model_name}")
+        instance = self._ctx.artifacts.load(model_name, type_string)
+        kind = body.get("type")
+        if kind is None:
+            kind = "lm" if hasattr(instance, "serve_fns") else "predict"
+        if kind not in ("lm", "predict"):
+            raise V.HttpError(
+                V.HTTP_NOT_ACCEPTABLE,
+                f"{V.MESSAGE_INVALID_FIELD}: type must be 'lm' or "
+                f"'predict', got {kind!r}")
+        footprint = None
+        devices = V.valid_slice_devices(body.get(V.SLICE_DEVICES_FIELD))
+        if devices is not None:
+            footprint = {"devices": devices}
+        lease = ServingLease(
+            self._ctx.jobs.slice_lease, pool="serving",
+            policy=self._ctx.config.serve_lease_policy,
+            footprint=footprint)
+        lease.acquire()
+        try:
+            session = self._build_session(model_name, instance, kind,
+                                          body, lease)
+        except BaseException:
+            lease.release()
+            raise
+        session.start()
+        with self._lock:
+            if model_name in self._sessions:  # lost a create race
+                session.close()
+                raise V.HttpError(
+                    V.HTTP_CONFLICT,
+                    f"{V.MESSAGE_DUPLICATE_FILE}: serving session for "
+                    f"{model_name} already exists")
+            self._sessions[model_name] = session
+        return session.stats()
+
+    def _build_session(self, model_name: str, instance: Any, kind: str,
+                       body: Dict[str, Any],
+                       lease: ServingLease) -> _SessionBase:
+        if kind == "lm":
+            if not hasattr(instance, "serve_fns"):
+                raise V.HttpError(
+                    V.HTTP_NOT_ACCEPTABLE,
+                    f"{V.MESSAGE_INVALID_FIELD}: {model_name} is not a "
+                    f"language model (no decode cache support)")
+            slots = V.valid_positive_int(
+                body.get("maxSlots"), "maxSlots",
+                default=self._ctx.config.serve_max_batch)
+            cache_len = V.valid_positive_int(
+                body.get("cacheLen"), "cacheLen",
+                default=int(instance.max_len))
+            cache_len = min(cache_len, int(instance.max_len))
+            temperature, top_k, top_p = V.valid_sampling(body)
+            if top_k is not None and top_k >= instance.vocab_size:
+                top_k = None
+            return LMServingSession(
+                model_name, self._ctx, lease, instance, slots,
+                cache_len, temperature, top_k, top_p)
+        if not hasattr(instance, "predict"):
+            raise V.HttpError(
+                V.HTTP_NOT_ACCEPTABLE,
+                f"{V.MESSAGE_INVALID_FIELD}: {model_name} has no "
+                f"predict method")
+        return BucketServingSession(model_name, self._ctx, lease,
+                                    instance)
+
+    def predict(self, model_name: str,
+                body: Dict[str, Any]) -> Dict[str, Any]:
+        session = self._get(model_name)
+        body = body or {}
+        session.validate_request(body)
+        timeout = V.valid_timeout(body.get(V.TIMEOUT_FIELD))
+        return session.submit(body, timeout=timeout)
+
+    def _get(self, model_name: str) -> _SessionBase:
+        with self._lock:
+            session = self._sessions.get(model_name)
+        if session is None:
+            raise V.HttpError(
+                V.HTTP_NOT_FOUND,
+                f"{V.MESSAGE_NONEXISTENT_FILE}: no serving session "
+                f"for {model_name}")
+        return session
+
+    def session_stats(self, model_name: str) -> Dict[str, Any]:
+        return self._get(model_name).stats()
+
+    def list_sessions(self) -> List[Dict[str, Any]]:
+        with self._lock:
+            sessions = list(self._sessions.values())
+        return [s.stats() for s in sessions]
+
+    def delete(self, model_name: str) -> Dict[str, Any]:
+        with self._lock:
+            session = self._sessions.pop(model_name, None)
+        if session is None:
+            raise V.HttpError(
+                V.HTTP_NOT_FOUND,
+                f"{V.MESSAGE_NONEXISTENT_FILE}: no serving session "
+                f"for {model_name}")
+        final = session.stats()
+        session.close()
+        final["deleted"] = True
+        return final
+
+    # -- observability / lifecycle ------------------------------------
+    def stats(self) -> Dict[str, Any]:
+        with self._lock:
+            sessions = list(self._sessions.values())
+        per = [s.stats() for s in sessions]
+        return {
+            "sessions": len(per),
+            "requestsTotal": sum(p["requestsTotal"] for p in per),
+            "rejectedTotal": sum(p["rejectedTotal"] for p in per),
+            "tokensTotal": sum(p.get("tokensTotal", 0) for p in per),
+            "leaseYields": sum(p["lease"].get("yields", 0)
+                               for p in per),
+            "bySession": per,
+        }
+
+    def close(self) -> None:
+        with self._lock:
+            sessions = list(self._sessions.values())
+            self._sessions.clear()
+        for session in sessions:
+            session.close()

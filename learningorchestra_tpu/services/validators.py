@@ -23,6 +23,12 @@ HTTP_CREATED = 201
 HTTP_CONFLICT = 409
 HTTP_NOT_ACCEPTABLE = 406
 HTTP_NOT_FOUND = 404
+# serving-plane admission control (docs/SERVING.md): 429 = the
+# session's bounded request queue is full (back off and retry), 503 =
+# the session exists but cannot take traffic right now (still warming,
+# or tearing down)
+HTTP_TOO_MANY_REQUESTS = 429
+HTTP_UNAVAILABLE = 503
 
 MESSAGE_DUPLICATE_FILE = "duplicated name"
 MESSAGE_INVALID_NAME = "invalid name"
@@ -124,6 +130,56 @@ def valid_health_policy(value: Any) -> Optional[Any]:
             HTTP_NOT_ACCEPTABLE,
             f"{MESSAGE_INVALID_FIELD}: {exc}") from None
     return value
+
+
+def valid_positive_int(value: Any, field: str,
+                       default: Optional[int] = None) -> Optional[int]:
+    """Serving-session sizing field (maxSlots, maxNewTokens, cacheLen):
+    a positive integer, or None → ``default``. Bools rejected (int
+    subclass)."""
+    if value is None:
+        return default
+    if isinstance(value, bool) or not isinstance(value, int) or value <= 0:
+        raise HttpError(
+            HTTP_NOT_ACCEPTABLE,
+            f"{MESSAGE_INVALID_FIELD}: {field} must be a positive "
+            f"integer, got {value!r}")
+    return int(value)
+
+
+def valid_sampling(body: Dict[str, Any]):
+    """Serving-session sampling triple (``temperature``/``topK``/
+    ``topP``) — fixed per session so every slot shares one compiled
+    step function. Returns the normalized (temperature, top_k, top_p)
+    exactly as ``LanguageModel.generate`` would resolve them."""
+    temperature = body.get("temperature", 0.0)
+    if isinstance(temperature, bool) or \
+            not isinstance(temperature, (int, float)):
+        raise HttpError(
+            HTTP_NOT_ACCEPTABLE,
+            f"{MESSAGE_INVALID_FIELD}: temperature must be a number, "
+            f"got {temperature!r}")
+    top_k = body.get("topK")
+    if top_k is not None and (isinstance(top_k, bool)
+                              or not isinstance(top_k, int) or top_k < 1):
+        raise HttpError(
+            HTTP_NOT_ACCEPTABLE,
+            f"{MESSAGE_INVALID_FIELD}: topK must be a positive integer, "
+            f"got {top_k!r}")
+    top_p = body.get("topP")
+    if top_p is not None and (isinstance(top_p, bool)
+                              or not isinstance(top_p, (int, float))
+                              or not 0.0 < float(top_p) <= 1.0):
+        raise HttpError(
+            HTTP_NOT_ACCEPTABLE,
+            f"{MESSAGE_INVALID_FIELD}: topP must be in (0, 1], "
+            f"got {top_p!r}")
+    if float(temperature) <= 0:
+        top_k = top_p = None  # greedy ignores the filters
+    if top_p is not None and float(top_p) == 1.0:
+        top_p = None
+    return float(temperature), top_k, (None if top_p is None
+                                       else float(top_p))
 
 
 def run_preflight(findings) -> list:

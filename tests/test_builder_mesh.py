@@ -39,7 +39,7 @@ def test_logreg_jax_learns_separable():
 
 
 def test_logreg_jax_on_sub_mesh():
-    from learningorchestra_tpu.models.sweep import sub_meshes
+    from learningorchestra_tpu.runtime.mesh import sub_meshes
 
     slices = sub_meshes(mesh_lib.get_default_mesh(), 2)
     assert len(slices) == 2 and slices[0].size >= 2
@@ -83,7 +83,7 @@ def test_gaussian_nb_jax_sharded_matches_unsharded():
     """The dp-sharded sufficient-stats pass (with zero-padded rows)
     must give the same model as the unsharded one — rows don't divide
     the slice evenly on purpose."""
-    from learningorchestra_tpu.models.sweep import sub_meshes
+    from learningorchestra_tpu.runtime.mesh import sub_meshes
 
     x, y = _synth(1000, seed=6)  # 1000 % 4 != 0
     plain = GaussianNBJAX().fit(x, y)

@@ -1,0 +1,402 @@
+"""Resident serving plane (docs/SERVING.md): session lifecycle over
+REST, continuous-batch bit-identity to solo decode, bucket padding
+correctness, and the serving-lease/gang-job no-deadlock property."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from learningorchestra_tpu import config as config_mod
+from learningorchestra_tpu.services.scheduler import (
+    ServingLease,
+    SliceLease,
+)
+
+PREFIX = "/api/learningOrchestra/v1"
+
+
+@pytest.fixture()
+def api(tmp_path):
+    config_mod.set_config(config_mod.Config(
+        home=str(tmp_path / "lo_home"), compute_dtype="float32",
+        serve_max_wait_ms=1.0))
+    from learningorchestra_tpu.services.server import Api
+
+    a = Api()
+    yield a
+    a.ctx.close()
+    config_mod.reset_config()
+
+
+def _fit_clf(api):
+    from learningorchestra_tpu.models.estimators import (
+        LogisticRegressionJAX)
+
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(256, 4)).astype(np.float32)
+    y = (x @ np.array([1.0, -2.0, 0.5, 1.5]) > 0).astype(np.int64)
+    clf = LogisticRegressionJAX(epochs=3, batch_size=128)
+    clf.fit(x, y)
+    api.ctx.artifacts.save(clf, "clf", "train/tensorflow")
+    return clf
+
+
+def _fit_lm(api):
+    from learningorchestra_tpu.models.transformer import LanguageModel
+
+    lm = LanguageModel(vocab_size=48, d_model=32, n_layers=1,
+                       n_heads=2, d_ff=64, max_len=32, attention="dot")
+    rng = np.random.default_rng(1)
+    tokens = rng.integers(1, 48, size=(16, 16)).astype(np.int32)
+    lm.fit(tokens, batch_size=16, epochs=1)
+    api.ctx.artifacts.save(lm, "slm", "train/tensorflow")
+    # compare against the RELOADED instance: the session loads its own
+    # copy, so both sides must see the same post-round-trip params
+    return api.ctx.artifacts.load("slm", "train/tensorflow")
+
+
+# ------------------------------------------------------------ lifecycle
+def test_session_lifecycle_over_rest(api):
+    """create -> warm predict -> overload 429 -> lease preemption by a
+    batch gang acquire -> teardown."""
+    clf = _fit_clf(api)
+
+    # create
+    status, body, _ = api.dispatch("POST", f"{PREFIX}/serve/clf", {}, {})
+    assert status == 201, body
+    assert body["kind"] == "predict"
+    assert body["lease"]["pool"] == "serving"
+    # duplicate create conflicts
+    status, body, _ = api.dispatch("POST", f"{PREFIX}/serve/clf", {}, {})
+    assert status == 409, body
+
+    # warm predict matches the instance's own predict exactly
+    rng = np.random.default_rng(2)
+    rows = [[float(v) for v in r] for r in rng.normal(size=(3, 4))]
+    status, body, _ = api.dispatch(
+        "POST", f"{PREFIX}/serve/clf/predict", {}, {"x": rows})
+    assert status == 200, body
+    assert body["predictions"] == clf.predict(np.asarray(rows)).tolist()
+
+    # overload: block the worker inside predict, fill the bounded
+    # queue (shrunk to 2), and the next request must be rejected 429
+    session = api.ctx.serving._sessions["clf"]
+    session._depth = 2
+    entered = threading.Event()
+    release = threading.Event()
+    orig_predict = session._instance.predict
+
+    def slow_predict(x):
+        entered.set()
+        release.wait(10)
+        return orig_predict(x)
+
+    session._instance.predict = slow_predict
+    results = []
+
+    def client():
+        s, b, _ = api.dispatch(
+            "POST", f"{PREFIX}/serve/clf/predict", {}, {"x": rows})
+        results.append(s)
+
+    blocker = threading.Thread(target=client)
+    blocker.start()
+    assert entered.wait(10), "worker never reached predict"
+    fillers = [threading.Thread(target=client) for _ in range(2)]
+    for t in fillers:
+        t.start()
+    deadline = time.time() + 10
+    while len(session._queue) < 2 and time.time() < deadline:
+        time.sleep(0.005)
+    assert len(session._queue) == 2, "queue never filled"
+    status, body, _ = api.dispatch(
+        "POST", f"{PREFIX}/serve/clf/predict", {}, {"x": rows})
+    assert status == 429, body
+    release.set()
+    blocker.join(timeout=10)
+    for t in fillers:
+        t.join(timeout=10)
+    del session._instance.predict
+    assert results == [200, 200, 200]
+    stats = api.dispatch("GET", f"{PREFIX}/serve/clf", {}, None)[1]
+    assert stats["rejectedTotal"] >= 1
+
+    # lease preemption: a batch gang acquire on the SAME allocator must
+    # go through (the session yields), then the session re-acquires
+    got = threading.Event()
+
+    def gang():
+        grant = api.ctx.jobs.slice_lease.acquire("batch")
+        got.set()
+        time.sleep(0.05)
+        api.ctx.jobs.slice_lease.release("batch", 0.05, grant=grant)
+
+    t = threading.Thread(target=gang)
+    t.start()
+    assert got.wait(10), "gang job deadlocked behind the serving lease"
+    t.join(timeout=10)
+    deadline = time.time() + 10
+    while time.time() < deadline:
+        stats = api.dispatch("GET", f"{PREFIX}/serve/clf", {}, None)[1]
+        if stats["lease"]["yields"] >= 1 and stats["lease"]["held"]:
+            break
+        time.sleep(0.02)
+    assert stats["lease"]["yields"] >= 1
+    assert stats["lease"]["held"]
+    # still serving after the re-pin
+    status, body, _ = api.dispatch(
+        "POST", f"{PREFIX}/serve/clf/predict", {}, {"x": rows})
+    assert status == 200, body
+
+    # teardown
+    status, body, _ = api.dispatch(
+        "DELETE", f"{PREFIX}/serve/clf", {}, None)
+    assert status == 200 and body["deleted"] is True
+    status, body, _ = api.dispatch(
+        "POST", f"{PREFIX}/serve/clf/predict", {}, {"x": rows})
+    assert status == 404, body
+    assert api.dispatch("GET", f"{PREFIX}/serve", {}, None)[1] == \
+        {"result": []}
+
+
+# ----------------------------------------------------- LM bit-identity
+def test_continuous_batch_bit_identical_to_solo_decode(api):
+    """Requests joining and leaving the continuous batcher at
+    staggered token boundaries must each emit EXACTLY the tokens a solo
+    ``generate`` of that request produces — same key schedule, same
+    masked attention, bit for bit."""
+    lm = _fit_lm(api)
+    status, body, _ = api.dispatch(
+        "POST", f"{PREFIX}/serve/slm", {}, {
+            "maxSlots": 4, "cacheLen": 32,
+            "temperature": 0.7, "topK": 12})
+    assert status == 201, body
+    assert body["kind"] == "lm" and body["slots"] == 4
+
+    rng = np.random.default_rng(3)
+    specs = []  # (prompt, new, seed)
+    for i, (plen, new) in enumerate(
+            [(3, 5), (5, 8), (8, 6), (4, 9), (6, 7), (7, 5)]):
+        prompt = [int(t) for t in rng.integers(1, 48, size=plen)]
+        specs.append((prompt, new, 100 + i))
+    out = [None] * len(specs)
+
+    def client(i):
+        prompt, new, seed = specs[i]
+        time.sleep(0.03 * i)  # join mid-flight of earlier requests
+        s, b, _ = api.dispatch(
+            "POST", f"{PREFIX}/serve/slm/predict", {}, {
+                "prompt": prompt, "maxNewTokens": new, "seed": seed})
+        assert s == 200, b
+        out[i] = b["tokens"]
+
+    threads = [threading.Thread(target=client, args=(i,))
+               for i in range(len(specs))]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=120)
+    for i, (prompt, new, seed) in enumerate(specs):
+        solo = lm.generate(np.asarray([prompt], np.int32),
+                           max_new_tokens=new, temperature=0.7,
+                           top_k=12, seed=seed)
+        assert out[i] == [int(t) for t in solo[0][len(prompt):]], \
+            f"request {i} diverged from its solo decode"
+    stats = api.dispatch("GET", f"{PREFIX}/serve/slm", {}, None)[1]
+    assert stats["tokensTotal"] == sum(n for _, n, _ in specs)
+    api.dispatch("DELETE", f"{PREFIX}/serve/slm", {}, None)
+
+
+def test_lm_serving_validates_requests(api):
+    _fit_lm(api)
+    status, body, _ = api.dispatch(
+        "POST", f"{PREFIX}/serve/slm", {}, {"cacheLen": 16})
+    assert status == 201, body
+    for bad in ({}, {"prompt": []}, {"prompt": "abc"},
+                {"prompt": [1, 2], "maxNewTokens": 16},   # >= cacheLen
+                {"prompt": [1, 2], "maxNewTokens": 0},
+                {"prompt": [1, 2], "seed": "x"}):
+        status, _, _ = api.dispatch(
+            "POST", f"{PREFIX}/serve/slm/predict", {}, bad)
+        assert status == 406, bad
+
+
+# ------------------------------------------------------ bucket padding
+def test_bucket_padding_correctness(api):
+    """Padding a burst up to the precompiled bucket shape must never
+    change any real row's prediction; ragged rows are rejected."""
+    clf = _fit_clf(api)
+    status, body, _ = api.dispatch("POST", f"{PREFIX}/serve/clf", {}, {})
+    assert status == 201, body
+    rng = np.random.default_rng(4)
+    for n, bucket in ((1, 1), (3, 4), (5, 8)):
+        rows = [[float(v) for v in r] for r in rng.normal(size=(n, 4))]
+        status, body, _ = api.dispatch(
+            "POST", f"{PREFIX}/serve/clf/predict", {}, {"x": rows})
+        assert status == 200, body
+        assert body["bucket"] == bucket
+        assert body["predictions"] == \
+            clf.predict(np.asarray(rows)).tolist()
+
+    # concurrent burst: aggregated into shared bucketed calls, every
+    # request still gets exactly its own rows' predictions back
+    sizes = (1, 2, 3)
+    rows_by_req = [
+        [[float(v) for v in r] for r in rng.normal(size=(n, 4))]
+        for n in sizes]
+    got = [None] * len(sizes)
+
+    def client(i):
+        s, b, _ = api.dispatch(
+            "POST", f"{PREFIX}/serve/clf/predict", {},
+            {"x": rows_by_req[i]})
+        assert s == 200, b
+        got[i] = b["predictions"]
+
+    threads = [threading.Thread(target=client, args=(i,))
+               for i in range(len(sizes))]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=30)
+    for i in range(len(sizes)):
+        assert got[i] == \
+            clf.predict(np.asarray(rows_by_req[i])).tolist()
+
+    # ragged rows inside one request do not stack -> 406
+    status, body, _ = api.dispatch(
+        "POST", f"{PREFIX}/serve/clf/predict", {},
+        {"x": [[1.0, 2.0], [1.0, 2.0, 3.0]]})
+    assert status == 406, body
+    status, _, _ = api.dispatch(
+        "POST", f"{PREFIX}/serve/clf/predict", {}, {"x": []})
+    assert status == 406
+
+
+# ------------------------------------------------- scheduler property
+def test_serving_leases_never_deadlock_gang_jobs():
+    """Property: with preempt-policy serving sessions occupying the
+    whole device line and continuously re-acquiring, EVERY full-mesh
+    gang job still completes — the idle-tick yield plus the
+    anti-starvation freeze guarantee forward progress."""
+    lease = SliceLease(leases=4, total_devices=8, aging_seconds=0.5)
+    sessions = [ServingLease(lease, footprint={"devices": d})
+                for d in (2, 2, 4)]
+    for s in sessions:
+        s.acquire()
+    stop = threading.Event()
+
+    def pump(s):
+        # the session worker loop: offer the slice back on every tick
+        while not stop.is_set():
+            s.maybe_yield()
+            time.sleep(0.002)
+
+    pumps = [threading.Thread(target=pump, args=(s,), daemon=True)
+             for s in sessions]
+    for t in pumps:
+        t.start()
+    done = []
+
+    def gang(i):
+        grant = lease.acquire("batch")  # full mesh, exclusively
+        time.sleep(0.01)
+        lease.release("batch", 0.01, grant=grant)
+        done.append(i)
+
+    gangs = [threading.Thread(target=gang, args=(i,)) for i in range(5)]
+    for t in gangs:
+        t.start()
+    for t in gangs:
+        t.join(timeout=60)
+    assert sorted(done) == list(range(5)), \
+        f"gang jobs starved behind serving leases: {sorted(done)}"
+    stop.set()
+    for t in pumps:
+        t.join(timeout=30)
+    # the sessions all came back up after the batch burst drained
+    for s in sessions:
+        assert s.held()
+        assert s.yields >= 1
+    for s in sessions:
+        s.release()
+
+
+def test_hold_policy_keeps_slice_until_release():
+    lease = SliceLease(leases=2, total_devices=8)
+    sess = ServingLease(lease, policy="hold", footprint={"devices": 4})
+    sess.acquire()
+    assert sess.maybe_yield() is False  # hold never yields
+    got = threading.Event()
+
+    def gang():
+        grant = lease.acquire("batch")
+        got.set()
+        lease.release("batch", 0.0, grant=grant)
+
+    t = threading.Thread(target=gang, daemon=True)
+    t.start()
+    assert not got.wait(0.3), "gang ran while hold-session kept mesh"
+    assert sess.maybe_yield() is False
+    sess.release()
+    assert got.wait(10), "gang never ran after session release"
+    t.join(timeout=10)
+
+
+def test_two_sessions_time_share_single_lease_mesh(api):
+    """On the default counting mesh (LO_MESH_LEASES=1) a second
+    session's create must NOT hang behind the first: sessions never
+    finish, so the preempt policy yields to same-pool waiters too and
+    the two sessions time-share the lease (regression — create used
+    to deadlock because holders only yielded to OTHER pools)."""
+    _fit_clf(api)
+    lm = _fit_lm(api)
+
+    status, body, _ = api.dispatch("POST", f"{PREFIX}/serve/clf", {}, {})
+    assert status == 201, body
+
+    created = {}
+
+    def create_second():
+        created["resp"] = api.dispatch(
+            "POST", f"{PREFIX}/serve/slm", {},
+            {"maxSlots": 2, "cacheLen": 24, "temperature": 0.7,
+             "topK": 8})
+
+    t = threading.Thread(target=create_second, daemon=True)
+    t.start()
+    t.join(timeout=30)
+    assert not t.is_alive(), \
+        "second serving create deadlocked behind the first session"
+    status, body, _ = created["resp"]
+    assert status == 201, body
+
+    # both sessions answer while coexisting
+    rng = np.random.default_rng(3)
+    rows = [[float(v) for v in r] for r in rng.normal(size=(2, 4))]
+    prompt = [int(v) for v in rng.integers(1, 48, size=5)]
+    for _ in range(3):
+        status, body, _ = api.dispatch(
+            "POST", f"{PREFIX}/serve/clf/predict", {}, {"x": rows})
+        assert status == 200, body
+        status, body, _ = api.dispatch(
+            "POST", f"{PREFIX}/serve/slm/predict", {},
+            {"prompt": prompt, "maxNewTokens": 4, "seed": 9})
+        assert status == 200, body
+        assert len(body["tokens"]) == 4
+        # the hand-offs are real lease yields, and bit-identity holds
+        # across them
+        solo = np.asarray(lm.generate([prompt], max_new_tokens=4,
+                                      temperature=0.7, top_k=8, seed=9))
+        assert body["tokens"] == [int(v) for v in solo[0][-4:]]
+
+    stats = api.ctx.serving.stats()
+    assert stats["sessions"] == 2
+    assert stats["leaseYields"] >= 1
+
+    for name in ("clf", "slm"):
+        status, body, _ = api.dispatch(
+            "DELETE", f"{PREFIX}/serve/{name}", {}, {})
+        assert status == 200, body

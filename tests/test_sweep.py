@@ -6,8 +6,8 @@ import pytest
 
 from learningorchestra_tpu import config as config_mod
 from learningorchestra_tpu.models import GridSearch, NeuralModel, RandomSearch
-from learningorchestra_tpu.models.sweep import sub_meshes
 from learningorchestra_tpu.runtime import mesh as mesh_lib
+from learningorchestra_tpu.runtime.mesh import sub_meshes
 
 
 @pytest.fixture(autouse=True)
@@ -34,6 +34,23 @@ def _data(n=64):
     y = (x[:, 0] > 0).astype(np.int32)
     x[:, 1] = y * 2.0  # separable
     return x, y
+
+
+def test_sweep_sub_meshes_reexport_deprecated():
+    """The models.sweep re-export is a compatibility shim now: it must
+    emit DeprecationWarning and delegate to runtime.mesh."""
+    import warnings
+
+    from learningorchestra_tpu.models import sweep as sweep_mod
+
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter("always")
+        via_shim = sweep_mod.sub_meshes
+    assert any(issubclass(w.category, DeprecationWarning)
+               for w in caught), "no DeprecationWarning emitted"
+    assert via_shim is mesh_lib.sub_meshes
+    with pytest.raises(AttributeError):
+        sweep_mod.no_such_attribute
 
 
 def test_sub_meshes_partition():
