@@ -97,7 +97,8 @@ PHASE_TIMEOUTS = {"cnn": 600, "lstm": 600, "tlm": 900, "proxy": 120,
                   "warm_pipeline": 600, "concurrent_jobs": 600,
                   "flash": 600, "ingest": 600, "gen": 900,
                   "serving": 900,
-                  "sentinel_overhead": 600, "sentinel_chaos": 600}
+                  "sentinel_overhead": 600, "sentinel_chaos": 600,
+                  "sweep_fusion": 900}
 
 # out-of-core Builder (reference config 4: 10M-row GBT via Spark)
 BUILDER_ROWS = int(os.environ.get("LO_BENCH_BUILDER_ROWS", "10000000"))
@@ -1119,6 +1120,87 @@ def phase_sentinel_chaos():
         api.ctx.jobs.shutdown()
 
 
+def phase_sweep_fusion():
+    """Vectorized sweep fusion (docs/PERFORMANCE.md "Sweep fusion"):
+    an 8-point learning-rate sweep over an MNIST-shaped MLP, fused
+    (one vmapped compiled program for the cohort) vs serial (fusion
+    off, one trial at a time — each point paying its own compile and
+    dispatch). A second fused run measures warm retraces: the fused
+    epoch program must trace exactly once per cohort, so the warm
+    delta CI gates on is zero."""
+    import jax
+    import numpy as np
+
+    from learningorchestra_tpu import config as config_mod
+    from learningorchestra_tpu.models.neural import NeuralModel
+    from learningorchestra_tpu.models.sweep import GridSearch
+    from learningorchestra_tpu.runtime import engine as engine_lib
+
+    rows = int(os.environ.get("LO_BENCH_SWEEP_ROWS", "2048"))
+    epochs = int(os.environ.get("LO_BENCH_SWEEP_EPOCHS", "2"))
+    home = tempfile.mkdtemp(prefix="lo_bench_sweep_")
+    rng = np.random.default_rng(0)
+    # MNIST-shaped synthetic blobs: 784 features, 10 separable classes
+    y = rng.integers(0, 10, size=rows).astype(np.int32)
+    x = rng.normal(size=(rows, 784)).astype(np.float32)
+    x[np.arange(rows), y] += 3.0
+    grid = {"learning_rate": [3e-4, 5e-4, 1e-3, 2e-3,
+                              3e-3, 5e-3, 1e-2, 2e-2]}
+
+    def estimator():
+        model = NeuralModel([
+            {"kind": "dense", "units": 128, "activation": "relu"},
+            {"kind": "dense", "units": 10, "activation": "softmax"}],
+            name="sweep_bench")
+        model.compile({"kind": "adam", "learning_rate": 1e-3})
+        return model
+
+    def run_sweep():
+        sweep = GridSearch(estimator(), grid, validation_split=0.2,
+                           refit=False)
+        t0 = time.perf_counter()
+        sweep.fit(x, y, epochs=epochs, batch_size=128)
+        return time.perf_counter() - t0, sweep
+
+    config_mod.set_config(config_mod.Config(home=home,
+                                            sweep_fusion=True))
+    fused_seconds, fused = run_sweep()
+    if fused.fusion_info_["fusedTrials"] != len(
+            grid["learning_rate"]):
+        return {"error": "planner did not fuse the full grid: "
+                         f"{fused.fusion_info_}"}
+    traces_before = engine_lib.fused_epoch_traces()
+    fused_warm_seconds, _ = run_sweep()
+    warm_retraces = engine_lib.fused_epoch_traces() - traces_before
+
+    config_mod.set_config(config_mod.Config(home=home,
+                                            sweep_fusion=False))
+    # serial arm: one trial at a time — the pre-fusion cost model
+    # (max_parallel=1 keeps the comparison about fusion, not the
+    # sub-slice scheduler)
+    serial_sweep = GridSearch(estimator(), grid, validation_split=0.2,
+                              max_parallel=1, refit=False)
+    t0 = time.perf_counter()
+    serial_sweep.fit(x, y, epochs=epochs, batch_size=128)
+    serial_seconds = time.perf_counter() - t0
+
+    if fused.best_params_ != serial_sweep.best_params_:
+        return {"error": "fused and serial sweeps disagree on the "
+                         f"winner: {fused.best_params_} vs "
+                         f"{serial_sweep.best_params_}"}
+    return {"points": len(grid["learning_rate"]),
+            "rows": rows, "epochs": epochs,
+            "fused_seconds": round(fused_seconds, 3),
+            "fused_warm_seconds": round(fused_warm_seconds, 3),
+            "serial_seconds": round(serial_seconds, 3),
+            "speedup": round(serial_seconds / fused_seconds, 3),
+            "warm_retraces": int(warm_retraces),
+            "fused_trials": fused.fusion_info_["fusedTrials"],
+            "cohorts": fused.fusion_info_["cohorts"],
+            "best_lr": fused.best_params_["learning_rate"],
+            "platform": jax.devices()[0].platform}
+
+
 PHASES = {"cnn": phase_cnn, "lstm": phase_lstm, "tlm": phase_tlm,
           "proxy": phase_proxy, "builder": phase_builder,
           "builder_mesh": phase_builder_mesh,
@@ -1127,7 +1209,8 @@ PHASES = {"cnn": phase_cnn, "lstm": phase_lstm, "tlm": phase_tlm,
           "flash": phase_flash, "ingest": phase_ingest,
           "gen": phase_gen, "serving": phase_serving,
           "sentinel_overhead": phase_sentinel_overhead,
-          "sentinel_chaos": phase_sentinel_chaos}
+          "sentinel_chaos": phase_sentinel_chaos,
+          "sweep_fusion": phase_sweep_fusion}
 
 _RESULT_MARK = "@@LO_BENCH_RESULT@@"
 
@@ -1373,6 +1456,9 @@ def main(argv=None):
         "serving", None if tpu_ok else serve_cpu_env,
         metrics=("decode_tokens_per_sec", "speedup_vs_solo", "p99_ms",
                  "predict_speedup"))
+    models["sweep_fusion"] = _run_phase_repeated(
+        "sweep_fusion", env,
+        metrics=("speedup", "fused_seconds", "serial_seconds"))
     # interpret-mode kernel timing is meaningless — flash runs on TPU only
     flash = _run_phase("flash") if tpu_ok else {
         "skipped": "TPU unreachable; interpret-mode timing is not "

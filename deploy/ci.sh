@@ -114,7 +114,8 @@ SENTINEL_TIMEOUT="${LO_CI_SENTINEL_TIMEOUT:-600}"
 CHAOS_OUT="$(mktemp)"
 OVERHEAD_OUT="$(mktemp)"
 SERVE_OUT="$(mktemp)"
-trap 'rm -rf "$PERF_CACHE" "$PERF_OUT" "$SLICE_OUT" "$CHAOS_OUT" "$OVERHEAD_OUT" "$SERVE_OUT"' EXIT
+SWEEP_OUT="$(mktemp)"
+trap 'rm -rf "$PERF_CACHE" "$PERF_OUT" "$SLICE_OUT" "$CHAOS_OUT" "$OVERHEAD_OUT" "$SERVE_OUT" "$SWEEP_OUT"' EXIT
 timeout -k 10 "$SENTINEL_TIMEOUT" env JAX_PLATFORMS=cpu \
     JAX_COMPILATION_CACHE_DIR="$PERF_CACHE" \
     LO_COMPUTE_DTYPE=float32 \
@@ -218,6 +219,54 @@ assert p50 <= 100, (
 print(f"serving-smoke: OK (decode {decode}x solo, "
       f"p99 {result['p99_ms']}ms over {result['streams']} streams, "
       f"clf predict {pspeed}x vs submit->poll, p50 {p50}ms)")
+EOF
+
+echo "== sweep-smoke: fused sweep must beat serial trials =="
+# An 8-point learning-rate grid over one MLP architecture, fused into
+# a single vmapped train program vs the serial one-trial-at-a-time
+# path (bench.py sweep_fusion; docs/PERFORMANCE.md "Sweep fusion").
+# Gates:
+#  - the warm fused run re-traces nothing (warm_retraces == 0): the
+#    whole cohort shares ONE compiled epoch program
+#  - fused wall-clock vs serial: >= 4x on an accelerator, where the 8
+#    serial compiles dominate and the fused step keeps the chip fed;
+#    >= 2x on the CPU backend, where XLA:CPU already amortizes small
+#    GEMMs so the win is mostly the 7 avoided compiles. Override with
+#    LO_SMOKE_SWEEP_FLOOR.
+SWEEP_TIMEOUT="${LO_CI_SWEEP_TIMEOUT:-900}"
+timeout -k 10 "$SWEEP_TIMEOUT" env JAX_PLATFORMS=cpu \
+    JAX_COMPILATION_CACHE_DIR="$PERF_CACHE" \
+    LO_COMPUTE_DTYPE=float32 \
+    python bench.py --phase sweep_fusion | tee "$SWEEP_OUT"
+python - "$SWEEP_OUT" <<'EOF'
+import json, os, sys
+
+mark = "@@LO_BENCH_RESULT@@"
+result = None
+for line in reversed(open(sys.argv[1]).read().splitlines()):
+    if line.startswith(mark):
+        result = json.loads(line[len(mark):])
+        break
+assert result is not None, "sweep-smoke: no bench result line"
+assert "error" not in result, f"sweep-smoke: phase failed: {result}"
+result = result.get("result", result)  # unwrap the ok-envelope
+assert result["warm_retraces"] == 0, (
+    f"sweep-smoke: warm fused sweep re-traced "
+    f"{result['warm_retraces']} epoch program(s) (gate == 0): {result}")
+assert result["fused_trials"] == result["points"], (
+    f"sweep-smoke: only {result['fused_trials']}/{result['points']} "
+    f"trials fused: {result}")
+floor = os.environ.get("LO_SMOKE_SWEEP_FLOOR")
+floor = float(floor) if floor else (
+    2.0 if result["platform"] == "cpu" else 4.0)
+speedup = result["speedup"]
+assert speedup >= floor, (
+    f"sweep-smoke: fused sweep only {speedup}x serial "
+    f"(gate >= {floor}x on {result['platform']}): {result}")
+print(f"sweep-smoke: OK ({result['points']} points in "
+      f"{result['cohorts']} cohort(s), fused {result['fused_seconds']}s "
+      f"vs serial {result['serial_seconds']}s, {speedup}x, "
+      f"0 warm retraces)")
 EOF
 
 echo "== ci: OK =="

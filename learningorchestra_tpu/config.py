@@ -193,6 +193,28 @@ class Config:
     health_retries: int = dataclasses.field(
         default_factory=lambda: int(os.environ.get(
             "LO_HEALTH_RETRIES", "1")))
+    # Vectorized sweep fusion (docs/PERFORMANCE.md "Sweep fusion").
+    # When on, GridSearch/RandomSearch fuse same-architecture sweep
+    # points into one compiled vmapped training program; off = every
+    # point runs as an independent slice-parallel trial.
+    sweep_fusion: bool = dataclasses.field(
+        default_factory=lambda: os.environ.get(
+            "LO_SWEEP_FUSION", "1") not in ("0", "false", "no"))
+    # Early-stop margin for fused sweeps: a config whose EMA validation
+    # score trails the cohort best by more than this stops updating
+    # (its state frozen by the where-guard mask). 0 disables.
+    sweep_earlystop_margin: float = dataclasses.field(
+        default_factory=lambda: float(os.environ.get(
+            "LO_SWEEP_EARLYSTOP_MARGIN", "0")))
+    # epochs every config is guaranteed to train before the margin
+    # check arms
+    sweep_earlystop_min_epochs: int = dataclasses.field(
+        default_factory=lambda: int(os.environ.get(
+            "LO_SWEEP_EARLYSTOP_MIN_EPOCHS", "2")))
+    # EMA smoothing for the per-config validation score
+    sweep_earlystop_alpha: float = dataclasses.field(
+        default_factory=lambda: float(os.environ.get(
+            "LO_SWEEP_EARLYSTOP_ALPHA", "0.5")))
     # byte budget for the $name DataFrame resolution cache (0 disables)
     param_cache_bytes: int = dataclasses.field(
         default_factory=lambda: int(os.environ.get(

@@ -56,6 +56,47 @@ def build_optimizer(spec: Dict[str, Any]) -> optax.GradientTransformation:
     raise ValueError(f"unknown optimizer: {kind!r}")
 
 
+# Which hyperparameters of each optimizer kind are pure scalar inputs
+# to the update math — i.e. can become TRACED per-config arrays in a
+# fused sweep (docs/PERFORMANCE.md "Sweep fusion") without changing
+# the optimizer state STRUCTURE. Everything else (kind itself, the
+# bool nesterov flag, batch_size/epochs) changes the traced program
+# and forces that sweep point onto the unfused fallback path.
+_FUSABLE_BY_KIND = {
+    "adam": ("learning_rate", "beta_1", "beta_2"),
+    "adamw": ("learning_rate", "weight_decay", "beta_1", "beta_2"),
+    "sgd": ("learning_rate", "momentum"),
+    "rmsprop": ("learning_rate", "rho", "momentum"),
+    "adagrad": ("learning_rate",),
+}
+
+# defaults mirroring build_optimizer, for grid points that vary a key
+# some sibling point omits
+_FUSABLE_DEFAULTS = {"learning_rate": 1e-3, "beta_1": 0.9,
+                     "beta_2": 0.999, "weight_decay": 1e-4,
+                     "momentum": 0.0, "rho": 0.9}
+
+
+def fusable_hyperparams(spec: Dict[str, Any]) -> Tuple[str, ...]:
+    """The optimizer-spec keys a fused sweep may vary for this kind."""
+    return _FUSABLE_BY_KIND.get(spec.get("kind", "adam").lower(), ())
+
+
+def build_optimizer_factory(spec: Dict[str, Any]):
+    """A factory the fused engine calls INSIDE the traced step:
+    ``factory(hp)`` rebuilds the transformation with ``hp``'s (possibly
+    traced) scalars layered over the spec's constants. optax treats a
+    non-callable learning rate / decay as data, so the same compiled
+    program serves every value — the ``inject_hyperparams`` trick
+    without carrying hyperparameters in opt_state."""
+    base = dict(spec)
+
+    def factory(hp: Dict[str, Any]) -> optax.GradientTransformation:
+        return build_optimizer({**base, **hp})
+
+    return factory
+
+
 _LOSSES = {
     "sparse_categorical_crossentropy": engine_lib.sparse_softmax_loss,
     "categorical_crossentropy": engine_lib.sparse_softmax_loss,
@@ -173,19 +214,36 @@ class NeuralModel:
         self.input_shape = list(sample_x.shape[1:])
         self.input_dtype = str(sample_x.dtype)
 
+    def _compute_dtype(self):
+        from learningorchestra_tpu.config import get_config
+        return jnp.bfloat16 \
+            if get_config().compute_dtype == "bfloat16" else jnp.float32
+
+    def _engine_cache_key(self):
+        """Identity of the traced program: equal keys mean equal flax
+        module (layer configs are in the hashable module), loss,
+        metrics, and optimizer constants — so repeat jobs and sweep
+        trials with identical specs share one executable
+        (docs/PERFORMANCE.md)."""
+        try:
+            return ("neural", type(self).__qualname__, self.module,
+                    self.loss_name, tuple(self.metric_names),
+                    tuple(sorted((k, v) for k, v
+                                 in self.optimizer_spec.items())))
+        except TypeError:  # unhashable layer/spec value: no sharing
+            return None
+
     def _get_engine(self) -> engine_lib.Engine:
         if self._engine is None:
-            from learningorchestra_tpu.config import get_config
-            dtype = jnp.bfloat16 \
-                if get_config().compute_dtype == "bfloat16" else jnp.float32
             self._engine = engine_lib.Engine(
                 apply_fn=self._apply_fn,
                 loss_fn=_LOSSES[self.loss_name],
                 optimizer=build_optimizer(self.optimizer_spec),
                 mesh=self._mesh(),
                 metrics={n: _METRICS[n] for n in self.metric_names},
-                compute_dtype=dtype,
-                grad_accum=self._accum)
+                compute_dtype=self._compute_dtype(),
+                grad_accum=self._accum,
+                cache_key=self._engine_cache_key())
         return self._engine
 
     def _set_grad_accum(self, grad_accum: Optional[int]) -> None:
@@ -304,6 +362,87 @@ class NeuralModel:
         self.model_state = engine_lib.to_host(state.model_state)
         self.history.extend(history)
         return History(history)
+
+    # ------------------------------------------------------------------
+    # vectorized sweep fusion (models/sweep.py cohort planner calls
+    # this; docs/PERFORMANCE.md "Sweep fusion")
+    # ------------------------------------------------------------------
+    def supports_sweep_fusion(self) -> bool:
+        """True when this instance runs the stock NeuralModel training
+        path — a subclass overriding apply/fit/engine construction
+        opts out and its sweep points fall back to independent
+        trials."""
+        cls = type(self)
+        return (cls._apply_fn is NeuralModel._apply_fn
+                and cls.fit is NeuralModel.fit
+                and cls._get_engine is NeuralModel._get_engine)
+
+    def fit_sweep_fused(self, x, y, hyper_overrides, *,
+                        batch_size: Optional[int] = None,
+                        epochs: int = 1,
+                        validation_data: Optional[Tuple] = None,
+                        shuffle: bool = True, score_fn=None,
+                        earlystop: Optional[Dict[str, Any]] = None,
+                        ) -> Tuple[List[Dict[str, float]],
+                                   List[Optional[int]]]:
+        """Train ``len(hyper_overrides)`` optimizer variants of this
+        model in ONE compiled program: stacked params, vmapped step,
+        per-config hyperparameters as traced arrays. Every config
+        shares this model's init/shuffle/dropout seed — exactly what
+        independent trials cloned from the same estimator would use —
+        so per-config results match unfused trials. Returns
+        ``(per_config_eval_metrics, stopped_epochs)``; metrics come
+        from ``validation_data`` when given, else the last training
+        epoch."""
+        overrides = [dict(o) for o in hyper_overrides]
+        names = sorted({k for o in overrides for k in o})
+        allowed = set(fusable_hyperparams(self.optimizer_spec))
+        bad = [k for k in names if k not in allowed]
+        if bad or not names:
+            raise engine_lib.FusedSweepUnsupported(
+                f"hyperparameters {bad or names} are not fusable for "
+                f"optimizer kind "
+                f"{self.optimizer_spec.get('kind', 'adam')!r}")
+        hyper = {
+            k: np.asarray(
+                [float(o.get(k, self.optimizer_spec.get(
+                    k, _FUSABLE_DEFAULTS[k]))) for o in overrides],
+                np.float32)
+            for k in names}
+        batcher = self._batcher(x, y, batch_size, shuffle=shuffle)
+        if self.params is None:
+            self._build_params(batcher.array("x"))
+        feng = engine_lib.FusedEngine(
+            apply_fn=self._apply_fn,
+            loss_fn=_LOSSES[self.loss_name],
+            optimizer_factory=build_optimizer_factory(
+                self.optimizer_spec),
+            hyper=hyper, mesh=self._mesh(),
+            metrics={n: _METRICS[n] for n in self.metric_names},
+            compute_dtype=self._compute_dtype(),
+            grad_accum=self._accum,
+            cache_key=self._engine_cache_key())
+        eval_batcher = None
+        if validation_data is not None:
+            eval_batcher = self._batcher(
+                validation_data[0], validation_data[1], batch_size)
+        state = feng.init_fused_state(self.params, self.model_state)
+        state, history, _active, stopped = feng.fit_fused(
+            state, batcher, epochs=epochs, seed=self.seed,
+            eval_batcher=eval_batcher, score_fn=score_fn,
+            earlystop=earlystop)
+        if eval_batcher is not None:
+            final = feng.evaluate_fused(state, eval_batcher)
+            per_config = [
+                {k: float(v[i]) for k, v in final.items()}
+                for i in range(feng.n_configs)]
+        else:
+            last = history[-1] if history else {}
+            per_config = [
+                {k: float(v[i]) for k, v in last.items()
+                 if isinstance(v, list)}
+                for i in range(feng.n_configs)]
+        return per_config, stopped
 
     def evaluate(self, x=None, y=None, batch_size: Optional[int] = None,
                  sample_weight=None, **_: Any) -> Dict[str, float]:

@@ -14,6 +14,16 @@ The surface is GridSearchCV-shaped on purpose (``fit``,
 ``best_params_``, ``best_score_``, ``cv_results_``) because those
 names are what reference clients send through the REST method-call
 contract.
+
+Sweep fusion (docs/PERFORMANCE.md "Sweep fusion"): before dispatching
+trials, a planner partitions the grid into cohorts whose points share
+everything that changes the traced program (architecture, optimizer
+kind, batch_size, epochs) and differ only in vmappable optimizer
+scalars (learning rate, decay, momentum, betas). Each cohort trains as
+ONE compiled vmapped program over a config axis — ~1 compile and ~1
+job slot for the whole cohort — while the residual falls back
+unchanged to the slice-parallel trial path above. ``LO_SWEEP_FUSION=0``
+disables the planner entirely.
 """
 
 from __future__ import annotations
@@ -23,9 +33,10 @@ import json
 import os
 import random as random_mod
 import tempfile
+import threading
 import time
 from concurrent.futures import ThreadPoolExecutor
-from typing import Any, Dict, List, Optional, Sequence
+from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -38,22 +49,26 @@ _OPTIMIZER_KEYS = {"kind", "learning_rate", "lr", "momentum",
 _FIT_KEYS = {"batch_size", "epochs"}
 
 
-# Deprecated re-export: sub-mesh construction is a runtime concern
-# now that the slice scheduler packs jobs onto device subsets — the
-# implementation lives in runtime.mesh. Import from there. The module
-# __getattr__ (PEP 562) keeps `from models.sweep import sub_meshes`
-# working one more release, with a DeprecationWarning at use site.
-def __getattr__(name: str):
-    if name == "sub_meshes":
-        import warnings
+# process-wide fusion counters, exported as lo_sweep_* gauges by the
+# /metrics endpoint (services/server.py)
+_FUSION_LOCK = threading.Lock()
+_FUSION_STATS = {"fusedTrials": 0, "cohorts": 0, "fallbackTrials": 0,
+                 "earlyStopped": 0, "trialErrors": 0}
 
-        warnings.warn(
-            "models.sweep.sub_meshes is deprecated; import it from "
-            "learningorchestra_tpu.runtime.mesh instead",
-            DeprecationWarning, stacklevel=2)
-        return mesh_lib.sub_meshes
-    raise AttributeError(
-        f"module {__name__!r} has no attribute {name!r}")
+
+def _fusion_count(**deltas: int) -> None:
+    with _FUSION_LOCK:
+        for k, v in deltas.items():
+            _FUSION_STATS[k] = _FUSION_STATS.get(k, 0) + v
+
+
+def fusion_stats() -> Dict[str, int]:
+    with _FUSION_LOCK:
+        out = dict(_FUSION_STATS)
+    from learningorchestra_tpu.runtime import engine as engine_lib
+
+    out["fusedEpochTraces"] = engine_lib.fused_epoch_traces()
+    return out
 
 
 def _clone(estimator):
@@ -138,6 +153,9 @@ class GridSearch:
         self.best_params_: Optional[Dict[str, Any]] = None
         self.best_score_: Optional[float] = None
         self.best_estimator_ = None
+        # filled by fit(): how much of the sweep the fusion planner
+        # claimed (job metadata surfaces this as "sweepFusion")
+        self.fusion_info_: Dict[str, int] = {}
 
     # ------------------------------------------------------------------
     def _combinations(self) -> List[Dict[str, Any]]:
@@ -210,7 +228,98 @@ class GridSearch:
             return -float(metrics["loss"])
         if self.scoring == "loss":
             return -float(metrics["loss"])
+        if self.scoring not in metrics:
+            raise ValueError(
+                f"scoring metric {self.scoring!r} not reported by the "
+                f"estimator; available: {sorted(metrics)}")
         return float(metrics[self.scoring])
+
+    # ------------------------------------------------------------------
+    # fusion planner (docs/PERFORMANCE.md "Sweep fusion")
+    # ------------------------------------------------------------------
+    def _plan_cohorts(self, combos: List[Dict[str, Any]]
+                      ) -> Tuple[List[Dict[str, Any]], List[int]]:
+        """Partition ``combos`` into fusable cohorts + residual
+        indices. A cohort's points share every program-shaping entry
+        (architecture, optimizer kind, batch_size/epochs, attribute
+        overrides) and differ only in the optimizer scalars the
+        estimator declares vmappable for its kind; groups of one stay
+        residual (nothing to fuse)."""
+        from learningorchestra_tpu.models import neural as neural_lib
+
+        est = self.estimator
+        supports = getattr(est, "supports_sweep_fusion", None)
+        if supports is None or not supports():
+            return [], list(range(len(combos)))
+        spec = getattr(est, "optimizer_spec", None) or {}
+        base_kind = str(spec.get("kind", "adam")).lower()
+        groups: Dict[Any, List[Tuple[int, Dict[str, float],
+                                     Dict[str, Any]]]] = {}
+        residual: List[int] = []
+        for i, combo in enumerate(combos):
+            kind = str(combo.get("optimizer",
+                                 combo.get("kind", base_kind))).lower()
+            allowed = set(neural_lib._FUSABLE_BY_KIND.get(kind, ()))
+            hyper: Dict[str, float] = {}
+            shared: Dict[str, Any] = {}
+            for k, v in combo.items():
+                nk = "learning_rate" if k == "lr" else k
+                if nk in allowed and isinstance(v, (int, float)) \
+                        and not isinstance(v, bool):
+                    hyper[nk] = float(v)
+                else:
+                    shared[k] = v
+            if not hyper:
+                residual.append(i)
+                continue
+            key = (tuple(sorted((k, repr(v)) for k, v in shared.items())),
+                   tuple(sorted(hyper)))
+            groups.setdefault(key, []).append((i, hyper, shared))
+        cohorts = []
+        for members in groups.values():
+            if len(members) < 2:
+                residual.extend(i for i, _, _ in members)
+                continue
+            cohorts.append({"indices": [i for i, _, _ in members],
+                            "hyper": [h for _, h, _ in members],
+                            "shared": dict(members[0][2])})
+        residual.sort()
+        return cohorts, residual
+
+    def _run_fused_cohort(self, cohort: Dict[str, Any],
+                          combos: List[Dict[str, Any]], tx, ty, vx, vy,
+                          fit_kwargs: Dict[str, Any], mesh
+                          ) -> Tuple[List[Dict[str, Any]], List[Any]]:
+        from learningorchestra_tpu.config import get_config
+
+        model = _clone(self.estimator)
+        model.set_mesh(mesh)
+        trial_kwargs = dict(fit_kwargs)
+        trial_kwargs.update(
+            _apply_overrides(model, dict(cohort["shared"])))
+        cfg = get_config()
+        earlystop = None
+        if cfg.sweep_earlystop_margin > 0:
+            earlystop = {"margin": cfg.sweep_earlystop_margin,
+                         "min_epochs": cfg.sweep_earlystop_min_epochs,
+                         "alpha": cfg.sweep_earlystop_alpha}
+        t0 = time.perf_counter()
+        per_config, stopped = model.fit_sweep_fused(
+            tx, ty, cohort["hyper"],
+            batch_size=trial_kwargs.get("batch_size"),
+            epochs=trial_kwargs.get("epochs", 1),
+            validation_data=(vx, vy),
+            shuffle=trial_kwargs.get("shuffle", True),
+            score_fn=self._score, earlystop=earlystop)
+        # one program trained the whole cohort: amortize its wall-clock
+        # evenly so mean_fit_time stays comparable across paths
+        dt = (time.perf_counter() - t0) / max(1, len(per_config))
+        results = []
+        for idx, metrics in zip(cohort["indices"], per_config):
+            results.append({"params": combos[idx], "metrics": metrics,
+                            "score": self._score(metrics),
+                            "fit_time": round(dt, 4)})
+        return results, stopped
 
     # ------------------------------------------------------------------
     def fit(self, x=None, y=None, **fit_kwargs) -> "GridSearch":
@@ -218,65 +327,148 @@ class GridSearch:
 
         import jax
 
+        from learningorchestra_tpu.config import get_config
+        from learningorchestra_tpu.runtime import preempt
+
         combos = self._combinations()
         tx, ty, vx, vy = self._split(x, y)
         # current_mesh: a sweep running under a scheduler slice grant
         # cuts ITS slice into trial sub-slices, not the whole mesh
         mesh = mesh_lib.current_mesh()
-        if jax.process_count() > 1:
-            # multi-host: every host replays this fit (execution.py
-            # fan-out) and must execute identical programs in identical
-            # order — sub-slice thread scheduling is timing-dependent
-            # and a sub-slice may own no local devices, so trials run
-            # sequentially over the full global mesh instead
-            k = 1
-            slices = [mesh]
-        else:
-            k = min(len(combos), self.max_parallel or mesh.size)
-            slices = mesh_lib.sub_meshes(mesh, k)
-            k = min(k, len(slices))  # never more workers than slices
-        # free pool, not idx % k: a fast trial returns its slice for
-        # the next combo instead of contending with a slow neighbour
-        free = queue_mod.Queue()
-        for s in slices:
-            free.put(s)
+        self.fusion_info_ = {"fusedTrials": 0, "cohorts": 0,
+                             "fallbackTrials": 0, "earlyStopped": 0}
+        results: List[Optional[Dict[str, Any]]] = [None] * len(combos)
+        residual_idx = list(range(len(combos)))
+        # Fusion is single-host only: the multi-host fan-out replays
+        # this fit on every host and the residual path already
+        # serializes there; a fused cohort would be fine numerically
+        # but buys nothing over the per-host sequential trials.
+        if get_config().sweep_fusion and jax.process_count() == 1:
+            cohorts, residual_idx = self._plan_cohorts(combos)
+            for cohort in cohorts:
+                try:
+                    cohort_results, stopped = self._run_fused_cohort(
+                        cohort, combos, tx, ty, vx, vy, fit_kwargs,
+                        mesh)
+                except preempt.JobCancelled:
+                    raise
+                except Exception:
+                    # any fused failure (scan budget exceeded, odd
+                    # spec, device error) reverts the cohort to
+                    # independent trials — fusion is an optimization,
+                    # never a behavior change
+                    residual_idx.extend(cohort["indices"])
+                    _fusion_count(
+                        fallbackTrials=len(cohort["indices"]))
+                    self.fusion_info_["fallbackTrials"] += \
+                        len(cohort["indices"])
+                    continue
+                for idx, res in zip(cohort["indices"], cohort_results):
+                    results[idx] = res
+                n_stopped = sum(1 for s in stopped if s is not None)
+                self.fusion_info_["fusedTrials"] += \
+                    len(cohort["indices"])
+                self.fusion_info_["cohorts"] += 1
+                self.fusion_info_["earlyStopped"] += n_stopped
+                _fusion_count(fusedTrials=len(cohort["indices"]),
+                              cohorts=1, earlyStopped=n_stopped)
+            residual_idx.sort()
+        residual = [combos[i] for i in residual_idx]
+        if residual:
+            if jax.process_count() > 1:
+                # multi-host: every host replays this fit
+                # (execution.py fan-out) and must execute identical
+                # programs in identical order — sub-slice thread
+                # scheduling is timing-dependent and a sub-slice may
+                # own no local devices, so trials run sequentially
+                # over the full global mesh instead
+                k = 1
+                slices = [mesh]
+            else:
+                k = min(len(residual), self.max_parallel or mesh.size)
+                slices = mesh_lib.sub_meshes(mesh, k)
+                k = min(k, len(slices))  # never more workers than slices
+            # free pool, not idx % k: a fast trial returns its slice
+            # for the next combo instead of contending with a slow
+            # neighbour
+            free = queue_mod.Queue()
+            for s in slices:
+                free.put(s)
 
-        def run_trial(combo):
-            model = _clone(self.estimator)
-            sub = free.get()
-            try:
-                model.set_mesh(sub)
-                trial_kwargs = dict(fit_kwargs)
-                trial_kwargs.update(_apply_overrides(model, combo))
+            def run_trial(combo):
+                from learningorchestra_tpu.services import faults
+
+                model = _clone(self.estimator)
+                sub = free.get()
                 t0 = time.perf_counter()
-                if ty is None:
-                    model.fit(tx, **trial_kwargs)
-                    metrics = model.evaluate(
-                        vx, batch_size=trial_kwargs.get("batch_size"))
-                else:
-                    model.fit(tx, ty, **trial_kwargs)
-                    metrics = model.evaluate(
-                        vx, vy, batch_size=trial_kwargs.get("batch_size"))
-            finally:
-                free.put(sub)
-            return {"params": combo, "metrics": metrics,
-                    "score": self._score(metrics),
-                    "fit_time": round(time.perf_counter() - t0, 4)}
+                try:
+                    faults.maybe_inject("sweep_trial")
+                    model.set_mesh(sub)
+                    trial_kwargs = dict(fit_kwargs)
+                    trial_kwargs.update(_apply_overrides(model, combo))
+                    if ty is None:
+                        model.fit(tx, **trial_kwargs)
+                        metrics = model.evaluate(
+                            vx,
+                            batch_size=trial_kwargs.get("batch_size"))
+                    else:
+                        model.fit(tx, ty, **trial_kwargs)
+                        metrics = model.evaluate(
+                            vx, vy,
+                            batch_size=trial_kwargs.get("batch_size"))
+                    return {"params": combo, "metrics": metrics,
+                            "score": self._score(metrics),
+                            "fit_time":
+                                round(time.perf_counter() - t0, 4)}
+                except preempt.JobCancelled:
+                    raise
+                except Exception as exc:
+                    # trial fault isolation: one bad point must not
+                    # abort the sweep — record it and keep searching;
+                    # the raw exception rides along so an all-failed
+                    # sweep can re-raise the real cause
+                    _fusion_count(trialErrors=1)
+                    return {"params": combo, "metrics": {},
+                            "score": float("-inf"),
+                            "fit_time":
+                                round(time.perf_counter() - t0, 4),
+                            "error": f"{type(exc).__name__}: {exc}",
+                            "_exc": exc}
+                finally:
+                    free.put(sub)
 
-        if k > 1:
-            results = self._run_trials_preemptibly(run_trial, combos, k)
-        else:
-            # sequential trials run on THIS thread, so the engine's
-            # per-epoch preempt hook fires naturally inside each fit
-            results = [run_trial(c) for c in combos]
+            if k > 1:
+                res_list = self._run_trials_preemptibly(
+                    run_trial, residual, k)
+            else:
+                # sequential trials run on THIS thread, so the
+                # engine's per-epoch preempt hook fires naturally
+                # inside each fit
+                res_list = [run_trial(c) for c in residual]
+            for i, r in zip(residual_idx, res_list):
+                results[i] = r
 
+        failed = [r for r in results if "error" in r]
+        ok = [r for r in results if "error" not in r]
+        if not ok:
+            first = failed[0].get("_exc")
+            if first is not None:
+                raise first
+            raise RuntimeError(
+                f"all {len(results)} sweep trials failed; first: "
+                f"{failed[0]['error']}")
+        for r in failed:
+            r.pop("_exc", None)
         self.cv_results_ = {
             "params": [r["params"] for r in results],
             "mean_test_score": [r["score"] for r in results],
             "mean_fit_time": [r["fit_time"] for r in results],
             "metrics": [r["metrics"] for r in results],
         }
-        best = max(results, key=lambda r: r["score"])
+        if failed:
+            self.cv_results_["error"] = [r.get("error")
+                                         for r in results]
+        best = max(ok, key=lambda r: r["score"])
         self.best_params_ = best["params"]
         self.best_score_ = best["score"]
         if self.refit:

@@ -154,6 +154,40 @@ def batch_spec(mesh: Mesh, seq_axis: bool = False) -> P:
     return P(first)
 
 
+def config_axis_spec(mesh: Mesh, n_configs: int) -> P:
+    """PartitionSpec for the leading config axis of a fused sweep
+    (docs/PERFORMANCE.md "Sweep fusion"): shard it over the data axes
+    when the cohort size divides them — GSPMD then places each config's
+    params/opt_state on its own device group, the same trick the batch
+    axis uses — else replicate (small cohorts still win by sharing one
+    compile)."""
+    data = mesh_lib.data_axes(mesh)
+    if not data:
+        return P()
+    size = 1
+    for a in data:
+        size *= mesh.shape[a]
+    if size > 1 and n_configs % size == 0:
+        return P(data)
+    return P()
+
+
+def fused_state_shardings(state: Any, mesh: Mesh, n_configs: int) -> Any:
+    """NamedSharding pytree for config-stacked train state: every leaf
+    whose leading dim is the config axis gets ``config_axis_spec``;
+    scalars (the step counter, optimizer counts that vmap left
+    unstacked) stay replicated."""
+    spec = config_axis_spec(mesh, n_configs)
+
+    def leaf_sharding(leaf):
+        shape = getattr(leaf, "shape", ())
+        if len(shape) >= 1 and shape[0] == n_configs:
+            return NamedSharding(mesh, spec)
+        return NamedSharding(mesh, P())
+
+    return jax.tree_util.tree_map(leaf_sharding, state)
+
+
 def constrain(x, mesh: Mesh, *spec_entries) -> Any:
     """``with_sharding_constraint`` shorthand that tolerates axes
     missing from the mesh and dims the axis size doesn't divide (e.g.

@@ -1036,6 +1036,337 @@ class Engine:
         return full[:batcher.num_samples]  # drop padding
 
 
+# ----------------------------------------------------------------------
+# Vectorized sweep fusion (docs/PERFORMANCE.md "Sweep fusion"): train N
+# same-architecture hyperparameter configs in ONE compiled program by
+# vmapping the train/eval step over a leading config axis. Counters are
+# module-level so the bench/CI gate can assert a fused sweep compiled
+# its epoch program exactly once (zero warm retraces across points).
+# ----------------------------------------------------------------------
+_FUSED_STATS = {"epochTraces": 0}
+
+
+def fused_epoch_traces() -> int:
+    """How many times a fused epoch program has been TRACED process-
+    wide (incremented at trace time, not per call): one fused sweep
+    cohort must contribute exactly 1."""
+    return _FUSED_STATS["epochTraces"]
+
+
+class FusedEngine(Engine):
+    """Config-axis mode of the engine: stacked params/opt_state with a
+    leading config dimension, per-config optimizer hyperparameters as
+    traced arrays, one vmapped train step shared by every config.
+
+    ``optimizer_factory(hyper)`` rebuilds the optax transformation from
+    a dict of scalar hyperparameters INSIDE the traced step (the
+    ``inject_hyperparams`` trick without carrying them in opt_state),
+    so learning rate / decay / momentum become data instead of
+    compile-time constants — N sweep points cost one compile. The
+    batch and rng stream are broadcast (in_axes=None): every config
+    sees exactly the shuffle order and dropout draws an independent
+    trial with the same seed would, which is what makes fused metrics
+    match unfused trials. The config axis is sharded over the data
+    axes when it divides them (parallel/sharding.py
+    ``fused_state_shardings``); the batch is then replicated so each
+    device advances its configs on the full batch.
+    """
+
+    def __init__(self, *, apply_fn: Callable, loss_fn: Callable,
+                 optimizer_factory: Callable[[Dict[str, Any]], Any],
+                 hyper: Dict[str, Any], mesh=None,
+                 metrics: Optional[Dict[str, Callable]] = None,
+                 compute_dtype: Any = jnp.bfloat16,
+                 donate_state: bool = True, grad_accum: int = 1,
+                 cache_key: Any = None):
+        names = tuple(sorted(hyper))
+        if not names:
+            raise ValueError("fused engine needs hyperparameter arrays")
+        self._hyper_names = names
+        self._hyper = {k: jnp.asarray(np.asarray(hyper[k], np.float32))
+                       for k in names}
+        sizes = {int(v.shape[0]) for v in self._hyper.values()}
+        if len(sizes) != 1:
+            raise ValueError(
+                f"hyperparameter arrays disagree on config count: "
+                f"{sorted(sizes)}")
+        self._n_configs = sizes.pop()
+        self._opt_factory = optimizer_factory
+        # structure-defining init optimizer: opt_state layout does not
+        # depend on the hyperparameter VALUES, only on the kind
+        base = optimizer_factory(
+            {k: float(np.asarray(hyper[k])[0]) for k in names})
+        super().__init__(
+            apply_fn=apply_fn, loss_fn=loss_fn, optimizer=base,
+            mesh=mesh, metrics=metrics, compute_dtype=compute_dtype,
+            donate_state=donate_state, grad_accum=grad_accum,
+            # the config axis + hyper names change the traced program,
+            # so they extend the shared-cache identity
+            cache_key=None if cache_key is None else
+            ("fused", cache_key, names, self._n_configs))
+        self._fused_epoch_steps: Dict[Any, Callable] = {}
+        self._fused_eval = None
+
+    @property
+    def n_configs(self) -> int:
+        return self._n_configs
+
+    def _config_sharded(self) -> bool:
+        if self._mesh is None:
+            return False
+        dp = mesh_lib.data_parallel_size(self._mesh)
+        return dp > 1 and self._n_configs % dp == 0
+
+    def _resolve_batch_sharding(self):
+        if self._batch_sharding is not None:
+            return self._batch_sharding
+        if self._mesh is None:
+            return None
+        if self._config_sharded():
+            # configs own the data axes; the batch is replicated so
+            # each device trains its config shard on the full batch
+            return mesh_lib.replicated(self._mesh)
+        return mesh_lib.batch_sharding(self._mesh)
+
+    # ------------------------------------------------------------------
+    def init_fused_state(self, params, model_state=None) -> TrainState:
+        """Stack one set of initial params N-ways (every config of a
+        fused cohort shares the clone's init seed, exactly like the
+        independent trials it replaces) and vmap the optimizer init
+        over the stack."""
+        n = self._n_configs
+
+        def tile(p):
+            p = jnp.asarray(p)
+            return jnp.tile(p[None], (n,) + (1,) * p.ndim)
+
+        stacked = jax.tree_util.tree_map(tile, params)
+        opt_state = jax.vmap(self._optimizer.init)(stacked)
+        state = TrainState(step=jnp.zeros((), jnp.int32), params=stacked,
+                           opt_state=opt_state,
+                           model_state=jax.tree_util.tree_map(
+                               tile, model_state or {}))
+        if self._mesh is not None:
+            from learningorchestra_tpu.parallel import \
+                sharding as rules_lib
+
+            state = jax.device_put(state, rules_lib.fused_state_shardings(
+                state, self._mesh, n))
+        return state
+
+    def _fused_step_body(self, state: TrainState, hyper, active, batch,
+                         rng):
+        """One vmapped optimizer step over the config axis. ``active``
+        masks early-stopped configs with the health-word where-guard
+        pattern (PR 5): a stopped config keeps its old state wholesale
+        and contributes zeroed metric sums."""
+        def one(params, opt_state, model_state, hp, act):
+            if self._grad_accum > 1:
+                tmp = TrainState(step=state.step, params=params,
+                                 opt_state=opt_state,
+                                 model_state=model_state)
+                grads, new_ms, metrics = self._accum_grads(tmp, batch, rng)
+            else:
+                grads, new_ms, metrics = self._micro_grads(
+                    params, model_state, batch, rng)
+            opt = self._opt_factory(hp)
+            updates, new_opt = opt.update(grads, opt_state, params)
+            new_params = optax.apply_updates(params, updates)
+            stop = jnp.logical_not(act)
+            old = (params, opt_state, model_state)
+            new = (new_params, new_opt, new_ms)
+            new = jax.tree_util.tree_map(
+                lambda o, nv: jnp.where(stop, o, nv), old, new)
+            metrics = {
+                k: (jnp.where(stop, 0.0, s.astype(jnp.float32)),
+                    jnp.where(stop, 0.0, c.astype(jnp.float32)))
+                for k, (s, c) in metrics.items()}
+            return new, metrics
+
+        hp_stack = tuple(hyper[k] for k in self._hyper_names)
+
+        def one_by_stack(params, opt_state, model_state, hps, act):
+            return one(params, opt_state, model_state,
+                       dict(zip(self._hyper_names, hps)), act)
+
+        (new_params, new_opt, new_ms), metrics = jax.vmap(one_by_stack)(
+            state.params, state.opt_state, state.model_state,
+            hp_stack, active)
+        new_state = state.replace(step=state.step + 1, params=new_params,
+                                  opt_state=new_opt, model_state=new_ms)
+        return new_state, metrics
+
+    def _build_fused_epoch_step(self, steps: int, batch_size: int,
+                                shuffle: bool):
+        """Whole-epoch scan over the vmapped step — the fused twin of
+        ``_build_epoch_step``: one dispatch per epoch, one shared
+        shuffle permutation, per-config (sum, count) metric totals."""
+        n_total = steps * batch_size
+
+        def epoch_fn(state: TrainState, hyper, active, arrays, step_rng,
+                     shuffle_rng, epoch_idx):
+            # trace-time side effect: each (re)trace of the fused
+            # program counts once — the sweep-smoke gate asserts this
+            # stays at 1 across all sweep points and warm repeats
+            _FUSED_STATS["epochTraces"] += 1
+            if shuffle:
+                perm = jax.random.permutation(
+                    jax.random.fold_in(shuffle_rng, epoch_idx), n_total)
+                arrays = jax.tree_util.tree_map(
+                    lambda a: jnp.take(a, perm, axis=0), arrays)
+            batches = jax.tree_util.tree_map(
+                lambda a: a.reshape((steps, batch_size) + a.shape[1:]),
+                arrays)
+
+            def step(carry, batch):
+                rng = jax.random.fold_in(step_rng, carry.step)
+                return self._fused_step_body(carry, hyper, active,
+                                             batch, rng)
+
+            state_out, metrics = jax.lax.scan(step, state, batches)
+            # sum over the step axis, KEEP the config axis: metrics
+            # stay per-config so results unstack into per-trial rows
+            totals = {k: (jnp.sum(s, axis=0), jnp.sum(c, axis=0))
+                      for k, (s, c) in metrics.items()}
+            return state_out, totals
+
+        donate = (0,) if self._donate else ()
+        return jax.jit(epoch_fn, donate_argnums=donate)
+
+    def _build_fused_eval_step(self):
+        def step_fn(state: TrainState, batch):
+            weights = batch.get(data_lib.MASK_KEY)
+
+            def one(params, model_state):
+                outputs, _ = self._apply_fn(
+                    self._cast(params), model_state, self._cast(batch),
+                    False, None)
+                res = self._loss_fn(outputs, batch, weights)
+                loss, extra = res if isinstance(res, tuple) else (res, {})
+                loss = loss.astype(jnp.float32)
+                metrics = {"loss": (loss * _total(weights),
+                                    _total(weights))}
+                metrics.update(extra)
+                for name, fn in self._metrics.items():
+                    if name in extra:
+                        continue
+                    metrics[name] = fn(outputs, batch, weights)
+                return metrics
+
+            return jax.vmap(one)(state.params, state.model_state)
+
+        return jax.jit(step_fn)
+
+    # ------------------------------------------------------------------
+    def fit_fused(self, state: TrainState,
+                  batcher: data_lib.ArrayBatcher, epochs: int = 1,
+                  seed: int = 0, eval_batcher=None, score_fn=None,
+                  earlystop: Optional[Dict[str, Any]] = None,
+                  log_fn: Optional[Callable] = None,
+                  ) -> Tuple[TrainState, List[Dict[str, Any]],
+                             np.ndarray, List[Optional[int]]]:
+        """Scan-mode fused fit. Returns ``(state, history, active,
+        stopped_epochs)`` — ``active[i]`` False means config ``i`` was
+        early-stopped at ``stopped_epochs[i]`` (its params frozen from
+        that epoch on). Early stop needs ``eval_batcher`` +
+        ``score_fn`` and fires once a config's EMA validation score
+        trails the cohort best by more than ``earlystop["margin"]``."""
+        if not self._should_scan(batcher):
+            raise FusedSweepUnsupported(
+                "dataset exceeds the scan-fit budget "
+                "(LO_SCAN_FIT_MAX_BYTES) — fused sweeps require the "
+                "whole-epoch scan path")
+        n = self._n_configs
+        steps = batcher.steps_per_epoch
+        bs = batcher.batch_size
+        key = (steps, bs, batcher.shuffles)
+        epoch_step = self._fused_epoch_steps.get(key)
+        if epoch_step is None:
+            epoch_step = self._fused_epoch_steps[key] = self._shared_step(
+                "fused_epoch",
+                lambda: self._build_fused_epoch_step(
+                    steps, bs, batcher.shuffles),
+                extra=key)
+        base_rng = jax.random.PRNGKey(seed)
+        shuffle_rng = _shuffle_rng(batcher.seed)
+        sharding = self._resolve_batch_sharding()
+        device_arrays = {k: data_lib.stage_to_device(v, sharding)
+                         for k, v in batcher.padded_arrays().items()}
+        active = np.ones(n, bool)
+        stopped: List[Optional[int]] = [None] * n
+        ema: List[Optional[float]] = [None] * n
+        es = dict(earlystop or {})
+        es_margin = float(es.get("margin", 0.0) or 0.0)
+        es_armed = (es_margin > 0.0 and eval_batcher is not None
+                    and score_fn is not None)
+        es_min_epochs = max(1, int(es.get("min_epochs", 2)))
+        es_alpha = float(es.get("alpha", 0.5))
+        history: List[Dict[str, Any]] = []
+        for epoch in range(epochs):
+            preempt.check_cancel()
+            preempt.heartbeat(epoch=epoch, fusedConfigs=n)
+            t0 = time.perf_counter()
+            state, totals = epoch_step(
+                state, self._hyper, jnp.asarray(active), device_arrays,
+                base_rng, shuffle_rng, jnp.asarray(epoch))
+            jax.block_until_ready(state.params)
+            dt = time.perf_counter() - t0
+            record: Dict[str, Any] = {
+                k: (np.asarray(s, np.float64)
+                    / np.maximum(np.asarray(c, np.float64), 1e-9)
+                    ).round(6).tolist()
+                for k, (s, c) in totals.items()}
+            record.update(epoch=epoch, epochSeconds=round(dt, 4))
+            history.append(record)
+            if log_fn is not None:
+                log_fn(record)
+            if es_armed and epoch + 1 < epochs:
+                vals = self.evaluate_fused(state, eval_batcher)
+                for i in range(n):
+                    if not active[i]:
+                        continue
+                    score = score_fn(
+                        {k: float(v[i]) for k, v in vals.items()})
+                    ema[i] = (score if ema[i] is None else
+                              es_alpha * score
+                              + (1.0 - es_alpha) * ema[i])
+                live = [ema[i] for i in range(n) if active[i]]
+                best = max(v for v in live if v is not None)
+                if epoch + 1 >= es_min_epochs:
+                    for i in range(n):
+                        if active[i] and ema[i] is not None and \
+                                best - ema[i] > es_margin:
+                            active[i] = False
+                            stopped[i] = epoch + 1
+            if epoch + 1 < epochs:
+                preempt.maybe_yield()
+        return state, history, active, stopped
+
+    def evaluate_fused(self, state: TrainState,
+                       batcher: data_lib.ArrayBatcher
+                       ) -> Dict[str, np.ndarray]:
+        """Per-config metric means: dict of (n_configs,) arrays."""
+        if self._fused_eval is None:
+            self._fused_eval = self._shared_step(
+                "fused_eval", self._build_fused_eval_step)
+        sums: Dict[str, Any] = {}
+        counts: Dict[str, Any] = {}
+        for step, batch in enumerate(self._device_feed(batcher, 0)):
+            preempt.check_cancel()
+            preempt.heartbeat(phase="evaluate_fused", step=step)
+            metrics = self._fused_eval(state, batch)
+            for k, (s, c) in metrics.items():
+                sums[k] = sums.get(k, 0) + np.asarray(s, np.float64)
+                counts[k] = counts.get(k, 0) + np.asarray(c, np.float64)
+        return {k: sums[k] / np.maximum(counts[k], 1e-9) for k in sums}
+
+
+class FusedSweepUnsupported(RuntimeError):
+    """The fused sweep path cannot serve this cohort (e.g. the dataset
+    exceeds the scan budget) — callers fall back to independent
+    trials."""
+
+
 # per-chip dense bf16 peak FLOP/s, public spec-sheet numbers; substring
 # matched against jax's device_kind
 _PEAK_FLOPS_BF16 = (

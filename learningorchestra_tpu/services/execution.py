@@ -253,6 +253,7 @@ class ExecutionService:
                 result = instance  # the fitted object is the artifact
             self._ctx.artifacts.save(result, name, type_string)
             _record_result_shapes(self._ctx, name, result)
+            _record_sweep_fusion(self._ctx, name, result)
             summary = summarize_result(result)
             if summary is not None:
                 self._ctx.catalog.append_document(name, {"result": summary})
@@ -280,6 +281,25 @@ def _record_result_shapes(ctx, name: str, result: Any) -> None:
         if shapes:
             ctx.catalog.update_metadata(
                 name, {A.RESULT_SHAPES_FIELD: shapes})
+    except Exception:  # noqa: BLE001
+        pass
+
+
+def _record_sweep_fusion(ctx, name: str, result: Any) -> None:
+    """Record how much of a finished sweep the fusion planner claimed
+    (``fusedTrials``/``cohorts``/``fallbackTrials``/``earlyStopped``)
+    plus any isolated per-trial errors on the job's metadata doc.
+    Best-effort, like shape metadata: never sinks a finished job."""
+    try:
+        updates: Dict[str, Any] = {}
+        info = getattr(result, "fusion_info_", None)
+        if info:
+            updates["sweepFusion"] = dict(info)
+        errors = getattr(result, "cv_results_", {}).get("error")
+        if errors:
+            updates["trialErrors"] = [e for e in errors if e]
+        if updates:
+            ctx.catalog.update_metadata(name, updates)
     except Exception:  # noqa: BLE001
         pass
 

@@ -23,8 +23,10 @@ rollback, quarantine-and-fallback restore) are testable end-to-end
 through the real REST/job stack instead of only with hand-made flaky
 callables. Known sites: ``artifact_save`` (catalog/artifacts.py),
 ``job_run`` (services/jobs.py, fired while the mesh lease is held),
-``engine_step`` (runtime/engine.py, ``nan`` mode only) and
-``ckpt_write`` (runtime/checkpoint.py, ``corrupt`` mode only)."""
+``engine_step`` (runtime/engine.py, ``nan`` mode only),
+``ckpt_write`` (runtime/checkpoint.py, ``corrupt`` mode only) and
+``sweep_trial`` (models/sweep.py, fired at the start of each unfused
+sweep trial — exercises trial fault isolation)."""
 
 from __future__ import annotations
 

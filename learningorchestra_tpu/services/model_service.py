@@ -32,6 +32,18 @@ CLASS_FIELD = "class"
 CLASS_PARAMETERS_FIELD = "classParameters"
 
 
+def _valid_sweep_scoring(cls, class_parameters: Dict[str, Any]) -> None:
+    """Submit-time 406 for an unknown sweep ``scoring`` metric —
+    without it the name only failed in ``_score`` after every trial
+    had already trained."""
+    try:
+        from learningorchestra_tpu.models.sweep import GridSearch
+    except Exception:
+        return
+    if isinstance(cls, type) and issubclass(cls, GridSearch):
+        V.valid_scoring(class_parameters.get(V.SCORING_FIELD))
+
+
 class ModelService:
     def __init__(self, context):
         self._ctx = context
@@ -50,6 +62,7 @@ class ModelService:
         self._validator.not_duplicate(name)
         cls = self._validator.valid_class(module_path, class_name)
         self._validator.valid_class_parameters(cls, class_parameters)
+        _valid_sweep_scoring(cls, class_parameters)
         analysis = self._preflight(module_path, class_name,
                                    class_parameters)
         type_string = D.normalize_type(f"model/{tool}")
@@ -75,6 +88,7 @@ class ModelService:
         cls = self._validator.valid_class(
             meta[D.MODULE_PATH_FIELD], meta[D.CLASS_FIELD])
         self._validator.valid_class_parameters(cls, class_parameters)
+        _valid_sweep_scoring(cls, class_parameters)
         analysis = self._preflight(meta[D.MODULE_PATH_FIELD],
                                    meta[D.CLASS_FIELD], class_parameters)
         type_string = meta[D.TYPE_FIELD]

@@ -327,6 +327,9 @@ class Api:
         # resident serving plane (docs/SERVING.md): session counts,
         # admission rejects, decode throughput and p50/p99 latency
         out["serving"] = self.ctx.serving.stats()
+        # vectorized sweep fusion (docs/PERFORMANCE.md "Sweep fusion")
+        from learningorchestra_tpu.models import sweep as sweep_lib
+        out["sweepFusion"] = sweep_lib.fusion_stats()
         return out
 
     def metrics_prometheus(self) -> bytes:
@@ -445,6 +448,23 @@ class Api:
             "# TYPE lo_checkpoints_quarantined_total counter",
             f"lo_checkpoints_quarantined_total "
             f"{training_health.get('quarantined', 0)}",
+        ]
+        sweep_fusion = m["sweepFusion"]
+        lines += [
+            "# TYPE lo_sweep_fused_trials_total counter",
+            f"lo_sweep_fused_trials_total "
+            f"{sweep_fusion.get('fusedTrials', 0)}",
+            "# TYPE lo_sweep_cohorts_total counter",
+            f"lo_sweep_cohorts_total {sweep_fusion.get('cohorts', 0)}",
+            "# TYPE lo_sweep_fallback_trials_total counter",
+            f"lo_sweep_fallback_trials_total "
+            f"{sweep_fusion.get('fallbackTrials', 0)}",
+            "# TYPE lo_sweep_early_stopped_total counter",
+            f"lo_sweep_early_stopped_total "
+            f"{sweep_fusion.get('earlyStopped', 0)}",
+            "# TYPE lo_sweep_trial_errors_total counter",
+            f"lo_sweep_trial_errors_total "
+            f"{sweep_fusion.get('trialErrors', 0)}",
         ]
         serving = m["serving"]
         lines += [

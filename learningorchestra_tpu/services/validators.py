@@ -132,6 +132,29 @@ def valid_health_policy(value: Any) -> Optional[Any]:
     return value
 
 
+SCORING_FIELD = "scoring"
+
+
+def valid_scoring(value: Any) -> Optional[str]:
+    """Sweep ``scoring`` class parameter (GridSearch/RandomSearch): a
+    metric name the estimator can report. Validated at submit time —
+    without this, an unknown name surfaced as a raw KeyError from
+    ``_score`` only AFTER every trial had trained. ``"auto"`` and
+    ``"loss"`` are the selector modes; the rest are the evaluate()
+    metric names."""
+    if value is None:
+        return None
+    from learningorchestra_tpu.models import neural as neural_lib
+
+    allowed = sorted({"auto", "loss"} | set(neural_lib._METRICS))
+    if not isinstance(value, str) or value not in allowed:
+        raise HttpError(
+            HTTP_NOT_ACCEPTABLE,
+            f"{MESSAGE_INVALID_FIELD}: scoring must be one of "
+            f"{allowed}, got {value!r}")
+    return value
+
+
 def valid_positive_int(value: Any, field: str,
                        default: Optional[int] = None) -> Optional[int]:
     """Serving-session sizing field (maxSlots, maxNewTokens, cacheLen):

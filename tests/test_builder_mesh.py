@@ -3,7 +3,7 @@ jobs over mesh slices'; reference trains 5 classifiers concurrently on
 a 3-executor Spark cluster, builder_image/builder.py:62-78).
 
 ``meshParallel: true`` hands each JAX-native family (LR, NB) a
-disjoint device sub-slice (models/sweep.sub_meshes) while the tree
+disjoint device sub-slice (runtime/mesh.sub_meshes) while the tree
 families keep host sklearn threads.
 """
 import numpy as np

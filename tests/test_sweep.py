@@ -36,23 +36,6 @@ def _data(n=64):
     return x, y
 
 
-def test_sweep_sub_meshes_reexport_deprecated():
-    """The models.sweep re-export is a compatibility shim now: it must
-    emit DeprecationWarning and delegate to runtime.mesh."""
-    import warnings
-
-    from learningorchestra_tpu.models import sweep as sweep_mod
-
-    with warnings.catch_warnings(record=True) as caught:
-        warnings.simplefilter("always")
-        via_shim = sweep_mod.sub_meshes
-    assert any(issubclass(w.category, DeprecationWarning)
-               for w in caught), "no DeprecationWarning emitted"
-    assert via_shim is mesh_lib.sub_meshes
-    with pytest.raises(AttributeError):
-        sweep_mod.no_such_attribute
-
-
 def test_sub_meshes_partition():
     mesh = mesh_lib.get_default_mesh()
     slices = sub_meshes(mesh, 4)
