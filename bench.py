@@ -98,7 +98,7 @@ PHASE_TIMEOUTS = {"cnn": 600, "lstm": 600, "tlm": 900, "proxy": 120,
                   "flash": 600, "ingest": 600, "gen": 900,
                   "serving": 900,
                   "sentinel_overhead": 600, "sentinel_chaos": 600,
-                  "sweep_fusion": 900}
+                  "obs_overhead": 600, "sweep_fusion": 900}
 
 # out-of-core Builder (reference config 4: 10M-row GBT via Spark)
 BUILDER_ROWS = int(os.environ.get("LO_BENCH_BUILDER_ROWS", "10000000"))
@@ -1052,6 +1052,87 @@ def phase_sentinel_overhead():
             "platform": jax.devices()[0].platform}
 
 
+def phase_obs_overhead():
+    """Tracer correctness + cost (docs/OBSERVABILITY.md). Two parts:
+    (1) one small checkpointed train job through the REST stack must
+    leave a span tree holding queue wait, a cold compile, per-epoch
+    and checkpointCommit spans plus a per-epoch timeline; (2) the same
+    MLP fit timed with the tracer recording (under an open job span)
+    vs tracing disabled, interleaved, min-of-repeats — the tracer
+    shares the sentinel's < 3% steady-state overhead gate."""
+    import jax
+    import numpy as np
+
+    from learningorchestra_tpu import config as config_mod
+    from learningorchestra_tpu.models.neural import NeuralModel
+    from learningorchestra_tpu.observability import (
+        timeline as obs_timeline)
+    from learningorchestra_tpu.observability import trace as obs_trace
+
+    # -- (1) correctness through the full job path
+    api, prefix = _make_api()
+    home = api.ctx.config.home
+    try:
+        _run_pipeline(
+            api, prefix, "obs",
+            ("import numpy as np\n"
+             "rng = np.random.default_rng(0)\n"
+             "x = rng.normal(size=(2048, 32)).astype(np.float32)\n"
+             "y = (x[:, 0] > 0).astype(np.int32)\n"
+             "response = {'x': x, 'y': y}\n"),
+            "learningorchestra_tpu.models", "NeuralModel",
+            {"layer_configs": [
+                {"kind": "dense", "units": 32, "activation": "relu"},
+                {"kind": "dense", "units": 2,
+                 "activation": "softmax"}]},
+            {"x": "$obs_data.x", "y": "$obs_data.y", "epochs": 2,
+             "batch_size": 128, "shuffle": False, "checkpoint": True})
+        totals = obs_trace.durations_by_name("obs_train")
+        spans_present = {k: k in totals for k in
+                         ("queueWait", "compile", "epoch",
+                          "checkpointCommit")}
+        cold_compiles = sum(
+            1 for s in obs_trace.spans_of("obs_train")
+            if s.name == "compile" and s.attrs.get("cold"))
+        tl = obs_timeline.summary("obs_train") or {}
+    finally:
+        api.ctx.jobs.shutdown()
+
+    # -- (2) steady-state overhead, traced vs LO_TRACE=0
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(8192, 64)).astype(np.float32)
+    y = (x[:, 0] > 0).astype(np.int64)
+    model = NeuralModel([
+        {"kind": "dense", "units": 128, "activation": "relu"},
+        {"kind": "dense", "units": 128, "activation": "relu"},
+        {"kind": "dense", "units": 2, "activation": "softmax"}])
+    model.fit(x, y, epochs=1, batch_size=256, shuffle=False)  # warm-up
+    # the timed region must be long enough (~0.5 s) that host
+    # scheduler jitter cannot fake a 3% delta between the arms
+    times = {"traced": [], "untraced": []}
+    for _ in range(5):
+        config_mod.set_config(config_mod.Config(home=home, trace=True))
+        t0 = time.perf_counter()
+        with obs_trace.span("fit", trace="obs_overhead"):
+            model.fit(x, y, epochs=18, batch_size=256, shuffle=False)
+        times["traced"].append(time.perf_counter() - t0)
+        config_mod.set_config(config_mod.Config(home=home,
+                                                trace=False))
+        t0 = time.perf_counter()
+        model.fit(x, y, epochs=18, batch_size=256, shuffle=False)
+        times["untraced"].append(time.perf_counter() - t0)
+    best = {name: min(ts) for name, ts in times.items()}
+    return {"spans_present": spans_present,
+            "cold_compiles": cold_compiles,
+            "timeline_windows": int(tl.get("windows", 0)),
+            "timeline_steps": int(tl.get("steps", 0)),
+            "traced_seconds": round(best["traced"], 4),
+            "untraced_seconds": round(best["untraced"], 4),
+            "overhead_ratio": round(
+                best["traced"] / best["untraced"], 4),
+            "platform": jax.devices()[0].platform}
+
+
 def phase_sentinel_chaos():
     """NaN + bit-rot chaos through the full REST stack: an armed
     ``engine_step`` NaN plus a corrupted checkpoint write, under
@@ -1210,9 +1291,49 @@ PHASES = {"cnn": phase_cnn, "lstm": phase_lstm, "tlm": phase_tlm,
           "gen": phase_gen, "serving": phase_serving,
           "sentinel_overhead": phase_sentinel_overhead,
           "sentinel_chaos": phase_sentinel_chaos,
+          "obs_overhead": phase_obs_overhead,
           "sweep_fusion": phase_sweep_fusion}
 
 _RESULT_MARK = "@@LO_BENCH_RESULT@@"
+
+
+def _trace_breakdown():
+    """Compile-vs-run-vs-wait attribution from the span tracer,
+    summed over every trace this phase produced (phases run their Api
+    in-process, so the tracer rings are right here). This is what
+    makes ``builder_10m_streaming`` variance attributable: a slow
+    repeat shows up as compile (fresh jit), wait (queue/lease
+    contention) or run (actual step time) instead of one opaque
+    wall-clock number."""
+    from learningorchestra_tpu.observability import trace as obs_trace
+
+    agg = {"compileSeconds": 0.0, "waitSeconds": 0.0,
+           "runSeconds": 0.0, "checkpointSeconds": 0.0}
+    by_trace = {}
+    for tid in obs_trace.known_traces():
+        totals = obs_trace.durations_by_name(tid)
+        if not totals:
+            continue
+        c = totals.get("compile", 0.0)
+        w = totals.get("queueWait", 0.0) + totals.get("leaseWait", 0.0)
+        k = totals.get("checkpointCommit", 0.0)
+        # the attempt span (job execution) / request span (serving)
+        # covers the whole body; run time is what's left after the
+        # compile and checkpoint slices are attributed
+        body = totals.get("attempt", totals.get("request", 0.0))
+        r = max(0.0, body - c - k)
+        by_trace[tid] = {"compileSeconds": round(c, 4),
+                         "waitSeconds": round(w, 4),
+                         "runSeconds": round(r, 4),
+                         "checkpointSeconds": round(k, 4)}
+        agg["compileSeconds"] += c
+        agg["waitSeconds"] += w
+        agg["runSeconds"] += r
+        agg["checkpointSeconds"] += k
+    if not by_trace:
+        return None
+    return {"totals": {k: round(v, 4) for k, v in agg.items()},
+            "byTrace": dict(sorted(by_trace.items())[:48])}
 
 
 def _child_main(phase: str) -> int:
@@ -1232,6 +1353,14 @@ def _child_main(phase: str) -> int:
 
             jax.config.update("jax_platforms", "cpu")
         result = PHASES[phase]()
+        if os.environ.get("LO_BENCH_TRACE") == "1" and \
+                isinstance(result, dict):
+            try:
+                breakdown = _trace_breakdown()
+                if breakdown is not None:
+                    result["traceBreakdown"] = breakdown
+            except Exception:  # noqa: BLE001 — attribution is advisory
+                pass
         print(_RESULT_MARK + json.dumps({"ok": True, "result": result}),
               flush=True)
         return 0
@@ -1349,6 +1478,12 @@ def _run_phase_repeated(phase: str, extra_env=None, metrics=()):
             agg[metric] = {"median": med, "iqr": iqr, "n": len(vals),
                            "values": [round(v, 3) for v in vals]}
     out["repeats"] = {"n": n, "successful": len(good), "metrics": agg}
+    # --trace mode: keep EVERY repeat's compile/run/wait totals (not
+    # just the last run's) so a variance outlier is attributable
+    breakdowns = [(r.get("traceBreakdown") or {}).get("totals")
+                  for r in runs]
+    if any(breakdowns):
+        out["repeats"]["traceBreakdowns"] = breakdowns
     return out
 
 
@@ -1393,7 +1528,17 @@ def main(argv=None):
     parser.add_argument("--write-md", metavar="PATH",
                         help="also render the results table to PATH "
                              "(the committed BENCHMARKS.md)")
+    parser.add_argument("--trace", action="store_true",
+                        help="pull the span tree after each phase and "
+                             "report a compile-vs-run-vs-wait "
+                             "breakdown per repeat (stored in the "
+                             "BENCH json; docs/OBSERVABILITY.md)")
     args = parser.parse_args(argv)
+    if args.trace:
+        # phase children inherit this and attach traceBreakdown to
+        # their result line
+        os.environ["LO_BENCH_TRACE"] = "1"
+        os.environ.setdefault("LO_TRACE", "1")
     if args.phase:
         return _child_main(args.phase)
 
@@ -1464,6 +1609,19 @@ def main(argv=None):
         "skipped": "TPU unreachable; interpret-mode timing is not "
                    "kernel evidence"}
     proxy = _run_phase("proxy")
+
+    if args.trace:
+        for tag, res in models.items():
+            totals = (res.get("traceBreakdown") or {}).get("totals")
+            per_repeat = (res.get("repeats") or {}).get(
+                "traceBreakdowns")
+            if totals:
+                print(f"TRACE {tag}: {json.dumps(totals)}",
+                      file=sys.stderr)
+            for i, bd in enumerate(per_repeat or []):
+                if bd:
+                    print(f"TRACE {tag} repeat {i}: {json.dumps(bd)}",
+                          file=sys.stderr)
 
     headline = models["mnist_cnn"].get("samples_per_sec_per_chip")
     baseline = proxy.get("samples_per_sec")

@@ -113,9 +113,10 @@ echo "== sentinel-smoke: chaos train must finish via rollback =="
 SENTINEL_TIMEOUT="${LO_CI_SENTINEL_TIMEOUT:-600}"
 CHAOS_OUT="$(mktemp)"
 OVERHEAD_OUT="$(mktemp)"
+OBS_OUT="$(mktemp)"
 SERVE_OUT="$(mktemp)"
 SWEEP_OUT="$(mktemp)"
-trap 'rm -rf "$PERF_CACHE" "$PERF_OUT" "$SLICE_OUT" "$CHAOS_OUT" "$OVERHEAD_OUT" "$SERVE_OUT" "$SWEEP_OUT"' EXIT
+trap 'rm -rf "$PERF_CACHE" "$PERF_OUT" "$SLICE_OUT" "$CHAOS_OUT" "$OVERHEAD_OUT" "$OBS_OUT" "$SERVE_OUT" "$SWEEP_OUT"' EXIT
 timeout -k 10 "$SENTINEL_TIMEOUT" env JAX_PLATFORMS=cpu \
     JAX_COMPILATION_CACHE_DIR="$PERF_CACHE" \
     LO_COMPUTE_DTYPE=float32 \
@@ -167,6 +168,43 @@ assert ratio < 1.03, (
     f"(gate < 1.03x): {result}")
 print(f"sentinel-overhead: OK (off {result['off_seconds']}s, "
       f"skip {result['skip_seconds']}s, ratio {ratio})")
+EOF
+
+echo "== obs-smoke: traced job must tell its whole story for < 3% =="
+# One checkpointed train job through the REST stack (bench.py
+# obs_overhead; docs/OBSERVABILITY.md): the span tree must contain
+# queue-wait, a COLD compile, per-epoch and checkpointCommit spans
+# plus a per-epoch timeline — and the tracer's steady-state cost vs
+# LO_TRACE=0 must stay under the same < 3% gate as the sentinel.
+OBS_TIMEOUT="${LO_CI_OBS_TIMEOUT:-600}"
+timeout -k 10 "$OBS_TIMEOUT" env JAX_PLATFORMS=cpu \
+    JAX_COMPILATION_CACHE_DIR="$PERF_CACHE" \
+    LO_COMPUTE_DTYPE=float32 \
+    python bench.py --phase obs_overhead | tee "$OBS_OUT"
+python - "$OBS_OUT" <<'EOF'
+import json, sys
+
+mark = "@@LO_BENCH_RESULT@@"
+result = None
+for line in reversed(open(sys.argv[1]).read().splitlines()):
+    if line.startswith(mark):
+        result = json.loads(line[len(mark):])
+        break
+assert result is not None, "obs-smoke: no bench result line"
+assert "error" not in result, f"obs-smoke: phase failed: {result}"
+result = result.get("result", result)  # unwrap the ok-envelope
+missing = [k for k, ok in result["spans_present"].items() if not ok]
+assert not missing, f"obs-smoke: spans missing from trace: {missing}"
+assert result["cold_compiles"] >= 1, (
+    f"obs-smoke: no cold compile span recorded: {result}")
+assert result["timeline_windows"] >= 1, (
+    f"obs-smoke: empty per-step timeline: {result}")
+ratio = result["overhead_ratio"]
+assert ratio < 1.03, (
+    f"obs-smoke: tracer costs {ratio}x (gate < 1.03x): {result}")
+print(f"obs-smoke: OK (all spans present, {result['cold_compiles']} "
+      f"cold compile(s), {result['timeline_windows']} timeline "
+      f"window(s), overhead {ratio}x)")
 EOF
 
 echo "== serving-smoke: resident plane must beat the batch path =="

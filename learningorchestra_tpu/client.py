@@ -343,6 +343,26 @@ class Context:
             params={"seq": seq, "timeout": timeout})
         return payload["result"]
 
+    def trace(self, name: str, chrome: bool = False) -> Dict[str, Any]:
+        """The server-side span tree of a job (or a
+        ``serve/{model}/{seq}`` request). ``chrome=True`` returns
+        Chrome/Perfetto ``trace_event`` JSON instead — dump it to a
+        file and drag it into ui.perfetto.dev
+        (docs/OBSERVABILITY.md)."""
+        params = {"format": "chrome"} if chrome else None
+        _, payload = self._http.request(
+            "GET", f"{API_PREFIX}/observability/trace/{name}",
+            params=params)
+        return payload
+
+    def timeline(self, name: str) -> Dict[str, Any]:
+        """Per-step training telemetry of a job: the step-window ring
+        (dt, examples/s, loss, retrace flags) plus p50/p90/p99
+        summary (docs/OBSERVABILITY.md)."""
+        _, payload = self._http.request(
+            "GET", f"{API_PREFIX}/observability/timeline/{name}")
+        return payload
+
     def wait(self, name: str, timeout: float = 600.0) -> Dict[str, Any]:
         """Observe-driven wait on any collection's ``finished`` flag
         (event-driven; falls back to the poll in Tool.wait only through

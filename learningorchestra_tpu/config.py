@@ -277,6 +277,26 @@ class Config:
     # Observability.
     log_level: str = dataclasses.field(
         default_factory=lambda: os.environ.get("LO_LOG_LEVEL", "INFO"))
+    # Span tracing master switch (docs/OBSERVABILITY.md). Off = every
+    # tracer call degrades to a shared no-op (no allocation, no lock).
+    trace: bool = dataclasses.field(
+        default_factory=lambda: os.environ.get(
+            "LO_TRACE", "1") not in ("0", "false", "no"))
+    # Spans kept per trace (bounded ring; oldest finished spans drop
+    # first once a trace exceeds this).
+    trace_ring: int = dataclasses.field(
+        default_factory=lambda: int(os.environ.get(
+            "LO_TRACE_RING", "512")))
+    # Per-step training telemetry entries kept per job (ring buffer).
+    timeline_ring: int = dataclasses.field(
+        default_factory=lambda: int(os.environ.get(
+            "LO_TIMELINE_RING", "4096")))
+    # JSONL lifecycle event log path; empty = off. Appends one JSON
+    # object per job/serving lifecycle event, carrying traceIds for
+    # offline correlation. Strictly best-effort: a failing log never
+    # fails the job.
+    event_log: str = dataclasses.field(
+        default_factory=lambda: os.environ.get("LO_EVENT_LOG", ""))
 
     def ensure_dirs(self) -> None:
         for sub in ("datasets", "artifacts", "checkpoints", "tmp"):

@@ -40,6 +40,7 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from learningorchestra_tpu.observability import trace as obs_trace
 from learningorchestra_tpu.runtime import mesh as mesh_lib
 
 # hyperparameter names routed into the optimizer spec
@@ -347,9 +348,14 @@ class GridSearch:
             cohorts, residual_idx = self._plan_cohorts(combos)
             for cohort in cohorts:
                 try:
-                    cohort_results, stopped = self._run_fused_cohort(
-                        cohort, combos, tx, ty, vx, vy, fit_kwargs,
-                        mesh)
+                    with obs_trace.span(
+                            "fusedCohort",
+                            points=len(cohort["indices"]),
+                            hyper=sorted(cohort["hyper"][0])):
+                        cohort_results, stopped = \
+                            self._run_fused_cohort(
+                                cohort, combos, tx, ty, vx, vy,
+                                fit_kwargs, mesh)
                 except preempt.JobCancelled:
                     raise
                 except Exception:
@@ -394,6 +400,10 @@ class GridSearch:
             free = queue_mod.Queue()
             for s in slices:
                 free.put(s)
+            # trials may run on pool threads with an empty span stack,
+            # so anchor unfused-trial spans to the sweep's open span
+            # here and add them retroactively per trial
+            sweep_anchor = obs_trace.current()
 
             def run_trial(combo):
                 from learningorchestra_tpu.services import faults
@@ -401,6 +411,7 @@ class GridSearch:
                 model = _clone(self.estimator)
                 sub = free.get()
                 t0 = time.perf_counter()
+                mono0 = time.monotonic()
                 try:
                     faults.maybe_inject("sweep_trial")
                     model.set_mesh(sub)
@@ -435,6 +446,12 @@ class GridSearch:
                             "error": f"{type(exc).__name__}: {exc}",
                             "_exc": exc}
                 finally:
+                    if sweep_anchor is not None:
+                        obs_trace.add(
+                            "trial", sweep_anchor[0], mono0,
+                            time.monotonic(), parent=sweep_anchor[1],
+                            params={k: v for k, v in combo.items()
+                                    if isinstance(v, (int, float, str))})
                     free.put(sub)
 
             if k > 1:

@@ -1,0 +1,24 @@
+"""Zero-dependency tracing + telemetry (docs/OBSERVABILITY.md).
+
+Four small pieces threaded through every plane:
+
+- :mod:`trace` — bounded in-memory span tracer (one trace per job /
+  serving request) with a thread-local current-span stack so nested
+  code (engine inside lease inside job) attaches children without
+  plumbing;
+- :mod:`timeline` — fixed-size host-side ring of per-step-window
+  training telemetry fed by the engine from values the health
+  sentinel already computes;
+- :mod:`hist` — fixed-bucket latency histograms exported on
+  ``/metrics`` (JSON + Prometheus ``_bucket``/``le``);
+- :mod:`export` — span-tree / Chrome ``trace_event`` JSON and the
+  best-effort JSONL lifecycle event log (``LO_EVENT_LOG``).
+
+Everything degrades to no-ops when ``LO_TRACE=0``; nothing here may
+ever fail or stall the job it observes.
+"""
+
+from learningorchestra_tpu.observability import trace  # noqa: F401
+from learningorchestra_tpu.observability import timeline  # noqa: F401
+from learningorchestra_tpu.observability import hist  # noqa: F401
+from learningorchestra_tpu.observability import export  # noqa: F401

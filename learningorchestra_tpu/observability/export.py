@@ -1,0 +1,97 @@
+"""Trace export: Chrome ``trace_event`` JSON + JSONL event log.
+
+:func:`chrome_trace` converts a trace's spans into the Chrome
+tracing / Perfetto ``trace_event`` format (``ph:"X"`` complete
+events, microsecond timestamps) so ``GET /observability/trace/{job}
+?format=chrome`` downloads a file that drags straight into
+https://ui.perfetto.dev.
+
+:func:`log_event` appends one JSON object per job/serving lifecycle
+event to the ``LO_EVENT_LOG`` path, carrying traceIds for offline
+correlation. Export is STRICTLY best-effort: every failure (or an
+armed ``trace_export`` fault, services/faults.py) is swallowed —
+observability must never fail or stall the job it observes.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+from learningorchestra_tpu.observability import trace as trace_lib
+
+_log_lock = threading.Lock()
+
+
+def chrome_trace(trace_id: str) -> Optional[Dict[str, Any]]:
+    """``{"traceEvents": [...], "displayTimeUnit": "ms"}`` for the
+    given trace, or None if unknown. Span threads map to Chrome
+    ``tid`` rows; metadata events name them."""
+    spans = trace_lib.spans_of(trace_id)
+    anchor = trace_lib.anchor_of(trace_id)
+    if not spans or anchor is None:
+        return None
+    _, created_mono = anchor
+    tids: Dict[str, int] = {}
+    events: List[Dict[str, Any]] = [
+        {"ph": "M", "pid": 1, "tid": 0, "name": "process_name",
+         "args": {"name": f"learningorchestra:{trace_id}"}}]
+    now = time.monotonic()
+    for sp in spans:
+        tid = tids.setdefault(sp.thread, len(tids) + 1)
+        args = {k: _jsonable(v) for k, v in sp.attrs.items()}
+        args["spanId"] = sp.span_id
+        if sp.parent_id:
+            args["parentId"] = sp.parent_id
+        events.append({
+            "ph": "X", "pid": 1, "tid": tid, "name": sp.name,
+            "cat": "span",
+            "ts": round((sp.start - created_mono) * 1e6, 3),
+            "dur": round(((sp.end if sp.end is not None else now)
+                          - sp.start) * 1e6, 3),
+            "args": args})
+    for tname, tid in tids.items():
+        events.append({"ph": "M", "pid": 1, "tid": tid,
+                       "name": "thread_name",
+                       "args": {"name": tname}})
+    return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+
+def _jsonable(v: Any) -> Any:
+    if isinstance(v, (str, int, float, bool)) or v is None:
+        return v
+    return str(v)
+
+
+def log_event(kind: str, name: str, trace_id: Optional[str] = None,
+              **fields: Any) -> None:
+    """Append one lifecycle event to the JSONL event log
+    (``LO_EVENT_LOG``; empty = off). Never raises: a failing or slow
+    sink (exercised by the ``trace_export`` fault site) must not
+    touch the job's outcome."""
+    try:
+        from learningorchestra_tpu.config import get_config
+
+        path = getattr(get_config(), "event_log", "") or ""
+        if not path:
+            return
+        from learningorchestra_tpu.services import faults
+
+        faults.maybe_inject("trace_export")
+        entry: Dict[str, Any] = {
+            "ts": round(time.time(), 6), "kind": kind, "name": name}
+        if trace_id:
+            entry["traceId"] = trace_id
+        for k, v in fields.items():
+            entry[k] = _jsonable(v)
+        line = json.dumps(entry, separators=(",", ":")) + "\n"
+        with _log_lock:
+            os.makedirs(os.path.dirname(os.path.abspath(path)),
+                        exist_ok=True)
+            with open(path, "a", encoding="utf-8") as f:
+                f.write(line)
+    except Exception:  # noqa: BLE001 — strictly best-effort
+        pass

@@ -252,6 +252,34 @@ class Checkpointer:
             RuntimeWarning, stacklevel=3)
 
     def save(self, step: int, tree: Any) -> None:
+        """Commit ``step`` (atomic; see module docstring). The commit
+        wall clock — the training thread's checkpoint stall — is
+        recorded as a ``checkpointCommit`` span on the current job
+        trace and in the ``lo_checkpoint_commit_seconds`` histogram."""
+        t0 = time.monotonic()
+        try:
+            self._save_impl(step, tree)
+        finally:
+            self._observe_commit(step, t0)
+
+    @staticmethod
+    def _observe_commit(step: int, t0: float) -> None:
+        # lazy import, like _chaos_corrupt: the runtime layer must
+        # stay importable without the services package
+        try:
+            from learningorchestra_tpu.observability import hist
+            from learningorchestra_tpu.observability import trace
+
+            end = time.monotonic()
+            cur = trace.current()
+            if cur is not None:
+                trace.add("checkpointCommit", cur[0], t0, end,
+                          parent=cur[1], step=int(step))
+            hist.observe("lo_checkpoint_commit_seconds", end - t0)
+        except Exception:  # noqa: BLE001 — observability is advisory
+            pass
+
+    def _save_impl(self, step: int, tree: Any) -> None:
         if _use_orbax():
             import orbax.checkpoint as ocp
 

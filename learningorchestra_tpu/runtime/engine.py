@@ -28,6 +28,9 @@ import numpy as np
 import optax
 from flax import struct
 
+from learningorchestra_tpu.observability import hist as obs_hist
+from learningorchestra_tpu.observability import timeline as obs_timeline
+from learningorchestra_tpu.observability import trace as obs_trace
 from learningorchestra_tpu.runtime import arena as arena_lib
 from learningorchestra_tpu.runtime import data as data_lib
 from learningorchestra_tpu.runtime import health as health_lib
@@ -486,6 +489,47 @@ class Engine:
         if peak:
             record["mfu"] = round(achieved / n_dev / peak, 4)
 
+    def _observe_window(self, mono0: float, dt: float,
+                        record: Dict[str, Any], bad_steps: int, *,
+                        step: int, epoch: int, first: bool,
+                        cold: bool,
+                        compile_end: Optional[float] = None) -> None:
+        """Feed the observability plane once per step-window: an
+        ``epoch`` span (+ a ``compile`` span on the first window,
+        its ``cold``/``cacheHit`` attrs distinguishing a first trace
+        from an executable-cache hit) under the job's current span,
+        and one timeline ring entry. Reuses values the fit loop /
+        health sentinel already pulled to the host — no extra device
+        syncs — and is best-effort: it must never sink a fit."""
+        try:
+            cur = obs_trace.current()
+            if cur is None:
+                return
+            trace_id, parent = cur
+            end = mono0 + dt
+            if first:
+                c_end = compile_end if compile_end is not None else end
+                obs_trace.add("compile", trace_id, mono0, c_end,
+                              parent=parent, cold=bool(cold),
+                              cacheHit=not cold)
+                if cold:
+                    obs_hist.observe("lo_compile_seconds",
+                                     c_end - mono0)
+            attrs: Dict[str, Any] = {"epoch": epoch}
+            if record.get("loss") is not None:
+                attrs["loss"] = round(float(record["loss"]), 6)
+            obs_trace.add("epoch", trace_id, mono0, end, parent=parent,
+                          **attrs)
+            obs_timeline.record(
+                trace_id, step=step, dt=dt,
+                examples_per_second=record.get(
+                    "samplesPerSecond", 0.0),
+                loss=record.get("loss"),
+                bad_steps=bad_steps if bad_steps else None,
+                retrace=bool(first and cold))
+        except Exception:  # noqa: BLE001 — observability is advisory
+            pass
+
     def _measure_flops(self, state, batch, rng, step_fn=None) -> None:
         """Per-step flop estimate from the lowered HLO (cheap — no
         compile). Basis for the MFU line in every history record."""
@@ -765,12 +809,21 @@ class Engine:
         bs = batcher.batch_size
         key = (steps, bs, batcher.shuffles)
         epoch_step = self._epoch_steps.get(key)
+        # cold = this fit will trace+compile its epoch program on the
+        # first dispatch; warm = a process-wide executable-cache hit
+        # (jax's dispatch cache makes the first call steady-state).
+        # The distinction rides on the compile span (docs/
+        # OBSERVABILITY.md).
+        compile_cold = False
         if epoch_step is None:
+            before_misses = _EXEC_STATS["misses"]
             epoch_step = self._epoch_steps[key] = self._shared_step(
                 "epoch",
                 lambda: self._build_epoch_step(steps, bs,
                                                batcher.shuffles),
                 extra=key)
+            compile_cold = (self._exec_key("epoch", key) is None or
+                            _EXEC_STATS["misses"] > before_misses)
         base_rng = jax.random.PRNGKey(seed)
         shuffle_rng = _shuffle_rng(batcher.seed)
         # one host->HBM transfer for the whole fit; epochs shuffle in
@@ -816,6 +869,7 @@ class Engine:
                 preempt.heartbeat(epoch=epoch,
                                   rollbacks=sent["rollbacks"])
                 t0 = time.perf_counter()
+                mono0 = time.monotonic()
                 if epoch == start_epoch and sent["rollbacks"] == 0:
                     # sliced from the device copy so an arena hit never
                     # re-materializes the padded host arrays
@@ -856,6 +910,11 @@ class Engine:
                 # mode; roofline numbers start with the second epoch
                 if epoch > start_epoch:
                     self._roofline_record(record, steps, dt)
+                self._observe_window(
+                    mono0, dt, record, bad_steps,
+                    step=(epoch + 1) * steps, epoch=epoch,
+                    first=epoch == start_epoch,
+                    cold=compile_cold)
                 history.append(record)
                 if checkpointer is not None:
                     self._save_checkpoint(checkpointer, state, epoch)
@@ -914,9 +973,13 @@ class Engine:
                                      checkpointer, log_fn,
                                      start_epoch=start_epoch,
                                      policy=policy)
+        compile_cold = False
         if self._train_step is None:
+            before_misses = _EXEC_STATS["misses"]
             self._train_step = self._shared_step(
                 "train", self._build_train_step)
+            compile_cold = (self._exec_key("train", ()) is None or
+                            _EXEC_STATS["misses"] > before_misses)
         base_rng = jax.random.PRNGKey(seed)
         history: List[Dict[str, Any]] = []
         sent = self._new_sentinel()
@@ -932,6 +995,8 @@ class Engine:
         epoch = start_epoch
         while epoch < epochs:
             t0 = time.perf_counter()
+            mono0 = time.monotonic()
+            compile_mono_end: Optional[float] = None
             # metric accumulation stays on-device (async); one sync at
             # epoch end
             sums: Dict[str, Any] = {}
@@ -967,6 +1032,10 @@ class Engine:
                 if steps == 0 and epoch == start_epoch:
                     jax.block_until_ready(metrics)
                     t_steady, steady_steps = time.perf_counter(), -1
+                    # the first step's dispatch+sync window is where
+                    # XLA compiled (on a cold trace) — the compile
+                    # span's boundary (docs/OBSERVABILITY.md)
+                    compile_mono_end = time.monotonic()
                 steps += 1
                 for k, (s, c) in metrics.items():
                     sums[k] = sums.get(k, 0) + s
@@ -995,6 +1064,10 @@ class Engine:
                           samplesPerSecond=round(batcher.num_samples / dt, 2))
             steady_steps += steps
             self._roofline_record(record, steady_steps, now - t_steady)
+            self._observe_window(
+                mono0, dt, record, bad_steps, step=host_step,
+                epoch=epoch, first=epoch == start_epoch,
+                cold=compile_cold, compile_end=compile_mono_end)
             history.append(record)
             if checkpointer is not None:
                 self._save_checkpoint(checkpointer, state, epoch)
@@ -1302,10 +1375,12 @@ class FusedEngine(Engine):
         es_min_epochs = max(1, int(es.get("min_epochs", 2)))
         es_alpha = float(es.get("alpha", 0.5))
         history: List[Dict[str, Any]] = []
+        traces_before = _FUSED_STATS["epochTraces"]
         for epoch in range(epochs):
             preempt.check_cancel()
             preempt.heartbeat(epoch=epoch, fusedConfigs=n)
             t0 = time.perf_counter()
+            mono0 = time.monotonic()
             state, totals = epoch_step(
                 state, self._hyper, jnp.asarray(active), device_arrays,
                 base_rng, shuffle_rng, jnp.asarray(epoch))
@@ -1317,6 +1392,11 @@ class FusedEngine(Engine):
                     ).round(6).tolist()
                 for k, (s, c) in totals.items()}
             record.update(epoch=epoch, epochSeconds=round(dt, 4))
+            self._observe_window(
+                mono0, dt, {"epoch": epoch}, 0,
+                step=(epoch + 1) * steps, epoch=epoch,
+                first=epoch == 0,
+                cold=_FUSED_STATS["epochTraces"] > traces_before)
             history.append(record)
             if log_fn is not None:
                 log_fn(record)

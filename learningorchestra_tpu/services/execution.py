@@ -31,6 +31,7 @@ from typing import Any, Dict, Optional, Tuple
 
 from learningorchestra_tpu import analysis as A
 from learningorchestra_tpu.catalog import documents as D
+from learningorchestra_tpu.observability import trace as obs_trace
 from learningorchestra_tpu.services import validators as V
 
 NAME_FIELD = "name"
@@ -113,13 +114,23 @@ class ExecutionService:
             body.get(V.SLICE_DEVICES_FIELD))
         health_policy = V.valid_health_policy(
             body.get(V.HEALTH_POLICY_FIELD))
-        self._validator.not_duplicate(name)
-        self._validator.existing_finished(parent_name)
-        root_meta = self.root_model_metadata(parent_name)
-        self._validate_method(root_meta, method, method_parameters)
-        analysis = self._preflight(root_meta, method, method_parameters)
-        footprint = self._footprint(root_meta, method, method_parameters,
-                                    slice_devices)
+        # the trace (id == collection name) starts HERE, on the HTTP
+        # thread: submit/validate/preflight spans precede the job
+        # root span the worker thread opens later
+        with obs_trace.span("submit", trace=name, verb=verb,
+                            tool=tool):
+            with obs_trace.span("validate"):
+                self._validator.not_duplicate(name)
+                self._validator.existing_finished(parent_name)
+                root_meta = self.root_model_metadata(parent_name)
+                self._validate_method(root_meta, method,
+                                      method_parameters)
+            with obs_trace.span("preflight"):
+                analysis = self._preflight(root_meta, method,
+                                           method_parameters)
+                footprint = self._footprint(root_meta, method,
+                                            method_parameters,
+                                            slice_devices)
         type_string = D.normalize_type(f"{verb}/{tool}")
         extra = {
             D.PARENT_NAME_FIELD: parent_name,
@@ -236,9 +247,12 @@ class ExecutionService:
         def run():
             _broadcast_to_workers(name, type_string, parent_name, method,
                                   method_parameters, health_policy)
-            parent_type = self._ctx.params.artifact_type(parent_name)
-            instance = self._ctx.artifacts.load(parent_name, parent_type)
-            treated = self._ctx.params.treat(method_parameters)
+            with obs_trace.span("dataLoad"):
+                parent_type = self._ctx.params.artifact_type(
+                    parent_name)
+                instance = self._ctx.artifacts.load(parent_name,
+                                                    parent_type)
+                treated = self._ctx.params.treat(method_parameters)
             ckpt = _prepare_checkpointer(self._ctx, name, type_string,
                                          treated)
             _inject_epoch_log(self._ctx, name, instance, method, treated)
@@ -251,7 +265,8 @@ class ExecutionService:
                     ckpt.close()  # flush async orbax writes
             if type_string.startswith(_INSTANCE_RESULT_PREFIXES):
                 result = instance  # the fitted object is the artifact
-            self._ctx.artifacts.save(result, name, type_string)
+            with obs_trace.span("artifactSave"):
+                self._ctx.artifacts.save(result, name, type_string)
             _record_result_shapes(self._ctx, name, result)
             _record_sweep_fusion(self._ctx, name, result)
             summary = summarize_result(result)

@@ -24,9 +24,12 @@ through the real REST/job stack instead of only with hand-made flaky
 callables. Known sites: ``artifact_save`` (catalog/artifacts.py),
 ``job_run`` (services/jobs.py, fired while the mesh lease is held),
 ``engine_step`` (runtime/engine.py, ``nan`` mode only),
-``ckpt_write`` (runtime/checkpoint.py, ``corrupt`` mode only) and
+``ckpt_write`` (runtime/checkpoint.py, ``corrupt`` mode only),
 ``sweep_trial`` (models/sweep.py, fired at the start of each unfused
-sweep trial — exercises trial fault isolation)."""
+sweep trial — exercises trial fault isolation) and ``trace_export``
+(observability/export.py, fired inside the JSONL event-log append —
+proves a failing/slow export never fails or stalls the job, since
+the whole write is best-effort)."""
 
 from __future__ import annotations
 

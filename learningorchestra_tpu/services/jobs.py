@@ -56,6 +56,8 @@ from typing import Any, Callable, Dict, Optional
 
 from learningorchestra_tpu.catalog import documents as D
 from learningorchestra_tpu.catalog.store import Catalog
+from learningorchestra_tpu.observability import export as obs_export
+from learningorchestra_tpu.observability import trace as obs_trace
 from learningorchestra_tpu.runtime import preempt
 from learningorchestra_tpu.runtime.health import NumericalDivergence
 from learningorchestra_tpu.services import faults
@@ -216,6 +218,28 @@ class JobManager:
         self._count("timedOut" if status == D.STATUS_TIMED_OUT
                     else "cancelled")
 
+    def _record_attribution(self, name: str) -> None:
+        """Roll trace-derived wall-clock attribution into the job's
+        metadata (docs/LIFECYCLE.md): ``leaseWaitSeconds`` (mesh
+        grant wait), ``compileSeconds`` (engine lowering/first-trace
+        time) and ``checkpointCommitSeconds`` (summed commit stalls) —
+        so clients see where the time went without the trace endpoint.
+        Best-effort; requires LO_TRACE=1 (the default)."""
+        try:
+            totals = obs_trace.durations_by_name(name)
+            meta: Dict[str, Any] = {}
+            if "leaseWait" in totals:
+                meta["leaseWaitSeconds"] = totals["leaseWait"]
+            if "compile" in totals:
+                meta["compileSeconds"] = totals["compile"]
+            if "checkpointCommit" in totals:
+                meta["checkpointCommitSeconds"] = \
+                    totals["checkpointCommit"]
+            if meta:
+                self._catalog.update_metadata(name, meta)
+        except Exception:  # noqa: BLE001 — observability is advisory
+            pass
+
     def _backoff_seconds(self, attempt: int) -> float:
         """Exponential backoff with full jitter: base * 2^attempt,
         scaled by a uniform [0.5, 1.5) factor so synchronized retries
@@ -283,10 +307,20 @@ class JobManager:
                 extra=extra))
             self._set_status(name, status)
             self._count_cancel(status)
+            obs_export.log_event("job", "cancelled", trace_id=name,
+                                 reason=status)
 
         def run() -> Any:
             submitted = time.monotonic()
             token.started = submitted
+            # root span of this job's trace (trace id == collection
+            # name); every nested span — lease, dataLoad, compile,
+            # epochs, checkpoint commits — attaches under it through
+            # the thread-local stack
+            job_span = obs_trace.span("job", trace=name, pool=pool,
+                                      needsMesh=needs_mesh)
+            obs_export.log_event("job", "start", trace_id=name,
+                                 pool=pool)
             attempts = max_retries + 1
             # attempt_no counts every try (documents/diagnostics);
             # transient failures burn the max_retries budget while
@@ -297,6 +331,7 @@ class JobManager:
             transient_failures = 0
             numerical_used = 0
             preempt.install_cancel(token)
+            job_span.__enter__()
             try:
                 while True:
                     attempt_no += 1
@@ -324,9 +359,31 @@ class JobManager:
                                  else contextlib.nullcontext())
                         with lease as lease_token, \
                                 contextlib.ExitStack() as stack:
-                            queue_wait = time.monotonic() - submitted
+                            granted = time.monotonic()
+                            queue_wait = granted - submitted
                             slice_devices = getattr(
                                 lease_token, "devices", None)
+                            # retro spans: pool-queue wait, then the
+                            # fair-queue lease wait (the tail of it)
+                            lease_wait = (getattr(
+                                lease_token, "wait_seconds", 0.0)
+                                if needs_mesh else 0.0)
+                            lease_wait = min(max(lease_wait, 0.0),
+                                             queue_wait)
+                            obs_trace.add(
+                                "queueWait", name, submitted,
+                                granted - lease_wait,
+                                parent=job_span.span_id,
+                                attempt=attempt_no)
+                            if needs_mesh:
+                                # the lease-wait HISTOGRAM is fed at
+                                # the scheduler's grant site; only the
+                                # span is recorded here
+                                obs_trace.add(
+                                    "leaseWait", name,
+                                    granted - lease_wait, granted,
+                                    parent=job_span.span_id,
+                                    pool=pool)
                             if slice_devices is not None:
                                 # the granted sub-mesh becomes this
                                 # thread's current_mesh() so engines
@@ -389,7 +446,10 @@ class JobManager:
                                 # holding the mesh; raise mode a
                                 # transient attempt failure)
                                 faults.maybe_inject("job_run")
-                                result = fn()
+                                with obs_trace.span(
+                                        "attempt",
+                                        attempt=attempt_no):
+                                    result = fn()
                                 if on_success is not None:
                                     on_success(result)
                                 if mark_finished:
@@ -403,6 +463,11 @@ class JobManager:
                                             {"queueWaitSeconds": round(
                                                 queue_wait, 6),
                                              "attempt": attempt_no})))
+                                self._record_attribution(name)
+                                obs_export.log_event(
+                                    "job", "finished", trace_id=name,
+                                    elapsedSeconds=round(
+                                        time.monotonic() - start, 6))
                                 return result
                             except preempt.JobCancelled as exc:
                                 # deadline / DELETE / stall escalation
@@ -467,6 +532,11 @@ class JobManager:
                                         self._set_status(
                                             name,
                                             D.STATUS_DEAD_LETTERED)
+                                    self._record_attribution(name)
+                                    obs_export.log_event(
+                                        "job", "failed", trace_id=name,
+                                        errorKind=kind,
+                                        error=repr(exception))
                                     # finished stays False (reference
                                     # parity)
                                     return None
@@ -500,6 +570,7 @@ class JobManager:
                             "queuedOnly": True})
                         return None
             finally:
+                job_span.__exit__(None, None, None)
                 preempt.clear_cancel()
 
         with self._lock:
@@ -540,6 +611,7 @@ class JobManager:
                                     "needs_mesh": needs_mesh,
                                     "footprint": footprint,
                                     "token": token}
+        obs_export.log_event("job", "queued", trace_id=name, pool=pool)
         return future
 
     # ------------------------------------------------------------------

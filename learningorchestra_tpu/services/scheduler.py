@@ -332,6 +332,11 @@ class SliceLease:
             self._wait_max = max(self._wait_max, grant.wait_seconds)
             self._grants_by_pool[pool] = \
                 self._grants_by_pool.get(pool, 0) + 1
+            # every grant (job gang/slice AND serving lease) feeds the
+            # lease-wait histogram here — the one authoritative site
+            from learningorchestra_tpu.observability import hist
+
+            hist.observe("lo_lease_wait_seconds", grant.wait_seconds)
             return grant
 
     def release(self, pool: str, held_seconds: float,

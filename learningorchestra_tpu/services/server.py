@@ -25,6 +25,8 @@ GET    /{service}/{tool}/{name}           universal paged read
                                           for explore plots)
 DELETE /{service}/{tool}/{name}           per-service ``delete``
 GET    /observe/{name}?seq=N              long-poll change feed
+GET    /observability/trace/{name}        span tree (?format=chrome)
+GET    /observability/timeline/{name}     per-step training telemetry
 POST   /profile {action: start|stop}      jax.profiler trace capture
 GET    /profile                           profiler status + trace list
 GET    /health                            liveness + topology info
@@ -51,6 +53,10 @@ from urllib.parse import parse_qs, urlparse
 
 from learningorchestra_tpu import analysis as A
 from learningorchestra_tpu.catalog import documents as D
+from learningorchestra_tpu.observability import export as obs_export
+from learningorchestra_tpu.observability import hist as obs_hist
+from learningorchestra_tpu.observability import timeline as obs_timeline
+from learningorchestra_tpu.observability import trace as obs_trace
 from learningorchestra_tpu.services import validators as V
 from learningorchestra_tpu.services.builder_service import BuilderService
 from learningorchestra_tpu.services.columnar import (DataTypeService,
@@ -68,6 +74,15 @@ from learningorchestra_tpu.services.model_service import ModelService
 EXECUTION_VERBS = ("train", "tune", "evaluate", "predict")
 SERVICES = ("dataset", "model", "transform", "explore", "tune", "train",
             "evaluate", "predict", "builder", "function", "serve")
+
+
+def escape_label_value(v: Any) -> str:
+    """Prometheus exposition-format label-value escaping. Per the
+    spec, backslash MUST be escaped first (or the escapes introduced
+    for ``"`` and newline would themselves be double-escaped), then
+    the double quote, then line feeds."""
+    return (str(v).replace("\\", r"\\").replace('"', r'\"')
+            .replace("\n", r"\n"))
 
 
 class Api:
@@ -290,6 +305,7 @@ class Api:
             self._statuses[sk] = self._statuses.get(sk, 0) + 1
             self._latency_sum += seconds
             self._latency_count += 1
+        obs_hist.observe("lo_dispatch_seconds", seconds)
 
     def metrics(self) -> Dict[str, Any]:
         with self._metrics_lock:
@@ -330,6 +346,9 @@ class Api:
         # vectorized sweep fusion (docs/PERFORMANCE.md "Sweep fusion")
         from learningorchestra_tpu.models import sweep as sweep_lib
         out["sweepFusion"] = sweep_lib.fusion_stats()
+        # latency histograms (docs/OBSERVABILITY.md): cumulative
+        # buckets, same snapshots the Prometheus exposition serializes
+        out["latencyHistograms"] = obs_hist.snapshot_all()
         return out
 
     def metrics_prometheus(self) -> bytes:
@@ -340,11 +359,7 @@ class Api:
         # sum and count come from the same metrics() snapshot so
         # rate(sum)/rate(count) stays consistent under load
         m = self.metrics()
-
-        def esc(v: str) -> str:
-            return (str(v).replace("\\", r"\\").replace('"', r'\"')
-                    .replace("\n", r"\n"))
-
+        esc = escape_label_value
         lines = [
             "# TYPE lo_uptime_seconds gauge",
             f"lo_uptime_seconds {m['uptimeSeconds']}",
@@ -357,10 +372,10 @@ class Api:
         for status, n in m["responsesByStatus"].items():
             lines.append(
                 f'lo_responses_total{{status="{esc(status)}"}} {n}')
+        # lo_dispatch_seconds / lo_lease_wait_seconds moved from
+        # sum+count summaries to full histograms — emitted with every
+        # other latency histogram at the end of this exposition
         lines += [
-            "# TYPE lo_dispatch_seconds summary",
-            f"lo_dispatch_seconds_sum {m['dispatchSecondsSum']}",
-            f"lo_dispatch_seconds_count {m['requestsTotal']}",
             "# TYPE lo_jobs_running gauge",
             f"lo_jobs_running {m['jobsRunning']}",
             "# TYPE lo_collections gauge",
@@ -414,11 +429,6 @@ class Api:
         ]
         scheduler = m["meshScheduler"]
         lines += [
-            "# TYPE lo_lease_wait_seconds summary",
-            f"lo_lease_wait_seconds_sum "
-            f"{scheduler.get('leaseWaitSum', 0.0)}",
-            f"lo_lease_wait_seconds_count "
-            f"{scheduler.get('leaseWaitCount', 0)}",
             "# TYPE lo_lease_wait_seconds_max gauge",
             f"lo_lease_wait_seconds_max "
             f"{scheduler.get('leaseWaitMax', 0.0)}",
@@ -491,6 +501,11 @@ class Api:
                 lines.append(
                     f'{metric}{{model="{esc(sess["model"])}"}} '
                     f'{value_of(sess)}')
+        # latency histograms: lo_dispatch_seconds, lo_lease_wait_...,
+        # lo_serving_request_..., lo_compile_..., lo_checkpoint_commit_
+        # — cumulative _bucket{le=...}/_sum/_count per the exposition
+        # format, sharing the escaper above
+        lines.extend(obs_hist.prometheus_lines(esc))
         return ("\n".join(lines) + "\n").encode()
 
     # ------------------------------------------------------------------
@@ -512,6 +527,8 @@ class Api:
             return self._observe(parts, params)
         if parts and parts[0] == "profile":
             return self._profile(method, body or {})
+        if parts and parts[0] == "observability":
+            return self._observability(method, parts, params)
         if parts and parts[0] == "serve":
             # serving sessions address the MODEL in the path (the
             # session IS the resource), so the generic
@@ -538,6 +555,55 @@ class Api:
                 raise V.HttpError(V.HTTP_NOT_ACCEPTABLE, "missing name")
             return self._delete(service, tool, name)
         return 405, {"result": "unsupported method"}, "application/json"
+
+    # ------------------------------------------------------------------
+    def _observability(self, method: str, parts: list,
+                       params: Dict[str, Any]) -> Tuple[int, Any, str]:
+        """Trace / timeline read surface (docs/OBSERVABILITY.md):
+
+        - ``GET /observability/trace``              known trace ids
+        - ``GET /observability/trace/{name}``       span tree JSON
+        - ``GET /observability/trace/{name}?format=chrome``
+          Chrome/Perfetto ``trace_event`` JSON (drag into ui.perfetto.dev)
+        - ``GET /observability/timeline``           jobs with telemetry
+        - ``GET /observability/timeline/{name}``    per-step ring +
+          percentile summary
+
+        Trace names may contain ``/`` (serving requests are
+        ``serve/{model}/{seq}``), so the remaining path joins back up.
+        """
+        if method != "GET":
+            return (405, {"result": "unsupported method"},
+                    "application/json")
+        kind = parts[1] if len(parts) > 1 else ""
+        name = "/".join(parts[2:])
+        if kind == "trace":
+            if not name:
+                return (200, {"result": obs_trace.known_traces()},
+                        "application/json")
+            if params.get("format") == "chrome":
+                doc = obs_export.chrome_trace(name)
+            else:
+                doc = obs_trace.tree(name)
+            if doc is None:
+                raise V.HttpError(
+                    V.HTTP_NOT_FOUND,
+                    f"no trace recorded for {name} (job never ran "
+                    f"here, trace evicted, or LO_TRACE=0)")
+            return 200, doc, "application/json"
+        if kind == "timeline":
+            if not name:
+                return (200, {"result": obs_timeline.known_jobs()},
+                        "application/json")
+            summary = obs_timeline.summary(name)
+            if summary is None:
+                raise V.HttpError(
+                    V.HTTP_NOT_FOUND,
+                    f"no step telemetry recorded for {name}")
+            return (200, {"job": name, "summary": summary,
+                          "timeline": obs_timeline.entries(name)},
+                    "application/json")
+        return 404, {"result": "unknown route"}, "application/json"
 
     # ------------------------------------------------------------------
     def _serve(self, method: str, parts: list,

@@ -37,6 +37,9 @@ from typing import Any, Deque, Dict, List, Optional
 
 import numpy as np
 
+from learningorchestra_tpu.observability import export as obs_export
+from learningorchestra_tpu.observability import hist as obs_hist
+from learningorchestra_tpu.observability import trace as obs_trace
 from learningorchestra_tpu.services import validators as V
 from learningorchestra_tpu.services.scheduler import ServingLease
 
@@ -71,7 +74,8 @@ class LatencyTracker:
 
 
 class _Request:
-    __slots__ = ("payload", "event", "result", "error", "queued_at")
+    __slots__ = ("payload", "event", "result", "error", "queued_at",
+                 "trace_id", "popped_at", "stages", "finished_at")
 
     def __init__(self, payload: Dict[str, Any]):
         self.payload = payload
@@ -79,13 +83,22 @@ class _Request:
         self.result: Optional[Dict[str, Any]] = None
         self.error: Optional[V.HttpError] = None
         self.queued_at = time.monotonic()
+        # observability marks: the worker thread appends completed
+        # (name, start, end, attrs) stage intervals; the client thread
+        # replays them into a span tree after the response arrives
+        self.trace_id = ""
+        self.popped_at = 0.0
+        self.stages: List[Any] = []
+        self.finished_at = 0.0
 
     def finish(self, result: Dict[str, Any]) -> None:
         self.result = result
+        self.finished_at = time.monotonic()
         self.event.set()
 
     def fail(self, error: V.HttpError) -> None:
         self.error = error
+        self.finished_at = time.monotonic()
         self.event.set()
 
 
@@ -130,6 +143,7 @@ class _SessionBase:
                     f"serving queue full ({self._depth} requests "
                     f"queued) — retry with backoff")
             self.requests_total += 1
+            req.trace_id = f"serve/{self.name}/{self.requests_total}"
             self._queue.append(req)
             self._cv.notify_all()
         if timeout is None:
@@ -137,14 +151,50 @@ class _SessionBase:
             # (the client's socket timeout still bounds the call)
             timeout = self._ctx.config.request_timeout_seconds or None
         if not req.event.wait(timeout):
+            self._trace_request(req, time.monotonic(), error="timeout")
             raise V.HttpError(V.HTTP_UNAVAILABLE,
                               f"request timed out after {timeout}s "
                               f"(session overloaded or preempted)")
         if req.error is not None:
+            self._trace_request(req, time.monotonic(),
+                                error=type(req.error).__name__)
             raise req.error
-        self.latency.record(time.monotonic() - req.queued_at)
+        now = time.monotonic()
+        elapsed = now - req.queued_at
+        self.latency.record(elapsed)
+        obs_hist.observe("lo_serving_request_seconds", elapsed)
+        self._trace_request(req, now)
         assert req.result is not None
         return req.result
+
+    def _trace_request(self, req: _Request, end: float,
+                       error: Optional[str] = None) -> None:
+        """Retro-build the request's span tree (``admit → queueWait →
+        stage… → respond``) under its own trace id. The batcher thread
+        only knows stage boundaries after the fact, so it stashes
+        (name, start, end, attrs) marks on the request and the client
+        thread replays them here once the response lands."""
+        try:
+            attrs: Dict[str, Any] = {"model": self.name,
+                                     "kind": self.kind}
+            if error is not None:
+                attrs["error"] = error
+            root = obs_trace.add("request", req.trace_id,
+                                 req.queued_at, end, **attrs)
+            if root is None:
+                return
+            picked = req.popped_at or min(
+                (s[1] for s in req.stages), default=end)
+            obs_trace.add("queueWait", req.trace_id, req.queued_at,
+                          min(picked, end), parent=root)
+            for name, start, stop, st_attrs in req.stages:
+                obs_trace.add(name, req.trace_id, start, stop,
+                              parent=root, **st_attrs)
+            if req.finished_at:
+                obs_trace.add("respond", req.trace_id,
+                              req.finished_at, end, parent=root)
+        except Exception:  # noqa: BLE001 — observability is advisory
+            pass
 
     # -- worker side ---------------------------------------------------
     def _run(self) -> None:
@@ -302,6 +352,7 @@ class LMServingSession(_SessionBase):
         import jax.numpy as jnp
         import jax.random as jr
 
+        admit_t0 = time.monotonic()
         payload = req.payload
         prompt = list(payload["prompt"])
         new = int(payload.get("maxNewTokens") or 32)
@@ -321,6 +372,8 @@ class LMServingSession(_SessionBase):
         tokens = jnp.asarray(np.asarray(prompt, np.int32)[None, :])
         nxt, pcache = prefill(self._model.params, tokens, sub_prefill)
         self._cache = self._join(self._cache, pcache, slot)
+        req.stages.append(("prefill", admit_t0, time.monotonic(),
+                           {"promptTokens": s, "slot": slot}))
         first = int(nxt[0])
         self._slot_req[slot] = req
         self._slot_out[slot] = [first]
@@ -338,8 +391,11 @@ class LMServingSession(_SessionBase):
         self._slot_req[slot] = None
         if req is None:
             return
+        tokens = [int(t) for t in self._slot_out[slot]]
+        req.stages.append(("decodeIters", self._slot_t0[slot],
+                           time.monotonic(), {"tokens": len(tokens)}))
         req.finish({
-            "tokens": [int(t) for t in self._slot_out[slot]],
+            "tokens": tokens,
             "decodeSeconds": round(
                 time.monotonic() - self._slot_t0[slot], 6),
         })
@@ -357,6 +413,7 @@ class LMServingSession(_SessionBase):
                 if not free or not self._queue:
                     break
                 req = self._queue.popleft()
+            req.popped_at = time.monotonic()
             try:
                 self._admit(free[0], req)
                 admitted = True
@@ -452,6 +509,7 @@ class BucketServingSession(_SessionBase):
             with self._cv:
                 while self._queue and rows < limit:
                     req = self._queue.popleft()
+                    req.popped_at = time.monotonic()
                     n = len(req.payload["x"])
                     batch.append(req)
                     rows += n
@@ -484,6 +542,7 @@ class BucketServingSession(_SessionBase):
             # is hit exactly; padded rows are sliced off below
             pad = np.repeat(stacked[:1], bucket - n, axis=0)
             stacked = np.concatenate([stacked, pad], axis=0)
+        predict_t0 = time.monotonic()
         try:
             out = np.asarray(self._instance.predict(stacked))
         except Exception as exc:  # noqa: BLE001
@@ -491,11 +550,16 @@ class BucketServingSession(_SessionBase):
                 req.fail(V.HttpError(V.HTTP_UNAVAILABLE,
                                      f"predict failed: {exc}"))
             return True
+        predict_t1 = time.monotonic()
         self.predicts_total += 1
         self.rows_total += n
         offset = 0
         for req in batch:
             k = len(req.payload["x"])
+            req.stages.append(("batchForm", req.popped_at, predict_t0,
+                               {"rows": k}))
+            req.stages.append(("predict", predict_t0, predict_t1,
+                               {"bucket": bucket, "batchRows": n}))
             req.finish({"predictions": out[offset:offset + k].tolist(),
                         "bucket": bucket})
             offset += k
@@ -570,6 +634,8 @@ class ServingManager:
                     f"{V.MESSAGE_DUPLICATE_FILE}: serving session for "
                     f"{model_name} already exists")
             self._sessions[model_name] = session
+        obs_export.log_event("serving", "create", model=model_name,
+                             sessionKind=kind)
         return session.stats()
 
     def _build_session(self, model_name: str, instance: Any, kind: str,
@@ -639,6 +705,7 @@ class ServingManager:
         final = session.stats()
         session.close()
         final["deleted"] = True
+        obs_export.log_event("serving", "delete", model=model_name)
         return final
 
     # -- observability / lifecycle ------------------------------------
