@@ -116,7 +116,8 @@ OVERHEAD_OUT="$(mktemp)"
 OBS_OUT="$(mktemp)"
 SERVE_OUT="$(mktemp)"
 SWEEP_OUT="$(mktemp)"
-trap 'rm -rf "$PERF_CACHE" "$PERF_OUT" "$SLICE_OUT" "$CHAOS_OUT" "$OVERHEAD_OUT" "$OBS_OUT" "$SERVE_OUT" "$SWEEP_OUT"' EXIT
+MONITOR_OUT="$(mktemp)"
+trap 'rm -rf "$PERF_CACHE" "$PERF_OUT" "$SLICE_OUT" "$CHAOS_OUT" "$OVERHEAD_OUT" "$OBS_OUT" "$SERVE_OUT" "$SWEEP_OUT" "$MONITOR_OUT"' EXIT
 timeout -k 10 "$SENTINEL_TIMEOUT" env JAX_PLATFORMS=cpu \
     JAX_COMPILATION_CACHE_DIR="$PERF_CACHE" \
     LO_COMPUTE_DTYPE=float32 \
@@ -306,5 +307,57 @@ print(f"sweep-smoke: OK ({result['points']} points in "
       f"vs serial {result['serial_seconds']}s, {speedup}x, "
       f"0 warm retraces)")
 EOF
+
+echo "== monitor-smoke: SLO watchdog must page, resolve, and cost < 1% =="
+# A serving-latency fault injected through a real resident predict
+# session (bench.py monitor_smoke; docs/OBSERVABILITY.md "Cluster
+# monitor, SLOs & alerts"). Gates:
+#  - the servingP99 page alert FIRES while the fault is armed and
+#    GET /healthz reports 503
+#  - clearing the fault RESOLVES the alert and /healthz returns to
+#    200 with no restart
+#  - the background sampler at the production tick rate costs < 1%
+#    steady-state vs the monitor stopped
+MONITOR_TIMEOUT="${LO_CI_MONITOR_TIMEOUT:-600}"
+timeout -k 10 "$MONITOR_TIMEOUT" env JAX_PLATFORMS=cpu \
+    JAX_COMPILATION_CACHE_DIR="$PERF_CACHE" \
+    LO_COMPUTE_DTYPE=float32 \
+    python bench.py --phase monitor_smoke | tee "$MONITOR_OUT"
+python - "$MONITOR_OUT" <<'EOF'
+import json, sys
+
+mark = "@@LO_BENCH_RESULT@@"
+result = None
+for line in reversed(open(sys.argv[1]).read().splitlines()):
+    if line.startswith(mark):
+        result = json.loads(line[len(mark):])
+        break
+assert result is not None, "monitor-smoke: no bench result line"
+assert "error" not in result, f"monitor-smoke: phase failed: {result}"
+result = result.get("result", result)  # unwrap the ok-envelope
+assert result["alert_fired"], (
+    f"monitor-smoke: servingP99 never fired under the latency "
+    f"fault: {result}")
+assert result["healthz_during"] == 503, (
+    f"monitor-smoke: /healthz did not report 503 while a page "
+    f"alert was firing: {result}")
+assert result["alert_resolved"], (
+    f"monitor-smoke: servingP99 did not resolve after the fault "
+    f"cleared: {result}")
+assert result["healthz_after"] == 200, (
+    f"monitor-smoke: /healthz did not return to 200: {result}")
+ratio = result["overhead_ratio"]
+assert ratio < 1.01, (
+    f"monitor-smoke: sampler costs {ratio}x (gate < 1.01x): {result}")
+print(f"monitor-smoke: OK (alert fired on trace "
+      f"{result['alert_trace']}, healthz 503 -> 200, sampler "
+      f"overhead {ratio}x)")
+EOF
+
+echo "== bench-regress: newest round must not regress the prior one =="
+# IQR-scaled per-metric gate over the committed BENCH_r*.json rounds
+# (scripts/bench_regress.py); passes trivially when fewer than two
+# rounds carry a parseable extra.models payload.
+python scripts/bench_regress.py
 
 echo "== ci: OK =="

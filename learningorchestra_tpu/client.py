@@ -363,6 +363,30 @@ class Context:
             "GET", f"{API_PREFIX}/observability/timeline/{name}")
         return payload
 
+    def cluster(self) -> Dict[str, Any]:
+        """The cluster resource sampler's bounded time-series rings:
+        per-device HBM watermarks, arena occupancy, slice
+        fragmentation, queue depths and host RSS
+        (docs/OBSERVABILITY.md "Cluster monitor")."""
+        _, payload = self._http.request(
+            "GET", f"{API_PREFIX}/observability/cluster")
+        return payload
+
+    def alerts(self) -> Dict[str, Any]:
+        """SLO objectives plus currently-firing alerts and the recent
+        firing/resolved transition history
+        (docs/OBSERVABILITY.md "Cluster monitor")."""
+        _, payload = self._http.request(
+            "GET", f"{API_PREFIX}/observability/alerts")
+        return payload
+
+    def healthz(self) -> Dict[str, Any]:
+        """Readiness probe: raises on 503 (draining or a
+        page-severity SLO alert firing); returns the status body on
+        200."""
+        _, payload = self._http.request("GET", "/healthz")
+        return payload
+
     def wait(self, name: str, timeout: float = 600.0) -> Dict[str, Any]:
         """Observe-driven wait on any collection's ``finished`` flag
         (event-driven; falls back to the poll in Tool.wait only through

@@ -297,6 +297,58 @@ class Config:
     # fails the job.
     event_log: str = dataclasses.field(
         default_factory=lambda: os.environ.get("LO_EVENT_LOG", ""))
+    # Cluster resource monitor (docs/OBSERVABILITY.md "Cluster
+    # monitor"). A background sampler thread collects per-device HBM
+    # watermarks, arena occupancy, slice-scheduler
+    # occupancy/fragmentation, serving queue depth, job-queue depth
+    # and host RSS into bounded time-series rings, and the SLO
+    # watchdog evaluates the declared objectives against them.
+    monitor: bool = dataclasses.field(
+        default_factory=lambda: os.environ.get(
+            "LO_MONITOR", "1") not in ("0", "false", "no"))
+    monitor_interval_ms: float = dataclasses.field(
+        default_factory=lambda: float(os.environ.get(
+            "LO_MONITOR_INTERVAL_MS", "1000")))
+    # samples kept per monitored series (ring buffer)
+    monitor_ring: int = dataclasses.field(
+        default_factory=lambda: int(os.environ.get(
+            "LO_MONITOR_RING", "600")))
+    # Declarative SLOs (0 / NaN disables an objective). Each is
+    # evaluated over fast/slow burn-rate windows; a breach in BOTH
+    # windows fires an Alert (page severity for serving latency and
+    # HBM headroom, ticket otherwise).
+    slo_serving_p99_ms: float = dataclasses.field(
+        default_factory=lambda: float(os.environ.get(
+            "LO_SLO_SERVING_P99_MS", "0")))
+    slo_queue_wait_s: float = dataclasses.field(
+        default_factory=lambda: float(os.environ.get(
+            "LO_SLO_QUEUE_WAIT_S", "0")))
+    slo_hbm_headroom_frac: float = dataclasses.field(
+        default_factory=lambda: float(os.environ.get(
+            "LO_SLO_HBM_HEADROOM_FRAC", "0")))
+    slo_deadletter_rate: float = dataclasses.field(
+        default_factory=lambda: float(os.environ.get(
+            "LO_SLO_DEADLETTER_RATE", "0")))
+    # SLO burn-rate windows, seconds (fast catches an acute breach,
+    # slow confirms it is sustained before paging).
+    slo_fast_window_s: float = dataclasses.field(
+        default_factory=lambda: float(os.environ.get(
+            "LO_SLO_FAST_WINDOW_S", "10")))
+    slo_slow_window_s: float = dataclasses.field(
+        default_factory=lambda: float(os.environ.get(
+            "LO_SLO_SLOW_WINDOW_S", "60")))
+    # Closed-loop footprint calibration: prefer a repeat execution's
+    # measured peakHbmBytes (safety-margined, clamped to the static
+    # estimate's order of magnitude) over the preflight heuristic when
+    # sizing its mesh slice (docs/SCALING.md §7).
+    footprint_calibrate: bool = dataclasses.field(
+        default_factory=lambda: os.environ.get(
+            "LO_FOOTPRINT_CALIBRATE", "0") not in ("0", "false", "no"))
+    # safety margin multiplied onto the measured peak before it
+    # replaces the estimate
+    footprint_margin: float = dataclasses.field(
+        default_factory=lambda: float(os.environ.get(
+            "LO_FOOTPRINT_MARGIN", "1.25")))
 
     def ensure_dirs(self) -> None:
         for sub in ("datasets", "artifacts", "checkpoints", "tmp"):

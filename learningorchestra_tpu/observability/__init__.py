@@ -12,13 +12,23 @@ Four small pieces threaded through every plane:
 - :mod:`hist` — fixed-bucket latency histograms exported on
   ``/metrics`` (JSON + Prometheus ``_bucket``/``le``);
 - :mod:`export` — span-tree / Chrome ``trace_event`` JSON and the
-  best-effort JSONL lifecycle event log (``LO_EVENT_LOG``).
+  best-effort JSONL lifecycle event log (``LO_EVENT_LOG``);
+- :mod:`monitor` — background cluster resource sampler (per-device
+  HBM, arena, slice fragmentation, serving queues, host RSS) with
+  bounded time-series rings behind ``GET /observability/cluster``,
+  plus the footprint-calibration registry;
+- :mod:`slo` — burn-rate SLO watchdog over the histograms and sampler
+  rings, emitting firing/resolved alerts into the event log,
+  ``/metrics`` and ``GET /healthz``.
 
-Everything degrades to no-ops when ``LO_TRACE=0``; nothing here may
-ever fail or stall the job it observes.
+Everything degrades to no-ops when ``LO_TRACE=0`` (tracing) or
+``LO_MONITOR=0`` (sampler); nothing here may ever fail or stall the
+job it observes.
 """
 
 from learningorchestra_tpu.observability import trace  # noqa: F401
 from learningorchestra_tpu.observability import timeline  # noqa: F401
 from learningorchestra_tpu.observability import hist  # noqa: F401
 from learningorchestra_tpu.observability import export  # noqa: F401
+from learningorchestra_tpu.observability import monitor  # noqa: F401
+from learningorchestra_tpu.observability import slo  # noqa: F401

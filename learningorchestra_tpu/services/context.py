@@ -68,6 +68,22 @@ class ServiceContext:
         # heartbeats resume (the Api registers worker-lost requeue)
         self.on_pod_healthy: list = []
         self._pod_guard = _start_pod_guard(self, force=force_pod_guard)
+        # readiness: /healthz reports 503 while this is set (server
+        # shutdown flips it before the listener stops accepting)
+        self._draining = False
+        # cluster resource sampler + SLO watchdog
+        # (docs/OBSERVABILITY.md "Cluster monitor"); LO_MONITOR=0
+        # leaves both off
+        self.monitor = _start_monitor(self)
+
+    @property
+    def draining(self) -> bool:
+        return self._draining
+
+    def begin_drain(self) -> None:
+        """Flip /healthz to 503 so load balancers stop routing here
+        before the listener goes away."""
+        self._draining = True
 
     @property
     def mesh(self):
@@ -79,6 +95,9 @@ class ServiceContext:
         return mesh_lib.get_default_mesh()
 
     def close(self) -> None:
+        self._draining = True
+        if self.monitor is not None:
+            self.monitor.stop()
         if self._pod_guard is not None:
             self._pod_guard.set()
         # serving sessions first: they hold leases on the mesh the job
@@ -105,6 +124,54 @@ def _wire_xla_cache(config: Config) -> None:
                           config.xla_cache_dir)
     except Exception as exc:  # noqa: BLE001 — cache is best-effort
         print(f"xla cache: disabled ({exc!r})", flush=True)
+
+
+def _start_monitor(ctx: "ServiceContext"):
+    """Start the cluster resource sampler + SLO watchdog
+    (docs/OBSERVABILITY.md "Cluster monitor"). Collectors close over
+    the context's live components; everything is best-effort inside
+    the monitor. Returns None when ``LO_MONITOR=0``."""
+    if not getattr(ctx.config, "monitor", True):
+        return None
+    from learningorchestra_tpu.observability.monitor import \
+        ClusterMonitor
+    from learningorchestra_tpu.observability.slo import SloWatchdog
+    from learningorchestra_tpu.runtime import arena as arena_lib
+
+    def arena_stats():
+        return arena_lib.get_default_arena().stats()
+
+    def serving_stats():
+        s = ctx.serving.stats()
+        by = s.get("bySession") or []
+        depth = sum(int(v.get("queueDepth") or 0) for v in by)
+        fills = [v["batchFill"] for v in by
+                 if v.get("batchFill") is not None]
+        return {"queueDepth": depth,
+                "batchFill": (round(sum(fills) / len(fills), 4)
+                              if fills else None),
+                "sessions": len(by),
+                "requestsTotal": s.get("requestsTotal"),
+                "rejectedTotal": s.get("rejectedTotal")}
+
+    def active_trace():
+        name = ctx.jobs.active_job()
+        if name:
+            return name
+        for session in ctx.serving.stats().get("bySession") or []:
+            return f"serve/{session.get('model')}"
+        return None
+
+    monitor = ClusterMonitor(
+        interval_seconds=max(
+            0.01, float(ctx.config.monitor_interval_ms) / 1000.0),
+        ring=ctx.config.monitor_ring,
+        scheduler_stats=ctx.jobs.scheduler_stats,
+        serving_stats=serving_stats,
+        job_stats=ctx.jobs.queue_stats,
+        arena_stats=arena_stats,
+        watchdog=SloWatchdog(active_trace=active_trace))
+    return monitor.start()
 
 
 def _start_pod_guard(ctx: "ServiceContext", force: bool = False):

@@ -178,6 +178,16 @@ class ExecutionService:
         root_meta = self.root_model_metadata(parent_name)
         self._validate_method(root_meta, method, method_parameters)
         analysis = self._preflight(root_meta, method, method_parameters)
+        # re-seed the in-process calibration registry from the prior
+        # run's durable measurement, so calibration survives restarts
+        if getattr(self._ctx.config, "footprint_calibrate", False) \
+                and meta.get("peakHbmBytes"):
+            from learningorchestra_tpu.observability import \
+                monitor as monitor_lib
+
+            monitor_lib.record_peak(
+                f"{root_meta.get(D.NAME_FIELD)}:{method}",
+                int(meta["peakHbmBytes"]))
         footprint = self._footprint(root_meta, method, method_parameters,
                                     slice_devices)
         self._ctx.catalog.update_metadata(
@@ -234,9 +244,40 @@ class ExecutionService:
             estimate = A.estimate_footprint(
                 self._ctx.catalog, root_meta, method, method_parameters)
         footprint = dict(estimate) if estimate else {}
+        self._calibrate(footprint, root_meta, method)
         if slice_devices is not None:
             footprint["devices"] = slice_devices
         return footprint or None
+
+    def _calibrate(self, footprint: Dict[str, Any],
+                   root_meta: Dict[str, Any], method: str) -> None:
+        """Closed-loop footprint calibration (docs/SCALING.md §7,
+        LO_FOOTPRINT_CALIBRATE): when a prior execution of the same
+        (model, method) recorded its measured peak HBM
+        (``peakHbmBytes`` on the terminal metadata, mirrored into the
+        in-process registry), prefer that — with LO_FOOTPRINT_MARGIN
+        safety padding, clamped to one order of magnitude around the
+        static estimate — over the eval-shape heuristic, which pads
+        hardest exactly where it matters most (repeat sweeps of one
+        architecture). Always stamps ``calibrationKey`` so the job
+        layer knows where to record this run's measured peak."""
+        from learningorchestra_tpu.observability import \
+            monitor as monitor_lib
+
+        cfg = self._ctx.config
+        if not getattr(cfg, "footprint_calibrate", False):
+            return
+        key = f"{root_meta.get(D.NAME_FIELD)}:{method}"
+        footprint["calibrationKey"] = key
+        estimate = footprint.get("hbmBytes")
+        measured = monitor_lib.measured_peak(key)
+        if not measured or not estimate:
+            return
+        footprint["estimatedHbmBytes"] = int(estimate)
+        footprint["hbmBytes"] = monitor_lib.calibrated_hbm_bytes(
+            measured, int(estimate),
+            float(getattr(cfg, "footprint_margin", 1.25)))
+        footprint["estimator"] = "measured-peak"
 
     def _submit(self, name: str, type_string: str, parent_name: str,
                 method: str, method_parameters: Dict[str, Any],

@@ -26,10 +26,13 @@ callables. Known sites: ``artifact_save`` (catalog/artifacts.py),
 ``engine_step`` (runtime/engine.py, ``nan`` mode only),
 ``ckpt_write`` (runtime/checkpoint.py, ``corrupt`` mode only),
 ``sweep_trial`` (models/sweep.py, fired at the start of each unfused
-sweep trial — exercises trial fault isolation) and ``trace_export``
+sweep trial — exercises trial fault isolation), ``trace_export``
 (observability/export.py, fired inside the JSONL event-log append —
 proves a failing/slow export never fails or stalls the job, since
-the whole write is best-effort)."""
+the whole write is best-effort) and ``serving_step``
+(services/serving.py, fired before a serving iteration with queued
+work; ``latency`` mode inflates request latency so the SLO
+watchdog's ``servingP99`` alert path is testable end-to-end)."""
 
 from __future__ import annotations
 

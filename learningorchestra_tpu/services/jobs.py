@@ -57,6 +57,7 @@ from typing import Any, Callable, Dict, Optional
 from learningorchestra_tpu.catalog import documents as D
 from learningorchestra_tpu.catalog.store import Catalog
 from learningorchestra_tpu.observability import export as obs_export
+from learningorchestra_tpu.observability import monitor as obs_monitor
 from learningorchestra_tpu.observability import trace as obs_trace
 from learningorchestra_tpu.runtime import preempt
 from learningorchestra_tpu.runtime.health import NumericalDivergence
@@ -158,7 +159,8 @@ class JobManager:
         self._numerical_retries = max(0, int(numerical_retries))
         self._counters: Dict[str, int] = {"retries": 0, "cancelled": 0,
                                           "timedOut": 0,
-                                          "numericalRetries": 0}
+                                          "numericalRetries": 0,
+                                          "deadLettered": 0}
         self._stalled: set = set()
         self._watchdog_stop = threading.Event()
         if self._stall_seconds > 0:
@@ -191,6 +193,24 @@ class JobManager:
         as ``lo_mesh_devices_busy`` etc. by the Api)."""
         return self._mesh.stats()
 
+    def queue_stats(self) -> Dict[str, int]:
+        """Live job-queue depth for the cluster monitor: submitted
+        jobs split into started-on-a-worker (``running``) vs still
+        waiting for a thread (``queued``), plus the monotonic
+        dead-letter counter the SLO watchdog rates."""
+        with self._lock:
+            live = [k for k, f in self._futures.items()
+                    if not f.done()]
+            started = 0
+            for k in live:
+                token = (self._job_info.get(k) or {}).get("token")
+                if token is not None and getattr(token, "started",
+                                                 None):
+                    started += 1
+        counters = self.lifecycle_counters()
+        return {"running": started, "queued": len(live) - started,
+                "deadLettered": counters.get("deadLettered", 0)}
+
     def lifecycle_counters(self) -> Dict[str, int]:
         """Monotonic lifecycle counters + the currently-stalled gauge
         (exported as ``lo_job_retries_total`` etc. by the Api)."""
@@ -218,13 +238,20 @@ class JobManager:
         self._count("timedOut" if status == D.STATUS_TIMED_OUT
                     else "cancelled")
 
-    def _record_attribution(self, name: str) -> None:
+    def _record_attribution(self, name: str,
+                            footprint: Optional[Dict[str, Any]] = None,
+                            measure_hbm: bool = False) -> None:
         """Roll trace-derived wall-clock attribution into the job's
         metadata (docs/LIFECYCLE.md): ``leaseWaitSeconds`` (mesh
         grant wait), ``compileSeconds`` (engine lowering/first-trace
         time) and ``checkpointCommitSeconds`` (summed commit stalls) —
         so clients see where the time went without the trace endpoint.
-        Best-effort; requires LO_TRACE=1 (the default)."""
+        Mesh jobs additionally record ``peakHbmBytes`` — the process's
+        device high-water mark while the job ran (an upper bound under
+        slice concurrency) — and feed the footprint-calibration
+        registry so a repeat execution's slice is sized from the
+        measurement (docs/SCALING.md §7). Best-effort; requires
+        LO_TRACE=1 (the default)."""
         try:
             totals = obs_trace.durations_by_name(name)
             meta: Dict[str, Any] = {}
@@ -235,6 +262,13 @@ class JobManager:
             if "checkpointCommit" in totals:
                 meta["checkpointCommitSeconds"] = \
                     totals["checkpointCommit"]
+            if measure_hbm:
+                peak = obs_monitor.peak_hbm_bytes()
+                if peak:
+                    meta["peakHbmBytes"] = int(peak)
+                    key = (footprint.get("calibrationKey")
+                           if isinstance(footprint, dict) else None)
+                    obs_monitor.record_peak(key or name, peak)
             if meta:
                 self._catalog.update_metadata(name, meta)
         except Exception:  # noqa: BLE001 — observability is advisory
@@ -463,7 +497,9 @@ class JobManager:
                                             {"queueWaitSeconds": round(
                                                 queue_wait, 6),
                                              "attempt": attempt_no})))
-                                self._record_attribution(name)
+                                self._record_attribution(
+                                    name, footprint,
+                                    measure_hbm=needs_mesh)
                                 obs_export.log_event(
                                     "job", "finished", trace_id=name,
                                     elapsedSeconds=round(
@@ -514,6 +550,7 @@ class JobManager:
                                         extra[D.STATUS_FIELD] = \
                                             D.STATUS_DEAD_LETTERED
                                         extra["deadLettered"] = True
+                                        self._count("deadLettered")
                                         if kind == PERMANENT and \
                                                 max_retries > 0:
                                             extra["retriesSkipped"] = \
@@ -532,7 +569,9 @@ class JobManager:
                                         self._set_status(
                                             name,
                                             D.STATUS_DEAD_LETTERED)
-                                    self._record_attribution(name)
+                                    self._record_attribution(
+                                        name, footprint,
+                                        measure_hbm=needs_mesh)
                                     obs_export.log_event(
                                         "job", "failed", trace_id=name,
                                         errorKind=kind,
@@ -739,6 +778,15 @@ class JobManager:
     def running(self) -> int:
         with self._lock:
             return sum(1 for f in self._futures.values() if not f.done())
+
+    def active_job(self) -> Optional[str]:
+        """Name (= trace id) of one live job, for alert↔trace
+        correlation; None when idle."""
+        with self._lock:
+            for name, future in self._futures.items():
+                if not future.done():
+                    return name
+        return None
 
     def shutdown(self, cancel_futures: bool = True) -> None:
         self._watchdog_stop.set()

@@ -392,13 +392,31 @@ class SliceLease:
         LEASES there (0 or 1) and ``devicesTotal`` is None."""
         with self._cv:
             busy = len(self._holders) + len(self._granted)
+            free_n = largest = 0
+            fragmentation = 0.0
             if self._sliced and self._free is not None:
                 busy = self._total - len(self._free)
+                free_n = len(self._free)
+                run = 0
+                for i in range(self._total):
+                    if i in self._free:
+                        run += 1
+                        largest = max(largest, run)
+                    else:
+                        run = 0
+                # 1 - largest contiguous free run / free total: 0 =
+                # all free devices are one grantable block, ->1 = free
+                # capacity exists but is shredded into unusable holes
+                if free_n:
+                    fragmentation = round(1.0 - largest / free_n, 6)
             return {
                 "sliced": self._sliced,
                 "capacity": self._capacity,
                 "devicesTotal": self._total,
                 "devicesBusy": busy,
+                "devicesFree": free_n,
+                "largestFreeRun": largest,
+                "fragmentation": fragmentation,
                 "waiters": len(self._waiters),
                 "grantsByPool": dict(self._grants_by_pool),
                 "leaseWaitSum": self._wait_sum,

@@ -349,6 +349,15 @@ class Api:
         # latency histograms (docs/OBSERVABILITY.md): cumulative
         # buckets, same snapshots the Prometheus exposition serializes
         out["latencyHistograms"] = obs_hist.snapshot_all()
+        # cluster resource sampler + SLO watchdog (docs/OBSERVABILITY
+        # .md "Cluster monitor"); absent when LO_MONITOR=0
+        monitor = getattr(self.ctx, "monitor", None)
+        if monitor is not None:
+            out["cluster"] = monitor.latest()
+            watchdog = monitor.watchdog
+            if watchdog is not None:
+                out["alerts"] = watchdog.firing()
+                out["alertsFiring"] = len(out["alerts"])
         return out
 
     def metrics_prometheus(self) -> bytes:
@@ -501,6 +510,38 @@ class Api:
                 lines.append(
                     f'{metric}{{model="{esc(sess["model"])}"}} '
                     f'{value_of(sess)}')
+        # cluster monitor + SLO watchdog gauges (absent when
+        # LO_MONITOR=0, so scrapers see the series disappear rather
+        # than freeze at the last value)
+        cluster = m.get("cluster")
+        if cluster:
+            hbm = cluster.get("hbm") or {}
+            sched = cluster.get("scheduler") or {}
+            serving_sample = cluster.get("serving") or {}
+            for metric, value in (
+                    ("lo_hbm_bytes_in_use", hbm.get("bytesInUse")),
+                    ("lo_hbm_peak_bytes_in_use",
+                     hbm.get("peakBytesInUse")),
+                    ("lo_hbm_headroom_frac", hbm.get("headroomFrac")),
+                    ("lo_slice_fragmentation",
+                     sched.get("fragmentation")),
+                    ("lo_serving_queue_depth_total",
+                     serving_sample.get("queueDepth")),
+                    ("lo_host_rss_bytes", cluster.get("hostRssBytes"))):
+                if value is not None:
+                    lines.append(f"# TYPE {metric} gauge")
+                    lines.append(f"{metric} {value}")
+        if "alertsFiring" in m:
+            lines += [
+                "# TYPE lo_alerts_firing gauge",
+                f"lo_alerts_firing {m['alertsFiring']}",
+            ]
+            if m.get("alerts"):
+                lines.append("# TYPE lo_alert_firing gauge")
+                for alert in m["alerts"]:
+                    lines.append(
+                        f'lo_alert_firing{{alert="{esc(alert["name"])}"'
+                        f',severity="{esc(alert["severity"])}"}} 1')
         # latency histograms: lo_dispatch_seconds, lo_lease_wait_...,
         # lo_serving_request_..., lo_compile_..., lo_checkpoint_commit_
         # — cumulative _bucket{le=...}/_sum/_count per the exposition
@@ -515,6 +556,8 @@ class Api:
         prefix = self.ctx.config.api_prefix
         if path == "/health":
             return 200, self._health(), "application/json"
+        if path == "/healthz":
+            return self._healthz()
         if path == "/metrics":
             if params.get("format") == "prometheus":
                 return (200, self.metrics_prometheus(),
@@ -568,6 +611,10 @@ class Api:
         - ``GET /observability/timeline``           jobs with telemetry
         - ``GET /observability/timeline/{name}``    per-step ring +
           percentile summary
+        - ``GET /observability/cluster``            resource-sampler
+          rings (HBM, arena, slices, queues, RSS)
+        - ``GET /observability/alerts``             SLO objectives +
+          firing/ resolved alert history
 
         Trace names may contain ``/`` (serving requests are
         ``serve/{model}/{seq}``), so the remaining path joins back up.
@@ -603,6 +650,21 @@ class Api:
             return (200, {"job": name, "summary": summary,
                           "timeline": obs_timeline.entries(name)},
                     "application/json")
+        if kind == "cluster":
+            monitor = getattr(self.ctx, "monitor", None)
+            if monitor is None:
+                raise V.HttpError(
+                    V.HTTP_NOT_FOUND,
+                    "cluster monitor disabled (LO_MONITOR=0)")
+            return 200, monitor.snapshot(), "application/json"
+        if kind == "alerts":
+            monitor = getattr(self.ctx, "monitor", None)
+            watchdog = getattr(monitor, "watchdog", None)
+            if watchdog is None:
+                raise V.HttpError(
+                    V.HTTP_NOT_FOUND,
+                    "SLO watchdog disabled (LO_MONITOR=0)")
+            return 200, watchdog.snapshot(), "application/json"
         return 404, {"result": "unknown route"}, "application/json"
 
     # ------------------------------------------------------------------
@@ -660,6 +722,23 @@ class Api:
             info["deviceError"] = repr(e)
         return info
 
+    def _healthz(self) -> Tuple[int, Any, str]:
+        """Readiness probe (docs/OBSERVABILITY.md "/healthz"): 503
+        while the server drains (load balancers stop routing before
+        the listener dies) or while any page-severity SLO alert fires;
+        200 otherwise. Distinct from ``/health``, which reports
+        liveness detail and never changes the status code."""
+        monitor = getattr(self.ctx, "monitor", None)
+        watchdog = getattr(monitor, "watchdog", None)
+        paging = [a for a in watchdog.firing()
+                  if a["severity"] == "page"] if watchdog else []
+        if self.ctx.draining:
+            return (503, {"status": "draining"}, "application/json")
+        if paging:
+            return (503, {"status": "failing", "alerts": paging},
+                    "application/json")
+        return 200, {"status": "ok"}, "application/json"
+
     def _profile(self, method: str, body: Dict[str, Any],
                  ) -> Tuple[int, Any, str]:
         """``POST /profile {"action": "start"|"stop"}`` captures a
@@ -700,8 +779,16 @@ class Api:
                 if self._profile_dir is None:
                     raise V.HttpError(V.HTTP_NOT_ACCEPTABLE,
                                       "no active trace")
-                jax.profiler.stop_trace()
-                trace_dir, self._profile_dir = self._profile_dir, None
+                # clear the active marker no matter how stop_trace()
+                # exits: if it raised with the marker still set, every
+                # later start would 406 "already active" forever with
+                # no live profiler session behind it. The raise itself
+                # propagates to the dispatcher's generic 500 handler.
+                try:
+                    jax.profiler.stop_trace()
+                finally:
+                    trace_dir, self._profile_dir = \
+                        self._profile_dir, None
                 n_files = sum(len(fs) for _, _, fs in os.walk(trace_dir))
                 return 200, {"result": trace_dir,
                              "files": n_files}, "application/json"
@@ -980,6 +1067,9 @@ class RestServer:
         self.httpd.serve_forever()
 
     def stop(self) -> None:
+        # flip /healthz to 503 while the listener still answers, so a
+        # load balancer health-checking this node drains it first
+        self.api.ctx.begin_drain()
         self.httpd.shutdown()
         self.httpd.server_close()
         self.api.ctx.close()

@@ -40,6 +40,7 @@ import numpy as np
 from learningorchestra_tpu.observability import export as obs_export
 from learningorchestra_tpu.observability import hist as obs_hist
 from learningorchestra_tpu.observability import trace as obs_trace
+from learningorchestra_tpu.services import faults
 from learningorchestra_tpu.services import validators as V
 from learningorchestra_tpu.services.scheduler import ServingLease
 
@@ -214,6 +215,12 @@ class _SessionBase:
                 # holds its grant across a contended boundary
                 if self._lease.maybe_yield():
                     self._on_reacquired()
+                if self._have_work():
+                    # chaos site (latency mode inflates request
+                    # latency for the SLO watchdog's servingP99
+                    # alert); gated on queued work so idle ticks
+                    # don't burn a count-budgeted fault spec
+                    faults.maybe_inject("serving_step")
                 self._serve_once()
             except Exception as exc:  # noqa: BLE001 — fail requests, not the thread
                 self._fail_all(V.HttpError(
@@ -247,6 +254,12 @@ class _SessionBase:
             f"serving session {self.name} was deleted"))
         self._lease.release()
 
+    def _batch_fill(self) -> Optional[float]:
+        """Fraction of the compiled batch the last iteration actually
+        used (slot occupancy / bucket fill), for the cluster monitor;
+        None before any batch formed."""
+        return None
+
     def stats(self) -> Dict[str, Any]:
         with self._cv:
             depth = len(self._queue)
@@ -255,6 +268,7 @@ class _SessionBase:
             "kind": self.kind,
             "queueDepth": depth,
             "queueBound": self._depth,
+            "batchFill": self._batch_fill(),
             "requestsTotal": self.requests_total,
             "rejectedTotal": self.rejected_total,
             "uptimeSeconds": round(time.time() - self.created_at, 3),
@@ -449,6 +463,12 @@ class LMServingSession(_SessionBase):
         super().close()
         self._params_entry.release()
 
+    def _batch_fill(self) -> Optional[float]:
+        active = sum(1 for r in self._slot_req if r is not None)
+        if not active and not self.tokens_total:
+            return None
+        return round(active / self.slots, 4)
+
     def stats(self) -> Dict[str, Any]:
         out = super().stats()
         out.update({
@@ -483,6 +503,7 @@ class BucketServingSession(_SessionBase):
         self._max_wait = float(ctx.config.serve_max_wait_ms) / 1e3
         self.predicts_total = 0
         self.rows_total = 0
+        self._last_fill: Optional[float] = None
 
     def validate_request(self, payload: Dict[str, Any]) -> None:
         x = payload.get("x")
@@ -553,6 +574,7 @@ class BucketServingSession(_SessionBase):
         predict_t1 = time.monotonic()
         self.predicts_total += 1
         self.rows_total += n
+        self._last_fill = round(n / bucket, 4)
         offset = 0
         for req in batch:
             k = len(req.payload["x"])
@@ -564,6 +586,9 @@ class BucketServingSession(_SessionBase):
                         "bucket": bucket})
             offset += k
         return True
+
+    def _batch_fill(self) -> Optional[float]:
+        return self._last_fill
 
     def stats(self) -> Dict[str, Any]:
         out = super().stats()

@@ -1,0 +1,197 @@
+#!/usr/bin/env python3
+"""Benchmark regression gate over the committed round reports.
+
+Compares the NEWEST ``BENCH_r*.json`` against the prior round,
+per phase and per metric. Repeated phases (``repeats.metrics``) carry
+a median + IQR from bench.py's ``_run_phase_repeated``; the allowed
+slack per metric is::
+
+    slack = max(rel_tol * |prior|, iqr_mult * IQR)
+
+so a metric that is naturally noisy across repeats (wide IQR) gets a
+proportionally wider gate, while a tight metric is held to the
+relative floor. Point metrics (no repeats block) use the relative
+floor alone. Direction is inferred from the metric name: latency /
+seconds / RSS-style metrics regress UPWARD, throughput / speedup /
+accuracy metrics regress DOWNWARD.
+
+Prints a pass/regress table and exits nonzero when any metric
+regressed — the CI hook. Rounds whose ``parsed`` line carries no
+``extra.models`` payload (tail-truncated captures, compact-only
+trailers) are skipped when picking the two rounds to compare.
+
+Usage::
+
+    python scripts/bench_regress.py            # newest vs prior
+    python scripts/bench_regress.py --rel-tol 0.15 --iqr-mult 2.0
+    python scripts/bench_regress.py --dir /path/with/BENCH_r*.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+from typing import Dict, List, Optional, Tuple
+
+# metric-name fragments where SMALLER is better; everything else is
+# treated as higher-is-better (throughput, speedup, accuracy, MFU)
+_LOWER_IS_BETTER = re.compile(
+    r"(seconds|_ms$|_ms\b|p50|p99|rss|overhead|retraces|latency"
+    r"|time_to|evictions|rejected)", re.IGNORECASE)
+
+_SKIP_KEYS = {"platform", "rows", "epochs", "batch_size", "n_samples",
+              "streams", "requests_per_stream", "prompt_len",
+              "new_tokens", "points", "cohorts", "fused_trials",
+              "best_lr", "n", "ring", "healthz_during",
+              "healthz_after"}
+
+
+def _round_number(path: str) -> int:
+    m = re.search(r"BENCH_r(\d+)\.json$", path)
+    return int(m.group(1)) if m else -1
+
+
+def find_rounds(directory: str) -> List[str]:
+    paths = glob.glob(os.path.join(directory, "BENCH_r*.json"))
+    return sorted((p for p in paths if _round_number(p) >= 0),
+                  key=_round_number)
+
+
+def load_models(path: str) -> Dict[str, dict]:
+    """``extra.models`` of one round file, or {} when the round's
+    parsed line was truncated/compact-only."""
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except (OSError, ValueError):
+        return {}
+    parsed = doc.get("parsed") if isinstance(doc, dict) else None
+    if not isinstance(parsed, dict):
+        return {}
+    models = (parsed.get("extra") or {}).get("models")
+    return models if isinstance(models, dict) else {}
+
+
+def phase_metrics(stats: dict) -> Dict[str, Tuple[float,
+                                                  Optional[float]]]:
+    """``{metric: (value, iqr_or_None)}`` for one phase's stats dict.
+    Repeat-aggregated metrics win over same-named flat fields."""
+    out: Dict[str, Tuple[float, Optional[float]]] = {}
+    for key, value in stats.items():
+        if key in _SKIP_KEYS or isinstance(value, bool):
+            continue
+        if isinstance(value, (int, float)):
+            out[key] = (float(value), None)
+    repeats = stats.get("repeats")
+    if isinstance(repeats, dict):
+        for metric, agg in (repeats.get("metrics") or {}).items():
+            if not isinstance(agg, dict):
+                continue
+            med = agg.get("median")
+            if isinstance(med, (int, float)):
+                iqr = agg.get("iqr")
+                out[metric] = (float(med),
+                               float(iqr)
+                               if isinstance(iqr, (int, float))
+                               else None)
+    return out
+
+
+def compare(prior: Dict[str, dict], newest: Dict[str, dict],
+            rel_tol: float, iqr_mult: float) -> List[dict]:
+    rows = []
+    for phase in sorted(set(prior) & set(newest)):
+        old_stats, new_stats = prior[phase], newest[phase]
+        if "error" in old_stats or "error" in new_stats:
+            rows.append({"phase": phase, "metric": "-",
+                         "prior": None, "newest": None, "slack": None,
+                         "verdict": "skip (errored round)"})
+            continue
+        old_m = phase_metrics(old_stats)
+        new_m = phase_metrics(new_stats)
+        for metric in sorted(set(old_m) & set(new_m)):
+            old_val, old_iqr = old_m[metric]
+            new_val, _ = new_m[metric]
+            slack = abs(old_val) * rel_tol
+            if old_iqr is not None:
+                slack = max(slack, iqr_mult * old_iqr)
+            if _LOWER_IS_BETTER.search(metric):
+                regressed = new_val > old_val + slack
+            else:
+                regressed = new_val < old_val - slack
+            rows.append({"phase": phase, "metric": metric,
+                         "prior": old_val, "newest": new_val,
+                         "slack": round(slack, 4),
+                         "verdict": "REGRESS" if regressed
+                         else "pass"})
+    return rows
+
+
+def _fmt(v) -> str:
+    if v is None:
+        return "-"
+    if isinstance(v, float):
+        return f"{v:.4g}"
+    return str(v)
+
+
+def print_table(rows: List[dict], prior_path: str,
+                newest_path: str) -> None:
+    print(f"bench regress: {os.path.basename(newest_path)} vs "
+          f"{os.path.basename(prior_path)}")
+    header = ("phase", "metric", "prior", "newest", "slack", "verdict")
+    table = [header] + [
+        tuple(_fmt(r[k]) for k in ("phase", "metric", "prior",
+                                   "newest", "slack", "verdict"))
+        for r in rows]
+    widths = [max(len(row[i]) for row in table)
+              for i in range(len(header))]
+    for i, row in enumerate(table):
+        print("  ".join(cell.ljust(w)
+                        for cell, w in zip(row, widths)).rstrip())
+        if i == 0:
+            print("  ".join("-" * w for w in widths))
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        description="Gate the newest benchmark round against the "
+                    "prior one (IQR-scaled per-metric tolerance).")
+    ap.add_argument("--dir", default=os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))),
+        help="directory holding BENCH_r*.json (default: repo root)")
+    ap.add_argument("--rel-tol", type=float, default=0.10,
+                    help="relative tolerance floor (default 0.10)")
+    ap.add_argument("--iqr-mult", type=float, default=1.5,
+                    help="IQR multiplier for repeat-aggregated "
+                         "metrics (default 1.5)")
+    args = ap.parse_args(argv)
+
+    usable = [(p, load_models(p)) for p in find_rounds(args.dir)]
+    usable = [(p, m) for p, m in usable if m]
+    if len(usable) < 2:
+        print(f"bench regress: fewer than 2 rounds with a parseable "
+              f"extra.models payload under {args.dir} — nothing to "
+              f"compare (pass)")
+        return 0
+    (prior_path, prior), (newest_path, newest) = usable[-2], usable[-1]
+    rows = compare(prior, newest, args.rel_tol, args.iqr_mult)
+    if not rows:
+        print("bench regress: no common phases/metrics between the "
+              "two newest rounds (pass)")
+        return 0
+    print_table(rows, prior_path, newest_path)
+    regressed = [r for r in rows if r["verdict"] == "REGRESS"]
+    if regressed:
+        print(f"\nbench regress: {len(regressed)} metric(s) regressed")
+        return 1
+    print("\nbench regress: OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
