@@ -99,7 +99,8 @@ PHASE_TIMEOUTS = {"cnn": 600, "lstm": 600, "tlm": 900, "proxy": 120,
                   "serving": 900,
                   "sentinel_overhead": 600, "sentinel_chaos": 600,
                   "obs_overhead": 600, "monitor_smoke": 600,
-                  "sweep_fusion": 900}
+                  "sweep_fusion": 900,
+                  "ckpt_stall": 300, "migration_smoke": 600}
 
 # out-of-core Builder (reference config 4: 10M-row GBT via Spark)
 BUILDER_ROWS = int(os.environ.get("LO_BENCH_BUILDER_ROWS", "10000000"))
@@ -1412,6 +1413,225 @@ def phase_sweep_fusion():
             "platform": jax.devices()[0].platform}
 
 
+def phase_ckpt_stall():
+    """Train-thread checkpoint stall: synchronous commit vs the async
+    tiered manager (docs/RELIABILITY.md "Async checkpointing"). The
+    same multi-MB state tree is saved SAVES times; the sync arm pays
+    serialize+hash+fsync on the caller thread, the async arm pays only
+    the device->host snapshot + enqueue while the background worker
+    commits during the (emulated) epoch compute between saves. CI
+    gates on stall_ratio < 0.10."""
+    import jax
+    import numpy as np
+
+    from learningorchestra_tpu import config as config_mod
+    from learningorchestra_tpu.runtime.async_ckpt import (
+        AsyncCheckpointManager,
+    )
+    from learningorchestra_tpu.runtime.checkpoint import Checkpointer
+
+    home = tempfile.mkdtemp(prefix="lo_bench_ckpt_")
+    config_mod.set_config(config_mod.Config(home=home))
+    mb = int(os.environ.get("LO_BENCH_CKPT_MB", "32"))
+    saves = int(os.environ.get("LO_BENCH_CKPT_SAVES", "5"))
+    leaves = 8
+    n = mb * (1 << 20) // 4 // leaves
+    rng = np.random.default_rng(0)
+    tree = {"step": np.int32(0),
+            "params": {f"w{i}": jax.device_put(
+                rng.normal(size=(n,)).astype(np.float32))
+                for i in range(leaves)}}
+
+    def timed_saves(ckpt, gap):
+        stall = 0.0
+        for step in range(1, saves + 1):
+            t0 = time.perf_counter()
+            ckpt.save(step, tree)
+            stall += time.perf_counter() - t0
+            if gap:
+                time.sleep(gap)
+        return stall
+
+    sync = Checkpointer(os.path.join(home, "sync"), max_to_keep=2)
+    sync.save(0, tree)  # warm-up: first-write/page-cache costs
+    sync_stall = timed_saves(sync, 0.0)
+    sync.close()
+    per_commit = sync_stall / saves
+
+    amgr = AsyncCheckpointManager(
+        Checkpointer(os.path.join(home, "async"), max_to_keep=2),
+        inflight=2)
+    amgr.save(0, tree)  # warm-up
+    amgr.wait_until_finished()
+    # the gap emulates an epoch of compute the background commit
+    # overlaps, sized to the measured commit so the bounded queue's
+    # backpressure never engages in the steady state being measured
+    async_stall = timed_saves(amgr, per_commit)
+    amgr.wait_until_finished()
+    amgr.close()
+
+    return {"payload_mb": mb, "saves": saves,
+            "sync_stall_seconds": round(sync_stall, 4),
+            "async_stall_seconds": round(async_stall, 4),
+            "commit_seconds_each": round(per_commit, 4),
+            "stall_ratio": round(async_stall / sync_stall, 4),
+            "platform": jax.devices()[0].platform}
+
+
+def phase_migration_smoke():
+    """Live migration must be invisible to the math, and defrag must
+    place an aged waiter (docs/SCALING.md §7). Part 1 runs the same
+    deterministic fit twice through the slice scheduler — untouched vs
+    force-migrated mid-fit — and compares final params bit-for-bit.
+    Part 2 re-creates the fragmentation scenario (a 6/8-device holder
+    starving a 4-device waiter) with LO_SLICE_DEFRAG armed; the
+    waiter must land WHILE the holder still runs."""
+    import threading
+
+    import jax
+    import numpy as np
+
+    from learningorchestra_tpu import config as config_mod
+    from learningorchestra_tpu.catalog import Catalog
+    from learningorchestra_tpu.runtime import preempt
+    from learningorchestra_tpu.services.jobs import JobManager
+
+    total = len(jax.devices())
+    if total < 2:
+        return {"skipped": f"needs >=2 devices, have {total}"}
+    half = total // 2
+    home = tempfile.mkdtemp(prefix="lo_bench_mig_")
+    cfg = config_mod.set_config(config_mod.Config(home=home))
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(2048, 32)).astype(np.float32)
+    y = (x @ rng.normal(size=(32, 1)).astype(np.float32))[:, 0]
+
+    def fit_job(ckpt_dir, sink):
+        import jax.numpy as jnp
+        import optax
+
+        from learningorchestra_tpu.runtime import data as data_lib
+        from learningorchestra_tpu.runtime import mesh as mesh_lib
+        from learningorchestra_tpu.runtime.checkpoint import (
+            Checkpointer,
+        )
+        from learningorchestra_tpu.runtime.engine import (
+            Engine, mse_loss, to_host)
+
+        def apply_fn(params, model_state, batch, train, step_rng):
+            return batch["x"] @ params["w"], model_state
+
+        def job():
+            eng = Engine(apply_fn=apply_fn, loss_fn=mse_loss,
+                         optimizer=optax.sgd(0.01),
+                         mesh=mesh_lib.current_mesh(),
+                         compute_dtype=jnp.float32,
+                         donate_state=False)
+            state = eng.init_state(
+                {"w": jnp.zeros((32,), jnp.float32)})
+            batcher = data_lib.ArrayBatcher(
+                {"x": x, "y": y}, batch_size=256, seed=3)
+            ckpt = Checkpointer(ckpt_dir)
+            try:
+                state, _ = eng.fit(state, batcher, epochs=6, seed=7,
+                                   checkpointer=ckpt,
+                                   scan_batches=False)
+            finally:
+                ckpt.close()
+            sink.append(to_host(state))
+            return "ok"
+
+        return job
+
+    # part 1: forced migration, bit-identical resume
+    cat = Catalog(cfg.catalog_path, cfg.datasets_dir)
+    jobs = JobManager(cat, max_workers=4, mesh_leases=2)
+    results = {}
+    elapsed = {}
+    try:
+        for tag in ("base", "mig"):
+            name = f"mig_{tag}"
+            cat.create_collection(name, "train/tensorflow")
+            sink = []
+            results[tag] = sink
+            t0 = time.perf_counter()
+            jobs.submit(name, fit_job(os.path.join(home, tag), sink),
+                        needs_mesh=True, pool="train",
+                        footprint={"devices": half})
+            if tag == "mig":
+                deadline = time.monotonic() + 60
+                while time.monotonic() < deadline:
+                    if jobs.migrate(name):
+                        break
+                    time.sleep(0.02)
+            jobs.wait(name, timeout=300)
+            elapsed[tag] = time.perf_counter() - t0
+        mig_stats = jobs.migration_stats()
+    finally:
+        jobs.shutdown()
+        cat.close()
+    base, mig = results["base"][0], results["mig"][0]
+    bit_identical = bool(
+        int(base.step) == int(mig.step)
+        and np.array_equal(np.asarray(base.params["w"]),
+                           np.asarray(mig.params["w"])))
+
+    # part 2: defrag-via-migration places an aged waiter
+    cat2 = Catalog(os.path.join(home, "cat2.db"),
+                   os.path.join(home, "ds2"))
+    jobs2 = JobManager(cat2, max_workers=4, mesh_leases=2,
+                       slice_aging_seconds=0.3, slice_defrag=0.99)
+    stop = threading.Event()
+    holder_migrated = threading.Event()
+
+    def holder():
+        while not stop.is_set():
+            if preempt.migrate_requested():
+                performed, _devices = preempt.perform_migrate()
+                if performed:
+                    holder_migrated.set()
+            time.sleep(0.02)
+        return "held"
+
+    waiter_placed = False
+    big = max(2, (3 * total) // 4)
+    try:
+        cat2.create_collection("frag_holder", "train/tensorflow")
+        cat2.create_collection("frag_waiter", "train/tensorflow")
+        jobs2.submit("frag_holder", holder, needs_mesh=True,
+                     pool="train", footprint={"devices": big})
+        time.sleep(0.2)  # holder claims its slice
+        t_defrag = time.perf_counter()
+        jobs2.submit("frag_waiter", lambda: "b", needs_mesh=True,
+                     pool="train", footprint={"devices": half})
+        try:
+            waiter_placed = jobs2.wait("frag_waiter",
+                                       timeout=60) == "b"
+        except Exception:
+            waiter_placed = False
+        defrag_seconds = time.perf_counter() - t_defrag
+        defrag_stats = jobs2.migration_stats()
+    finally:
+        stop.set()
+        try:
+            jobs2.wait("frag_holder", timeout=30)
+        except Exception:
+            pass
+        jobs2.shutdown()
+        cat2.close()
+
+    return {"devices_total": total, "slice_devices": half,
+            "bit_identical": bit_identical,
+            "migrations_requested": mig_stats["requested"],
+            "base_seconds": round(elapsed["base"], 3),
+            "migrated_seconds": round(elapsed["mig"], 3),
+            "defrag_placed_waiter": bool(
+                waiter_placed and holder_migrated.is_set()),
+            "defrag_picks": defrag_stats["defragPicks"],
+            "defrag_seconds": round(defrag_seconds, 3),
+            "platform": jax.devices()[0].platform}
+
+
 PHASES = {"cnn": phase_cnn, "lstm": phase_lstm, "tlm": phase_tlm,
           "proxy": phase_proxy, "builder": phase_builder,
           "builder_mesh": phase_builder_mesh,
@@ -1423,7 +1643,9 @@ PHASES = {"cnn": phase_cnn, "lstm": phase_lstm, "tlm": phase_tlm,
           "sentinel_chaos": phase_sentinel_chaos,
           "obs_overhead": phase_obs_overhead,
           "monitor_smoke": phase_monitor_smoke,
-          "sweep_fusion": phase_sweep_fusion}
+          "sweep_fusion": phase_sweep_fusion,
+          "ckpt_stall": phase_ckpt_stall,
+          "migration_smoke": phase_migration_smoke}
 
 _RESULT_MARK = "@@LO_BENCH_RESULT@@"
 
@@ -1735,6 +1957,12 @@ def main(argv=None):
     models["sweep_fusion"] = _run_phase_repeated(
         "sweep_fusion", env,
         metrics=("speedup", "fused_seconds", "serial_seconds"))
+    models["ckpt_stall"] = _run_phase("ckpt_stall", env)
+    # the migration phase needs a sliceable mesh; on the CPU fallback
+    # that means forcing a multi-device host platform
+    mig_env = env if tpu_ok else dict(
+        cpu_env, XLA_FLAGS="--xla_force_host_platform_device_count=8")
+    models["migration_smoke"] = _run_phase("migration_smoke", mig_env)
     # interpret-mode kernel timing is meaningless — flash runs on TPU only
     flash = _run_phase("flash") if tpu_ok else {
         "skipped": "TPU unreachable; interpret-mode timing is not "

@@ -27,11 +27,17 @@ echo "== chaos: lifecycle under fault injection =="
 # A bounded hang at the job_run site (reclaimed by deadlines/cancel)
 # plus a slow artifact store. Tests that arm their own LO_FAULT_INJECT
 # override this ambient spec; the point is that the lifecycle suites
-# keep passing with chaos in the environment.
+# keep passing with chaos in the environment. LO_CKPT_ASYNC=1 routes
+# every checkpointed train through the async tiered manager, and the
+# async/migration suites ride along — they arm the
+# ckpt_async_commit / migration fault sites themselves
+# (docs/RELIABILITY.md).
 CHAOS_TIMEOUT="${LO_CI_CHAOS_TIMEOUT:-300}"
 timeout -k 10 "$CHAOS_TIMEOUT" env JAX_PLATFORMS=cpu \
     LO_FAULT_INJECT="job_run:1:hang:0.2,artifact_save:1:latency:0.05" \
-    python -m pytest tests/test_faults.py tests/test_lifecycle.py -q \
+    LO_CKPT_ASYNC=1 \
+    python -m pytest tests/test_faults.py tests/test_lifecycle.py \
+    tests/test_async_ckpt.py tests/test_migration.py -q \
     -p no:cacheprovider -p no:xdist -p no:randomly
 
 echo "== perf-smoke: warm pipeline must hit the feature-plane cache =="
@@ -105,6 +111,82 @@ print(f"slice-smoke: OK (serialized {serialized}s, "
       f"concurrent {concurrent}s, ratio {ratio})")
 EOF
 
+echo "== ckpt-stall: async checkpointing must hide the commit =="
+# The same multi-MB state saved through the sync Checkpointer vs the
+# async tiered manager (bench.py ckpt_stall; docs/RELIABILITY.md
+# "Async checkpointing"). The gate asserts the train-thread stall
+# under LO_CKPT_ASYNC semantics is < 10% of the synchronous commit
+# wall-clock — the snapshot is the only cost the caller pays.
+CKPT_TIMEOUT="${LO_CI_CKPT_TIMEOUT:-300}"
+CKPT_OUT="$(mktemp)"
+MIG_OUT="$(mktemp)"
+trap 'rm -rf "$PERF_CACHE" "$PERF_OUT" "$SLICE_OUT" "$CKPT_OUT" "$MIG_OUT"' EXIT
+timeout -k 10 "$CKPT_TIMEOUT" env JAX_PLATFORMS=cpu \
+    JAX_COMPILATION_CACHE_DIR="$PERF_CACHE" \
+    LO_COMPUTE_DTYPE=float32 \
+    python bench.py --phase ckpt_stall | tee "$CKPT_OUT"
+python - "$CKPT_OUT" <<'EOF'
+import json, sys
+
+mark = "@@LO_BENCH_RESULT@@"
+result = None
+for line in reversed(open(sys.argv[1]).read().splitlines()):
+    if line.startswith(mark):
+        result = json.loads(line[len(mark):])
+        break
+assert result is not None, "ckpt-stall: no bench result line"
+assert "error" not in result, f"ckpt-stall: phase failed: {result}"
+result = result.get("result", result)  # unwrap the ok-envelope
+ratio = result["stall_ratio"]
+assert ratio < 0.10, (
+    f"ckpt-stall: async stall is {ratio}x the sync commit "
+    f"(gate < 0.10x): {result}")
+print(f"ckpt-stall: OK (sync {result['sync_stall_seconds']}s, "
+      f"async {result['async_stall_seconds']}s over "
+      f"{result['saves']} saves of {result['payload_mb']}MB, "
+      f"ratio {ratio})")
+EOF
+
+echo "== migration-smoke: live migration must not perturb the math =="
+# A forced mid-fit migration through the fair queue vs an untouched
+# twin run (bench.py migration_smoke; docs/SCALING.md §7). Gates:
+#  - the migrated run's final params are BIT-identical to the
+#    unmigrated run's (placement must be invisible to the math)
+#  - with LO_SLICE_DEFRAG armed, an aged waiter starved by a
+#    fragmented holder is placed while the holder still runs
+#    (defrag-via-migration actually frees a usable slice)
+MIG_TIMEOUT="${LO_CI_MIG_TIMEOUT:-600}"
+timeout -k 10 "$MIG_TIMEOUT" env JAX_PLATFORMS=cpu \
+    XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+    JAX_COMPILATION_CACHE_DIR="$PERF_CACHE" \
+    LO_COMPUTE_DTYPE=float32 \
+    python bench.py --phase migration_smoke | tee "$MIG_OUT"
+python - "$MIG_OUT" <<'EOF'
+import json, sys
+
+mark = "@@LO_BENCH_RESULT@@"
+result = None
+for line in reversed(open(sys.argv[1]).read().splitlines()):
+    if line.startswith(mark):
+        result = json.loads(line[len(mark):])
+        break
+assert result is not None, "migration-smoke: no bench result line"
+assert "error" not in result, f"migration-smoke: phase failed: {result}"
+result = result.get("result", result)  # unwrap the ok-envelope
+assert "skipped" not in result, f"migration-smoke: {result['skipped']}"
+assert result["migrations_requested"] >= 1, (
+    f"migration-smoke: no migration was requested: {result}")
+assert result["bit_identical"], (
+    f"migration-smoke: migrated run diverged from the unmigrated "
+    f"twin: {result}")
+assert result["defrag_placed_waiter"], (
+    f"migration-smoke: defrag did not place the aged waiter: {result}")
+print(f"migration-smoke: OK (bit-identical across "
+      f"{result['migrations_requested']} migration(s), defrag placed "
+      f"the waiter in {result['defrag_seconds']}s via "
+      f"{result['defrag_picks']} pick(s))")
+EOF
+
 echo "== sentinel-smoke: chaos train must finish via rollback =="
 # NaN'd train step + bit-rotted checkpoint write through the full REST
 # stack under healthPolicy rollback (bench.py sentinel_chaos): the job
@@ -117,7 +199,7 @@ OBS_OUT="$(mktemp)"
 SERVE_OUT="$(mktemp)"
 SWEEP_OUT="$(mktemp)"
 MONITOR_OUT="$(mktemp)"
-trap 'rm -rf "$PERF_CACHE" "$PERF_OUT" "$SLICE_OUT" "$CHAOS_OUT" "$OVERHEAD_OUT" "$OBS_OUT" "$SERVE_OUT" "$SWEEP_OUT" "$MONITOR_OUT"' EXIT
+trap 'rm -rf "$PERF_CACHE" "$PERF_OUT" "$SLICE_OUT" "$CKPT_OUT" "$MIG_OUT" "$CHAOS_OUT" "$OVERHEAD_OUT" "$OBS_OUT" "$SERVE_OUT" "$SWEEP_OUT" "$MONITOR_OUT"' EXIT
 timeout -k 10 "$SENTINEL_TIMEOUT" env JAX_PLATFORMS=cpu \
     JAX_COMPILATION_CACHE_DIR="$PERF_CACHE" \
     LO_COMPUTE_DTYPE=float32 \

@@ -129,6 +129,16 @@ class Tool:
                                         f"{self._base}/{name}/run")
         return payload["result"]
 
+    def migrate(self, name: str) -> Any:
+        """Ask ``name``'s running job to move to a fresh mesh-slice
+        placement at its next epoch boundary
+        (``POST .../{name}/migrate``, docs/SCALING.md §7). 406 when
+        the job is not a live migratable mesh job."""
+        _, payload = self._http.request("POST",
+                                        f"{self._base}/{name}/migrate",
+                                        body={})
+        return payload["result"]
+
     def wait(self, name: str, timeout: float = 600.0,
              poll_interval: float = 0.25) -> Dict[str, Any]:
         """Block until ``finished`` is True (the platform's universal

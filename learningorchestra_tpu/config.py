@@ -74,6 +74,14 @@ class Config:
     mesh_yield: bool = dataclasses.field(
         default_factory=lambda: os.environ.get(
             "LO_MESH_YIELD", "1") not in ("0", "false", "no"))
+    # Defrag-via-migration policy (docs/SCALING.md §7): >0 arms it —
+    # when a waiter can't fit AND (fragmentation >= this threshold OR
+    # the waiter has aged past LO_SLICE_AGING), the scheduler asks the
+    # job manager to checkpoint-migrate the cheapest migratable
+    # holder instead of letting the waiter starve. 0 = off.
+    slice_defrag: float = dataclasses.field(
+        default_factory=lambda: float(os.environ.get(
+            "LO_SLICE_DEFRAG", "0")))
 
     # Device mesh defaults: axis names follow the scaling-book
     # convention. Shape 'auto' = 1D data-parallel over all devices.
@@ -193,6 +201,23 @@ class Config:
     health_retries: int = dataclasses.field(
         default_factory=lambda: int(os.environ.get(
             "LO_HEALTH_RETRIES", "1")))
+    # Async tiered checkpointing (docs/RELIABILITY.md "Async
+    # checkpointing"): train-thread saves become a device->host
+    # snapshot + a bounded background commit queue
+    # (runtime/async_ckpt.py). Off by default: the sync path is the
+    # reference behavior and async trades host memory for stall.
+    ckpt_async: bool = dataclasses.field(
+        default_factory=lambda: os.environ.get(
+            "LO_CKPT_ASYNC", "0") not in ("0", "false", "no", ""))
+    # Max commits (host snapshots) in flight before save() blocks.
+    ckpt_inflight: int = dataclasses.field(
+        default_factory=lambda: int(os.environ.get(
+            "LO_CKPT_INFLIGHT", "2")))
+    # Newest quarantined (corrupt) checkpoint dirs kept as evidence;
+    # older ones are deleted so chaos can't fill the disk.
+    ckpt_quarantine_keep: int = dataclasses.field(
+        default_factory=lambda: int(os.environ.get(
+            "LO_CKPT_QUARANTINE_KEEP", "4")))
     # Vectorized sweep fusion (docs/PERFORMANCE.md "Sweep fusion").
     # When on, GridSearch/RandomSearch fuse same-architecture sweep
     # points into one compiled vmapped training program; off = every

@@ -1,0 +1,242 @@
+"""Async tiered checkpointing (Orbax-style, docs/RELIABILITY.md).
+
+A synchronous ``Checkpointer.save()`` serializes, hashes and fsyncs
+on the TRAINING thread — at production cadence the train loop stalls
+for the full commit on every epoch. ``AsyncCheckpointManager`` splits
+the save into the two tiers the Orbax paper describes:
+
+1. **snapshot** (caller thread, cheap): the train state is copied
+   device→host (``np.asarray`` per leaf). This must happen before the
+   step path continues — the jitted step donates its input buffers,
+   so the device arrays the state references are dead the moment the
+   next step runs. The snapshot wall-clock is the only stall the
+   train thread pays (``lo_checkpoint_snapshot_seconds``).
+2. **commit** (background worker): the host tree is enqueued to a
+   single worker thread that runs the SAME atomic
+   tmp+fsync+manifest machinery as the sync path
+   (``Checkpointer._commit_host``). One worker + a FIFO queue gives
+   the ordering guarantee for free: a newer commit can never land
+   before an older one finishes.
+
+Semantics:
+
+- the queue is bounded (``LO_CKPT_INFLIGHT``): when full, ``save()``
+  blocks until the oldest commit drains — backpressure, not unbounded
+  host memory;
+- a worker failure is LATCHED and re-raised on the next ``save()`` or
+  barrier — an async commit failure surfaces on the job, it never
+  kills or deadlocks the worker (which keeps draining);
+- every READ (``latest_step``/``restore``/``restore_partial``/
+  ``saved_metadata``/``load_meta``) barriers first, so the health
+  sentinel's rollback-to-last-good and resume-from-latest semantics
+  are unchanged: what was saved is on disk before anything reads;
+- ``wait_until_finished()`` is the explicit barrier for job end;
+  ``close()`` drains without re-raising (teardown must not mask the
+  job's real error).
+
+The manager duck-types ``Checkpointer``, so the engine and the
+health sentinel run unmodified against either.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from typing import Any, Optional
+
+import jax
+import numpy as np
+
+from learningorchestra_tpu.runtime.checkpoint import Checkpointer
+
+_SENTINEL = object()
+
+
+def _maybe_inject(site: str) -> None:
+    # lazy import mirrors checkpoint._chaos_corrupt: the runtime layer
+    # stays importable without the services package
+    try:
+        from learningorchestra_tpu.services import faults
+    except Exception:  # noqa: BLE001
+        return
+    faults.maybe_inject(site)
+
+
+def _observe(name: str, t0: float, end: float, ctx, **attrs) -> None:
+    """Record a span (against a trace context captured on the CALLER
+    thread — the worker has no thread-local trace) + histogram."""
+    try:
+        from learningorchestra_tpu.observability import hist
+        from learningorchestra_tpu.observability import trace
+
+        if ctx is not None:
+            trace.add(name, ctx[0], t0, end, parent=ctx[1], **attrs)
+        hist.observe(
+            {"checkpointSnapshot": "lo_checkpoint_snapshot_seconds",
+             "checkpointCommit": "lo_checkpoint_commit_seconds",
+             }.get(name, f"lo_{name}_seconds"), end - t0)
+    except Exception:  # noqa: BLE001 — observability is advisory
+        pass
+
+
+def _trace_ctx():
+    try:
+        from learningorchestra_tpu.observability import trace
+
+        return trace.current()
+    except Exception:  # noqa: BLE001
+        return None
+
+
+class AsyncCheckpointError(RuntimeError):
+    """A background commit failed. Carries the original exception as
+    ``__cause__``; raised on the train thread at the next save() or
+    barrier so the failure lands on the JOB, not the worker."""
+
+
+class AsyncCheckpointManager:
+    """Checkpointer facade that commits on a background worker.
+
+    ``save()`` = device→host snapshot (caller thread) + enqueue;
+    reads and ``wait_until_finished()`` barrier; errors latch."""
+
+    def __init__(self, checkpointer: Checkpointer,
+                 inflight: int = 2):
+        self._ckpt = checkpointer
+        # the queue bound is the max host snapshots alive at once —
+        # the memory/stall trade the LO_CKPT_INFLIGHT knob sets
+        self._queue: "queue.Queue" = queue.Queue(
+            maxsize=max(1, int(inflight)))
+        self._error: Optional[BaseException] = None
+        self._error_lock = threading.Lock()
+        self._closed = False
+        self._worker = threading.Thread(
+            target=self._drain, daemon=True, name="lo-ckpt-commit")
+        self._worker.start()
+
+    # -- background worker ---------------------------------------------
+    def _drain(self) -> None:
+        while True:
+            item = self._queue.get()
+            try:
+                if item is _SENTINEL:
+                    return
+                kind, payload, ctx, t_enq = item
+                t0 = time.monotonic()
+                try:
+                    _maybe_inject("ckpt_async_commit")
+                    if kind == "save":
+                        step, host = payload
+                        self._ckpt._commit_host(step, host)
+                        _observe("checkpointCommit", t0,
+                                 time.monotonic(), ctx, step=int(step),
+                                 async_=True,
+                                 queued_seconds=round(t0 - t_enq, 6))
+                    else:  # "meta" — sidecar rides the same FIFO so
+                        # progress.json never outruns its step commit
+                        self._ckpt.save_meta(payload)
+                except BaseException as exc:  # noqa: BLE001 — latch,
+                    # keep draining: the worker must never die or
+                    # deadlock; the error surfaces on the train thread
+                    with self._error_lock:
+                        if self._error is None:
+                            self._error = exc
+            finally:
+                self._queue.task_done()
+
+    def _check_error(self) -> None:
+        with self._error_lock:
+            exc = self._error
+        if exc is not None:
+            raise AsyncCheckpointError(
+                f"async checkpoint commit failed: {exc!r}") from exc
+
+    # -- write path ----------------------------------------------------
+    def save(self, step: int, tree: Any) -> None:
+        """Snapshot device→host and enqueue the commit. Blocks only
+        for the snapshot (and for backpressure when ``inflight``
+        commits are already queued). Re-raises a prior commit failure
+        first — the job sees the error at its next step boundary."""
+        self._check_error()
+        if self._closed:
+            raise AsyncCheckpointError(
+                "save() after close(): manager is shut down")
+        ctx = _trace_ctx()
+        t0 = time.monotonic()
+        host = jax.tree_util.tree_map(np.asarray, tree)
+        _observe("checkpointSnapshot", t0, time.monotonic(), ctx,
+                 step=int(step))
+        self._queue.put(("save", (int(step), host), ctx,
+                         time.monotonic()))
+
+    def save_meta(self, meta: dict) -> None:
+        self._check_error()
+        if self._closed:
+            raise AsyncCheckpointError(
+                "save_meta() after close(): manager is shut down")
+        self._queue.put(("meta", dict(meta), _trace_ctx(),
+                         time.monotonic()))
+
+    # -- barrier ---------------------------------------------------------
+    def wait_until_finished(self, reraise: bool = True) -> None:
+        """Block until every enqueued commit has landed (or failed).
+        Call at job end and before any restore/rollback — all read
+        methods below do it implicitly."""
+        self._queue.join()
+        if reraise:
+            self._check_error()
+
+    # -- read path (barriers first) --------------------------------------
+    def latest_step(self) -> Optional[int]:
+        self.wait_until_finished()
+        return self._ckpt.latest_step()
+
+    def restore(self, target: Any, step: Optional[int] = None) -> Any:
+        self.wait_until_finished()
+        return self._ckpt.restore(target, step)
+
+    def restore_partial(self, target_subtree: Any,
+                        step: Optional[int] = None) -> Any:
+        self.wait_until_finished()
+        return self._ckpt.restore_partial(target_subtree, step)
+
+    def saved_metadata(self, step: Optional[int] = None) -> Any:
+        self.wait_until_finished()
+        return self._ckpt.saved_metadata(step)
+
+    def load_meta(self) -> Optional[dict]:
+        self.wait_until_finished()
+        return self._ckpt.load_meta()
+
+    # -- teardown --------------------------------------------------------
+    def close(self) -> None:
+        """Drain (without re-raising — teardown must not mask the
+        job's own exception), stop the worker, close the inner
+        checkpointer."""
+        if self._closed:
+            return
+        self._closed = True
+        self._queue.join()
+        self._queue.put(_SENTINEL)
+        self._worker.join(timeout=30.0)
+        self._ckpt.close()
+
+
+def wrap_checkpointer(checkpointer: Checkpointer,
+                      config=None) -> Any:
+    """``checkpointer`` or an async facade over it, per
+    ``LO_CKPT_ASYNC``/``LO_CKPT_INFLIGHT`` (services/execution.py
+    calls this where train jobs build their checkpointer)."""
+    if config is None:
+        try:
+            from learningorchestra_tpu.config import get_config
+
+            config = get_config()
+        except Exception:  # noqa: BLE001
+            return checkpointer
+    if not getattr(config, "ckpt_async", False):
+        return checkpointer
+    return AsyncCheckpointManager(
+        checkpointer,
+        inflight=int(getattr(config, "ckpt_inflight", 2)))

@@ -25,8 +25,22 @@ place, so a kill mid-save can never leave a half-written step that
 ``latest_step()`` would pick (leftover ``*.tmp`` dirs are swept on
 init). ``restore()`` re-hashes the payload against the manifest;
 a torn or bit-flipped step dir is moved to ``<dir>/.quarantine/``
-and restore transparently falls back to the newest VERIFIED step.
+(bounded to the ``LO_CKPT_QUARANTINE_KEEP`` newest entries) and
+restore transparently falls back to the newest VERIFIED step.
 Orbax (TPU) keeps its own atomic-commit + metadata machinery.
+
+Layout (``shards > 1``): the state dict is partitioned into N
+byte-balanced sub-files (``shard-00000-of-00002.msgpack``, …) under
+one merged manifest, so each mesh-slice shard can be written by its
+owning host on a multi-host pod; every sub-file verifies
+independently and restore merges them. ``shards == 1`` keeps the
+single ``checkpoint.msgpack`` layout, byte-compatible with older
+dirs.
+
+The commit machinery is split so the async manager
+(``runtime/async_ckpt.py``) can reuse it off the training thread:
+``save()`` = device→host + ``_commit_host()``; the async worker
+calls ``_commit_host()`` directly on an already-host-resident tree.
 """
 
 from __future__ import annotations
@@ -49,6 +63,51 @@ from learningorchestra_tpu.runtime import health as health_lib
 _MSGPACK_NAME = "checkpoint.msgpack"
 _MANIFEST_NAME = "manifest.json"
 _QUARANTINE_DIR = ".quarantine"
+_SHARD_PREFIX = "shard-"
+
+
+def _quarantine_keep() -> int:
+    """How many quarantined step dirs to retain (newest wins).
+    Config-first so tests overriding Config see it; env fallback keeps
+    the runtime layer importable standalone."""
+    try:
+        from learningorchestra_tpu.config import get_config
+
+        return max(0, int(get_config().ckpt_quarantine_keep))
+    except Exception:  # noqa: BLE001
+        return max(0, int(os.environ.get(
+            "LO_CKPT_QUARANTINE_KEEP", "4") or 4))
+
+
+def _flatten_state(tree: Any, prefix: str = "") -> dict:
+    """Flatten a nested state dict to ``{"a/b/c": leaf}``. Empty dict
+    nodes survive as leaves (``from_state_dict`` requires every target
+    key present, including ``model_state: {}``)."""
+    if isinstance(tree, dict) and tree:
+        out: dict = {}
+        for key in tree:
+            joined = f"{prefix}/{key}" if prefix else str(key)
+            out.update(_flatten_state(tree[key], joined))
+        return out
+    return {prefix: tree}
+
+
+def _unflatten_state(flat: dict) -> dict:
+    out: dict = {}
+    for key, value in flat.items():
+        parts = key.split("/")
+        node = out
+        for part in parts[:-1]:
+            node = node.setdefault(part, {})
+        node[parts[-1]] = value
+    return out
+
+
+def _leaf_nbytes(leaf: Any) -> int:
+    try:
+        return max(1, int(np.asarray(leaf).nbytes))
+    except Exception:  # noqa: BLE001 — non-array leaf (e.g. {} node)
+        return 1
 
 
 class CheckpointCorrupted(IOError):
@@ -133,10 +192,14 @@ class Checkpointer:
     """save(step, pytree) / latest_step() / restore — Orbax on TPU,
     msgpack files off-TPU (same directory-per-step layout)."""
 
-    def __init__(self, directory: str, max_to_keep: int = 3):
+    def __init__(self, directory: str, max_to_keep: int = 3,
+                 shards: int = 1):
         self._dir = os.path.abspath(directory)
         os.makedirs(self._dir, exist_ok=True)
         self._max_to_keep = max_to_keep
+        # sub-files per step commit (multi-host: one per mesh-slice
+        # shard, i.e. shards=jax.process_count()); 1 = legacy layout
+        self._shards = max(1, int(shards))
         if _use_orbax():
             import orbax.checkpoint as ocp
 
@@ -160,8 +223,13 @@ class Checkpointer:
         for name in os.listdir(self._dir):
             if not name.isdigit():
                 continue
-            if os.path.exists(
-                    os.path.join(self._dir, name, _MSGPACK_NAME)):
+            # sharded steps have no checkpoint.msgpack — the manifest
+            # is the commit marker either way (legacy dirs keep the
+            # payload-only check)
+            step_dir = os.path.join(self._dir, name)
+            if os.path.exists(os.path.join(step_dir, _MSGPACK_NAME)) \
+                    or os.path.exists(
+                        os.path.join(step_dir, _MANIFEST_NAME)):
                 steps.append(int(name))
         return sorted(steps)
 
@@ -208,34 +276,74 @@ class Checkpointer:
                     f"step {step}: {name} is {size} bytes, manifest "
                     f"says {meta.get('bytes')} (torn write?)")
 
-    def _read_verified(self, step: int) -> bytes:
-        """The step's payload bytes, re-hashed against the manifest.
-        Raises CheckpointCorrupted on any mismatch; a legacy dir with
-        no manifest is accepted as-is."""
-        manifest = self._load_manifest(step)
+    def _read_file_verified(self, step: int, name: str,
+                            meta: dict) -> bytes:
+        """One payload file's bytes, re-hashed against its manifest
+        entry. Raises CheckpointCorrupted on any mismatch."""
         try:
-            with open(self._step_path(step), "rb") as f:
+            with open(os.path.join(self._dir, str(step), name),
+                      "rb") as f:
                 data = f.read()
         except OSError as exc:
             raise CheckpointCorrupted(
-                f"step {step}: unreadable payload: {exc}") from exc
-        if manifest is not None:
-            meta = manifest["files"].get(_MSGPACK_NAME, {})
-            if len(data) != meta.get("bytes"):
-                raise CheckpointCorrupted(
-                    f"step {step}: payload is {len(data)} bytes, "
-                    f"manifest says {meta.get('bytes')} (torn write?)")
-            digest = hashlib.sha256(data).hexdigest()
-            if digest != meta.get("sha256"):
-                raise CheckpointCorrupted(
-                    f"step {step}: payload sha256 {digest[:12]}… does "
-                    f"not match manifest {str(meta.get('sha256'))[:12]}… "
-                    f"(bit rot?)")
+                f"step {step}: unreadable payload {name!r}: "
+                f"{exc}") from exc
+        if len(data) != meta.get("bytes"):
+            raise CheckpointCorrupted(
+                f"step {step}: {name} is {len(data)} bytes, "
+                f"manifest says {meta.get('bytes')} (torn write?)")
+        digest = hashlib.sha256(data).hexdigest()
+        if digest != meta.get("sha256"):
+            raise CheckpointCorrupted(
+                f"step {step}: {name} sha256 {digest[:12]}… does "
+                f"not match manifest {str(meta.get('sha256'))[:12]}… "
+                f"(bit rot?)")
         return data
 
+    def _read_verified_tree(self, step: int) -> Any:
+        """The step's raw (nested) state dict, every manifest-listed
+        sub-file re-hashed — the single- and sharded-layout read path.
+        Raises CheckpointCorrupted; a legacy dir with no manifest is
+        accepted as-is."""
+        manifest = self._load_manifest(step)
+        if manifest is None:
+            try:
+                with open(self._step_path(step), "rb") as f:
+                    data = f.read()
+            except OSError as exc:
+                raise CheckpointCorrupted(
+                    f"step {step}: unreadable payload: {exc}") from exc
+            return serialization.msgpack_restore(data)
+        shard_names = sorted(n for n in manifest["files"]
+                             if n.startswith(_SHARD_PREFIX))
+        try:
+            if not shard_names:
+                data = self._read_file_verified(
+                    step, _MSGPACK_NAME,
+                    manifest["files"].get(_MSGPACK_NAME, {}))
+                return serialization.msgpack_restore(data)
+            flat: dict = {}
+            for name in shard_names:
+                data = self._read_file_verified(
+                    step, name, manifest["files"][name])
+                part = serialization.msgpack_restore(data)
+                if not isinstance(part, dict):
+                    raise CheckpointCorrupted(
+                        f"step {step}: {name} is not a shard map")
+                flat.update(part)
+            return _unflatten_state(flat)
+        except CheckpointCorrupted:
+            raise
+        except Exception as exc:  # noqa: BLE001 — undecodable bytes
+            raise CheckpointCorrupted(
+                f"step {step}: undecodable payload: {exc}") from exc
+
     def _quarantine(self, step: int, reason: str) -> None:
-        """Move a corrupt step dir aside (never delete evidence) so
-        latest_step()/restore() stop seeing it."""
+        """Move a corrupt step dir aside (evidence over deletion) so
+        latest_step()/restore() stop seeing it. The quarantine itself
+        is BOUNDED — only the newest ``LO_CKPT_QUARANTINE_KEEP``
+        entries survive, so repeated corruption under chaos cannot
+        fill the disk."""
         src = os.path.join(self._dir, str(step))
         qdir = os.path.join(self._dir, _QUARANTINE_DIR)
         os.makedirs(qdir, exist_ok=True)
@@ -246,10 +354,23 @@ class Checkpointer:
             os.replace(src, dst)
         except OSError:
             shutil.rmtree(src, ignore_errors=True)
+        self._prune_quarantine(qdir)
         health_lib.record("quarantined")
         warnings.warn(
             f"quarantined checkpoint step {step} -> {dst}: {reason}",
             RuntimeWarning, stacklevel=3)
+
+    @staticmethod
+    def _prune_quarantine(qdir: str) -> None:
+        keep = _quarantine_keep()
+        try:
+            entries = sorted(
+                os.listdir(qdir),
+                key=lambda n: os.path.getmtime(os.path.join(qdir, n)))
+        except OSError:
+            return
+        for name in entries[:max(0, len(entries) - keep)]:
+            shutil.rmtree(os.path.join(qdir, name), ignore_errors=True)
 
     def save(self, step: int, tree: Any) -> None:
         """Commit ``step`` (atomic; see module docstring). The commit
@@ -286,27 +407,59 @@ class Checkpointer:
             self._mgr.save(step, args=ocp.args.StandardSave(tree))
             return
         host = jax.tree_util.tree_map(np.asarray, tree)
-        data = serialization.to_bytes(host)
-        # stage the whole step dir, fsync contents, then one atomic
-        # rename commits it — a crash at any point leaves either the
-        # previous state or a .tmp dir the next init sweeps
+        self._commit_host(step, host)
+
+    def _shard_payloads(self, host: Any) -> dict:
+        """``{file_name: payload_bytes}`` for one commit: a single
+        msgpack blob, or N byte-balanced shard sub-files (greedy
+        least-loaded bin packing over the flattened leaves, sorted by
+        size then path — deterministic)."""
+        state = serialization.to_state_dict(host)
+        if self._shards <= 1 or not isinstance(state, dict) or not state:
+            return {_MSGPACK_NAME: serialization.to_bytes(host)}
+        flat = _flatten_state(state)
+        n = min(self._shards, len(flat))
+        bins: List[dict] = [{} for _ in range(n)]
+        loads = [0] * n
+        order = sorted(flat, key=lambda k: (-_leaf_nbytes(flat[k]), k))
+        for key in order:
+            i = loads.index(min(loads))
+            bins[i][key] = flat[key]
+            loads[i] += _leaf_nbytes(flat[key])
+        return {
+            f"{_SHARD_PREFIX}{i:05d}-of-{n:05d}.msgpack":
+                serialization.msgpack_serialize(bins[i])
+            for i in range(n)}
+
+    def _commit_host(self, step: int, host: Any) -> None:
+        """Atomically commit an already-host-resident pytree: stage
+        the whole step dir, fsync contents, then one rename — a crash
+        at any point leaves either the previous state or a .tmp dir
+        the next init sweeps. This is the piece the async manager's
+        background worker shares with the synchronous save path."""
+        payloads = self._shard_payloads(host)
         final_dir = os.path.join(self._dir, str(step))
         tmp_dir = final_dir + ".tmp"
         shutil.rmtree(tmp_dir, ignore_errors=True)
         os.makedirs(tmp_dir)
-        payload = os.path.join(tmp_dir, _MSGPACK_NAME)
-        with open(payload, "wb") as f:
-            f.write(data)
-            _fsync_file(f)
+        files = {}
+        first_payload = None
+        for name, data in payloads.items():
+            path = os.path.join(tmp_dir, name)
+            if first_payload is None:
+                first_payload = path
+            with open(path, "wb") as f:
+                f.write(data)
+                _fsync_file(f)
+            files[name] = {"sha256": hashlib.sha256(data).hexdigest(),
+                           "bytes": len(data)}
         manifest = {
             "step": int(step),
             "wallTime": time.time(),
-            "files": {_MSGPACK_NAME: {
-                "sha256": hashlib.sha256(data).hexdigest(),
-                "bytes": len(data),
-            }},
+            "files": files,
         }
-        _chaos_corrupt(payload)
+        if first_payload is not None:
+            _chaos_corrupt(first_payload)
         with open(os.path.join(tmp_dir, _MANIFEST_NAME), "w") as f:
             json.dump(manifest, f)
             _fsync_file(f)
@@ -344,12 +497,12 @@ class Checkpointer:
                 step, args=ocp.args.StandardRestore(target))
         if step is not None:
             try:
-                data = self._read_verified(step)
+                raw = self._read_verified_tree(step)
             except CheckpointCorrupted as exc:
                 # an explicitly requested step has no substitute
                 self._quarantine(step, str(exc))
                 raise
-            return self._decode(data, target)
+            return self._decode(raw, target)
         # newest VERIFIED step: quarantine corrupt/torn dirs and fall
         # back until one passes (or none are left -> fresh start)
         while True:
@@ -358,17 +511,17 @@ class Checkpointer:
                 return None
             step = candidates[-1]
             try:
-                data = self._read_verified(step)
+                raw = self._read_verified_tree(step)
             except CheckpointCorrupted as exc:
                 self._quarantine(step, str(exc))
                 continue
-            return self._decode(data, target)
+            return self._decode(raw, target)
 
-    def _decode(self, data: bytes, target: Any) -> Any:
+    def _decode(self, raw: Any, target: Any) -> Any:
         host_target = jax.tree_util.tree_map(np.asarray, target)
         # raises ValueError on structural drift (missing/extra keys) —
         # same contract the engine's migration fallback keys off
-        restored = serialization.from_bytes(host_target, data)
+        restored = serialization.from_state_dict(host_target, raw)
         for got, want in zip(jax.tree_util.tree_leaves(restored),
                              jax.tree_util.tree_leaves(host_target)):
             if np.shape(got) != np.shape(want):
@@ -389,24 +542,38 @@ class Checkpointer:
         if _use_orbax():
             meta = self._mgr.item_metadata(step)
             return getattr(meta, "tree", meta)
-        with open(self._step_path(step), "rb") as f:
-            data = f.read()
         # raw nested state dict; numpy leaves expose .shape/.dtype
-        return serialization.msgpack_restore(data)
+        return self._read_verified_tree(step)
 
     def restore_partial(self, target_subtree: Any,
                         step: Optional[int] = None) -> Any:
         """Restore only the subtrees named in ``target_subtree`` (e.g.
         params + step, skipping a drifted opt_state entirely, so the
-        stale optimizer arrays are never grafted into the new state)."""
-        if step is None:
-            step = self.latest_step()
-        if step is None:
-            return None
+        stale optimizer arrays are never grafted into the new state).
+        Reads are VERIFIED like ``restore()``: a corrupt step is
+        quarantined; with ``step=None`` the read falls back to the
+        next-newest verified step, an explicit step raises."""
         if _use_orbax():
+            if step is None:
+                step = self.latest_step()
+            if step is None:
+                return None
             return self._restore_partial_orbax(target_subtree, step)
-        with open(self._step_path(step), "rb") as f:
-            raw = serialization.msgpack_restore(f.read())
+        while True:
+            explicit = step is not None
+            if not explicit:
+                step = self.latest_step()
+            if step is None:
+                return None
+            try:
+                raw = self._read_verified_tree(step)
+            except CheckpointCorrupted as exc:
+                self._quarantine(step, str(exc))
+                if explicit:
+                    raise
+                step = None
+                continue
+            break
         if not isinstance(raw, dict):
             return None
         out = {}
@@ -446,10 +613,15 @@ class Checkpointer:
     # a re-run reshapes the feed (different batch_size / data size), so
     # the engine records it here next to the step checkpoints.
     def save_meta(self, meta: dict) -> None:
+        # atomic like a step commit (tmp + fsync + replace + parent
+        # fsync): a crash mid-write must never leave a torn sidecar
+        # that poisons resume
         path = os.path.join(self._dir, "progress.json")
         with open(path + ".tmp", "w") as f:
             json.dump(meta, f)
+            _fsync_file(f)
         os.replace(path + ".tmp", path)
+        _fsync_dir(self._dir)
 
     def load_meta(self) -> Optional[dict]:
         path = os.path.join(self._dir, "progress.json")
@@ -463,6 +635,14 @@ class Checkpointer:
             # checkpoints carry the real state; progress is best-effort
             return None
         return meta if isinstance(meta, dict) else None
+
+    def wait_until_finished(self, reraise: bool = True) -> None:
+        """Barrier for in-flight commits. The synchronous backend has
+        none (msgpack saves return committed; Orbax's manager drains
+        itself) — this exists so callers can treat sync and async
+        checkpointers uniformly (runtime/async_ckpt.py)."""
+        del reraise
+        self._mgr.wait_until_finished()
 
     def close(self) -> None:
         self._mgr.wait_until_finished()

@@ -799,6 +799,88 @@ class Engine:
         return TrainState(step=step, params=params, opt_state=opt_state,
                           model_state=model_state), "ok"
 
+    # -- live migration (docs/SCALING.md §7) ---------------------------
+    def _place_state(self, host_state: TrainState) -> TrainState:
+        """Land a host-snapshotted train state on the CURRENT mesh,
+        mirroring :meth:`init_state` placement: rules-sharded params
+        (opt_state leaves follow via a jitted init's shardings) or
+        whole-state replication."""
+        mesh = self._mesh
+        if mesh is None:
+            return jax.tree_util.tree_map(jnp.asarray, host_state)
+        if self._param_rules is not None:
+            from learningorchestra_tpu.parallel import \
+                sharding as rules_lib
+
+            shardings = rules_lib.param_shardings(
+                host_state.params, mesh, self._param_rules,
+                fsdp=self._fsdp)
+            params = jax.device_put(host_state.params, shardings)
+            ref_opt = jax.jit(self._optimizer.init)(params)
+            opt_state = jax.tree_util.tree_map(
+                lambda h, r: jax.device_put(
+                    jnp.asarray(h, r.dtype), r.sharding),
+                host_state.opt_state, ref_opt)
+            rep = mesh_lib.replicated(mesh)
+            return TrainState(
+                step=jax.device_put(
+                    jnp.asarray(host_state.step, jnp.int32), rep),
+                params=params, opt_state=opt_state,
+                model_state=jax.device_put(host_state.model_state, rep))
+        return jax.device_put(host_state, mesh_lib.replicated(mesh))
+
+    def _maybe_migrate(self, state: TrainState, checkpointer
+                       ) -> Tuple[TrainState, bool]:
+        """Epoch-boundary live migration (services/migration.py):
+        when a migrate request is latched on this job's token, barrier
+        any in-flight async checkpoint commits, snapshot train state
+        device→host, release the held slice and re-acquire a fresh
+        placement through the fair queue, re-point the thread-local
+        mesh at the new slice, and re-place the snapshot there.
+        Per-step rng derives from the host step counter, so the
+        resumed run replays bit-identically. Returns
+        ``(state, migrated)``."""
+        if not preempt.migrate_requested():
+            return state, False
+        t0 = time.monotonic()
+        _inject_migration_fault()
+        if checkpointer is not None and \
+                hasattr(checkpointer, "wait_until_finished"):
+            checkpointer.wait_until_finished()
+        host_state = to_host(state)
+        performed, new_devices = preempt.perform_migrate()
+        if not performed:
+            return state, False
+        new_mesh = mesh_lib.mesh_for_slice(new_devices)
+        mesh_lib.set_current_mesh(new_mesh)
+        self._mesh = new_mesh
+        # jitted-step identities key on the mesh: drop the
+        # per-instance handles so the next dispatch re-resolves
+        # through the shared cache under the new mesh
+        self._train_step = None
+        self._eval_step = None
+        self._predict_step = None
+        self._epoch_steps = {}
+        # an explicit batch sharding references the OLD mesh; fall
+        # back to the default data-axes sharding of the new one
+        self._batch_sharding = None
+        state = self._place_state(host_state)
+        jax.block_until_ready(state.params)
+        end = time.monotonic()
+        health_lib.record("migrations")
+        try:
+            obs_hist.observe("lo_migration_seconds", end - t0)
+            cur = obs_trace.current()
+            if cur is not None:
+                obs_trace.add(
+                    "migration", cur[0], t0, end, parent=cur[1],
+                    devices=(list(new_devices)
+                             if new_devices is not None else None),
+                    step=int(host_state.step))
+        except Exception:  # noqa: BLE001 — observability is advisory
+            pass
+        return state, True
+
     def _fit_scanned(self, state: TrainState,
                      batcher: data_lib.ArrayBatcher, epochs: int,
                      seed: int, checkpointer, log_fn,
@@ -928,7 +1010,45 @@ class Engine:
                 # more work for.
                 epoch += 1
                 if epoch < epochs:
+                    state, migrated = self._maybe_migrate(
+                        state, checkpointer)
+                    if migrated:
+                        # the job moved slices: everything keyed on
+                        # the old mesh re-resolves — batch sharding,
+                        # the staged epoch arrays (the old slice's HBM
+                        # belongs to someone else now) and the epoch
+                        # program
+                        sharding = self._resolve_batch_sharding()
+                        if entry is not None:
+                            entry.release()
+                            entry = arena_lib.get_default_arena() \
+                                .get_or_put(
+                                    ("fit_arrays", token, steps, bs,
+                                     batcher.shuffles, self._mesh,
+                                     sharding),
+                                    stage,
+                                    tags=getattr(batcher,
+                                                 "cache_tags", ()),
+                                    group=self._mesh,
+                                    group_fraction=mesh_lib
+                                    .mesh_fraction(self._mesh))
+                            device_arrays = entry.arrays
+                        else:
+                            device_arrays = stage()
+                        epoch_step = self._epoch_steps.get(key)
+                        if epoch_step is None:
+                            epoch_step = self._epoch_steps[key] = \
+                                self._shared_step(
+                                    "epoch",
+                                    lambda: self._build_epoch_step(
+                                        steps, bs, batcher.shuffles),
+                                    extra=key)
                     preempt.maybe_yield()
+            # surface any latched async-commit failure on the JOB
+            # before it reports success (no-op for the sync class)
+            if checkpointer is not None and \
+                    hasattr(checkpointer, "wait_until_finished"):
+                checkpointer.wait_until_finished()
         finally:
             # the pin must drop on EVERY exit — a JobCancelled /
             # timed-out unwind included (docs/LIFECYCLE.md) — or the
@@ -1075,7 +1195,20 @@ class Engine:
                 log_fn(record)
             epoch += 1
             if epoch < epochs:  # fair scheduling (see _fit_scanned)
+                state, migrated = self._maybe_migrate(
+                    state, checkpointer)
+                if migrated:
+                    # per-step path: the train step re-resolves under
+                    # the new mesh; the device feed re-reads
+                    # _resolve_batch_sharding() every epoch already
+                    self._train_step = self._shared_step(
+                        "train", self._build_train_step)
                 preempt.maybe_yield()
+        # surface any latched async-commit failure on the JOB before
+        # it reports success (no-op for the sync class)
+        if checkpointer is not None and \
+                hasattr(checkpointer, "wait_until_finished"):
+            checkpointer.wait_until_finished()
         return state, history
 
     def evaluate(self, state: TrainState, batcher: data_lib.ArrayBatcher,
@@ -1538,6 +1671,18 @@ def _poison_rows(arrays, rows: int):
     out[key] = out[key].at[:rows].mul(
         jnp.asarray(float("nan"), out[key].dtype))
     return out
+
+
+def _inject_migration_fault() -> None:
+    """Armed ``migration:*`` chaos fault fires at the top of the
+    migration sequence (before any state moved) — an InjectedFault is
+    an IOError subclass, so the job's transient-retry path absorbs it
+    and the latched migrate request survives to the retry."""
+    try:
+        from learningorchestra_tpu.services import faults
+    except Exception:  # noqa: BLE001
+        return
+    faults.maybe_inject("migration")
 
 
 def _armed_nan() -> bool:

@@ -222,6 +222,18 @@ def use_mesh(mesh: Optional[Mesh]) -> Iterator[Optional[Mesh]]:
         _mesh_override.mesh = previous
 
 
+def set_current_mesh(mesh: Optional[Mesh]) -> Optional[Mesh]:
+    """Swap this thread's mesh override IN PLACE and return the
+    previous one. Live migration (services/migration.py) uses this to
+    re-point a job that is already inside a ``use_mesh`` scope at its
+    NEW slice; the enclosing context manager's finally still restores
+    whatever preceded the scope, so the swap never leaks past the
+    lease."""
+    previous = getattr(_mesh_override, "mesh", None)
+    _mesh_override.mesh = mesh
+    return previous
+
+
 def mesh_for_slice(device_indices: Optional[Sequence[int]]) -> Mesh:
     """Materialize a scheduler grant (indices into the default mesh's
     flat device order) as a mesh. ``None`` or a full-cover grant

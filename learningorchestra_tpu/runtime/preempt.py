@@ -64,6 +64,34 @@ class CancelToken:
         self.progress: Dict[str, Any] = {}
         self.last_beat: Optional[float] = None
         self.started: Optional[float] = None
+        # -- live migration (services/migration.py) --------------------
+        # latched until the engine consumes it at a step boundary
+        self.migrate_pending: Optional[str] = None
+        self.migrations: int = 0
+        # stamped by the slice lease at grant time: the job's current
+        # device indices (None = whole mesh) and whether a migrate
+        # request makes sense for it (sliced, single-host)
+        self.slice_devices: Optional[tuple] = None
+        self.migratable: bool = False
+
+    # -- migration signal ----------------------------------------------
+    def request_migrate(self, reason: str = "migrate") -> bool:
+        """Latch a cooperative migrate request. Returns False when the
+        job is already cancelled (nothing to migrate) or a request is
+        already pending (idempotent)."""
+        with self._lock:
+            if self.reason is not None or self._event.is_set():
+                return False
+            if self.migrate_pending is not None:
+                return False
+            self.migrate_pending = reason
+            return True
+
+    def consume_migrate(self) -> Optional[str]:
+        """Take the pending request (engine, at a step boundary)."""
+        with self._lock:
+            reason, self.migrate_pending = self.migrate_pending, None
+            return reason
 
     # -- cancellation --------------------------------------------------
     def cancel(self, reason: str = "cancelled") -> bool:
@@ -148,6 +176,7 @@ def install(fn: Callable[[], None],
 def clear() -> None:
     _tls.fn = None
     _tls.contended = None
+    _tls.migrate = None
 
 
 def current() -> Optional[Callable[[], None]]:
@@ -162,14 +191,52 @@ def contended() -> bool:
     return bool(fn()) if fn is not None else False
 
 
+def install_migrate(fn: Optional[Callable[[], Any]]) -> None:
+    """Register this thread's migrate point (the slice lease CM):
+    ``fn()`` releases the held slice, re-acquires a fresh placement
+    through the fair queue, and returns the new grant's device
+    indices (or None for a whole-mesh grant)."""
+    _tls.migrate = fn
+
+
+def migrate_requested() -> bool:
+    """Peek (don't consume): does this thread's job have a pending
+    migrate request AND a way to perform one?"""
+    token = current_cancel()
+    return (token is not None
+            and token.migrate_pending is not None
+            and getattr(_tls, "migrate", None) is not None)
+
+
+def perform_migrate():
+    """Consume the pending request and run the installed migrate
+    point. Returns ``(performed, new_devices)`` — ``(False, None)``
+    when there was nothing to do. Called by the ENGINE after it has
+    snapshotted state off the devices (runtime/engine.py)."""
+    token = current_cancel()
+    fn = getattr(_tls, "migrate", None)
+    if token is None or fn is None:
+        return False, None
+    if token.consume_migrate() is None:
+        return False, None
+    return True, fn()
+
+
 def snapshot():
-    """(yield_fn, contended_fn) for save/restore around nested
-    installs (the lease CM restores its predecessor on exit)."""
-    return (getattr(_tls, "fn", None), getattr(_tls, "contended", None))
+    """(yield_fn, contended_fn, migrate_fn) for save/restore around
+    nested installs (the lease CM restores its predecessor on exit)."""
+    return (getattr(_tls, "fn", None),
+            getattr(_tls, "contended", None),
+            getattr(_tls, "migrate", None))
 
 
 def restore(snap) -> None:
-    _tls.fn, _tls.contended = snap
+    # older 2-tuple snapshots (pre-migration callers) still restore
+    if len(snap) == 2:
+        _tls.fn, _tls.contended = snap
+        _tls.migrate = None
+    else:
+        _tls.fn, _tls.contended, _tls.migrate = snap
 
 
 def install_cancel(token: Optional[CancelToken]) -> None:

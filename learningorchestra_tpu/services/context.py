@@ -53,7 +53,8 @@ class ServiceContext:
                                slice_aging_seconds=self.config
                                .slice_aging_seconds,
                                numerical_retries=self.config
-                               .health_retries)
+                               .health_retries,
+                               slice_defrag=self.config.slice_defrag)
         # feature-plane cache (docs/PERFORMANCE.md): the host tier all
         # dataset reads route through; shares the $name-cache budget
         self.features = FeatureCache(

@@ -469,9 +469,15 @@ def _prepare_checkpointer(ctx, name: str, type_string: str,
     enabled = treated.pop("checkpoint", False)
     if not type_string.startswith("train/") or not enabled:
         return None
+    from learningorchestra_tpu.runtime.async_ckpt import \
+        wrap_checkpointer
     from learningorchestra_tpu.runtime.checkpoint import Checkpointer
 
-    ckpt = Checkpointer(checkpoint_dir_for(ctx, name))
+    # LO_CKPT_ASYNC=1 moves the commit (serialize+hash+fsync) off the
+    # train thread onto a background worker; the engine barriers at
+    # fit end and before any restore/rollback (docs/RELIABILITY.md)
+    ckpt = wrap_checkpointer(Checkpointer(checkpoint_dir_for(ctx, name)),
+                             config=ctx.config)
     treated["checkpointer"] = ckpt
     return ckpt
 

@@ -32,7 +32,14 @@ proves a failing/slow export never fails or stalls the job, since
 the whole write is best-effort) and ``serving_step``
 (services/serving.py, fired before a serving iteration with queued
 work; ``latency`` mode inflates request latency so the SLO
-watchdog's ``servingP99`` alert path is testable end-to-end)."""
+watchdog's ``servingP99`` alert path is testable end-to-end),
+``ckpt_async_commit`` (runtime/async_ckpt.py, fired on the background
+commit worker — the failure must latch and re-raise on the TRAIN
+thread at its next save()/barrier, never kill or deadlock the
+worker) and ``migration`` (runtime/engine.py, fired at the top of a
+live slice migration before any state moved — surfaces as a
+transient attempt failure; the latched migrate request survives to
+the retry)."""
 
 from __future__ import annotations
 

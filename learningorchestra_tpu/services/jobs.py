@@ -127,7 +127,10 @@ class JobManager:
                  retry_backoff_max: float = 30.0,
                  slice_min_devices: int = 1,
                  slice_aging_seconds: float = 30.0,
-                 numerical_retries: int = 1):
+                 numerical_retries: int = 1,
+                 slice_defrag: float = 0.0):
+        from learningorchestra_tpu.services.migration import \
+            MigrationCoordinator
         from learningorchestra_tpu.services.scheduler import SliceLease
 
         self._catalog = catalog
@@ -136,6 +139,13 @@ class JobManager:
         self._mesh = SliceLease(mesh_leases, pool_weights,
                                 min_devices=slice_min_devices,
                                 aging_seconds=slice_aging_seconds)
+        self._migration = MigrationCoordinator(self)
+        # LO_SLICE_DEFRAG > 0 arms defrag-via-migration: the value is
+        # the fragmentation threshold past which a blocked waiter may
+        # ask the cheapest migratable holder to vacate its slice
+        if float(slice_defrag or 0.0) > 0:
+            self._mesh.set_defrag_policy(self._migration.defrag_pick,
+                                         threshold=float(slice_defrag))
         self._futures: Dict[str, Future] = {}
         # name -> {description, parameters, needs_mesh, token}: the
         # lifecycle registry (cancel API, stall watchdog, shutdown
@@ -687,6 +697,20 @@ class JobManager:
             return True
         token.cancel(reason)
         return True
+
+    # ------------------------------------------------------------------
+    def migrate(self, name: str, reason: str = "migrate") -> bool:
+        """Request live migration of mesh job ``name`` to a fresh
+        slice placement (the ``POST .../{name}/migrate`` backend).
+        Cooperative: the engine honors it at its next epoch boundary
+        — snapshot, release, re-acquire, restore (docs/SCALING.md §7).
+        Returns False when no live migratable mesh job exists under
+        that name."""
+        return self._migration.request(name, reason)
+
+    def migration_stats(self) -> Dict[str, int]:
+        """Monotonic migration counters (requested/refused/defrag)."""
+        return self._migration.stats()
 
     # ------------------------------------------------------------------
     def _watch_stalls(self) -> None:

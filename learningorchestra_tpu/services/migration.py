@@ -1,0 +1,125 @@
+"""Live job migration between mesh slices (docs/SCALING.md §7).
+
+A sliced cluster fragments: jobs finish at different times, and the
+first-fit packer can leave free devices shredded into runs too small
+for the next waiter even when TOTAL free capacity is ample. The
+reference has no notion of this — its Docker Swarm placement never
+moves a running container. Here a running job CAN move, because every
+checkpointed fit is already resumable by construction:
+
+1. :meth:`MigrationCoordinator.request` latches a cooperative migrate
+   signal on the job's :class:`~learningorchestra_tpu.runtime.preempt.
+   CancelToken` (same plumbing as cancellation — no new thread
+   channels);
+2. the engine notices at its next epoch boundary
+   (``runtime/engine.py``): it barriers any in-flight async
+   checkpoint commits, snapshots train state device→host, and calls
+   :func:`preempt.perform_migrate`;
+3. the slice lease's migrate point (services/scheduler.py) releases
+   the held device block and re-acquires the SAME footprint through
+   the fair queue — NON-exact, so starved waiters may claim the old
+   block and the job comes back wherever the packer now fits it;
+4. the engine re-points its thread-local mesh at the new slice,
+   re-places the host snapshot, and resumes — bit-identical replay,
+   since per-step rng is derived by folding the host step counter.
+
+**Defrag policy** (``LO_SLICE_DEFRAG``): the scheduler fires
+:meth:`defrag_pick` from a blocked waiter's poll loop when the
+fragmentation gauge exceeds the configured threshold or an aged
+waiter still cannot fit. The coordinator picks the CHEAPEST live
+migratable job (fewest held devices — least state to move, and small
+blocks are what shred the index line) and requests a migrate; the
+vacated block drains toward the starved waiter through the existing
+aging freeze in ``_grant_next``.
+
+Multi-host pods never migrate (same rule as epoch yielding: a
+coordinator-side placement change would diverge the SPMD replay) —
+the lease only marks tokens migratable on a single host.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, Optional
+
+from learningorchestra_tpu.observability import export as obs_export
+from learningorchestra_tpu.runtime import preempt
+
+
+class MigrationCoordinator:
+    """Picks and signals migration candidates over a JobManager's
+    live-job registry. Owns no threads: requests are latched on the
+    job's own token and consumed by the job's own thread."""
+
+    def __init__(self, jobs: Any):
+        self._jobs = jobs
+        self._lock = threading.Lock()
+        self._requested = 0
+        self._refused = 0
+        self._defrag_picks = 0
+
+    # ------------------------------------------------------------------
+    def _live_tokens(self):
+        """[(name, token)] for running mesh jobs (registry snapshot)."""
+        jobs = self._jobs
+        with jobs._lock:
+            return [(k, v["token"]) for k, v in jobs._job_info.items()
+                    if v.get("needs_mesh") and k in jobs._futures
+                    and not jobs._futures[k].done()]
+
+    def request(self, name: str, reason: str = "migrate") -> bool:
+        """Latch a migrate request on job ``name`` (the
+        ``POST .../{name}/migrate`` backend). Returns False when no
+        live mesh job exists under that name, the job is not
+        migratable (whole-mesh grant, counting mode, multi-host), or
+        it is already cancelled / already migrating."""
+        token: Optional[preempt.CancelToken] = None
+        for job_name, job_token in self._live_tokens():
+            if job_name == name:
+                token = job_token
+                break
+        if token is None or not token.migratable or token.cancelled():
+            with self._lock:
+                self._refused += 1
+            return False
+        if not token.request_migrate(reason):
+            with self._lock:
+                self._refused += 1
+            return False
+        with self._lock:
+            self._requested += 1
+        obs_export.log_event("migration", "requested", trace_id=name,
+                             reason=reason)
+        return True
+
+    # ------------------------------------------------------------------
+    def defrag_pick(self, want: Optional[int] = None) -> Optional[str]:
+        """Scheduler defrag callback (lock NOT held): ask the cheapest
+        migratable holder to vacate its slice. Cheapest = fewest held
+        devices — least state to move, and the small blocks are what
+        shred the free-index line. Jobs already signalled are skipped
+        (idempotent under the waiter's ~1 Hz re-fire). Returns the
+        picked job name, or None when nothing can move."""
+        candidates = [
+            (name, token) for name, token in self._live_tokens()
+            if token.migratable and not token.cancelled()
+            and token.slice_devices is not None
+            and token.migrate_pending is None]
+        candidates.sort(key=lambda item: (len(item[1].slice_devices),
+                                          item[0]))
+        for name, token in candidates:
+            if token.request_migrate("defrag"):
+                with self._lock:
+                    self._defrag_picks += 1
+                    self._requested += 1
+                obs_export.log_event("migration", "defrag",
+                                     trace_id=name,
+                                     waiterWants=want)
+                return name
+        return None
+
+    def stats(self) -> Dict[str, int]:
+        with self._lock:
+            return {"requested": self._requested,
+                    "refused": self._refused,
+                    "defragPicks": self._defrag_picks}

@@ -147,6 +147,11 @@ class SliceLease:
         self._wait_sum = 0.0
         self._wait_count = 0
         self._wait_max = 0.0
+        # defrag-via-migration policy (LO_SLICE_DEFRAG, armed by the
+        # job manager when a MigrationCoordinator exists)
+        self._defrag_cb = None
+        self._defrag_threshold = 1.0
+        self._defrags = 0
 
     # -- policy --------------------------------------------------------
     @property
@@ -282,6 +287,66 @@ class SliceLease:
         self._free.update(range(self._total) if grant.devices is None
                           else grant.devices)
 
+    def _fragmentation_locked(self) -> float:
+        """0 = every free device is one grantable contiguous block,
+        ->1 = free capacity exists but is shredded into unusable
+        holes (same gauge :meth:`stats` reports)."""
+        if not self._sliced or not self._free:
+            return 0.0
+        run = largest = 0
+        for i in range(self._total):
+            if i in self._free:
+                run += 1
+                largest = max(largest, run)
+            else:
+                run = 0
+        return 1.0 - largest / len(self._free)
+
+    def set_defrag_policy(self, callback,
+                          threshold: float = 0.5) -> None:
+        """Arm defrag-via-migration (``LO_SLICE_DEFRAG``):
+        ``callback(want)`` fires from a blocked waiter's poll loop
+        when the waiter cannot fit AND either the fragmentation gauge
+        exceeds ``threshold`` or the waiter has aged past the
+        anti-starvation bound. The callback (services/migration.py)
+        asks the cheapest migratable holder to vacate its slice;
+        ``None`` disarms."""
+        with self._cv:
+            self._defrag_cb = callback
+            self._defrag_threshold = max(
+                0.0, min(1.0, float(threshold)))
+
+    def _maybe_defrag_locked(self, waiter: _Waiter,
+                             last: float) -> float:
+        """acquire()'s poll loop, lock held: fire the defrag policy
+        for a waiter that still cannot fit. Throttled to ~1 Hz per
+        waiter; the callback runs with the lock RELEASED (it walks
+        the job table and the holder it signals will re-enter this
+        scheduler to release + re-queue). Returns the updated
+        last-fired timestamp."""
+        cb = self._defrag_cb
+        if cb is None or not self._sliced or self._free is None:
+            return last
+        now = time.monotonic()
+        if now - last < 1.0:
+            return last
+        if self._fit_locked(waiter) is not _NOFIT:
+            return last
+        aged = bool(self._aging) and \
+            now - waiter.enqueued >= self._aging
+        if not aged and \
+                self._fragmentation_locked() < self._defrag_threshold:
+            return last
+        self._defrags += 1
+        self._cv.release()
+        try:
+            cb(waiter.want)
+        except Exception:  # noqa: BLE001 — defrag is best-effort
+            pass
+        finally:
+            self._cv.acquire()
+        return now
+
     # -- mechanics -----------------------------------------------------
     def acquire(self, pool: str = "default",
                 cancel: Optional["preempt.CancelToken"] = None,
@@ -310,6 +375,7 @@ class SliceLease:
             waiter = _Waiter(seq, pool, want, exact_t, t0)
             self._waiters.append(waiter)
             self._grant_next()
+            last_defrag = 0.0
             while seq not in self._granted:
                 self._cv.wait(0.1 if cancel is not None else None)
                 if cancel is not None and cancel.cancelled():
@@ -324,6 +390,9 @@ class SliceLease:
                     raise preempt.JobCancelled(
                         cancel.reason or "cancelled",
                         "cancelled while waiting for the mesh lease")
+                if seq not in self._granted:
+                    last_defrag = self._maybe_defrag_locked(
+                        waiter, last_defrag)
             grant = self._granted.pop(seq)
             self._holders[seq] = grant
             grant.wait_seconds = time.monotonic() - t0
@@ -418,6 +487,7 @@ class SliceLease:
                 "largestFreeRun": largest,
                 "fragmentation": fragmentation,
                 "waiters": len(self._waiters),
+                "defrags": self._defrags,
                 "grantsByPool": dict(self._grants_by_pool),
                 "leaseWaitSum": self._wait_sum,
                 "leaseWaitCount": self._wait_count,
@@ -450,6 +520,13 @@ class SliceLease:
         start = [time.monotonic()]
         held = [True]
         can_yield = _yield_enabled()
+        if cancel is not None:
+            # advertise migratability (services/migration.py reads
+            # these to pick defrag candidates): a whole-mesh or
+            # counting-mode grant has nowhere else to go
+            cancel.slice_devices = grant.devices
+            cancel.migratable = (can_yield and self._sliced
+                                 and grant.devices is not None)
 
         def yield_point() -> None:
             if not can_yield or not self.contended_by_other(pool):
@@ -467,11 +544,34 @@ class SliceLease:
             token.preempted_seconds += start[0] - t_wait
             token.yields += 1
 
+        def migrate_point() -> Optional[Tuple[int, ...]]:
+            # unlike yield_point this re-acquire is NOT exact=: the
+            # job ABANDONS its device block (starved waiters may claim
+            # it) and comes back wherever the packer now fits the same
+            # footprint. The engine has already snapshotted state off
+            # the devices before preempt.perform_migrate() lands here.
+            self.release(pool, time.monotonic() - start[0],
+                         grant=current[0])
+            held[0] = False
+            t_wait = time.monotonic()
+            current[0] = self.acquire(pool, cancel,
+                                      footprint=footprint)
+            held[0] = True
+            start[0] = time.monotonic()
+            token.preempted_seconds += start[0] - t_wait
+            token.migrations += 1
+            token.devices = current[0].devices
+            if cancel is not None:
+                cancel.slice_devices = current[0].devices
+                cancel.migrations += 1
+            return current[0].devices
+
         previous = preempt.snapshot()
         preempt.install(
             yield_point,
             contended_fn=lambda: can_yield and
             self.contended_by_other(pool))
+        preempt.install_migrate(migrate_point)
         try:
             yield token
         finally:
@@ -627,6 +727,7 @@ class LeaseToken:
     def __init__(self) -> None:
         self.preempted_seconds = 0.0
         self.yields = 0
+        self.migrations = 0
         self.devices: Optional[Tuple[int, ...]] = None
         self.wait_seconds = 0.0
 

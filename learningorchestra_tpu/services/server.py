@@ -328,6 +328,8 @@ class Api:
             sorted(self.ctx.jobs.mesh_served().items())}
         out["jobLifecycle"] = self.ctx.jobs.lifecycle_counters()
         out["meshScheduler"] = self.ctx.jobs.scheduler_stats()
+        # live migration between slices (docs/SCALING.md §7)
+        out["migrationStats"] = self.ctx.jobs.migration_stats()
         # feature-plane cache tiers (docs/PERFORMANCE.md). Lazy
         # imports: arena/engine stats never initialize a backend.
         out["featureCache"] = self.ctx.features.stats()
@@ -586,6 +588,9 @@ class Api:
             return self._get(service, tool, name, params)
         if method == "POST":
             if name is not None:
+                if name.endswith("/migrate") and \
+                        len(name) > len("/migrate"):
+                    return self._migrate_run(name[:-len("/migrate")])
                 raise V.HttpError(V.HTTP_NOT_ACCEPTABLE,
                                   "POST takes no name in the path")
             return self._post(service, tool, body or {})
@@ -867,6 +872,22 @@ class Api:
                               f"no cancellable job for {name} (already "
                               f"finished or never submitted here)")
         return 200, {"result": f"cancellation requested for {name}"}, \
+            "application/json"
+
+    def _migrate_run(self, name: str) -> Tuple[int, Any, str]:
+        # ``POST .../{name}/migrate`` asks the RUNNING JOB to move to
+        # a fresh slice placement at its next epoch boundary
+        # (docs/SCALING.md §7); refused (406) when the job is not a
+        # live migratable mesh job — finished, never submitted here,
+        # whole-mesh, or multi-host.
+        if self.ctx.catalog.get_metadata(name) is None:
+            raise V.HttpError(V.HTTP_NOT_FOUND,
+                              f"{V.MESSAGE_NONEXISTENT_FILE}: {name}")
+        if not self.ctx.jobs.migrate(name):
+            raise V.HttpError(V.HTTP_NOT_ACCEPTABLE,
+                              f"no migratable job for {name} (not "
+                              f"running, not sliced, or multi-host)")
+        return 200, {"result": f"migration requested for {name}"}, \
             "application/json"
 
     def _get(self, service: str, tool: str, name: Optional[str],

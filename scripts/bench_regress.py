@@ -41,7 +41,7 @@ from typing import Dict, List, Optional, Tuple
 # treated as higher-is-better (throughput, speedup, accuracy, MFU)
 _LOWER_IS_BETTER = re.compile(
     r"(seconds|_ms$|_ms\b|p50|p99|rss|overhead|retraces|latency"
-    r"|time_to|evictions|rejected)", re.IGNORECASE)
+    r"|time_to|evictions|rejected|stall_ratio)", re.IGNORECASE)
 
 _SKIP_KEYS = {"platform", "rows", "epochs", "batch_size", "n_samples",
               "streams", "requests_per_stream", "prompt_len",

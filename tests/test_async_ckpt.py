@@ -1,0 +1,254 @@
+"""Async tiered checkpointing (runtime/async_ckpt.py) and the
+checkpoint hardening that rides with it (runtime/checkpoint.py):
+sharded layout, verified partial restore with fallback, bounded
+quarantine, atomic progress sidecar, crash-shaped step dirs."""
+
+import dataclasses
+import json
+import os
+
+import numpy as np
+import pytest
+
+from learningorchestra_tpu.runtime.async_ckpt import (
+    AsyncCheckpointError, AsyncCheckpointManager, wrap_checkpointer)
+from learningorchestra_tpu.runtime.checkpoint import (
+    CheckpointCorrupted, Checkpointer)
+
+
+def _tree(step):
+    return {"step": np.int32(step),
+            "params": {"w": np.full((4, 4), float(step), np.float32),
+                       "b": np.arange(8, dtype=np.float32) + step}}
+
+
+def _corrupt_first_payload(ckpt_dir, step):
+    """Flip bytes in one payload file WITHOUT changing its size, so
+    the cheap stat check passes and the sha256 re-hash is what must
+    catch it."""
+    step_dir = os.path.join(ckpt_dir, str(step))
+    names = sorted(n for n in os.listdir(step_dir)
+                   if n.endswith(".msgpack"))
+    path = os.path.join(step_dir, names[0])
+    with open(path, "r+b") as f:
+        f.seek(0)
+        f.write(b"\xde\xad\xbe\xef")
+
+
+def _arm_faults(tmp_config, spec):
+    from learningorchestra_tpu import config as config_mod
+    from learningorchestra_tpu.services import faults
+
+    config_mod.set_config(
+        dataclasses.replace(tmp_config, fault_inject=spec))
+    faults.reset()
+
+
+# ----------------------------------------------------------------------
+# async manager
+# ----------------------------------------------------------------------
+def test_async_fifo_commits_and_reads_barrier(tmp_config, tmp_path):
+    mgr = AsyncCheckpointManager(
+        Checkpointer(str(tmp_path / "ck"), max_to_keep=10))
+    try:
+        for step in (1, 2, 3, 5, 8):
+            mgr.save(step, _tree(step))
+        # every read barriers first: what was saved is on disk
+        assert mgr.latest_step() == 8
+        restored = mgr.restore(_tree(0))
+        np.testing.assert_array_equal(
+            np.asarray(restored["params"]["w"]),
+            _tree(8)["params"]["w"])
+        # FIFO worker landed every step, in order (none overwritten
+        # out of order / dropped)
+        on_disk = sorted(int(d) for d in os.listdir(tmp_path / "ck")
+                         if d.isdigit())
+        assert on_disk == [1, 2, 3, 5, 8]
+    finally:
+        mgr.close()
+
+
+def test_async_meta_rides_the_same_fifo(tmp_config, tmp_path):
+    mgr = AsyncCheckpointManager(Checkpointer(str(tmp_path / "ck")))
+    try:
+        mgr.save(1, _tree(1))
+        mgr.save_meta({"epoch": 7})
+        # load_meta barriers, so the sidecar commit has landed
+        assert mgr.load_meta() == {"epoch": 7}
+    finally:
+        mgr.close()
+
+
+def test_async_commit_failure_latches_on_train_thread(
+        tmp_config, tmp_path):
+    _arm_faults(tmp_config, "ckpt_async_commit:1:raise")
+    from learningorchestra_tpu.services import faults
+
+    mgr = AsyncCheckpointManager(Checkpointer(str(tmp_path / "ck")))
+    try:
+        mgr.save(1, _tree(1))  # worker fails, latches, keeps draining
+        with pytest.raises(AsyncCheckpointError):
+            mgr.wait_until_finished()
+        # the latched error re-raises on the NEXT save too
+        with pytest.raises(AsyncCheckpointError):
+            mgr.save(2, _tree(2))
+        # the failed commit never left an accepted step on disk
+        probe = Checkpointer(str(tmp_path / "ck"))
+        assert probe.latest_step() is None
+        probe.close()
+    finally:
+        faults.reset()
+        # close() drains WITHOUT re-raising (teardown must not mask
+        # the job's real error) and must not hang on a latched error
+        mgr.close()
+
+
+def test_async_save_after_close_refuses(tmp_config, tmp_path):
+    mgr = AsyncCheckpointManager(Checkpointer(str(tmp_path / "ck")))
+    mgr.close()
+    with pytest.raises(AsyncCheckpointError):
+        mgr.save(1, _tree(1))
+
+
+def test_wrap_checkpointer_honors_config(tmp_config, tmp_path):
+    sync = Checkpointer(str(tmp_path / "ck"))
+    off = dataclasses.replace(tmp_config, ckpt_async=False)
+    assert wrap_checkpointer(sync, config=off) is sync
+    cfg = dataclasses.replace(tmp_config, ckpt_async=True,
+                              ckpt_inflight=3)
+    wrapped = wrap_checkpointer(sync, config=cfg)
+    assert isinstance(wrapped, AsyncCheckpointManager)
+    assert wrapped._queue.maxsize == 3
+    wrapped.close()
+
+
+# ----------------------------------------------------------------------
+# sharded layout
+# ----------------------------------------------------------------------
+def test_sharded_layout_roundtrip(tmp_config, tmp_path):
+    ckpt = Checkpointer(str(tmp_path / "ck"), shards=2)
+    try:
+        ckpt.save(3, _tree(3))
+        step_dir = tmp_path / "ck" / "3"
+        names = sorted(os.listdir(step_dir))
+        assert "shard-00000-of-00002.msgpack" in names
+        assert "shard-00001-of-00002.msgpack" in names
+        assert "checkpoint.msgpack" not in names
+        with open(step_dir / "manifest.json") as f:
+            manifest = json.load(f)
+        assert set(manifest["files"]) == {n for n in names
+                                          if n.endswith(".msgpack")}
+        restored = ckpt.restore(_tree(0))
+        np.testing.assert_array_equal(
+            np.asarray(restored["params"]["b"]),
+            _tree(3)["params"]["b"])
+    finally:
+        ckpt.close()
+
+
+def test_shard_corruption_quarantines_and_falls_back(
+        tmp_config, tmp_path):
+    ckpt = Checkpointer(str(tmp_path / "ck"), max_to_keep=5, shards=2)
+    try:
+        ckpt.save(1, _tree(1))
+        ckpt.save(2, _tree(2))
+        _corrupt_first_payload(str(tmp_path / "ck"), 2)
+        # size check still passes, so latest_step is fooled...
+        assert ckpt.latest_step() == 2
+        # ...but the re-hashing restore catches it, quarantines the
+        # torn step and falls back to the previous verified one
+        with pytest.warns(RuntimeWarning, match="quarantined"):
+            restored = ckpt.restore(_tree(0))
+        np.testing.assert_array_equal(
+            np.asarray(restored["params"]["w"]),
+            _tree(1)["params"]["w"])
+        qdir = tmp_path / "ck" / ".quarantine"
+        assert len(os.listdir(qdir)) == 1
+        assert ckpt.latest_step() == 1
+    finally:
+        ckpt.close()
+
+
+def test_restore_partial_verifies_and_falls_back(tmp_config, tmp_path):
+    ckpt = Checkpointer(str(tmp_path / "ck"), max_to_keep=5, shards=2)
+    try:
+        ckpt.save(1, _tree(1))
+        ckpt.save(2, _tree(2))
+        _corrupt_first_payload(str(tmp_path / "ck"), 2)
+        with pytest.warns(RuntimeWarning, match="quarantined"):
+            out = ckpt.restore_partial({"params": _tree(0)["params"]})
+        np.testing.assert_array_equal(
+            np.asarray(out["params"]["w"]), _tree(1)["params"]["w"])
+        # an EXPLICITLY requested corrupt step has no substitute
+        ckpt.save(4, _tree(4))
+        _corrupt_first_payload(str(tmp_path / "ck"), 4)
+        with pytest.warns(RuntimeWarning, match="quarantined"):
+            with pytest.raises(CheckpointCorrupted):
+                ckpt.restore_partial({"params": _tree(0)["params"]},
+                                     step=4)
+    finally:
+        ckpt.close()
+
+
+# ----------------------------------------------------------------------
+# quarantine bound + crash shapes + sidecar
+# ----------------------------------------------------------------------
+def test_quarantine_is_bounded(tmp_config, tmp_path):
+    from learningorchestra_tpu import config as config_mod
+
+    config_mod.set_config(
+        dataclasses.replace(tmp_config, ckpt_quarantine_keep=2))
+    ckpt = Checkpointer(str(tmp_path / "ck"), max_to_keep=10)
+    try:
+        for step in range(1, 6):
+            ckpt.save(step, _tree(step))
+            _corrupt_first_payload(str(tmp_path / "ck"), step)
+        with pytest.warns(RuntimeWarning, match="quarantined"):
+            assert ckpt.restore(_tree(0)) is None  # nothing verifies
+        qdir = tmp_path / "ck" / ".quarantine"
+        assert len(os.listdir(qdir)) <= 2
+    finally:
+        ckpt.close()
+
+
+def test_crash_mid_commit_never_accepted(tmp_config, tmp_path):
+    ckpt = Checkpointer(str(tmp_path / "ck"))
+    try:
+        ckpt.save(1, _tree(1))
+        # a crash mid-commit leaves a .tmp stage dir — readers must
+        # never see it as a step
+        tmp_dir = tmp_path / "ck" / "2.tmp"
+        os.makedirs(tmp_dir)
+        with open(tmp_dir / "checkpoint.msgpack", "wb") as f:
+            f.write(b"torn")
+        assert ckpt.latest_step() == 1
+        restored = ckpt.restore(_tree(0))
+        np.testing.assert_array_equal(
+            np.asarray(restored["params"]["w"]),
+            _tree(1)["params"]["w"])
+        # a manifest naming a missing payload (post-rename tamper) is
+        # skipped by the cheap check too
+        bad = tmp_path / "ck" / "3"
+        os.makedirs(bad)
+        with open(bad / "manifest.json", "w") as f:
+            json.dump({"step": 3, "wallTime": 0.0,
+                       "files": {"checkpoint.msgpack":
+                                 {"sha256": "0" * 64, "bytes": 4}}}, f)
+        assert ckpt.latest_step() == 1
+    finally:
+        ckpt.close()
+
+
+def test_save_meta_atomic_and_torn_sidecar_ignored(
+        tmp_config, tmp_path):
+    ckpt = Checkpointer(str(tmp_path / "ck"))
+    try:
+        ckpt.save_meta({"epoch": 3, "step": 12})
+        assert not os.path.exists(
+            tmp_path / "ck" / "progress.json.tmp")
+        assert ckpt.load_meta() == {"epoch": 3, "step": 12}
+        with open(tmp_path / "ck" / "progress.json", "w") as f:
+            f.write('{"epoch": 3, "ste')  # torn write
+        assert ckpt.load_meta() is None
+    finally:
+        ckpt.close()
