@@ -5,6 +5,11 @@
 #
 # Usage: deploy/ci.sh            (from anywhere; paths are self-rooted)
 # Env:   LO_CI_TIMEOUT        seconds for the tier-1 run (default 870)
+#        LO_CI_FULL           1 to also run the FULL suite incl. slow
+#                             oracle-parity tests (default 0: tier-1
+#                             keeps one parity test per subsystem, see
+#                             tests/conftest.py)
+#        LO_CI_FULL_TIMEOUT   seconds for the full-suite run (default 3600)
 #        LO_CI_CHAOS_TIMEOUT  seconds for the chaos stage (default 300)
 #        LO_CI_PERF_TIMEOUT   seconds for the perf-smoke stage (default 600)
 
@@ -22,6 +27,20 @@ timeout -k 10 "$TIMEOUT" env JAX_PLATFORMS=cpu \
     python -m pytest tests/ -q -m 'not slow' \
     --continue-on-collection-errors \
     -p no:cacheprovider -p no:xdist -p no:randomly
+
+if [ "${LO_CI_FULL:-0}" = "1" ]; then
+  echo "== full suite: slow oracle-parity tier included =="
+  # The nightly tier: everything tests/conftest.py demotes to slow
+  # (exhaustive oracle-parity sweeps, multi-config kernels) on top of
+  # tier-1. The default tier keeps at least one parity test per
+  # kernel/parallelism subsystem, so skipping this stage never means
+  # zero numerical-correctness coverage.
+  FULL_TIMEOUT="${LO_CI_FULL_TIMEOUT:-3600}"
+  timeout -k 10 "$FULL_TIMEOUT" env JAX_PLATFORMS=cpu \
+      python -m pytest tests/ -q -m 'slow or not slow' \
+      --continue-on-collection-errors \
+      -p no:cacheprovider -p no:xdist -p no:randomly
+fi
 
 echo "== chaos: lifecycle under fault injection =="
 # A bounded hang at the job_run site (reclaimed by deadlines/cancel)
@@ -199,7 +218,8 @@ OBS_OUT="$(mktemp)"
 SERVE_OUT="$(mktemp)"
 SWEEP_OUT="$(mktemp)"
 MONITOR_OUT="$(mktemp)"
-trap 'rm -rf "$PERF_CACHE" "$PERF_OUT" "$SLICE_OUT" "$CKPT_OUT" "$MIG_OUT" "$CHAOS_OUT" "$OVERHEAD_OUT" "$OBS_OUT" "$SERVE_OUT" "$SWEEP_OUT" "$MONITOR_OUT"' EXIT
+ROOFLINE_OUT="$(mktemp)"
+trap 'rm -rf "$PERF_CACHE" "$PERF_OUT" "$SLICE_OUT" "$CKPT_OUT" "$MIG_OUT" "$CHAOS_OUT" "$OVERHEAD_OUT" "$OBS_OUT" "$SERVE_OUT" "$SWEEP_OUT" "$MONITOR_OUT" "$ROOFLINE_OUT"' EXIT
 timeout -k 10 "$SENTINEL_TIMEOUT" env JAX_PLATFORMS=cpu \
     JAX_COMPILATION_CACHE_DIR="$PERF_CACHE" \
     LO_COMPUTE_DTYPE=float32 \
@@ -433,6 +453,51 @@ assert ratio < 1.01, (
     f"monitor-smoke: sampler costs {ratio}x (gate < 1.01x): {result}")
 print(f"monitor-smoke: OK (alert fired on trace "
       f"{result['alert_trace']}, healthz 503 -> 200, sampler "
+      f"overhead {ratio}x)")
+EOF
+
+echo "== roofline-smoke: perf reports must land and cost < 3% =="
+# Roofline perf observability end-to-end (bench.py perf_report;
+# docs/OBSERVABILITY.md "Roofline & perf reports"). Gates:
+#  - a finished train job answers GET /observability/perf/{name} with
+#    the full roofline block (mfu, achieved GB/s/chip, bound class)
+#    and its timeline carries the per-window perf percentiles
+#  - an ACTIVE predict session answers the same route with its live
+#    goodput block, and /metrics exposes the lo_mfu /
+#    lo_tflops_per_chip / lo_abandoned_dispatches gauges
+#  - LO_PERF=1 vs LO_PERF=0 steady-state fit cost stays < 3%
+ROOFLINE_TIMEOUT="${LO_CI_ROOFLINE_TIMEOUT:-600}"
+timeout -k 10 "$ROOFLINE_TIMEOUT" env JAX_PLATFORMS=cpu \
+    JAX_COMPILATION_CACHE_DIR="$PERF_CACHE" \
+    LO_COMPUTE_DTYPE=float32 \
+    python bench.py --phase perf_report | tee "$ROOFLINE_OUT"
+python - "$ROOFLINE_OUT" <<'EOF'
+import json, sys
+
+mark = "@@LO_BENCH_RESULT@@"
+result = None
+for line in reversed(open(sys.argv[1]).read().splitlines()):
+    if line.startswith(mark):
+        result = json.loads(line[len(mark):])
+        break
+assert result is not None, "roofline-smoke: no bench result line"
+assert "error" not in result, f"roofline-smoke: phase failed: {result}"
+result = result.get("result", result)  # unwrap the ok-envelope
+assert result["train_report_ok"], (
+    f"roofline-smoke: train perf report missing/incomplete: {result}")
+assert result["timeline_perf_ok"], (
+    f"roofline-smoke: timeline carries no perf block: {result}")
+assert result["serving_report_ok"], (
+    f"roofline-smoke: live serving perf report missing: {result}")
+assert result["prom_gauges_ok"], (
+    f"roofline-smoke: /metrics lacks the new gauges: {result}")
+ratio = result["perf_overhead_ratio"]
+assert ratio < 1.03, (
+    f"roofline-smoke: perf tracking costs {ratio}x "
+    f"(gate < 1.03x): {result}")
+print(f"roofline-smoke: OK (train mfu {result['train_mfu']}, "
+      f"bound by {result['train_bound_by']}, serving "
+      f"{result['serving_rows_per_sec_per_chip']} rows/s/chip, "
       f"overhead {ratio}x)")
 EOF
 

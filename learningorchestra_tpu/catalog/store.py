@@ -613,7 +613,6 @@ class DatasetWriter:
                 f"heterogeneous appends need a new writer session")
         self._pending.append(batch)
         self._pending_rows += batch.num_rows
-        self._rows += batch.num_rows
         if self._pending_rows >= self._group_rows:
             self._flush_pending()
 
@@ -622,16 +621,21 @@ class DatasetWriter:
             return
         table = (self._pending[0] if len(self._pending) == 1
                  else pa.concat_tables(self._pending))
-        # buffer clears only AFTER the write lands: a transient write
-        # failure (ENOSPC, remote fs) must surface to the caller with
-        # the rows still buffered, not silently drop a row group while
-        # rows_written keeps counting it
+        # buffer clears — and rows_written counts — only AFTER the
+        # write lands: a transient write failure (ENOSPC, remote fs)
+        # must surface to the caller with the rows still buffered, not
+        # silently drop a row group that throughput accounting already
+        # claimed
         self._writer.write_table(table)
+        self._rows += table.num_rows
         self._pending = []
         self._pending_rows = 0
 
     @property
     def rows_written(self) -> int:
+        """Rows durably written to parquet (NOT rows accepted —
+        buffered rows don't count until their row group lands; close()
+        flushes the remainder)."""
         return self._rows
 
     def fields(self) -> List[str]:

@@ -390,6 +390,18 @@ class Context:
             "GET", f"{API_PREFIX}/observability/alerts")
         return payload
 
+    def perf(self, name: Optional[str] = None) -> Dict[str, Any]:
+        """Roofline perf report (docs/OBSERVABILITY.md "Roofline &
+        perf reports"): without ``name``, the platform peaks and the
+        jobs with reports; with ``name``, the job's or serving
+        session's achieved-vs-peak block (mfu, TFLOPs/chip, GB/s/chip,
+        boundBy)."""
+        path = f"{API_PREFIX}/observability/perf"
+        if name:
+            path += f"/{name}"
+        _, payload = self._http.request("GET", path)
+        return payload
+
     def healthz(self) -> Dict[str, Any]:
         """Readiness probe: raises on 503 (draining or a
         page-severity SLO alert firing); returns the status body on

@@ -65,6 +65,13 @@ class Config:
     slice_aging_seconds: float = dataclasses.field(
         default_factory=lambda: float(os.environ.get(
             "LO_SLICE_AGING", "30")))
+    # Half-life (seconds) for the fair queue's served mesh-seconds:
+    # usage older than a few half-lives stops counting against a
+    # pool, so fairness tracks RECENT consumption instead of punishing
+    # a pool forever for last week's burst. 0 = no decay (all-time).
+    fair_served_half_life_seconds: float = dataclasses.field(
+        default_factory=lambda: float(os.environ.get(
+            "LO_FAIR_SERVED_HALF_LIFE", "600")))
     # Fair-scheduling pool weights, "train=2,tune=1" (unlisted pools
     # weigh 1) — reference fairscheduler.xml ``weight`` parity.
     pool_weights: str = dataclasses.field(
@@ -298,6 +305,14 @@ class Config:
     request_timeout_seconds: float = dataclasses.field(
         default_factory=lambda: float(os.environ.get(
             "LO_REQUEST_TIMEOUT", "0")))
+    # Cap on concurrent timed dispatches: each LO_REQUEST_TIMEOUT
+    # request runs on its own daemon thread that keeps running after
+    # a 504, so without a ceiling slow backends accumulate abandoned
+    # threads unboundedly. At the cap new timed requests are rejected
+    # 503 (counted as lo_gateway_saturated_total); 0 = uncapped.
+    gateway_max_inflight: int = dataclasses.field(
+        default_factory=lambda: int(os.environ.get(
+            "LO_GATEWAY_MAX_INFLIGHT", "64")))
 
     # Observability.
     log_level: str = dataclasses.field(

@@ -19,7 +19,11 @@ Four small pieces threaded through every plane:
   plus the footprint-calibration registry;
 - :mod:`slo` — burn-rate SLO watchdog over the histograms and sampler
   rings, emitting firing/resolved alerts into the event log,
-  ``/metrics`` and ``GET /healthz``.
+  ``/metrics`` and ``GET /healthz``;
+- :mod:`perf` — roofline perf layer: per-chip peak FLOP/bandwidth
+  registry, achieved-vs-peak classification from XLA cost analysis,
+  and the per-job report registry behind
+  ``GET /observability/perf/{name}``.
 
 Everything degrades to no-ops when ``LO_TRACE=0`` (tracing) or
 ``LO_MONITOR=0`` (sampler); nothing here may ever fail or stall the
@@ -32,3 +36,4 @@ from learningorchestra_tpu.observability import hist  # noqa: F401
 from learningorchestra_tpu.observability import export  # noqa: F401
 from learningorchestra_tpu.observability import monitor  # noqa: F401
 from learningorchestra_tpu.observability import slo  # noqa: F401
+from learningorchestra_tpu.observability import perf  # noqa: F401

@@ -112,6 +112,21 @@ def summary(job: str) -> Optional[Dict[str, Any]]:
     bad = sum(r.get("badSteps", 0) for r in rows)
     if bad:
         out["badSteps"] = bad
+    # roofline block (observability/perf): present only on windows
+    # past compile, so summarize over the windows that carry it
+    perf: Dict[str, Any] = {}
+    for key in ("mfu", "tflopsPerSecPerChip", "gbPerSecPerChip",
+                "hbmBwUtil"):
+        vals = sorted(float(r[key]) for r in rows if key in r)
+        if vals:
+            perf[key] = {"p50": _percentile(vals, 0.50),
+                         "p90": _percentile(vals, 0.90),
+                         "max": vals[-1]}
+    bounds = [r["boundBy"] for r in rows if "boundBy" in r]
+    if bounds:
+        perf["boundBy"] = bounds[-1]
+    if perf:
+        out["perf"] = perf
     return out
 
 

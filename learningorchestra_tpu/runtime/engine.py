@@ -29,6 +29,7 @@ import optax
 from flax import struct
 
 from learningorchestra_tpu.observability import hist as obs_hist
+from learningorchestra_tpu.observability import perf as obs_perf
 from learningorchestra_tpu.observability import timeline as obs_timeline
 from learningorchestra_tpu.observability import trace as obs_trace
 from learningorchestra_tpu.runtime import arena as arena_lib
@@ -82,9 +83,9 @@ _EXEC_CACHE: "collections.OrderedDict[Any, Callable]" = \
 _EXEC_LOCK = threading.Lock()
 _EXEC_STATS = {"hits": 0, "misses": 0}
 _EXEC_CACHE_CAP = 64
-# measured per-step flops by executable key: lets a warm fit skip the
-# _measure_flops lowering (a full trace) entirely
-_FLOPS_CACHE: Dict[Any, float] = {}
+# measured per-step (flops, bytes accessed) by executable key: lets a
+# warm fit skip the _measure_flops lowering (a full trace) entirely
+_FLOPS_CACHE: Dict[Any, Tuple[float, float]] = {}
 
 
 def executable_cache_stats() -> Dict[str, int]:
@@ -158,6 +159,9 @@ class Engine:
         # apply returns a tuple, e.g. (logits, moe_aux))
         self._predict_transform = predict_transform
         self._step_flops: Optional[float] = None
+        # XLA's "bytes accessed" for the same step — the denominator of
+        # arithmetic intensity in the roofline block (observability/perf)
+        self._step_bytes: Optional[float] = None
         self._flops_key = None
         # analytic lower bound on per-step flops given a batch dict —
         # XLA cost analysis reports ZERO flops for custom calls
@@ -477,17 +481,17 @@ class Engine:
 
     def _roofline_record(self, record: Dict[str, Any], steps: int,
                          dt: float) -> None:
-        """Attach achieved tflops/sec/chip + MFU for ``steps`` steady-
-        state steps over ``dt`` seconds."""
+        """Attach the roofline block for ``steps`` steady-state steps
+        over ``dt`` seconds: achieved tflops/sec/chip + MFU always,
+        plus GB/s/chip, arithmetic intensity, bandwidth utilization and
+        boundBy when bytes/peaks are known (observability/perf)."""
         if not self._step_flops or steps <= 0 or dt <= 0:
             return
         n_dev = (self._mesh.size if self._mesh is not None
                  else jax.device_count())
-        achieved = self._step_flops * steps / dt
-        record["tflopsPerSecPerChip"] = round(achieved / n_dev / 1e12, 4)
-        peak = peak_flops_per_chip()
-        if peak:
-            record["mfu"] = round(achieved / n_dev / peak, 4)
+        record.update(obs_perf.roofline(
+            self._step_flops, self._step_bytes or 0.0, steps, dt,
+            n_dev))
 
     def _observe_window(self, mono0: float, dt: float,
                         record: Dict[str, Any], bad_steps: int, *,
@@ -520,19 +524,33 @@ class Engine:
                 attrs["loss"] = round(float(record["loss"]), 6)
             obs_trace.add("epoch", trace_id, mono0, end, parent=parent,
                           **attrs)
+            # roofline block (stamped on the record by
+            # _roofline_record): rides the same ring entry so the
+            # timeline answers "how fast vs the hardware" per window,
+            # and keeps the job's latest report queryable after the fit
+            # via GET /observability/perf/{name}
+            perf_block = {k: record[k] for k in (
+                "mfu", "tflopsPerSecPerChip", "gbPerSecPerChip",
+                "arithmeticIntensity", "hbmBwUtil", "boundBy")
+                if k in record}
             obs_timeline.record(
                 trace_id, step=step, dt=dt,
                 examples_per_second=record.get(
                     "samplesPerSecond", 0.0),
                 loss=record.get("loss"),
                 bad_steps=bad_steps if bad_steps else None,
-                retrace=bool(first and cold))
+                retrace=bool(first and cold),
+                **perf_block)
+            if perf_block:
+                obs_perf.record_job(trace_id, dict(
+                    perf_block, kind="train", epoch=epoch))
         except Exception:  # noqa: BLE001 — observability is advisory
             pass
 
     def _measure_flops(self, state, batch, rng, step_fn=None) -> None:
-        """Per-step flop estimate from the lowered HLO (cheap — no
-        compile). Basis for the MFU line in every history record."""
+        """Per-step flop + bytes-accessed estimate from the lowered HLO
+        (cheap — no compile). Basis for the MFU line and the roofline
+        block in every history record."""
         key = tuple(sorted((k, tuple(v.shape)) for k, v in batch.items()))
         if self._step_flops is not None and key == self._flops_key:
             return
@@ -542,7 +560,7 @@ class Engine:
             if cached is not None:
                 # warm job: reuse the measured value — lowering below
                 # is a full trace, exactly what a repeat fit must skip
-                self._step_flops = cached
+                self._step_flops, self._step_bytes = cached
                 self._flops_key = key
                 return
         self._flops_key = key
@@ -556,16 +574,24 @@ class Engine:
                 cost = lowered.compile().cost_analysis()
             flops = float(cost.get("flops", 0.0)) if cost else 0.0
             self._step_flops = flops if flops > 0 else 0.0
+            bytes_acc = (float(cost.get("bytes accessed", 0.0))
+                         if cost else 0.0)
+            self._step_bytes = bytes_acc if bytes_acc > 0 else 0.0
         except Exception:  # noqa: BLE001 — accounting must never sink a run
             self._step_flops = 0.0
+            self._step_bytes = 0.0
         if self._flops_floor_fn is not None:
             try:
+                # the floor corrects custom calls' ZERO reported flops;
+                # their bytes ARE counted (operands/results), so only
+                # the flop side is raised
                 floor = float(self._flops_floor_fn(batch))
                 self._step_flops = max(self._step_flops or 0.0, floor)
             except Exception:  # noqa: BLE001
                 pass
         if shared_key is not None and self._step_flops is not None:
-            _FLOPS_CACHE[shared_key] = self._step_flops
+            _FLOPS_CACHE[shared_key] = (self._step_flops,
+                                        self._step_bytes or 0.0)
 
     def _should_scan(self, batcher: data_lib.ArrayBatcher) -> bool:
         from learningorchestra_tpu.config import get_config
@@ -1580,31 +1606,10 @@ class FusedSweepUnsupported(RuntimeError):
     trials."""
 
 
-# per-chip dense bf16 peak FLOP/s, public spec-sheet numbers; substring
-# matched against jax's device_kind
-_PEAK_FLOPS_BF16 = (
-    ("v6", 918e12),          # Trillium
-    ("v5p", 459e12),
-    ("v5 lite", 197e12),     # v5e reports "TPU v5 lite"
-    ("v5e", 197e12),
-    ("v5", 459e12),
-    ("v4", 275e12),
-    ("v3", 123e12),
-    ("v2", 45e12),
-)
-
-
-def peak_flops_per_chip() -> Optional[float]:
-    """Dense bf16 peak of the current accelerator, None off-TPU (MFU is
-    only meaningful against a hardware roofline)."""
-    dev = jax.devices()[0]
-    if dev.platform != "tpu":
-        return None
-    kind = getattr(dev, "device_kind", "").lower()
-    for key, peak in _PEAK_FLOPS_BF16:
-        if key in kind:
-            return peak
-    return None
+# The per-chip peak tables moved to observability/perf.py (which adds
+# HBM bandwidth and env overrides); re-exported here for back-compat.
+_PEAK_FLOPS_BF16 = obs_perf.PEAK_FLOPS_BF16
+peak_flops_per_chip = obs_perf.peak_flops_per_chip
 
 
 def to_host(tree):

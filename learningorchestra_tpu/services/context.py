@@ -52,6 +52,8 @@ class ServiceContext:
                                .slice_min_devices,
                                slice_aging_seconds=self.config
                                .slice_aging_seconds,
+                               served_half_life_seconds=self.config
+                               .fair_served_half_life_seconds,
                                numerical_retries=self.config
                                .health_retries,
                                slice_defrag=self.config.slice_defrag)

@@ -363,8 +363,10 @@ def _record_sweep_fusion(ctx, name: str, result: Any) -> None:
 def _inject_epoch_log(ctx, name: str, instance: Any, method: str,
                       treated: Dict[str, Any]) -> None:
     """Stream per-epoch training records (loss/accuracy/samplesPerSecond
-    and the engine's tflopsPerSecPerChip/mfu roofline numbers) into the
-    execution's documents as they happen, when the target method takes a
+    and the engine's roofline block — tflopsPerSecPerChip/mfu plus
+    gbPerSecPerChip/arithmeticIntensity/hbmBwUtil/boundBy when bytes
+    and peaks are known, observability/perf) into the execution's
+    documents as they happen, when the target method takes a
     ``log_fn`` (our engine-backed fits do; sklearn methods don't). The
     reference's only perf instrumentation is Builder's post-hoc fitTime
     (builder_image/builder.py:117-122) — live epoch records through the

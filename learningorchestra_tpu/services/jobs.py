@@ -58,6 +58,7 @@ from learningorchestra_tpu.catalog import documents as D
 from learningorchestra_tpu.catalog.store import Catalog
 from learningorchestra_tpu.observability import export as obs_export
 from learningorchestra_tpu.observability import monitor as obs_monitor
+from learningorchestra_tpu.observability import perf as obs_perf
 from learningorchestra_tpu.observability import trace as obs_trace
 from learningorchestra_tpu.runtime import preempt
 from learningorchestra_tpu.runtime.health import NumericalDivergence
@@ -128,7 +129,8 @@ class JobManager:
                  slice_min_devices: int = 1,
                  slice_aging_seconds: float = 30.0,
                  numerical_retries: int = 1,
-                 slice_defrag: float = 0.0):
+                 slice_defrag: float = 0.0,
+                 served_half_life_seconds: float = 600.0):
         from learningorchestra_tpu.services.migration import \
             MigrationCoordinator
         from learningorchestra_tpu.services.scheduler import SliceLease
@@ -136,9 +138,11 @@ class JobManager:
         self._catalog = catalog
         self._pool = ThreadPoolExecutor(max_workers=max_workers,
                                         thread_name_prefix="lo-job")
-        self._mesh = SliceLease(mesh_leases, pool_weights,
-                                min_devices=slice_min_devices,
-                                aging_seconds=slice_aging_seconds)
+        self._mesh = SliceLease(
+            mesh_leases, pool_weights,
+            min_devices=slice_min_devices,
+            aging_seconds=slice_aging_seconds,
+            served_half_life_seconds=served_half_life_seconds)
         self._migration = MigrationCoordinator(self)
         # LO_SLICE_DEFRAG > 0 arms defrag-via-migration: the value is
         # the fragmentation threshold past which a blocked waiter may
@@ -279,6 +283,16 @@ class JobManager:
                     key = (footprint.get("calibrationKey")
                            if isinstance(footprint, dict) else None)
                     obs_monitor.record_peak(key or name, peak)
+            # roofline summary of the job's last steady-state window
+            # (observability/perf): stamped on terminal metadata so
+            # GET /observability/perf/{name} answers after the
+            # in-process registry evicts the job
+            perf_report = obs_perf.job_report(name)
+            if perf_report:
+                meta["perf"] = {k: perf_report[k] for k in (
+                    "mfu", "tflopsPerSecPerChip", "gbPerSecPerChip",
+                    "arithmeticIntensity", "hbmBwUtil", "boundBy")
+                    if k in perf_report}
             if meta:
                 self._catalog.update_metadata(name, meta)
         except Exception:  # noqa: BLE001 — observability is advisory

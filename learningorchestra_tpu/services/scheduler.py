@@ -125,11 +125,19 @@ class SliceLease:
                  total_devices: Optional[int] = None,
                  min_devices: int = 1,
                  aging_seconds: float = 30.0,
-                 device_bytes: Optional[int] = None):
+                 device_bytes: Optional[int] = None,
+                 served_half_life_seconds: float = 600.0):
         self._capacity = max(1, int(leases))
         self._weights = dict(weights or {})
         self._cv = threading.Condition()
-        self._served: Dict[str, float] = {}   # pool -> total held seconds
+        # pool -> held mesh-seconds, exponentially decayed with the
+        # half-life below so fair-share order reflects RECENT usage: a
+        # pool that burned the mesh last week starts even, not in debt
+        # forever (0 = no decay — all-time totals, the old behavior)
+        self._served: Dict[str, float] = {}
+        self._served_half_life = max(
+            0.0, float(served_half_life_seconds or 0.0))
+        self._served_decayed_at = time.monotonic()
         self._waiters: list = []              # [_Waiter] arrival order
         self._granted: Dict[int, Grant] = {}  # reserved, not yet claimed
         self._holders: Dict[int, Grant] = {}  # claimed
@@ -161,6 +169,27 @@ class SliceLease:
     def _weight(self, pool: str) -> float:
         w = float(self._weights.get(pool, 1.0))
         return w if w > 0 else 1.0
+
+    def _decay_served_locked(self) -> None:
+        """With the lock held: lazily apply the exponential half-life
+        to every pool's served seconds (no background thread — decay
+        materializes whenever the totals are read or written)."""
+        if not self._served_half_life:
+            return
+        now = time.monotonic()
+        elapsed = now - self._served_decayed_at
+        if elapsed <= 0.0:
+            return
+        self._served_decayed_at = now
+        if not self._served:
+            return
+        factor = 0.5 ** (elapsed / self._served_half_life)
+        for pool in list(self._served):
+            decayed = self._served[pool] * factor
+            if decayed < 1e-6:
+                del self._served[pool]  # prune fully-forgotten pools
+            else:
+                self._served[pool] = decayed
 
     def _ensure_devices_locked(self) -> None:
         if self._total is None:
@@ -240,6 +269,7 @@ class SliceLease:
         smaller jobs backfill around it — unless it has aged past
         ``aging_seconds``, which freezes all further grants until
         releases drain enough devices for it (anti-starvation)."""
+        self._decay_served_locked()
         while self._waiters and \
                 len(self._holders) + len(self._granted) < self._capacity:
             now = time.monotonic()
@@ -421,6 +451,7 @@ class SliceLease:
                             if self._holders[s].pool == pool),
                            min(self._holders))
                 self._return_devices(self._holders.pop(seq))
+            self._decay_served_locked()
             self._served[pool] = self._served.get(pool, 0.0) \
                 + max(0.0, held_seconds)
             self._grant_next()
@@ -450,8 +481,11 @@ class SliceLease:
             return bool(self._waiters)
 
     def served(self) -> Dict[str, float]:
-        """Per-pool cumulative mesh seconds (observability)."""
+        """Per-pool recent mesh seconds (observability) — decayed by
+        ``served_half_life_seconds``, so this is a leaky integral of
+        usage, not an all-time total."""
         with self._cv:
+            self._decay_served_locked()
             return dict(self._served)
 
     def stats(self) -> Dict[str, Any]:

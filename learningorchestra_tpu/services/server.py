@@ -55,6 +55,7 @@ from learningorchestra_tpu import analysis as A
 from learningorchestra_tpu.catalog import documents as D
 from learningorchestra_tpu.observability import export as obs_export
 from learningorchestra_tpu.observability import hist as obs_hist
+from learningorchestra_tpu.observability import perf as obs_perf
 from learningorchestra_tpu.observability import timeline as obs_timeline
 from learningorchestra_tpu.observability import trace as obs_trace
 from learningorchestra_tpu.services import validators as V
@@ -113,6 +114,14 @@ class Api:
         self._statuses: Dict[str, int] = {}
         self._latency_sum = 0.0
         self._latency_count = 0
+        # timed-dispatch accounting (the LO_REQUEST_TIMEOUT path in
+        # _Handler._respond spawns a thread per request and abandons
+        # it on 504 — without a cap N slow dispatches pile up unseen)
+        self._gateway_lock = threading.Lock()
+        self._gateway_inflight = 0
+        self._gateway_abandoned_inflight = 0
+        self._gateway_abandoned_total = 0
+        self._gateway_saturated_total = 0
         self.recover_unfinished()
         # elastic pod recovery: when the guard sees heartbeats resume,
         # requeue checkpointed worker-lost executions automatically
@@ -351,6 +360,23 @@ class Api:
         # latency histograms (docs/OBSERVABILITY.md): cumulative
         # buckets, same snapshots the Prometheus exposition serializes
         out["latencyHistograms"] = obs_hist.snapshot_all()
+        # timed-dispatch gateway counters (docs/OBSERVABILITY.md):
+        # in-flight/abandoned dispatch threads and saturation rejects
+        with self._gateway_lock:
+            out["gateway"] = {
+                "inflight": self._gateway_inflight,
+                "abandonedInflight": self._gateway_abandoned_inflight,
+                "abandonedTotal": self._gateway_abandoned_total,
+                "saturatedTotal": self._gateway_saturated_total,
+                "maxInflight": self.ctx.config.gateway_max_inflight,
+            }
+        # roofline perf reports (docs/OBSERVABILITY.md "Roofline &
+        # perf reports"): latest per-job window + the platform peaks
+        # they measure against
+        out["perf"] = {
+            "platform": obs_perf.platform_summary(),
+            "jobs": obs_perf.latest(),
+        }
         # cluster resource sampler + SLO watchdog (docs/OBSERVABILITY
         # .md "Cluster monitor"); absent when LO_MONITOR=0
         monitor = getattr(self.ctx, "monitor", None)
@@ -512,6 +538,43 @@ class Api:
                 lines.append(
                     f'{metric}{{model="{esc(sess["model"])}"}} '
                     f'{value_of(sess)}')
+        # serving goodput (observability/perf): decode tokens/s/chip
+        # per LM session — the headline serving-efficiency gauge
+        lines.append("# TYPE lo_serving_tokens_per_sec_per_chip gauge")
+        for sess in serving["bySession"]:
+            tps = (sess.get("perf") or {}).get(
+                "decodeTokensPerSecPerChip")
+            if tps is not None:
+                lines.append(
+                    f'lo_serving_tokens_per_sec_per_chip'
+                    f'{{model="{esc(sess["model"])}"}} {tps}')
+        # timed-dispatch gateway
+        gateway = m["gateway"]
+        lines += [
+            "# TYPE lo_abandoned_dispatches gauge",
+            f"lo_abandoned_dispatches {gateway['abandonedInflight']}",
+            "# TYPE lo_abandoned_dispatches_total counter",
+            f"lo_abandoned_dispatches_total "
+            f"{gateway['abandonedTotal']}",
+            "# TYPE lo_gateway_inflight gauge",
+            f"lo_gateway_inflight {gateway['inflight']}",
+            "# TYPE lo_gateway_saturated_total counter",
+            f"lo_gateway_saturated_total {gateway['saturatedTotal']}",
+        ]
+        # roofline gauges per train job (observability/perf); absent
+        # until a job records a steady-state window
+        perf_jobs = (m.get("perf") or {}).get("jobs") or {}
+        for metric, key in (("lo_mfu", "mfu"),
+                            ("lo_tflops_per_chip",
+                             "tflopsPerSecPerChip"),
+                            ("lo_hbm_bw_util_frac", "hbmBwUtil")):
+            rows = [(job, rep[key]) for job, rep in perf_jobs.items()
+                    if rep.get(key) is not None]
+            if rows:
+                lines.append(f"# TYPE {metric} gauge")
+                for job, value in rows:
+                    lines.append(
+                        f'{metric}{{job="{esc(job)}"}} {value}')
         # cluster monitor + SLO watchdog gauges (absent when
         # LO_MONITOR=0, so scrapers see the series disappear rather
         # than freeze at the last value)
@@ -620,6 +683,11 @@ class Api:
           rings (HBM, arena, slices, queues, RSS)
         - ``GET /observability/alerts``             SLO objectives +
           firing/ resolved alert history
+        - ``GET /observability/perf``               jobs with perf
+          reports + platform peaks
+        - ``GET /observability/perf/{name}``        roofline report
+          (live serving session, in-process train window, or the
+          ``perf`` block stamped on terminal train metadata)
 
         Trace names may contain ``/`` (serving requests are
         ``serve/{model}/{seq}``), so the remaining path joins back up.
@@ -655,6 +723,34 @@ class Api:
             return (200, {"job": name, "summary": summary,
                           "timeline": obs_timeline.entries(name)},
                     "application/json")
+        if kind == "perf":
+            platform = obs_perf.platform_summary()
+            if not name:
+                return (200, {"platform": platform,
+                              "jobs": obs_perf.known_jobs()},
+                        "application/json")
+            # resolution order: live serving session -> in-process
+            # train registry -> the perf block stamped on terminal
+            # train metadata (survives the registry's LRU)
+            report = self.ctx.serving.perf_report(name)
+            if report is None:
+                job = obs_perf.job_report(name)
+                if job is not None:
+                    report = {"kind": "train", "job": name,
+                              "perf": job}
+            if report is None:
+                meta = self.ctx.catalog.get_metadata(name) or {}
+                stamped = meta.get("perf")
+                if stamped:
+                    report = {"kind": "train", "job": name,
+                              "perf": stamped, "terminal": True}
+            if report is None:
+                raise V.HttpError(
+                    V.HTTP_NOT_FOUND,
+                    f"no perf report for {name} (job never recorded "
+                    f"a steady-state window here, or LO_PERF=0)")
+            report["platform"] = platform
+            return 200, report, "application/json"
         if kind == "cluster":
             monitor = getattr(self.ctx, "monitor", None)
             if monitor is None:
@@ -1013,17 +1109,59 @@ class _Handler(BaseHTTPRequestHandler):
             t0 = time.monotonic()
             result: list = []
             done = threading.Event()
+            # abandoned dispatches are invisible by construction — the
+            # 504 already went out — so they are capped and counted
+            # (LO_GATEWAY_MAX_INFLIGHT; lo_abandoned_dispatches on
+            # /metrics): at the cap new timed requests get an instant
+            # 503 instead of stacking another thread on a slow backend
+            api = self.api
+            cap = api.ctx.config.gateway_max_inflight
+            finished = [False]
+            abandoned = [False]
+            with api._gateway_lock:
+                saturated = cap > 0 and api._gateway_inflight >= cap
+                if saturated:
+                    api._gateway_saturated_total += 1
+                else:
+                    api._gateway_inflight += 1
+            if saturated:
+                status, payload, content_type = (
+                    503,
+                    {"result": f"gateway saturated ({cap} timed "
+                               f"dispatches in flight) — retry with "
+                               f"backoff"},
+                    "application/json")
+                api._record_metrics(method, parsed.path, status,
+                                    time.monotonic() - t0)
+                self._send(status, payload, content_type)
+                return
 
             def run_dispatch() -> None:
-                result.append(self.api.dispatch(
-                    method, parsed.path, params, body, record=False))
-                done.set()
+                try:
+                    result.append(api.dispatch(
+                        method, parsed.path, params, body,
+                        record=False))
+                    done.set()
+                finally:
+                    with api._gateway_lock:
+                        api._gateway_inflight -= 1
+                        finished[0] = True
+                        if abandoned[0]:
+                            api._gateway_abandoned_inflight -= 1
 
             threading.Thread(target=run_dispatch, daemon=True,
                              name="lo-gateway").start()
             if done.wait(timeout):
                 status, payload, content_type = result[0]
             else:
+                with api._gateway_lock:
+                    # the dispatch may land between wait() expiring
+                    # and this lock — only a still-running one counts
+                    # as abandoned (its finally block decrements)
+                    if not finished[0]:
+                        abandoned[0] = True
+                        api._gateway_abandoned_total += 1
+                        api._gateway_abandoned_inflight += 1
                 status, payload, content_type = (
                     504,
                     {"result": f"request timed out after {timeout:g}s"},
@@ -1033,6 +1171,10 @@ class _Handler(BaseHTTPRequestHandler):
         else:
             status, payload, content_type = self.api.dispatch(
                 method, parsed.path, params, body)
+        self._send(status, payload, content_type)
+
+    def _send(self, status: int, payload: Any,
+              content_type: str) -> None:
         if isinstance(payload, (bytes, bytearray)):
             data = bytes(payload)
         else:

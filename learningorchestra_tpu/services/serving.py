@@ -39,6 +39,7 @@ import numpy as np
 
 from learningorchestra_tpu.observability import export as obs_export
 from learningorchestra_tpu.observability import hist as obs_hist
+from learningorchestra_tpu.observability import perf as obs_perf
 from learningorchestra_tpu.observability import trace as obs_trace
 from learningorchestra_tpu.services import faults
 from learningorchestra_tpu.services import validators as V
@@ -260,6 +261,25 @@ class _SessionBase:
         None before any batch formed."""
         return None
 
+    def _n_chips(self) -> int:
+        """Chips under the session's current grant (falls back to the
+        process device count) — the per-chip denominator for goodput."""
+        try:
+            grant = getattr(self._lease, "_grant", None)
+            devices = getattr(grant, "devices", None)
+            if devices:
+                return max(1, len(devices))
+        except Exception:  # noqa: BLE001
+            pass
+        import jax
+
+        return max(1, jax.device_count())
+
+    def perf_stats(self) -> Dict[str, Any]:
+        """Goodput/roofline block for the session (observability/perf);
+        empty until the first served iteration."""
+        return {}
+
     def stats(self) -> Dict[str, Any]:
         with self._cv:
             depth = len(self._queue)
@@ -274,6 +294,7 @@ class _SessionBase:
             "uptimeSeconds": round(time.time() - self.created_at, 3),
             "latency": self.latency.snapshot(),
             "lease": self._lease.stats(),
+            "perf": self.perf_stats(),
         }
         return out
 
@@ -306,6 +327,22 @@ class LMServingSession(_SessionBase):
             self.slots, self.cache_len, self.temperature, top_k, top_p)
         self._cache = model.serve_cache(self.slots, self.cache_len)
         self.tokens_total = 0
+        # decode-phase goodput accounting (observability/perf): every
+        # compiled step advances ALL slots; only active ones emit a
+        # useful token, so goodput = tokens / (steps x slots)
+        self.decode_steps = 0
+        self.decode_tokens_total = 0
+        self._decode_seconds = 0.0
+        # analytic decode footprint: each step reads every param and
+        # the whole slot KV cache from HBM (the classic reason decode
+        # is bandwidth-bound), and costs ~2 flops per param per token
+        import jax
+
+        p_leaves = jax.tree_util.tree_leaves(model.params)
+        self._param_count = int(sum(a.size for a in p_leaves))
+        self._param_bytes = int(sum(a.nbytes for a in p_leaves))
+        self._cache_bytes = int(sum(
+            a.nbytes for a in jax.tree_util.tree_leaves(self._cache)))
         # host-side slot state (device state is the KV cache)
         self._tok = np.zeros((self.slots, 1), np.int32)
         self._col = np.zeros((self.slots,), np.int32)
@@ -442,10 +479,14 @@ class LMServingSession(_SessionBase):
             return admitted
         # (2) one continuous-batch step: every active slot advances a
         # token; idle slots compute masked garbage that is discarded
+        step_t0 = time.monotonic()
         nxt, self._cache = self._step(
             self._model.params, self._cache, jnp.asarray(self._tok),
             jnp.asarray(self._col), jnp.asarray(self._keys))
-        nxt = np.asarray(nxt)
+        nxt = np.asarray(nxt)  # the device sync — step wall time ends here
+        self._decode_seconds += time.monotonic() - step_t0
+        self.decode_steps += 1
+        self.decode_tokens_total += len(active)
         # (3) harvest + retire
         for slot in active:
             tok = int(nxt[slot])
@@ -468,6 +509,33 @@ class LMServingSession(_SessionBase):
         if not active and not self.tokens_total:
             return None
         return round(active / self.slots, 4)
+
+    def perf_stats(self) -> Dict[str, Any]:
+        if not self.decode_steps or self._decode_seconds <= 0:
+            return {}
+        n = self._n_chips()
+        dt = self._decode_seconds
+        tps = self.decode_tokens_total / dt
+        out: Dict[str, Any] = {
+            "decodeSteps": self.decode_steps,
+            "decodeTokensPerSec": round(tps, 2),
+            "decodeTokensPerSecPerChip": round(tps / n, 3),
+            # batch-fill-weighted goodput: the fraction of slot-steps
+            # the batcher spent on real tokens vs masked idle lanes
+            "goodputFrac": round(
+                self.decode_tokens_total /
+                (self.decode_steps * self.slots), 4),
+        }
+        # analytic roofline for decode (XLA cost analysis never ran
+        # here): ~2 flops per param per emitted token, and every step
+        # streams params + the whole slot KV cache through HBM
+        flops_per_step = 2.0 * self._param_count * (
+            self.decode_tokens_total / self.decode_steps)
+        out.update(obs_perf.roofline(
+            flops_per_step,
+            float(self._param_bytes + self._cache_bytes),
+            self.decode_steps, dt, n))
+        return out
 
     def stats(self) -> Dict[str, Any]:
         out = super().stats()
@@ -504,6 +572,11 @@ class BucketServingSession(_SessionBase):
         self.predicts_total = 0
         self.rows_total = 0
         self._last_fill: Optional[float] = None
+        # fill-weighted goodput accounting: useful rows vs padded
+        # bucket capacity, and the device time spent producing them
+        self._predict_seconds = 0.0
+        self._fill_rows_sum = 0
+        self._fill_bucket_sum = 0
 
     def validate_request(self, payload: Dict[str, Any]) -> None:
         x = payload.get("x")
@@ -575,6 +648,9 @@ class BucketServingSession(_SessionBase):
         self.predicts_total += 1
         self.rows_total += n
         self._last_fill = round(n / bucket, 4)
+        self._predict_seconds += predict_t1 - predict_t0
+        self._fill_rows_sum += n
+        self._fill_bucket_sum += bucket
         offset = 0
         for req in batch:
             k = len(req.payload["x"])
@@ -589,6 +665,20 @@ class BucketServingSession(_SessionBase):
 
     def _batch_fill(self) -> Optional[float]:
         return self._last_fill
+
+    def perf_stats(self) -> Dict[str, Any]:
+        if not self.predicts_total or self._predict_seconds <= 0:
+            return {}
+        n = self._n_chips()
+        rps = self._fill_rows_sum / self._predict_seconds
+        return {
+            "predictsTotal": self.predicts_total,
+            "rowsPerSec": round(rps, 2),
+            "rowsPerSecPerChip": round(rps / n, 3),
+            # fill-weighted goodput: useful rows over padded capacity
+            "goodputFrac": round(
+                self._fill_rows_sum / max(1, self._fill_bucket_sum), 4),
+        }
 
     def stats(self) -> Dict[str, Any]:
         out = super().stats()
@@ -738,7 +828,7 @@ class ServingManager:
         with self._lock:
             sessions = list(self._sessions.values())
         per = [s.stats() for s in sessions]
-        return {
+        out = {
             "sessions": len(per),
             "requestsTotal": sum(p["requestsTotal"] for p in per),
             "rejectedTotal": sum(p["rejectedTotal"] for p in per),
@@ -746,6 +836,39 @@ class ServingManager:
             "leaseYields": sum(p["lease"].get("yields", 0)
                                for p in per),
             "bySession": per,
+        }
+        # fleet goodput roll-up (each session's per-chip rate is
+        # already normalized by its own grant)
+        perf_blocks = [p.get("perf") or {} for p in per]
+        agg = {
+            "decodeTokensPerSec": round(sum(
+                b.get("decodeTokensPerSec", 0.0)
+                for b in perf_blocks), 2),
+            "decodeTokensPerSecPerChip": round(sum(
+                b.get("decodeTokensPerSecPerChip", 0.0)
+                for b in perf_blocks), 3),
+            "rowsPerSecPerChip": round(sum(
+                b.get("rowsPerSecPerChip", 0.0)
+                for b in perf_blocks), 3),
+        }
+        if any(v for v in agg.values()):
+            out["perf"] = agg
+        return out
+
+    def perf_report(self, model_name: str) -> Optional[Dict[str, Any]]:
+        """Roofline/goodput report for one live session, served by
+        ``GET /observability/perf/{name}``; None if no session holds
+        the name (the route then falls back to train-job reports)."""
+        with self._lock:
+            session = self._sessions.get(model_name)
+        if session is None:
+            return None
+        return {
+            "kind": "serving",
+            "model": model_name,
+            "sessionKind": session.kind,
+            "batchFill": session._batch_fill(),
+            "perf": session.perf_stats(),
         }
 
     def close(self) -> None:

@@ -11,9 +11,12 @@ slack per metric is::
 so a metric that is naturally noisy across repeats (wide IQR) gets a
 proportionally wider gate, while a tight metric is held to the
 relative floor. Point metrics (no repeats block) use the relative
-floor alone. Direction is inferred from the metric name: latency /
-seconds / RSS-style metrics regress UPWARD, throughput / speedup /
-accuracy metrics regress DOWNWARD.
+floor alone. Direction is inferred from the metric name: an explicit
+higher-is-better pattern (MFU, tokens/sec/chip, goodput, bandwidth
+utilization, speedup, accuracy) is checked first and regresses
+DOWNWARD; latency / seconds / RSS-style metrics regress UPWARD;
+anything matching neither is treated as throughput-like
+(higher-is-better).
 
 Prints a pass/regress table and exits nonzero when any metric
 regressed — the CI hook. Rounds whose ``parsed`` line carries no
@@ -37,8 +40,17 @@ import re
 import sys
 from typing import Dict, List, Optional, Tuple
 
-# metric-name fragments where SMALLER is better; everything else is
-# treated as higher-is-better (throughput, speedup, accuracy, MFU)
+# metric-name fragments where BIGGER is unambiguously better —
+# checked FIRST so the roofline/goodput family (mfu,
+# decode_tokens_per_sec_per_chip, hbm_bw_util_frac, goodput_frac)
+# gates on downward moves even when a name also happens to contain a
+# lower-is-better fragment
+_HIGHER_IS_BETTER = re.compile(
+    r"(mfu|tokens_per_sec|samples_per_sec|rows_per_sec|per_chip"
+    r"|goodput|bw_util|speedup|accuracy|tflops)", re.IGNORECASE)
+
+# metric-name fragments where SMALLER is better; everything matching
+# neither pattern is treated as higher-is-better (throughput-like)
 _LOWER_IS_BETTER = re.compile(
     r"(seconds|_ms$|_ms\b|p50|p99|rss|overhead|retraces|latency"
     r"|time_to|evictions|rejected|stall_ratio)", re.IGNORECASE)
@@ -119,7 +131,9 @@ def compare(prior: Dict[str, dict], newest: Dict[str, dict],
             slack = abs(old_val) * rel_tol
             if old_iqr is not None:
                 slack = max(slack, iqr_mult * old_iqr)
-            if _LOWER_IS_BETTER.search(metric):
+            if _HIGHER_IS_BETTER.search(metric):
+                regressed = new_val < old_val - slack
+            elif _LOWER_IS_BETTER.search(metric):
                 regressed = new_val > old_val + slack
             else:
                 regressed = new_val < old_val - slack
