@@ -100,7 +100,8 @@ PHASE_TIMEOUTS = {"cnn": 600, "lstm": 600, "tlm": 900, "proxy": 120,
                   "sentinel_overhead": 600, "sentinel_chaos": 600,
                   "obs_overhead": 600, "monitor_smoke": 600,
                   "sweep_fusion": 900,
-                  "ckpt_stall": 300, "migration_smoke": 600}
+                  "ckpt_stall": 300, "migration_smoke": 600,
+                  "xray_overhead": 600}
 
 # out-of-core Builder (reference config 4: 10M-row GBT via Spark)
 BUILDER_ROWS = int(os.environ.get("LO_BENCH_BUILDER_ROWS", "10000000"))
@@ -1793,6 +1794,245 @@ def phase_perf_report():
     return out
 
 
+def phase_xray_overhead():
+    """HBM attribution + compiled-artifact X-ray end-to-end
+    (docs/OBSERVABILITY.md "HBM attribution & X-ray") plus its cost.
+    Four parts: (1) one train job through the REST stack — polled
+    mid-flight for its transient ``train-state`` ledger entry — must
+    leave a ``GET /observability/compile/{job}`` X-ray; (2) a live LM
+    serving session must attribute ``serving-params`` + ``kv-cache``
+    and the bare memory route's unattributed fraction must stay sane;
+    (3) an in-flight async-checkpoint snapshot must appear as the
+    ``snapshot`` owner (host-side) and release on commit, and a forced
+    retrace + a forced implicit transfer must each land a counted,
+    signature-carrying event; (4) the same MLP fit with LO_XRAY=1 vs
+    LO_XRAY=0, interleaved, min-of-repeats — the ledger shares the
+    observability stack's < 3% steady-state overhead gate."""
+    import threading
+
+    import jax
+    import numpy as np
+
+    from learningorchestra_tpu import config as config_mod
+    from learningorchestra_tpu.models.neural import NeuralModel
+    from learningorchestra_tpu.models.transformer import LanguageModel
+    from learningorchestra_tpu.observability import xray as obs_xray
+    from learningorchestra_tpu.runtime.async_ckpt import (
+        AsyncCheckpointManager)
+    from learningorchestra_tpu.runtime.checkpoint import Checkpointer
+
+    os.environ["LO_XRAY"] = "1"
+    obs_xray.reset()
+    api, prefix = _make_api()
+    out = {"platform": jax.devices()[0].platform}
+    owners_seen = set()
+    try:
+        # -- (1) train job; poll the memory route while it runs so the
+        # transient train-state registration is observed live
+        status, body, _ = api.dispatch(
+            "POST", f"{prefix}/function/python", {}, {
+                "name": "xray_data", "functionParameters": {},
+                "description": "xray bench data", "function": (
+                    "import numpy as np\n"
+                    "rng = np.random.default_rng(0)\n"
+                    "x = rng.normal(size=(2048, 32)).astype("
+                    "np.float32)\n"
+                    "y = (x[:, 0] > 0).astype(np.int32)\n"
+                    "response = {'x': x, 'y': y}\n")})
+        _expect_created(status, body)
+        _wait(api, body["result"])
+        status, body, _ = api.dispatch(
+            "POST", f"{prefix}/model/tensorflow", {}, {
+                "modelName": "xray_model",
+                "modulePath": "learningorchestra_tpu.models",
+                "class": "NeuralModel", "description": "xray bench",
+                "classParameters": {"layer_configs": [
+                    {"kind": "dense", "units": 32,
+                     "activation": "relu"},
+                    {"kind": "dense", "units": 2,
+                     "activation": "softmax"}]}})
+        _expect_created(status, body)
+        _wait(api, body["result"])
+        status, body, _ = api.dispatch(
+            "POST", f"{prefix}/train/tensorflow", {}, {
+                "name": "xray_train", "modelName": "xray_model",
+                "method": "fit", "methodParameters": {
+                    "x": "$xray_data.x", "y": "$xray_data.y",
+                    "epochs": 6, "batch_size": 64}})
+        _expect_created(status, body)
+        train_uri = body["result"]
+        deadline = time.time() + 300
+        while time.time() < deadline:
+            owners_seen |= {o for o, n in obs_xray.by_owner().items()
+                            if n > 0}
+            s2, b2, _ = api.dispatch(
+                "GET", train_uri, {"limit": "1"}, None)
+            if s2 == 200 and b2["metadata"].get("finished"):
+                break
+            time.sleep(0.002)
+        else:
+            raise TimeoutError("xray_train never finished")
+        status, rep, _ = api.dispatch(
+            "GET", f"{prefix}/observability/compile/xray_train",
+            {}, None)
+        prog = ((rep or {}).get("programs") or {}).get("trainStep", {})
+        out["compile_report_status"] = status
+        out["compile_peak_bytes"] = (prog.get("memory") or {}).get(
+            "peakBytesEstimate")
+        out["compile_report_ok"] = bool(
+            status == 200 and out["compile_peak_bytes"])
+
+        # the arena owner rides the feature-token path (builder /
+        # repeat-fit staging): a token-carrying fit leaves its staged
+        # device arrays resident in the arena between fits
+        from learningorchestra_tpu.models.estimators import (
+            LogisticRegressionJAX)
+
+        rng = np.random.default_rng(1)
+        xa = rng.normal(size=(1024, 16)).astype(np.float32)
+        ya = (xa[:, 0] > 0).astype(np.int64)
+        clf = LogisticRegressionJAX(epochs=2, batch_size=256)
+        clf.feature_token = ("bench", "xray", 1)
+        clf.feature_tags = ("xray_bench",)
+        clf.fit(xa, ya)
+
+        # -- (2) live LM serving session: params pin + KV slot cache
+        lm = LanguageModel(vocab_size=48, d_model=32, n_layers=1,
+                           n_heads=2, d_ff=64, max_len=32,
+                           attention="dot")
+        tokens = rng.integers(1, 48, size=(16, 16)).astype(np.int32)
+        lm.fit(tokens, batch_size=16, epochs=1)
+        api.ctx.artifacts.save(lm, "xray_lm", "train/tensorflow")
+        # the session pins its OWN reloaded copy; drop the local one
+        # (params + opt state) so it can't pollute the unattributed
+        # remainder the route computes from live arrays on CPU
+        del lm
+        import gc
+
+        gc.collect()
+        status, body, _ = api.dispatch(
+            "POST", f"{prefix}/serve/xray_lm", {},
+            {"maxSlots": 2, "cacheLen": 32})
+        _expect_created(status, body)
+        s2, b2, _ = api.dispatch(
+            "POST", f"{prefix}/serve/xray_lm/predict", {},
+            {"prompt": [1, 2, 3], "maxNewTokens": 4, "seed": 7})
+        if s2 != 200:
+            raise RuntimeError(f"xray lm predict failed: {s2} {b2}")
+
+        # -- (3) in-flight async-ckpt snapshot, gated so the ledger
+        # entry is observable rather than racing the commit
+        gate = threading.Event()
+
+        class _GatedCkpt(Checkpointer):
+            def _commit_host(self, step, host):
+                gate.wait(timeout=60)
+                return super()._commit_host(step, host)
+
+        ckpt_dir = tempfile.mkdtemp(prefix="lo_xray_ckpt_")
+        mgr = AsyncCheckpointManager(_GatedCkpt(ckpt_dir), inflight=2)
+        try:
+            mgr.save(1, {"w": np.ones((256, 256), np.float32)})
+            owners_seen |= {o for o, n in obs_xray.by_owner().items()
+                            if n > 0}
+            out["snapshot_ledgered"] = (
+                obs_xray.by_owner().get("snapshot", 0) > 0)
+        finally:
+            gate.set()
+            mgr.close()
+        out["snapshot_released"] = (
+            obs_xray.by_owner().get("snapshot", 0) == 0)
+
+        # the memory route, with the serving session still live
+        status, mem, _ = api.dispatch(
+            "GET", f"{prefix}/observability/memory", {}, None)
+        out["memory_route_status"] = status
+        owners_seen |= {o for o, n in (mem or {}).get(
+            "owners", {}).items() if n > 0}
+        out["owners_seen"] = sorted(owners_seen)
+        out["owners_ok"] = {"arena", "train-state", "serving-params",
+                            "kv-cache", "snapshot"} <= owners_seen
+        in_use = (mem or {}).get("bytesInUse")
+        unattr = (mem or {}).get("unattributedBytes")
+        out["bytes_in_use"] = in_use
+        out["bytes_source"] = (mem or {}).get("bytesSource")
+        out["unattributed_bytes"] = unattr
+        out["unattributed_frac"] = (
+            round(unattr / in_use, 4)
+            if in_use and unattr is not None else None)
+
+        # -- forced retrace: same program key, new batch signature
+        before = obs_xray.counters()["retraces"]
+        xb = np.random.default_rng(0).normal(
+            size=(512, 16)).astype(np.float32)
+        yb = (xb[:, 0] > 0).astype(np.int64)
+        probe = NeuralModel([
+            {"kind": "dense", "units": 8, "activation": "relu"},
+            {"kind": "dense", "units": 2, "activation": "softmax"}])
+        probe.fit(xb, yb, epochs=1, batch_size=64, shuffle=False)
+        probe.fit(xb, yb, epochs=1, batch_size=32, shuffle=False)
+        out["retraces_counted"] = obs_xray.counters()["retraces"] \
+            - before
+        events = obs_xray.retrace_events()
+        out["retrace_ok"] = bool(
+            out["retraces_counted"] >= 1 and events
+            and events[-1]["prevSignature"]
+            and events[-1]["newSignature"])
+
+        # -- forced implicit transfer under the armed sentinel: a
+        # jitted dispatch fed a host numpy array
+        before = obs_xray.counters()["implicitTransfers"]
+        cfg = config_mod.get_config()
+        prior_guard = cfg.transfer_guard
+        cfg.transfer_guard = "log"
+        try:
+            import jax.numpy as jnp
+
+            fn = jax.jit(lambda v: jnp.sum(v * 2.0))
+            got = float(obs_xray.guarded_call(
+                fn, np.ones(8, np.float32), name="xray_bench"))
+        finally:
+            cfg.transfer_guard = prior_guard
+        tev = obs_xray.transfer_events()
+        out["transfers_counted"] = \
+            obs_xray.counters()["implicitTransfers"] - before
+        out["transfer_ok"] = bool(
+            got == 16.0 and out["transfers_counted"] >= 1
+            and tev and tev[-1]["signature"])
+
+        api.dispatch("DELETE", f"{prefix}/serve/xray_lm", {}, None)
+    finally:
+        api.ctx.jobs.shutdown()
+
+    # -- (4) steady-state cost, LO_XRAY=1 vs LO_XRAY=0, interleaved
+    # min-of-repeats; neither arm runs under a job span so the delta is
+    # exactly the ledger/signature bookkeeping the switch gates
+    rng = np.random.default_rng(0)
+    xb = rng.normal(size=(8192, 64)).astype(np.float32)
+    yb = (xb[:, 0] > 0).astype(np.int64)
+    model = NeuralModel([
+        {"kind": "dense", "units": 128, "activation": "relu"},
+        {"kind": "dense", "units": 128, "activation": "relu"},
+        {"kind": "dense", "units": 2, "activation": "softmax"}])
+    model.fit(xb, yb, epochs=1, batch_size=256, shuffle=False)  # warm
+    times = {"on": [], "off": []}
+    for _ in range(4):
+        os.environ["LO_XRAY"] = "1"
+        t0 = time.perf_counter()
+        model.fit(xb, yb, epochs=30, batch_size=256, shuffle=False)
+        times["on"].append(time.perf_counter() - t0)
+        os.environ["LO_XRAY"] = "0"
+        t0 = time.perf_counter()
+        model.fit(xb, yb, epochs=30, batch_size=256, shuffle=False)
+        times["off"].append(time.perf_counter() - t0)
+    os.environ["LO_XRAY"] = "1"
+    best = {name: min(ts) for name, ts in times.items()}
+    out["xray_on_seconds"] = round(best["on"], 4)
+    out["xray_off_seconds"] = round(best["off"], 4)
+    out["xray_overhead_ratio"] = round(best["on"] / best["off"], 4)
+    return out
+
+
 PHASES = {"cnn": phase_cnn, "lstm": phase_lstm, "tlm": phase_tlm,
           "proxy": phase_proxy, "builder": phase_builder,
           "builder_mesh": phase_builder_mesh,
@@ -1807,7 +2047,8 @@ PHASES = {"cnn": phase_cnn, "lstm": phase_lstm, "tlm": phase_tlm,
           "sweep_fusion": phase_sweep_fusion,
           "ckpt_stall": phase_ckpt_stall,
           "migration_smoke": phase_migration_smoke,
-          "perf_report": phase_perf_report}
+          "perf_report": phase_perf_report,
+          "xray_overhead": phase_xray_overhead}
 
 _RESULT_MARK = "@@LO_BENCH_RESULT@@"
 
@@ -2120,6 +2361,9 @@ def main(argv=None):
         "sweep_fusion", env,
         metrics=("speedup", "fused_seconds", "serial_seconds"))
     models["ckpt_stall"] = _run_phase("ckpt_stall", env)
+    # HBM attribution/X-ray smoke + its steady-state overhead ratio —
+    # in the round payload so bench_regress gates the ratio drifting
+    models["xray_overhead"] = _run_phase("xray_overhead", env)
     # the migration phase needs a sliceable mesh; on the CPU fallback
     # that means forcing a multi-device host platform
     mig_env = env if tpu_ok else dict(

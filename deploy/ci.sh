@@ -219,7 +219,8 @@ SERVE_OUT="$(mktemp)"
 SWEEP_OUT="$(mktemp)"
 MONITOR_OUT="$(mktemp)"
 ROOFLINE_OUT="$(mktemp)"
-trap 'rm -rf "$PERF_CACHE" "$PERF_OUT" "$SLICE_OUT" "$CKPT_OUT" "$MIG_OUT" "$CHAOS_OUT" "$OVERHEAD_OUT" "$OBS_OUT" "$SERVE_OUT" "$SWEEP_OUT" "$MONITOR_OUT" "$ROOFLINE_OUT"' EXIT
+XRAY_OUT="$(mktemp)"
+trap 'rm -rf "$PERF_CACHE" "$PERF_OUT" "$SLICE_OUT" "$CKPT_OUT" "$MIG_OUT" "$CHAOS_OUT" "$OVERHEAD_OUT" "$OBS_OUT" "$SERVE_OUT" "$SWEEP_OUT" "$MONITOR_OUT" "$ROOFLINE_OUT" "$XRAY_OUT"' EXIT
 timeout -k 10 "$SENTINEL_TIMEOUT" env JAX_PLATFORMS=cpu \
     JAX_COMPILATION_CACHE_DIR="$PERF_CACHE" \
     LO_COMPUTE_DTYPE=float32 \
@@ -499,6 +500,61 @@ print(f"roofline-smoke: OK (train mfu {result['train_mfu']}, "
       f"bound by {result['train_bound_by']}, serving "
       f"{result['serving_rows_per_sec_per_chip']} rows/s/chip, "
       f"overhead {ratio}x)")
+EOF
+
+echo "== xray-smoke: HBM ledger must attribute + cost < 3% =="
+# HBM attribution ledger + compiled-artifact X-ray end-to-end
+# (bench.py xray_overhead; docs/OBSERVABILITY.md "HBM attribution &
+# X-ray"). Gates:
+#  - a train+serve workload shows EVERY expected owner in the ledger
+#    (arena, train-state, serving-params, kv-cache, snapshot) and the
+#    job leaves a GET /observability/compile/{name} X-ray
+#  - the bare memory route's unattributed fraction stays < 50% on the
+#    CPU backend (live-arrays accounting; XLA temps don't persist)
+#  - a forced retrace and a forced implicit transfer each land a
+#    counted, signature-carrying event
+#  - LO_XRAY=1 vs LO_XRAY=0 steady-state fit cost stays < 3%
+XRAY_TIMEOUT="${LO_CI_XRAY_TIMEOUT:-600}"
+timeout -k 10 "$XRAY_TIMEOUT" env JAX_PLATFORMS=cpu \
+    JAX_COMPILATION_CACHE_DIR="$PERF_CACHE" \
+    LO_COMPUTE_DTYPE=float32 \
+    python bench.py --phase xray_overhead | tee "$XRAY_OUT"
+python - "$XRAY_OUT" <<'EOF'
+import json, sys
+
+mark = "@@LO_BENCH_RESULT@@"
+result = None
+for line in reversed(open(sys.argv[1]).read().splitlines()):
+    if line.startswith(mark):
+        result = json.loads(line[len(mark):])
+        break
+assert result is not None, "xray-smoke: no bench result line"
+assert "error" not in result, f"xray-smoke: phase failed: {result}"
+result = result.get("result", result)  # unwrap the ok-envelope
+assert result["owners_ok"], (
+    f"xray-smoke: ledger missing expected owners "
+    f"(saw {result.get('owners_seen')}): {result}")
+assert result["compile_report_ok"], (
+    f"xray-smoke: compiled-artifact report missing/incomplete: "
+    f"{result}")
+assert result["snapshot_ledgered"] and result["snapshot_released"], (
+    f"xray-smoke: async-ckpt snapshot not ledgered/released: "
+    f"{result}")
+frac = result["unattributed_frac"]
+assert frac is not None and frac < 0.5, (
+    f"xray-smoke: unattributed fraction {frac} (gate < 0.5): "
+    f"{result}")
+assert result["retrace_ok"], (
+    f"xray-smoke: forced retrace left no counted signature event: "
+    f"{result}")
+assert result["transfer_ok"], (
+    f"xray-smoke: forced implicit transfer left no counted event: "
+    f"{result}")
+ratio = result["xray_overhead_ratio"]
+assert ratio < 1.03, (
+    f"xray-smoke: ledger costs {ratio}x (gate < 1.03x): {result}")
+print(f"xray-smoke: OK (owners {result['owners_seen']}, "
+      f"unattributed {frac}, overhead {ratio}x)")
 EOF
 
 echo "== bench-regress: newest round must not regress the prior one =="

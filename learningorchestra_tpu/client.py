@@ -402,6 +402,31 @@ class Context:
         _, payload = self._http.request("GET", path)
         return payload
 
+    def memory(self, name: Optional[str] = None) -> Dict[str, Any]:
+        """HBM attribution ledger (docs/OBSERVABILITY.md "HBM
+        attribution & X-ray"): without ``name``, per-owner byte totals
+        (arena, train-state, serving-params, kv-cache, snapshot),
+        device bytes-in-use and the unattributed remainder, plus the
+        retrace/implicit-transfer sentinel counters; with ``name``,
+        only the ledger rows tagged with that job / model / serving
+        session."""
+        path = f"{API_PREFIX}/observability/memory"
+        if name:
+            path += f"/{name}"
+        _, payload = self._http.request("GET", path)
+        return payload
+
+    def compile_report(self, name: str) -> Dict[str, Any]:
+        """Compiled-artifact X-ray of a job (docs/OBSERVABILITY.md
+        "HBM attribution & X-ray"): per-program XLA
+        ``memory_analysis()`` extracts (argument/output/temp/code
+        bytes, peak estimate) and ``cost_analysis()`` flops/bytes,
+        captured when the job's train step compiled in this
+        process."""
+        _, payload = self._http.request(
+            "GET", f"{API_PREFIX}/observability/compile/{name}")
+        return payload
+
     def healthz(self) -> Dict[str, Any]:
         """Readiness probe: raises on 503 (draining or a
         page-severity SLO alert firing); returns the status body on

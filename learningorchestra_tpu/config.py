@@ -337,6 +337,25 @@ class Config:
     # fails the job.
     event_log: str = dataclasses.field(
         default_factory=lambda: os.environ.get("LO_EVENT_LOG", ""))
+    # Size bound on the event log: once the file reaches this many
+    # bytes it is rolled to ``<path>.1`` (keep-1 rollover) before the
+    # next append, so a long-lived process cannot fill the disk.
+    # 0 disables rotation.
+    event_log_max_bytes: int = dataclasses.field(
+        default_factory=lambda: int(os.environ.get(
+            "LO_EVENT_LOG_MAX_BYTES", str(64 << 20))))
+    # HBM attribution ledger + compiled-artifact X-ray
+    # (docs/OBSERVABILITY.md "HBM attribution & X-ray"). Off = every
+    # allocation-site registration and compile capture is a no-op.
+    xray: bool = dataclasses.field(
+        default_factory=lambda: os.environ.get(
+            "LO_XRAY", "1") not in ("0", "false", "no"))
+    # Transfer sentinel: "" (off), "log" (count implicit host<->device
+    # transfers in hot loops + emit events, then proceed) or "fail"
+    # (raise — CI mode: an implicit transfer fails the job).
+    transfer_guard: str = dataclasses.field(
+        default_factory=lambda: os.environ.get(
+            "LO_TRANSFER_GUARD", ""))
     # Cluster resource monitor (docs/OBSERVABILITY.md "Cluster
     # monitor"). A background sampler thread collects per-device HBM
     # watermarks, arena occupancy, slice-scheduler
@@ -369,6 +388,13 @@ class Config:
     slo_deadletter_rate: float = dataclasses.field(
         default_factory=lambda: float(os.environ.get(
             "LO_SLO_DEADLETTER_RATE", "0")))
+    # Leak detector: page when unattributed HBM (bytes_in_use minus
+    # the X-ray ledger) GROWS by more than this many bytes across both
+    # burn-rate windows — sustained growth nobody owns is a leak or an
+    # unledgered allocation site. 0 disables.
+    slo_unattributed_growth_bytes: float = dataclasses.field(
+        default_factory=lambda: float(os.environ.get(
+            "LO_SLO_UNATTRIBUTED_GROWTH_BYTES", "0")))
     # SLO burn-rate windows, seconds (fast catches an acute breach,
     # slow confirms it is sustained before paging).
     slo_fast_window_s: float = dataclasses.field(

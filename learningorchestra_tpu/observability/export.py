@@ -69,15 +69,19 @@ def _jsonable(v: Any) -> Any:
 def log_event(kind: str, name: str, trace_id: Optional[str] = None,
               **fields: Any) -> None:
     """Append one lifecycle event to the JSONL event log
-    (``LO_EVENT_LOG``; empty = off). Never raises: a failing or slow
-    sink (exercised by the ``trace_export`` fault site) must not
-    touch the job's outcome."""
+    (``LO_EVENT_LOG``; empty = off). Bounded: once the file reaches
+    ``LO_EVENT_LOG_MAX_BYTES`` it rolls to ``<path>.1`` (keep-1)
+    before the append, so the log can never grow past roughly twice
+    the bound. Never raises: a failing or slow sink (exercised by the
+    ``trace_export`` fault site) must not touch the job's outcome."""
     try:
         from learningorchestra_tpu.config import get_config
 
-        path = getattr(get_config(), "event_log", "") or ""
+        cfg = get_config()
+        path = getattr(cfg, "event_log", "") or ""
         if not path:
             return
+        max_bytes = int(getattr(cfg, "event_log_max_bytes", 0) or 0)
         from learningorchestra_tpu.services import faults
 
         faults.maybe_inject("trace_export")
@@ -91,6 +95,12 @@ def log_event(kind: str, name: str, trace_id: Optional[str] = None,
         with _log_lock:
             os.makedirs(os.path.dirname(os.path.abspath(path)),
                         exist_ok=True)
+            if max_bytes > 0:
+                try:
+                    if os.path.getsize(path) >= max_bytes:
+                        os.replace(path, path + ".1")
+                except OSError:
+                    pass  # no file yet — nothing to roll
             with open(path, "a", encoding="utf-8") as f:
                 f.write(line)
     except Exception:  # noqa: BLE001 — strictly best-effort

@@ -42,6 +42,9 @@ _SCALAR_SERIES = (
     "servingQueueDepth", "servingBatchFill",
     "jobsRunning", "jobQueueDepth", "deadLettered",
     "hostRssBytes",
+    # X-ray HBM attribution (observability/xray): ledger total and the
+    # unattributed remainder the leak-detector SLO differences
+    "xrayAttributedBytes", "xrayUnattributedBytes",
 )
 
 
@@ -218,6 +221,17 @@ class ClusterMonitor:
             scalars["jobsRunning"] = jobs.get("running")
             scalars["jobQueueDepth"] = jobs.get("queued")
             scalars["deadLettered"] = jobs.get("deadLettered")
+        try:
+            from learningorchestra_tpu.observability import xray
+
+            attributed, unattributed = xray.ring_sample()
+            scalars["xrayAttributedBytes"] = attributed
+            scalars["xrayUnattributedBytes"] = unattributed
+            sample["xray"] = {"attributedBytes": attributed,
+                              "unattributedBytes": unattributed,
+                              "owners": xray.by_owner()}
+        except Exception:  # noqa: BLE001 — sampler is best-effort
+            self._sample_errors += 1
 
         with self._lock:
             for name, value in scalars.items():

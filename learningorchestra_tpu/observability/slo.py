@@ -143,6 +143,15 @@ class SloWatchdog:
                 "severity": "ticket",
                 "threshold": float(cfg.slo_deadletter_rate),
                 "unit": "perMin"},
+            # leak detector (observability/xray): sustained growth of
+            # device bytes NOBODY in the ledger owns — XLA temps are
+            # sawtooth, a leak (or an unledgered allocation site) is
+            # monotone across both windows
+            "unattributedGrowth": {
+                "severity": "page",
+                "threshold": float(getattr(
+                    cfg, "slo_unattributed_growth_bytes", 0.0)),
+                "unit": "bytes"},
         }
 
     # -- evaluation ---------------------------------------------------
@@ -198,6 +207,14 @@ class SloWatchdog:
                 return None
             span = max(pts[-1][0] - pts[0][0], 1e-9)
             return (pts[-1][1] - pts[0][1]) / span * 60.0
+        if name == "unattributedGrowth":
+            if monitor is None:
+                return None
+            pts = monitor.series_window("xrayUnattributedBytes",
+                                        window, now)
+            if len(pts) < 2:
+                return None
+            return float(pts[-1][1] - pts[0][1])
         return None
 
     @staticmethod

@@ -38,6 +38,23 @@ import threading
 from typing import Any, Callable, Dict, Iterable, Optional, Tuple
 
 
+def _ledger(op: str, key: Any, nbytes: int = 0,
+            tags: Tuple[str, ...] = ()) -> None:
+    """Mirror resident insert/drop into the X-ray HBM ledger (owner
+    ``arena``). Advisory — the import is lazy and any failure is
+    swallowed so the arena never depends on observability."""
+    try:
+        from learningorchestra_tpu.observability import xray
+
+        if op == "register":
+            xray.register("arena", key, nbytes,
+                          name=tags[0] if tags else None)
+        else:
+            xray.release("arena", key)
+    except Exception:  # noqa: BLE001
+        pass
+
+
 def _auto_budget() -> int:
     """A quarter of one device's reported memory; 1 GiB fallback
     (XLA:CPU and some PJRT plugins report no ``bytes_limit``)."""
@@ -163,6 +180,7 @@ class DeviceArena:
             res.pins = 1
             self._entries[key] = res
             self._bytes += nbytes
+            _ledger("register", key, nbytes, tags)
             if group is not None:
                 self._group_bytes[group] = \
                     self._group_bytes.get(group, 0) + nbytes
@@ -181,6 +199,7 @@ class DeviceArena:
     def _drop_locked(self, key: Any) -> "_Resident":
         res = self._entries.pop(key)
         self._bytes -= res.nbytes
+        _ledger("release", key)
         if res.group is not None:
             remaining = self._group_bytes.get(res.group, 0) - res.nbytes
             if remaining > 0:
@@ -243,6 +262,8 @@ class DeviceArena:
 
     def clear(self) -> None:
         with self._lock:
+            for key in self._entries:
+                _ledger("release", key)
             self._entries.clear()
             self._bytes = 0
             self._group_bytes.clear()

@@ -89,6 +89,31 @@ def _trace_ctx():
         return None
 
 
+def _xray_register(token: Any, host: Any) -> None:
+    """Ledger one in-flight host snapshot (owner ``snapshot``). The
+    bytes live in HOST memory, not HBM — ``host=True`` keeps them out
+    of the device-unattributed subtraction (observability/xray)."""
+    try:
+        from learningorchestra_tpu.observability import xray
+
+        nbytes = sum(int(getattr(a, "nbytes", 0))
+                     for a in jax.tree_util.tree_leaves(host))
+        ctx = _trace_ctx()
+        xray.register("snapshot", token, nbytes, host=True,
+                      name=ctx[0] if ctx else None)
+    except Exception:  # noqa: BLE001 — observability is advisory
+        pass
+
+
+def _xray_release(token: Any) -> None:
+    try:
+        from learningorchestra_tpu.observability import xray
+
+        xray.release("snapshot", token)
+    except Exception:  # noqa: BLE001
+        pass
+
+
 class AsyncCheckpointError(RuntimeError):
     """A background commit failed. Carries the original exception as
     ``__cause__``; raised on the train thread at the next save() or
@@ -127,8 +152,13 @@ class AsyncCheckpointManager:
                 try:
                     _maybe_inject("ckpt_async_commit")
                     if kind == "save":
-                        step, host = payload
-                        self._ckpt._commit_host(step, host)
+                        step, host, token = payload
+                        try:
+                            self._ckpt._commit_host(step, host)
+                        finally:
+                            # committed (or failed): the host snapshot
+                            # is droppable either way
+                            _xray_release(token)
                         _observe("checkpointCommit", t0,
                                  time.monotonic(), ctx, step=int(step),
                                  async_=True,
@@ -167,7 +197,12 @@ class AsyncCheckpointManager:
         host = jax.tree_util.tree_map(np.asarray, tree)
         _observe("checkpointSnapshot", t0, time.monotonic(), ctx,
                  step=int(step))
-        self._queue.put(("save", (int(step), host), ctx,
+        # ledger the snapshot while it waits for its commit; the
+        # worker releases it (id(host) is unique while the queue
+        # keeps the tree alive — exactly the entry's lifetime)
+        token = (id(self), int(step), id(host))
+        _xray_register(token, host)
+        self._queue.put(("save", (int(step), host, token), ctx,
                          time.monotonic()))
 
     def save_meta(self, meta: dict) -> None:

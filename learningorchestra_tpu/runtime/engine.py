@@ -32,6 +32,7 @@ from learningorchestra_tpu.observability import hist as obs_hist
 from learningorchestra_tpu.observability import perf as obs_perf
 from learningorchestra_tpu.observability import timeline as obs_timeline
 from learningorchestra_tpu.observability import trace as obs_trace
+from learningorchestra_tpu.observability import xray as obs_xray
 from learningorchestra_tpu.runtime import arena as arena_lib
 from learningorchestra_tpu.runtime import data as data_lib
 from learningorchestra_tpu.runtime import health as health_lib
@@ -61,6 +62,12 @@ class TrainState(struct.PyTreeNode):
 Metrics = Dict[str, Tuple[jax.Array, jax.Array]]  # name -> (sum, count)
 
 
+def _tree_nbytes(tree) -> int:
+    """Total leaf bytes of a pytree (ledger accounting)."""
+    return sum(int(getattr(x, "nbytes", 0))
+               for x in jax.tree_util.tree_leaves(tree))
+
+
 def default_grad_accum() -> int:
     """Process-wide microbatch-count default (LO_GRAD_ACCUM env)."""
     return max(1, int(os.environ.get("LO_GRAD_ACCUM", "1")))
@@ -86,6 +93,10 @@ _EXEC_CACHE_CAP = 64
 # measured per-step (flops, bytes accessed) by executable key: lets a
 # warm fit skip the _measure_flops lowering (a full trace) entirely
 _FLOPS_CACHE: Dict[Any, Tuple[float, float]] = {}
+# compiled-artifact X-ray by the same key: memory_analysis() /
+# cost_analysis() extracts captured once per cold executable and
+# re-attached to every job name that reuses it (observability/xray)
+_XRAY_CACHE: Dict[Any, Dict[str, Any]] = {}
 
 
 def executable_cache_stats() -> Dict[str, int]:
@@ -99,6 +110,7 @@ def reset_executable_cache() -> None:
     with _EXEC_LOCK:
         _EXEC_CACHE.clear()
         _FLOPS_CACHE.clear()
+        _XRAY_CACHE.clear()
         _EXEC_STATS["hits"] = 0
         _EXEC_STATS["misses"] = 0
 
@@ -550,8 +562,13 @@ class Engine:
     def _measure_flops(self, state, batch, rng, step_fn=None) -> None:
         """Per-step flop + bytes-accessed estimate from the lowered HLO
         (cheap — no compile). Basis for the MFU line and the roofline
-        block in every history record."""
+        block in every history record. Also feeds the X-ray plane: the
+        retrace sentinel sees every (program, batch-signature) pair —
+        a warm program under a NEW signature is a recompile — and the
+        compiled step's memory/cost analysis is captured once per cold
+        executable key for ``GET /observability/compile/{name}``."""
         key = tuple(sorted((k, tuple(v.shape)) for k, v in batch.items()))
+        self._note_signature(key)
         if self._step_flops is not None and key == self._flops_key:
             return
         shared_key = self._exec_key("flops", key)
@@ -562,21 +579,25 @@ class Engine:
                 # is a full trace, exactly what a repeat fit must skip
                 self._step_flops, self._step_bytes = cached
                 self._flops_key = key
+                self._record_compile_xray(_XRAY_CACHE.get(shared_key))
                 return
         self._flops_key = key
         try:
             fn = step_fn if step_fn is not None else self._train_step
             lowered = fn.lower(state, batch, rng)
+            compiled = None
             cost = lowered.cost_analysis()
             if not cost or not cost.get("flops"):
                 # some PJRT backends only report costs on the compiled
                 # executable (one extra compile, once per batch shape)
-                cost = lowered.compile().cost_analysis()
+                compiled = lowered.compile()
+                cost = compiled.cost_analysis()
             flops = float(cost.get("flops", 0.0)) if cost else 0.0
             self._step_flops = flops if flops > 0 else 0.0
             bytes_acc = (float(cost.get("bytes accessed", 0.0))
                          if cost else 0.0)
             self._step_bytes = bytes_acc if bytes_acc > 0 else 0.0
+            self._capture_xray(shared_key, lowered, compiled, key)
         except Exception:  # noqa: BLE001 — accounting must never sink a run
             self._step_flops = 0.0
             self._step_bytes = 0.0
@@ -592,6 +613,63 @@ class Engine:
         if shared_key is not None and self._step_flops is not None:
             _FLOPS_CACHE[shared_key] = (self._step_flops,
                                         self._step_bytes or 0.0)
+
+    def _program_key(self) -> Any:
+        """Shape-free identity of this engine's train program — what
+        the retrace sentinel tracks signatures against. Falls back to
+        the instance for engines without a shared cache key."""
+        return self._exec_key("flops", ()) or ("engine", id(self))
+
+    def _note_signature(self, shape_key: Tuple) -> None:
+        try:
+            cur = obs_trace.current()
+            obs_xray.note_signature(self._program_key(), shape_key,
+                                    name=cur[0] if cur else None)
+        except Exception:  # noqa: BLE001 — observability is advisory
+            pass
+
+    def _capture_xray(self, shared_key, lowered, compiled,
+                      shape_key: Tuple) -> None:
+        """Extract the compiled step's memory/cost X-ray (one extra
+        compile per COLD executable key — warm fits reuse the cached
+        extract) and attach it to the current job."""
+        if not obs_xray.enabled():
+            return
+        try:
+            if compiled is None:
+                compiled = lowered.compile()
+            report = {
+                "memory": obs_xray.extract_memory_analysis(compiled),
+                "cost": (obs_xray.extract_cost_analysis(compiled)
+                         or obs_xray.extract_cost_analysis(lowered)),
+                "batchShapes": {k: list(s) for k, s in shape_key},
+            }
+            if shared_key is not None:
+                _XRAY_CACHE[shared_key] = report
+            self._record_compile_xray(report)
+        except Exception:  # noqa: BLE001
+            pass
+
+    def _record_compile_xray(self, report) -> None:
+        try:
+            if report is None or not obs_xray.enabled():
+                return
+            cur = obs_trace.current()
+            if cur is not None:
+                obs_xray.record_compile(cur[0], "trainStep", report)
+        except Exception:  # noqa: BLE001
+            pass
+
+    def _ledger_state(self, state) -> None:
+        """Register this engine's placed train state in the HBM ledger
+        (owner ``train-state``); the fit wrapper releases it."""
+        try:
+            cur = obs_trace.current()
+            obs_xray.register("train-state", id(self),
+                              _tree_nbytes(state),
+                              name=cur[0] if cur else None)
+        except Exception:  # noqa: BLE001
+            pass
 
     def _should_scan(self, batcher: data_lib.ArrayBatcher) -> bool:
         from learningorchestra_tpu.config import get_config
@@ -991,8 +1069,10 @@ class Engine:
                 rb = sent["rollbacks"]
                 step_rng = (base_rng if rb == 0 else jax.random.fold_in(
                     base_rng, _HEALTH_TAG + rb))
-                state, totals = epoch_step(
-                    state, arrays_in, step_rng, shuffle_rng,
+                # once-per-epoch dispatch: the sentinel wrapper is
+                # off the per-step path, so it is always-on here
+                state, totals = obs_xray.guarded_call(
+                    epoch_step, state, arrays_in, step_rng, shuffle_rng,
                     jnp.asarray(epoch + rb * _ROLLBACK_STRIDE))
                 jax.block_until_ready(state.params)
                 dt = time.perf_counter() - t0
@@ -1090,6 +1170,29 @@ class Engine:
             scan_batches: Optional[bool] = None,
             health_policy=None,
             ) -> Tuple[TrainState, List[Dict[str, Any]]]:
+        """Train ``epochs`` over ``batcher``. Holds the train state's
+        X-ray ledger entry (owner ``train-state``) for the duration of
+        the fit so ``GET /observability/memory`` can attribute the
+        resident state while the job runs."""
+        self._ledger_state(state)
+        try:
+            return self._fit_impl(state, batcher, epochs=epochs,
+                                  seed=seed, checkpointer=checkpointer,
+                                  log_fn=log_fn,
+                                  scan_batches=scan_batches,
+                                  health_policy=health_policy)
+        finally:
+            obs_xray.release("train-state", id(self))
+
+    def _fit_impl(self, state: TrainState,
+                  batcher: data_lib.ArrayBatcher,
+                  epochs: int = 1, seed: int = 0,
+                  checkpointer=None,
+                  log_fn: Optional[Callable[[Dict[str, Any]],
+                                            None]] = None,
+                  scan_batches: Optional[bool] = None,
+                  health_policy=None,
+                  ) -> Tuple[TrainState, List[Dict[str, Any]]]:
         policy = health_lib.coerce_policy(health_policy)
         self._set_health(policy)
         state, restored = self._maybe_restore(state, checkpointer)
@@ -1138,6 +1241,9 @@ class Engine:
         # continues from the restored step, so the per-step rng stream
         # does not replay draws consumed before a crash.
         host_step = int(state.step)
+        # transfer sentinel (LO_TRANSFER_GUARD): resolved once per fit
+        # so the per-step hot path stays branch-only when disarmed
+        guard = obs_xray.transfer_guard_mode()
         epoch = start_epoch
         while epoch < epochs:
             t0 = time.perf_counter()
@@ -1174,7 +1280,11 @@ class Engine:
                 host_step += 1
                 if steps == 0 and epoch == start_epoch and rb == 0:
                     self._measure_flops(state, batch, rng)
-                state, metrics = self._train_step(state, batch, rng)
+                if guard:
+                    state, metrics = obs_xray.guarded_call(
+                        self._train_step, state, batch, rng)
+                else:
+                    state, metrics = self._train_step(state, batch, rng)
                 if steps == 0 and epoch == start_epoch:
                     jax.block_until_ready(metrics)
                     t_steady, steady_steps = time.perf_counter(), -1
@@ -1497,12 +1607,30 @@ class FusedEngine(Engine):
                   log_fn: Optional[Callable] = None,
                   ) -> Tuple[TrainState, List[Dict[str, Any]],
                              np.ndarray, List[Optional[int]]]:
-        """Scan-mode fused fit. Returns ``(state, history, active,
-        stopped_epochs)`` — ``active[i]`` False means config ``i`` was
-        early-stopped at ``stopped_epochs[i]`` (its params frozen from
-        that epoch on). Early stop needs ``eval_batcher`` +
-        ``score_fn`` and fires once a config's EMA validation score
+        """Scan-mode fused fit (ledgers the STACKED cohort state as
+        ``train-state`` for its duration). Returns ``(state, history,
+        active, stopped_epochs)`` — ``active[i]`` False means config
+        ``i`` was early-stopped at ``stopped_epochs[i]`` (its params
+        frozen from that epoch on). Early stop needs ``eval_batcher``
+        + ``score_fn`` and fires once a config's EMA validation score
         trails the cohort best by more than ``earlystop["margin"]``."""
+        self._ledger_state(state)
+        try:
+            return self._fit_fused_impl(
+                state, batcher, epochs=epochs, seed=seed,
+                eval_batcher=eval_batcher, score_fn=score_fn,
+                earlystop=earlystop, log_fn=log_fn)
+        finally:
+            obs_xray.release("train-state", id(self))
+
+    def _fit_fused_impl(self, state: TrainState,
+                        batcher: data_lib.ArrayBatcher,
+                        epochs: int = 1, seed: int = 0,
+                        eval_batcher=None, score_fn=None,
+                        earlystop: Optional[Dict[str, Any]] = None,
+                        log_fn: Optional[Callable] = None,
+                        ) -> Tuple[TrainState, List[Dict[str, Any]],
+                                   np.ndarray, List[Optional[int]]]:
         if not self._should_scan(batcher):
             raise FusedSweepUnsupported(
                 "dataset exceeds the scan-fit budget "

@@ -58,6 +58,7 @@ from learningorchestra_tpu.observability import hist as obs_hist
 from learningorchestra_tpu.observability import perf as obs_perf
 from learningorchestra_tpu.observability import timeline as obs_timeline
 from learningorchestra_tpu.observability import trace as obs_trace
+from learningorchestra_tpu.observability import xray as obs_xray
 from learningorchestra_tpu.services import validators as V
 from learningorchestra_tpu.services.builder_service import BuilderService
 from learningorchestra_tpu.services.columnar import (DataTypeService,
@@ -377,6 +378,16 @@ class Api:
             "platform": obs_perf.platform_summary(),
             "jobs": obs_perf.latest(),
         }
+        # HBM attribution ledger + retrace/transfer sentinels
+        # (docs/OBSERVABILITY.md "HBM attribution & X-ray"). Only the
+        # jax-free subset — the full report with bytes-in-use lives on
+        # GET /observability/memory
+        out["xray"] = {
+            "enabled": obs_xray.enabled(),
+            "owners": obs_xray.by_owner(),
+            "attributedBytes": obs_xray.attributed_bytes(),
+            "counters": obs_xray.counters(),
+        }
         # cluster resource sampler + SLO watchdog (docs/OBSERVABILITY
         # .md "Cluster monitor"); absent when LO_MONITOR=0
         monitor = getattr(self.ctx, "monitor", None)
@@ -575,6 +586,25 @@ class Api:
                 for job, value in rows:
                     lines.append(
                         f'{metric}{{job="{esc(job)}"}} {value}')
+        # X-ray HBM attribution + sentinels (observability/xray): the
+        # per-owner ledger gauge family and the retrace / implicit-
+        # transfer counters
+        xr = m.get("xray") or {}
+        owners = xr.get("owners") or {}
+        if owners:
+            lines.append("# TYPE lo_hbm_attributed_bytes gauge")
+            for owner, nbytes in sorted(owners.items()):
+                lines.append(
+                    f'lo_hbm_attributed_bytes{{owner="{esc(owner)}"}} '
+                    f'{nbytes}')
+        xr_counters = xr.get("counters") or {}
+        lines += [
+            "# TYPE lo_retraces_total counter",
+            f"lo_retraces_total {xr_counters.get('retraces', 0)}",
+            "# TYPE lo_implicit_transfers_total counter",
+            f"lo_implicit_transfers_total "
+            f"{xr_counters.get('implicitTransfers', 0)}",
+        ]
         # cluster monitor + SLO watchdog gauges (absent when
         # LO_MONITOR=0, so scrapers see the series disappear rather
         # than freeze at the last value)
@@ -583,6 +613,7 @@ class Api:
             hbm = cluster.get("hbm") or {}
             sched = cluster.get("scheduler") or {}
             serving_sample = cluster.get("serving") or {}
+            xray_sample = cluster.get("xray") or {}
             for metric, value in (
                     ("lo_hbm_bytes_in_use", hbm.get("bytesInUse")),
                     ("lo_hbm_peak_bytes_in_use",
@@ -592,7 +623,9 @@ class Api:
                      sched.get("fragmentation")),
                     ("lo_serving_queue_depth_total",
                      serving_sample.get("queueDepth")),
-                    ("lo_host_rss_bytes", cluster.get("hostRssBytes"))):
+                    ("lo_host_rss_bytes", cluster.get("hostRssBytes")),
+                    ("lo_hbm_unattributed_bytes",
+                     xray_sample.get("unattributedBytes"))):
                 if value is not None:
                     lines.append(f"# TYPE {metric} gauge")
                     lines.append(f"{metric} {value}")
@@ -688,6 +721,15 @@ class Api:
         - ``GET /observability/perf/{name}``        roofline report
           (live serving session, in-process train window, or the
           ``perf`` block stamped on terminal train metadata)
+        - ``GET /observability/memory``             HBM attribution
+          ledger: per-owner byte totals, bytes-in-use and the
+          unattributed remainder (XLA temps / leaks) + sentinel
+          counters
+        - ``GET /observability/memory/{name}``      ledger rows tagged
+          with one job / serving session / model name
+        - ``GET /observability/compile/{name}``     compiled-artifact
+          X-ray: per-program ``memory_analysis()`` (argument/output/
+          temp/code bytes) and ``cost_analysis()`` extracts
 
         Trace names may contain ``/`` (serving requests are
         ``serve/{model}/{seq}``), so the remaining path joins back up.
@@ -750,6 +792,26 @@ class Api:
                     f"no perf report for {name} (job never recorded "
                     f"a steady-state window here, or LO_PERF=0)")
             report["platform"] = platform
+            return 200, report, "application/json"
+        if kind == "memory":
+            report = obs_xray.memory_report(name or None)
+            if name and not report["entries"]:
+                raise V.HttpError(
+                    V.HTTP_NOT_FOUND,
+                    f"no ledgered allocations tagged {name} (nothing "
+                    f"resident for it right now, or LO_XRAY=0)")
+            return 200, report, "application/json"
+        if kind == "compile":
+            if not name:
+                return (200, {"result": obs_xray.known_compiles()},
+                        "application/json")
+            report = obs_xray.compile_report(name)
+            if report is None:
+                raise V.HttpError(
+                    V.HTTP_NOT_FOUND,
+                    f"no compiled-artifact report for {name} (job "
+                    f"never compiled a step here, report evicted, or "
+                    f"LO_XRAY=0)")
             return 200, report, "application/json"
         if kind == "cluster":
             monitor = getattr(self.ctx, "monitor", None)

@@ -41,6 +41,7 @@ from learningorchestra_tpu.observability import export as obs_export
 from learningorchestra_tpu.observability import hist as obs_hist
 from learningorchestra_tpu.observability import perf as obs_perf
 from learningorchestra_tpu.observability import trace as obs_trace
+from learningorchestra_tpu.observability import xray as obs_xray
 from learningorchestra_tpu.services import faults
 from learningorchestra_tpu.services import validators as V
 from learningorchestra_tpu.services.scheduler import ServingLease
@@ -354,6 +355,10 @@ class LMServingSession(_SessionBase):
         # pin params in the HBM arena for the session's lifetime —
         # tagged with the model name so a retrain invalidates the pin
         self._params_entry = self._pin_params()
+        # the slot KV cache is the session's other standing HBM claim
+        obs_xray.register("kv-cache", ("kv", self.name, id(self)),
+                          self._cache_bytes, name=self.name,
+                          slots=self.slots, cacheLen=self.cache_len)
 
     def _pin_params(self):
         import jax
@@ -362,9 +367,16 @@ class LMServingSession(_SessionBase):
 
         leaves = jax.tree_util.tree_leaves(self._model.params)
         flat = {f"leaf{i}": a for i, a in enumerate(leaves)}
-        return arena_lib.get_default_arena().get_or_put(
-            ("serving", self.name, id(self)), lambda: flat,
-            tags=(self.name,))
+        key = ("serving", self.name, id(self))
+        entry = arena_lib.get_default_arena().get_or_put(
+            key, lambda: flat, tags=(self.name,))
+        # re-tag the pin in the X-ray ledger: these bytes are THIS
+        # session's resident params, not anonymous arena residency
+        # (the arena's own registration would double-count them)
+        obs_xray.release("arena", key)
+        obs_xray.register("serving-params", key, entry.nbytes,
+                          name=self.name)
+        return entry
 
     def _on_reacquired(self) -> None:
         # the slice changed hands while we were yielded: re-pin so
@@ -503,6 +515,9 @@ class LMServingSession(_SessionBase):
     def close(self) -> None:
         super().close()
         self._params_entry.release()
+        obs_xray.release("serving-params",
+                         ("serving", self.name, id(self)))
+        obs_xray.release("kv-cache", ("kv", self.name, id(self)))
 
     def _batch_fill(self) -> Optional[float]:
         active = sum(1 for r in self._slot_req if r is not None)

@@ -436,7 +436,10 @@ def test_objectives_reflect_config(tmp_config):
     assert objectives["servingP99"]["threshold"] == 250.0
     assert objectives["servingP99"]["severity"] == "page"
     assert set(objectives) == {"servingP99", "queueWait",
-                               "hbmHeadroom", "deadLetterRate"}
+                               "hbmHeadroom", "deadLetterRate",
+                               "unattributedGrowth"}
+    # leak detector ships disabled; evaluate() retires thr<=0 objectives
+    assert objectives["unattributedGrowth"]["threshold"] == 0.0
 
 
 # ----------------------------------------------------------------------

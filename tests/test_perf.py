@@ -190,7 +190,10 @@ def _measure(floor_fn=None):
     eng = types.SimpleNamespace(
         _step_flops=None, _step_bytes=None, _flops_key=None,
         _flops_floor_fn=floor_fn, _train_step=None,
-        _exec_key=lambda *a, **k: None)
+        _exec_key=lambda *a, **k: None,
+        _note_signature=lambda key: None,
+        _capture_xray=lambda *a, **k: None,
+        _record_compile_xray=lambda *a, **k: None)
     batch = {"x": np.ones((64, 64), np.float32)}
     Engine._measure_flops(eng, np.float32(0.0), batch,
                           jax.random.PRNGKey(0), step_fn=step)
