@@ -99,6 +99,7 @@ PHASE_TIMEOUTS = {"cnn": 600, "lstm": 600, "tlm": 900, "proxy": 120,
                   "serving": 900,
                   "sentinel_overhead": 600, "sentinel_chaos": 600,
                   "obs_overhead": 600, "monitor_smoke": 600,
+                  "incident_smoke": 600,
                   "sweep_fusion": 900,
                   "ckpt_stall": 300, "migration_smoke": 600,
                   "xray_overhead": 600}
@@ -1357,6 +1358,160 @@ def phase_monitor_smoke():
     return out
 
 
+def phase_incident_smoke():
+    """Incident flight recorder end-to-end (docs/OBSERVABILITY.md
+    "Incidents & flight recorder"). Three parts: (1) chaos — the same
+    armed ``serving_step`` latency fault as monitor_smoke drives a
+    real resident predict session until the ``servingP99`` page alert
+    fires, and the recorder must AUTO-capture a debug bundle whose
+    manifest carries every evidence section, the firing alert context
+    and zero collector errors, downloadable through the REST tar
+    route; (2) bounds — a re-trigger inside the cooldown is muted and
+    ``LO_INCIDENT_KEEP`` retention holds the bundle count; (3)
+    steady-state cost: the obs_overhead MLP fit with an idle recorder
+    armed vs recorder off, interleaved, min-of-repeats — CI gates the
+    ratio at < 3%."""
+    import jax
+    import numpy as np
+
+    from learningorchestra_tpu import config as config_mod
+    from learningorchestra_tpu.models.estimators import \
+        LogisticRegressionJAX
+    from learningorchestra_tpu.models.neural import NeuralModel
+    from learningorchestra_tpu.observability import hist as obs_hist
+    from learningorchestra_tpu.observability import \
+        incidents as obs_incidents
+    from learningorchestra_tpu.runtime import health as health_lib
+    from learningorchestra_tpu.services import faults
+    from learningorchestra_tpu.services.context import _start_incidents
+    from learningorchestra_tpu.services.server import Api
+
+    home = tempfile.mkdtemp(prefix="lo_bench_incident_")
+    config_mod.set_config(config_mod.Config(
+        home=home,
+        monitor_interval_ms=100.0,
+        slo_serving_p99_ms=60.0,
+        slo_fast_window_s=1.0,
+        slo_slow_window_s=2.0,
+        fault_inject="serving_step:1000:latency:0.25"))
+    faults.reset()
+    obs_hist.reset()
+    api = Api()
+    prefix = "/api/learningOrchestra/v1"
+    out = {"platform": jax.devices()[0].platform}
+    try:
+        recorder = api.ctx.incidents
+        # -- (1) resident predict session under the latency fault
+        rng = np.random.default_rng(0)
+        x = rng.normal(size=(512, 8)).astype(np.float32)
+        y = (x[:, 0] > 0).astype(np.int32)
+        clf = LogisticRegressionJAX(epochs=2, batch_size=128)
+        clf.fit(x, y)
+        api.ctx.artifacts.save(clf, "inc_clf", "train/tensorflow")
+        status, body, _ = api.dispatch(
+            "POST", f"{prefix}/serve/inc_clf", {}, {})
+        _expect_created(status, body)
+        rows = [[float(v) for v in r] for r in rng.normal(size=(4, 8))]
+
+        def slo_bundles():
+            return [b for b in recorder.list()
+                    if b["trigger"] == "slo:servingP99"]
+
+        deadline = time.time() + 90
+        while not slo_bundles() and time.time() < deadline:
+            s2, b2, _ = api.dispatch(
+                "POST", f"{prefix}/serve/inc_clf/predict", {},
+                {"x": rows})
+            if s2 != 200:
+                raise RuntimeError(
+                    f"incident predict failed: {s2} {b2}")
+        bundles = slo_bundles()
+        out["incident_captured"] = bool(bundles)
+        if bundles:
+            iid = bundles[0]["id"]
+            manifest = recorder.manifest(iid)
+            required = {"cluster.json", "alerts.json", "memory.json",
+                        "perf.json", "metrics.json", "eventlog.tail",
+                        "config.json", "versions.json"}
+            present = set(manifest["files"])
+            out["sections_missing"] = sorted(required - present)
+            out["manifest_errors"] = len(manifest["errors"])
+            out["bundle_bytes"] = manifest["totalBytes"]
+            alert = manifest["context"].get("alert") or {}
+            out["alert_context_ok"] = \
+                alert.get("name") == "servingP99" and \
+                alert.get("transition") == "firing"
+            out["implicated_serving"] = any(
+                t.startswith("serve/") for t in
+                manifest["implicated"]["traces"])
+            status, blob, ctype = api.dispatch(
+                "GET",
+                f"{prefix}/observability/incidents/{iid}/download",
+                {}, None)
+            out["download_ok"] = (status == 200
+                                  and ctype == "application/x-tar"
+                                  and len(blob) > 0)
+            out["download_bytes"] = len(blob)
+        # -- (2) bounds: cooldown mutes a re-fire; retention holds
+        out["cooldown_muted"] = \
+            recorder.trigger("slo:servingP99") is False
+        api.ctx.config.incident_keep = 2
+        for i in range(3):
+            recorder.capture("manual", {"rep": i})
+        out["retention_ok"] = len(recorder.list()) <= 2
+        api.ctx.config.fault_inject = ""
+        api.dispatch("DELETE", f"{prefix}/serve/inc_clf", {}, None)
+
+        # -- (3) recorder steady-state overhead: an armed-but-idle
+        # recorder (worker blocked on its queue) vs recorder off,
+        # fresh per rep so the arms interleave; the monitor is stopped
+        # so only the recorder differs between arms
+        api.ctx.monitor.stop()
+        health_lib.remove_listener(api.ctx._health_listener)
+        obs_incidents.set_recorder(None)
+        recorder.close()
+        api.ctx.incidents = None
+        api.ctx.config.incident_keep = 8
+        xb = rng.normal(size=(8192, 64)).astype(np.float32)
+        yb = (xb[:, 0] > 0).astype(np.int64)
+        model = NeuralModel([
+            {"kind": "dense", "units": 128, "activation": "relu"},
+            {"kind": "dense", "units": 128, "activation": "relu"},
+            {"kind": "dense", "units": 2, "activation": "softmax"}])
+        model.fit(xb, yb, epochs=1, batch_size=256,
+                  shuffle=False)  # warm-up pays the compile
+        times = {"on": [], "off": []}
+        for _ in range(5):
+            rec, listener = _start_incidents(api.ctx)
+            t0 = time.perf_counter()
+            model.fit(xb, yb, epochs=60, batch_size=256,
+                      shuffle=False)
+            times["on"].append(time.perf_counter() - t0)
+            health_lib.remove_listener(listener)
+            obs_incidents.set_recorder(None)
+            rec.close()
+            t0 = time.perf_counter()
+            model.fit(xb, yb, epochs=60, batch_size=256,
+                      shuffle=False)
+            times["off"].append(time.perf_counter() - t0)
+        best = {name: min(ts) for name, ts in times.items()}
+        out.update({
+            "recorded_seconds": round(best["on"], 4),
+            "unrecorded_seconds": round(best["off"], 4),
+            "overhead_ratio": round(best["on"] / best["off"], 4),
+        })
+    finally:
+        if api.ctx.monitor is not None:
+            api.ctx.monitor.stop()
+        if api.ctx.incidents is not None:
+            if obs_incidents.get_recorder() is api.ctx.incidents:
+                obs_incidents.set_recorder(None)
+            api.ctx.incidents.close()
+        api.ctx.serving.close()
+        api.ctx.jobs.shutdown()
+    return out
+
+
 def phase_sweep_fusion():
     """Vectorized sweep fusion (docs/PERFORMANCE.md "Sweep fusion"):
     an 8-point learning-rate sweep over an MNIST-shaped MLP, fused
@@ -2044,6 +2199,7 @@ PHASES = {"cnn": phase_cnn, "lstm": phase_lstm, "tlm": phase_tlm,
           "sentinel_chaos": phase_sentinel_chaos,
           "obs_overhead": phase_obs_overhead,
           "monitor_smoke": phase_monitor_smoke,
+          "incident_smoke": phase_incident_smoke,
           "sweep_fusion": phase_sweep_fusion,
           "ckpt_stall": phase_ckpt_stall,
           "migration_smoke": phase_migration_smoke,

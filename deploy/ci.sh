@@ -218,6 +218,7 @@ OBS_OUT="$(mktemp)"
 SERVE_OUT="$(mktemp)"
 SWEEP_OUT="$(mktemp)"
 MONITOR_OUT="$(mktemp)"
+INCIDENT_OUT="$(mktemp)"
 ROOFLINE_OUT="$(mktemp)"
 XRAY_OUT="$(mktemp)"
 trap 'rm -rf "$PERF_CACHE" "$PERF_OUT" "$SLICE_OUT" "$CKPT_OUT" "$MIG_OUT" "$CHAOS_OUT" "$OVERHEAD_OUT" "$OBS_OUT" "$SERVE_OUT" "$SWEEP_OUT" "$MONITOR_OUT" "$ROOFLINE_OUT" "$XRAY_OUT"' EXIT
@@ -455,6 +456,61 @@ assert ratio < 1.01, (
 print(f"monitor-smoke: OK (alert fired on trace "
       f"{result['alert_trace']}, healthz 503 -> 200, sampler "
       f"overhead {ratio}x)")
+EOF
+
+echo "== incident-smoke: a page must auto-capture a bundle, cost < 3% =="
+# Incident flight recorder end-to-end (bench.py incident_smoke;
+# docs/OBSERVABILITY.md "Incidents & flight recorder"). Gates:
+#  - the servingP99 page alert firing under the injected latency
+#    fault AUTO-captures a debug bundle carrying every evidence
+#    section, the firing alert context and zero collector errors,
+#    and the bundle downloads through the REST tar route
+#  - a re-trigger inside the cooldown is muted and LO_INCIDENT_KEEP
+#    bounds the on-disk bundle count
+#  - an armed-but-idle recorder costs < 3% steady-state vs off
+INCIDENT_TIMEOUT="${LO_CI_INCIDENT_TIMEOUT:-600}"
+timeout -k 10 "$INCIDENT_TIMEOUT" env JAX_PLATFORMS=cpu \
+    JAX_COMPILATION_CACHE_DIR="$PERF_CACHE" \
+    LO_COMPUTE_DTYPE=float32 \
+    python bench.py --phase incident_smoke | tee "$INCIDENT_OUT"
+python - "$INCIDENT_OUT" <<'EOF'
+import json, sys
+
+mark = "@@LO_BENCH_RESULT@@"
+result = None
+for line in reversed(open(sys.argv[1]).read().splitlines()):
+    if line.startswith(mark):
+        result = json.loads(line[len(mark):])
+        break
+assert result is not None, "incident-smoke: no bench result line"
+assert "error" not in result, f"incident-smoke: phase failed: {result}"
+result = result.get("result", result)  # unwrap the ok-envelope
+assert result["incident_captured"], (
+    f"incident-smoke: servingP99 page never auto-captured a "
+    f"bundle: {result}")
+assert result["sections_missing"] == [], (
+    f"incident-smoke: bundle missing evidence sections "
+    f"{result['sections_missing']}: {result}")
+assert result["manifest_errors"] == 0, (
+    f"incident-smoke: bundle collectors errored: {result}")
+assert result["alert_context_ok"], (
+    f"incident-smoke: manifest lacks the firing alert context: "
+    f"{result}")
+assert result["download_ok"], (
+    f"incident-smoke: REST tar download failed: {result}")
+assert result["cooldown_muted"], (
+    f"incident-smoke: re-trigger inside the cooldown was not "
+    f"muted: {result}")
+assert result["retention_ok"], (
+    f"incident-smoke: LO_INCIDENT_KEEP did not bound the bundle "
+    f"count: {result}")
+ratio = result["overhead_ratio"]
+assert ratio < 1.03, (
+    f"incident-smoke: idle recorder costs {ratio}x "
+    f"(gate < 1.03x): {result}")
+print(f"incident-smoke: OK (bundle {result['bundle_bytes']} bytes, "
+      f"download {result['download_bytes']} bytes, cooldown muted, "
+      f"retention bounded, overhead {ratio}x)")
 EOF
 
 echo "== roofline-smoke: perf reports must land and cost < 3% =="

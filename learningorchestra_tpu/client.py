@@ -427,6 +427,45 @@ class Context:
             "GET", f"{API_PREFIX}/observability/compile/{name}")
         return payload
 
+    def incidents(self) -> list:
+        """Captured incident debug bundles (docs/OBSERVABILITY.md
+        "Incidents & flight recorder"): id, trigger, creation time
+        and size of each bundle the flight recorder committed."""
+        _, payload = self._http.request(
+            "GET", f"{API_PREFIX}/observability/incidents")
+        return payload["result"]
+
+    def incident(self, incident_id: str) -> Dict[str, Any]:
+        """One bundle's manifest: trigger, context, implicated
+        job/trace names, the evidence files with their sizes, and
+        the build pin of what was running."""
+        _, payload = self._http.request(
+            "GET",
+            f"{API_PREFIX}/observability/incidents/{incident_id}")
+        return payload
+
+    def incident_download(self, incident_id: str) -> bytes:
+        """The whole bundle as an uncompressed tar stream — feed it
+        to ``scripts/incident_diff.py`` or untar it for postmortem
+        reading."""
+        _, payload = self._http.request(
+            "GET",
+            f"{API_PREFIX}/observability/incidents/{incident_id}"
+            f"/download")
+        return payload
+
+    def capture_incident(self, **context: Any) -> Dict[str, Any]:
+        """Manual on-demand capture (bypasses the trigger cooldown);
+        returns the committed bundle's manifest. Keyword arguments
+        become the manifest's ``context`` — pass ``job=``/``model=``
+        to pull that name's trace/timeline/compile evidence in, or
+        ``profile=True`` to request a deep-profiling window
+        (``LO_INCIDENT_PROFILE_S``)."""
+        _, payload = self._http.request(
+            "POST", f"{API_PREFIX}/observability/incidents",
+            body=dict(context))
+        return payload
+
     def healthz(self) -> Dict[str, Any]:
         """Readiness probe: raises on 503 (draining or a
         page-severity SLO alert firing); returns the status body on

@@ -415,6 +415,42 @@ class Config:
     footprint_margin: float = dataclasses.field(
         default_factory=lambda: float(os.environ.get(
             "LO_FOOTPRINT_MARGIN", "1.25")))
+    # Incident flight recorder (docs/OBSERVABILITY.md "Incidents &
+    # flight recorder"). On a failure trigger — an SLO alert firing, a
+    # job dead-lettering/stalling/timing out, a health-sentinel
+    # rollback — the recorder freezes the in-memory telemetry rings
+    # into a durable debug bundle under ``home/incidents/<id>/``.
+    # Off = every trigger is a no-op.
+    incidents: bool = dataclasses.field(
+        default_factory=lambda: os.environ.get(
+            "LO_INCIDENTS", "1") not in ("0", "false", "no"))
+    # Newest bundles kept on disk; older ones are pruned after each
+    # commit so alert storms cannot fill the disk.
+    incident_keep: int = dataclasses.field(
+        default_factory=lambda: int(os.environ.get(
+            "LO_INCIDENT_KEEP", "8")))
+    # Per-trigger cooldown: a trigger that captured a bundle is muted
+    # for this many seconds (manual POST captures bypass it).
+    incident_cooldown_s: float = dataclasses.field(
+        default_factory=lambda: float(os.environ.get(
+            "LO_INCIDENT_COOLDOWN_S", "300")))
+    # Triggered deep profiling: on a serving-latency page the recorder
+    # captures a jax.profiler window of this many seconds into the
+    # bundle (skipped when a manual /profile session holds the
+    # singleton). 0 disables.
+    incident_profile_s: float = dataclasses.field(
+        default_factory=lambda: float(os.environ.get(
+            "LO_INCIDENT_PROFILE_S", "0")))
+    # /profile hardening: auto-stop watchdog — a started session that
+    # nobody stops is force-stopped after this many seconds (0
+    # disables) — and bounded retention of captured profile dirs under
+    # ``home/profiles`` (newest kept).
+    profile_max_seconds: float = dataclasses.field(
+        default_factory=lambda: float(os.environ.get(
+            "LO_PROFILE_MAX_SECONDS", "600")))
+    profile_keep: int = dataclasses.field(
+        default_factory=lambda: int(os.environ.get(
+            "LO_PROFILE_KEEP", "8")))
 
     def ensure_dirs(self) -> None:
         for sub in ("datasets", "artifacts", "checkpoints", "tmp"):

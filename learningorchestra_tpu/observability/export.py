@@ -105,3 +105,50 @@ def log_event(kind: str, name: str, trace_id: Optional[str] = None,
                 f.write(line)
     except Exception:  # noqa: BLE001 — strictly best-effort
         pass
+
+
+def read_tail(max_bytes: int = 256 << 10) -> str:
+    """The last ``max_bytes`` of the event log as COMPLETE lines,
+    spliced across the ``.1`` rollover (incident bundles want the
+    window straddling a rotation, not just the fresh file). Reads
+    under the writer's lock, so it can never observe the torn instant
+    between the ``os.replace`` roll and the re-append, and never
+    returns a half-written last line. Empty string when the log is
+    off or unreadable; never raises."""
+    try:
+        from learningorchestra_tpu.config import get_config
+
+        path = getattr(get_config(), "event_log", "") or ""
+        if not path:
+            return ""
+        chunks = []
+        with _log_lock:
+            for p in (path + ".1", path):
+                try:
+                    with open(p, "rb") as f:
+                        f.seek(0, os.SEEK_END)
+                        size = f.tell()
+                        f.seek(max(0, size - max_bytes))
+                        chunks.append((f.read(max_bytes),
+                                       size > max_bytes))
+                except OSError:
+                    continue
+        parts = []
+        for data, truncated in chunks:
+            text = data.decode("utf-8", "replace")
+            if truncated:
+                # drop the leading partial line the byte-offset seek
+                # landed inside
+                nl = text.find("\n")
+                text = text[nl + 1:] if nl >= 0 else ""
+            parts.append(text)
+        merged = "".join(parts)
+        if len(merged) > max_bytes:
+            merged = merged[-max_bytes:]
+            nl = merged.find("\n")
+            merged = merged[nl + 1:] if nl >= 0 else ""
+        # the writer appends whole lines under the lock, so merged
+        # already ends at a line boundary (or is empty)
+        return merged
+    except Exception:  # noqa: BLE001 — strictly best-effort
+        return ""

@@ -31,6 +31,7 @@ from typing import Any, Callable, Dict, List, Optional
 
 from learningorchestra_tpu.observability import export as obs_export
 from learningorchestra_tpu.observability import hist as obs_hist
+from learningorchestra_tpu.observability import incidents as obs_incidents
 
 _HISTORY = 256
 
@@ -277,6 +278,13 @@ class SloWatchdog:
             "alert", f"{alert.name}.{transition}",
             trace_id=alert.trace, severity=alert.severity,
             value=alert.value, threshold=alert.threshold)
+        if transition == "firing":
+            # flight-recorder hook. MUST stay a cheap enqueue: we hold
+            # the watchdog's non-reentrant lock here, and the capture
+            # worker will call snapshot() on this very watchdog
+            obs_incidents.trigger(
+                f"slo:{alert.name}", trace=alert.trace,
+                alert=entry)
 
     # -- read side ----------------------------------------------------
 

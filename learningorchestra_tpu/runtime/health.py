@@ -134,11 +134,37 @@ def resolve_policy(request: Any, config) -> Optional[HealthPolicy]:
 _lock = threading.Lock()
 _counters: Dict[str, int] = {"nonfiniteSteps": 0, "lossSpikes": 0,
                              "rollbacks": 0, "quarantined": 0}
+# observers of sentinel events (the incident flight recorder
+# subscribes to rollbacks); notified OUTSIDE the counter lock so a
+# listener can read health_stats() without deadlocking, and strictly
+# best-effort — a raising listener never touches the fit
+_listeners: list = []
+
+
+def add_listener(fn) -> None:
+    """Register ``fn(kind, n)`` to be called after every
+    :func:`record`."""
+    with _lock:
+        _listeners.append(fn)
+
+
+def remove_listener(fn) -> None:
+    with _lock:
+        try:
+            _listeners.remove(fn)
+        except ValueError:
+            pass
 
 
 def record(kind: str, n: int = 1) -> None:
     with _lock:
         _counters[kind] = _counters.get(kind, 0) + n
+        listeners = list(_listeners)
+    for fn in listeners:
+        try:
+            fn(kind, n)
+        except Exception:  # noqa: BLE001
+            pass
 
 
 def health_stats() -> Dict[str, int]:

@@ -78,6 +78,16 @@ class ServiceContext:
         # (docs/OBSERVABILITY.md "Cluster monitor"); LO_MONITOR=0
         # leaves both off
         self.monitor = _start_monitor(self)
+        # singleton jax.profiler owner, shared between the manual
+        # POST /profile surface and the flight recorder's triggered
+        # windows — per-context so test servers stay isolated
+        from learningorchestra_tpu.observability.incidents import \
+            ProfilerGate
+        self.profiler_gate = ProfilerGate()
+        # incident flight recorder (docs/OBSERVABILITY.md "Incidents
+        # & flight recorder"); LO_INCIDENTS=0 leaves it off. Must come
+        # after the monitor so its snapshot collectors resolve.
+        self.incidents, self._health_listener = _start_incidents(self)
 
     @property
     def draining(self) -> bool:
@@ -99,6 +109,18 @@ class ServiceContext:
 
     def close(self) -> None:
         self._draining = True
+        if self.incidents is not None:
+            from learningorchestra_tpu.observability import \
+                incidents as obs_incidents
+            from learningorchestra_tpu.runtime import health as \
+                health_lib
+            if self._health_listener is not None:
+                health_lib.remove_listener(self._health_listener)
+            # unhook the process-wide trigger registry only if it
+            # still points here (a later context may have replaced it)
+            if obs_incidents.get_recorder() is self.incidents:
+                obs_incidents.set_recorder(None)
+            self.incidents.close()
         if self.monitor is not None:
             self.monitor.stop()
         if self._pod_guard is not None:
@@ -175,6 +197,65 @@ def _start_monitor(ctx: "ServiceContext"):
         arena_stats=arena_stats,
         watchdog=SloWatchdog(active_trace=active_trace))
     return monitor.start()
+
+
+def _start_incidents(ctx: "ServiceContext"):
+    """Create the incident flight recorder (docs/OBSERVABILITY.md
+    "Incidents & flight recorder") and publish it to the process-wide
+    trigger registry the failure sites call into. Collectors close
+    over the context's live components, like the monitor's. Returns
+    ``(recorder, health_listener)`` — both None when
+    ``LO_INCIDENTS=0``."""
+    if not getattr(ctx.config, "incidents", True):
+        return None, None
+    from learningorchestra_tpu.observability import \
+        incidents as obs_incidents
+    from learningorchestra_tpu.runtime import health as health_lib
+
+    def cluster_snapshot():
+        return ctx.monitor.snapshot() \
+            if ctx.monitor is not None else None
+
+    def alerts_snapshot():
+        monitor = ctx.monitor
+        watchdog = getattr(monitor, "watchdog", None)
+        return watchdog.snapshot() if watchdog is not None else None
+
+    def stats_snapshot():
+        from learningorchestra_tpu.runtime import health as hl
+        return {"jobLifecycle": ctx.jobs.lifecycle_counters(),
+                "meshScheduler": ctx.jobs.scheduler_stats(),
+                "jobQueue": ctx.jobs.queue_stats(),
+                "serving": ctx.serving.stats(),
+                "trainingHealth": hl.health_stats()}
+
+    def active_names():
+        names = []
+        job = ctx.jobs.active_job()
+        if job:
+            names.append(job)
+        for session in ctx.serving.stats().get("bySession") or []:
+            names.append(f"serve/{session.get('model')}")
+        return names
+
+    recorder = obs_incidents.FlightRecorder(
+        home=ctx.config.home,
+        cluster_snapshot=cluster_snapshot,
+        alerts_snapshot=alerts_snapshot,
+        stats_snapshot=stats_snapshot,
+        active_names=active_names,
+        profiler_gate=ctx.profiler_gate)
+    obs_incidents.set_recorder(recorder)
+
+    def on_health_event(kind: str, n: int) -> None:
+        # sentinel interventions: a rollback means a fit restored its
+        # last-good checkpoint — exactly the moment the in-memory
+        # evidence is about to be overwritten by the resumed epochs
+        if kind == "rollbacks":
+            obs_incidents.trigger("health:rollback")
+
+    health_lib.add_listener(on_health_event)
+    return recorder, on_health_event
 
 
 def _start_pod_guard(ctx: "ServiceContext", force: bool = False):

@@ -57,6 +57,7 @@ from typing import Any, Callable, Dict, Optional
 from learningorchestra_tpu.catalog import documents as D
 from learningorchestra_tpu.catalog.store import Catalog
 from learningorchestra_tpu.observability import export as obs_export
+from learningorchestra_tpu.observability import incidents as obs_incidents
 from learningorchestra_tpu.observability import monitor as obs_monitor
 from learningorchestra_tpu.observability import perf as obs_perf
 from learningorchestra_tpu.observability import trace as obs_trace
@@ -367,6 +368,8 @@ class JobManager:
             self._count_cancel(status)
             obs_export.log_event("job", "cancelled", trace_id=name,
                                  reason=status)
+            if status == D.STATUS_TIMED_OUT:
+                obs_incidents.trigger("job:timedOut", job=name)
 
         def run() -> Any:
             submitted = time.monotonic()
@@ -600,6 +603,11 @@ class JobManager:
                                         "job", "failed", trace_id=name,
                                         errorKind=kind,
                                         error=repr(exception))
+                                    if not extra.get("workerLost"):
+                                        obs_incidents.trigger(
+                                            "job:deadLettered",
+                                            job=name, errorKind=kind,
+                                            error=repr(exception))
                                     # finished stays False (reference
                                     # parity)
                                     return None
@@ -766,6 +774,9 @@ class JobManager:
                     if newly:
                         self._set_status(name, D.STATUS_STALLED)
                         self._count("stalledSeen")
+                        obs_incidents.trigger(
+                            "job:stalled", job=name,
+                            heartbeatAgeSeconds=round(age, 3))
                         if self._stall_escalate and _single_host():
                             token.cancel(D.STATUS_STALLED)
                 else:

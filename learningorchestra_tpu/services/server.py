@@ -101,8 +101,9 @@ class Api:
         self.projection = ProjectionService(self.ctx)
         self.datatype = DataTypeService(self.ctx)
         self.builder = BuilderService(self.ctx)
-        self._profile_dir: Optional[str] = None  # active jax trace
-        self._profile_lock = threading.Lock()
+        # jax.profiler singleton owner, shared with the incident
+        # flight recorder's triggered-profiling window (context.py)
+        self._profiler_gate = self.ctx.profiler_gate
         from learningorchestra_tpu.services.cache import ReadCache
 
         self.read_cache = ReadCache(
@@ -397,6 +398,11 @@ class Api:
             if watchdog is not None:
                 out["alerts"] = watchdog.firing()
                 out["alertsFiring"] = len(out["alerts"])
+        # incident flight recorder (docs/OBSERVABILITY.md "Incidents
+        # & flight recorder"); absent when LO_INCIDENTS=0
+        recorder = getattr(self.ctx, "incidents", None)
+        if recorder is not None:
+            out["incidents"] = recorder.stats()
         return out
 
     def metrics_prometheus(self) -> bytes:
@@ -408,7 +414,17 @@ class Api:
         # rate(sum)/rate(count) stays consistent under load
         m = self.metrics()
         esc = escape_label_value
+        # constant build pin (satellite: dashboards and bundles can
+        # join every series onto exactly what was running)
+        from learningorchestra_tpu.observability import \
+            incidents as obs_incidents
+        info = obs_incidents.build_info()
         lines = [
+            "# TYPE lo_build_info gauge",
+            f'lo_build_info{{version="{esc(info["version"])}"'
+            f',jax_version="{esc(info["jaxVersion"])}"'
+            f',backend="{esc(info["backend"])}"'
+            f',device_kind="{esc(info["deviceKind"])}"}} 1',
             "# TYPE lo_uptime_seconds gauge",
             f"lo_uptime_seconds {m['uptimeSeconds']}",
             "# TYPE lo_requests_total counter",
@@ -640,6 +656,20 @@ class Api:
                     lines.append(
                         f'lo_alert_firing{{alert="{esc(alert["name"])}"'
                         f',severity="{esc(alert["severity"])}"}} 1')
+        # incident flight recorder (absent when LO_INCIDENTS=0)
+        incidents = m.get("incidents")
+        if incidents is not None:
+            lines.append("# TYPE lo_incidents_total counter")
+            for trig, n in sorted(
+                    (incidents.get("byTrigger") or {}).items()):
+                lines.append(
+                    f'lo_incidents_total{{trigger="{esc(trig)}"}} {n}')
+            lines += [
+                "# TYPE lo_incident_bundles gauge",
+                f"lo_incident_bundles {incidents['bundles']}",
+                "# TYPE lo_incident_bytes gauge",
+                f"lo_incident_bytes {incidents['bytes']}",
+            ]
         # latency histograms: lo_dispatch_seconds, lo_lease_wait_...,
         # lo_serving_request_..., lo_compile_..., lo_checkpoint_commit_
         # — cumulative _bucket{le=...}/_sum/_count per the exposition
@@ -669,7 +699,8 @@ class Api:
         if parts and parts[0] == "profile":
             return self._profile(method, body or {})
         if parts and parts[0] == "observability":
-            return self._observability(method, parts, params)
+            return self._observability(method, parts, params,
+                                       body or {})
         if parts and parts[0] == "serve":
             # serving sessions address the MODEL in the path (the
             # session IS the resource), so the generic
@@ -702,7 +733,9 @@ class Api:
 
     # ------------------------------------------------------------------
     def _observability(self, method: str, parts: list,
-                       params: Dict[str, Any]) -> Tuple[int, Any, str]:
+                       params: Dict[str, Any],
+                       body: Optional[Dict[str, Any]] = None,
+                       ) -> Tuple[int, Any, str]:
         """Trace / timeline read surface (docs/OBSERVABILITY.md):
 
         - ``GET /observability/trace``              known trace ids
@@ -730,14 +763,24 @@ class Api:
         - ``GET /observability/compile/{name}``     compiled-artifact
           X-ray: per-program ``memory_analysis()`` (argument/output/
           temp/code bytes) and ``cost_analysis()`` extracts
+        - ``GET  /observability/incidents``          captured debug
+          bundles (docs/OBSERVABILITY.md "Incidents & flight
+          recorder")
+        - ``GET  /observability/incidents/{id}``     bundle manifest
+        - ``GET  /observability/incidents/{id}/download``  the whole
+          bundle as a tar stream
+        - ``POST /observability/incidents``          manual on-demand
+          capture (bypasses the trigger cooldown)
 
         Trace names may contain ``/`` (serving requests are
         ``serve/{model}/{seq}``), so the remaining path joins back up.
         """
+        kind = parts[1] if len(parts) > 1 else ""
+        if kind == "incidents":
+            return self._incidents(method, parts, body or {})
         if method != "GET":
             return (405, {"result": "unsupported method"},
                     "application/json")
-        kind = parts[1] if len(parts) > 1 else ""
         name = "/".join(parts[2:])
         if kind == "trace":
             if not name:
@@ -831,6 +874,46 @@ class Api:
         return 404, {"result": "unknown route"}, "application/json"
 
     # ------------------------------------------------------------------
+    def _incidents(self, method: str, parts: list,
+                   body: Dict[str, Any]) -> Tuple[int, Any, str]:
+        """Incident flight-recorder surface (docs/OBSERVABILITY.md
+        "Incidents & flight recorder"). Auto captures ride the
+        trigger queue; POST here is the synchronous manual path —
+        both are serialized by the recorder's commit lock, so they
+        are race-safe against each other."""
+        recorder = getattr(self.ctx, "incidents", None)
+        if recorder is None:
+            raise V.HttpError(
+                V.HTTP_NOT_FOUND,
+                "incident recorder disabled (LO_INCIDENTS=0)")
+        if method == "POST":
+            if len(parts) != 2:
+                return (404, {"result": "unknown route"},
+                        "application/json")
+            manifest = recorder.capture("manual", body)
+            return V.HTTP_CREATED, manifest, "application/json"
+        if method != "GET":
+            return (405, {"result": "unsupported method"},
+                    "application/json")
+        if len(parts) == 2:
+            return (200, {"result": recorder.list()},
+                    "application/json")
+        iid = parts[2]
+        if len(parts) == 4 and parts[3] == "download":
+            data = recorder.tar_bytes(iid)
+            if data is None:
+                raise V.HttpError(V.HTTP_NOT_FOUND,
+                                  f"no incident bundle {iid}")
+            return 200, data, "application/x-tar"
+        if len(parts) == 3:
+            manifest = recorder.manifest(iid)
+            if manifest is None:
+                raise V.HttpError(V.HTTP_NOT_FOUND,
+                                  f"no incident bundle {iid}")
+            return 200, manifest, "application/json"
+        return 404, {"result": "unknown route"}, "application/json"
+
+    # ------------------------------------------------------------------
     def _serve(self, method: str, parts: list,
                body: Dict[str, Any]) -> Tuple[int, Any, str]:
         """Resident serving plane (docs/SERVING.md):
@@ -907,54 +990,61 @@ class Api:
         """``POST /profile {"action": "start"|"stop"}`` captures a
         ``jax.profiler`` trace (XLA device activity, HLO timelines —
         view in TensorBoard/Perfetto). ``GET /profile`` lists captured
-        traces. The reference's only profiling surface is the Spark UI
-        + builder fitTime (SURVEY §5); this is first-party and covers
-        every jitted computation in the process."""
+        traces. The singleton session is owned by the process-wide
+        :class:`~..observability.incidents.ProfilerGate` (shared with
+        the flight recorder's triggered windows), which arms a
+        ``LO_PROFILE_MAX_SECONDS`` auto-stop on every manual start;
+        captured dirs under ``home/profiles`` are retention-bounded
+        to the ``LO_PROFILE_KEEP`` newest. The reference's only
+        profiling surface is the Spark UI + builder fitTime
+        (SURVEY §5); this is first-party and covers every jitted
+        computation in the process."""
         import os
         import time as time_mod
 
-        import jax
+        from learningorchestra_tpu.observability import \
+            incidents as obs_incidents
 
+        gate = self._profiler_gate
+        root = os.path.join(self.ctx.config.home, "profiles")
         if method == "GET":
-            root = os.path.join(self.ctx.config.home, "profiles")
-            traces = sorted(os.listdir(root)) if os.path.isdir(root) else []
-            return 200, {"active": self._profile_dir is not None,
-                         "traces": traces}, "application/json"
+            traces = sorted(os.listdir(root)) \
+                if os.path.isdir(root) else []
+            doc: Dict[str, Any] = {"active": gate.active() is not None,
+                                   "traces": traces}
+            auto_stop = gate.last_auto_stop()
+            if auto_stop is not None:
+                doc["lastAutoStop"] = auto_stop
+            return 200, doc, "application/json"
         if method != "POST":
             return 405, {"result": "unsupported method"}, "application/json"
         action = (body.get("action") or "").lower()
-        # ThreadingHTTPServer: concurrent start/stop must not race the
-        # singleton profiler state
-        with self._profile_lock:
-            if action == "start":
-                if self._profile_dir is not None:
-                    raise V.HttpError(V.HTTP_NOT_ACCEPTABLE,
-                                      "a trace is already active")
-                trace_dir = os.path.join(
-                    self.ctx.config.home, "profiles",
-                    f"{time_mod.strftime('%Y%m%d-%H%M%S')}-"
-                    f"{time_mod.time_ns() % 1_000_000:06d}")
-                os.makedirs(trace_dir)
-                jax.profiler.start_trace(trace_dir)
-                self._profile_dir = trace_dir
-                return 201, {"result": trace_dir}, "application/json"
-            if action == "stop":
-                if self._profile_dir is None:
-                    raise V.HttpError(V.HTTP_NOT_ACCEPTABLE,
-                                      "no active trace")
-                # clear the active marker no matter how stop_trace()
-                # exits: if it raised with the marker still set, every
-                # later start would 406 "already active" forever with
-                # no live profiler session behind it. The raise itself
-                # propagates to the dispatcher's generic 500 handler.
-                try:
-                    jax.profiler.stop_trace()
-                finally:
-                    trace_dir, self._profile_dir = \
-                        self._profile_dir, None
-                n_files = sum(len(fs) for _, _, fs in os.walk(trace_dir))
-                return 200, {"result": trace_dir,
-                             "files": n_files}, "application/json"
+        if action == "start":
+            trace_dir = os.path.join(
+                root,
+                f"{time_mod.strftime('%Y%m%d-%H%M%S')}-"
+                f"{time_mod.time_ns() % 1_000_000:06d}")
+            started = gate.try_start(
+                trace_dir,
+                max_seconds=float(getattr(
+                    self.ctx.config, "profile_max_seconds", 0) or 0))
+            if not started:
+                raise V.HttpError(V.HTTP_NOT_ACCEPTABLE,
+                                  "a trace is already active")
+            return 201, {"result": trace_dir}, "application/json"
+        if action == "stop":
+            # the gate clears its active marker even when stop_trace
+            # raises (the raise propagates to the generic 500
+            # handler), so a failed stop never wedges later starts
+            trace_dir = gate.stop()
+            if trace_dir is None:
+                raise V.HttpError(V.HTTP_NOT_ACCEPTABLE,
+                                  "no active trace")
+            n_files = sum(len(fs) for _, _, fs in os.walk(trace_dir))
+            obs_incidents.prune_dirs(root, int(getattr(
+                self.ctx.config, "profile_keep", 0) or 0))
+            return 200, {"result": trace_dir,
+                         "files": n_files}, "application/json"
         raise V.HttpError(V.HTTP_NOT_ACCEPTABLE,
                           "action must be 'start' or 'stop'")
 

@@ -1,0 +1,177 @@
+#!/usr/bin/env python3
+"""Postmortem diff of two incident debug bundles.
+
+Feed it two bundles — directories under ``home/incidents/`` or the
+tar streams ``GET /observability/incidents/{id}/download`` returns —
+and it prints what changed between the two freezes:
+
+- **metric deltas**: every numeric leaf of ``metrics.json``
+  (lifecycle counters, scheduler stats, serving stats, health
+  counters, histogram counts) that moved, with the delta;
+- **config drift**: ``config.json`` keys whose value differs —
+  did someone change a knob between the baseline and the incident?
+- **alerts**: objectives that are newly firing, resolved, or whose
+  measured value moved, from ``alerts.json``;
+- **build drift**: any change in the ``versions.json`` pin
+  (package / jax version, backend, device kind).
+
+Usage::
+
+    python scripts/incident_diff.py BUNDLE_A BUNDLE_B [--json]
+
+where a bundle is a directory or a ``.tar`` file. A is the baseline
+(earlier), B the incident (later): deltas read B - A.
+"""
+import argparse
+import json
+import os
+import sys
+import tarfile
+
+SECTIONS = ("manifest.json", "metrics.json", "config.json",
+            "alerts.json", "versions.json")
+
+
+def load_bundle(path):
+    """{section name -> parsed JSON} from a bundle dir or tar."""
+    docs = {}
+    if os.path.isdir(path):
+        for name in SECTIONS:
+            try:
+                with open(os.path.join(path, name),
+                          encoding="utf-8") as f:
+                    docs[name] = json.load(f)
+            except (OSError, ValueError):
+                pass
+        return docs
+    with tarfile.open(path) as tar:
+        for member in tar.getmembers():
+            base = os.path.basename(member.name)
+            # bundle files live under <id>/ in the tar stream
+            if base in SECTIONS and member.isfile():
+                fh = tar.extractfile(member)
+                if fh is None:
+                    continue
+                try:
+                    docs[base] = json.load(fh)
+                except ValueError:
+                    pass
+    return docs
+
+
+def numeric_leaves(doc, prefix=""):
+    """Flatten to {dotted.path: number} (bools excluded)."""
+    out = {}
+    if isinstance(doc, dict):
+        for key, value in doc.items():
+            out.update(numeric_leaves(
+                value, f"{prefix}.{key}" if prefix else str(key)))
+    elif isinstance(doc, (int, float)) and not isinstance(doc, bool):
+        out[prefix] = doc
+    return out
+
+
+def diff_metrics(a, b):
+    la, lb = numeric_leaves(a or {}), numeric_leaves(b or {})
+    rows = []
+    for path in sorted(set(la) | set(lb)):
+        va, vb = la.get(path), lb.get(path)
+        if va != vb:
+            rows.append({"metric": path, "a": va, "b": vb,
+                         "delta": (round(vb - va, 6)
+                                   if va is not None
+                                   and vb is not None else None)})
+    return rows
+
+
+def diff_config(a, b):
+    a, b = a or {}, b or {}
+    return [{"key": key, "a": a.get(key), "b": b.get(key)}
+            for key in sorted(set(a) | set(b))
+            if a.get(key) != b.get(key)]
+
+
+def diff_alerts(a, b):
+    """Alert-state movement keyed by objective name."""
+    def by_name(doc):
+        return {al.get("name"): al
+                for al in (doc or {}).get("alerts") or []}
+
+    alerts_a, alerts_b = by_name(a), by_name(b)
+    rows = []
+    for name in sorted(set(alerts_a) | set(alerts_b)):
+        aa, ab = alerts_a.get(name), alerts_b.get(name)
+        state_a = (aa or {}).get("state", "absent")
+        state_b = (ab or {}).get("state", "absent")
+        value_a = (aa or {}).get("value")
+        value_b = (ab or {}).get("value")
+        if state_a != state_b or value_a != value_b:
+            rows.append({"alert": name,
+                         "stateA": state_a, "stateB": state_b,
+                         "valueA": value_a, "valueB": value_b})
+    return rows
+
+
+def diff_bundles(path_a, path_b):
+    a, b = load_bundle(path_a), load_bundle(path_b)
+    for path, docs in ((path_a, a), (path_b, b)):
+        if "manifest.json" not in docs:
+            raise SystemExit(
+                f"{path}: not an incident bundle (no manifest.json)")
+    return {
+        "a": {"id": a["manifest.json"].get("id"),
+              "trigger": a["manifest.json"].get("trigger"),
+              "createdUnixSeconds":
+                  a["manifest.json"].get("createdUnixSeconds")},
+        "b": {"id": b["manifest.json"].get("id"),
+              "trigger": b["manifest.json"].get("trigger"),
+              "createdUnixSeconds":
+                  b["manifest.json"].get("createdUnixSeconds")},
+        "metricDeltas": diff_metrics(a.get("metrics.json"),
+                                     b.get("metrics.json")),
+        "configDrift": diff_config(a.get("config.json"),
+                                   b.get("config.json")),
+        "alertChanges": diff_alerts(a.get("alerts.json"),
+                                    b.get("alerts.json")),
+        "buildDrift": diff_config(a.get("versions.json"),
+                                  b.get("versions.json")),
+    }
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(
+        description="diff two incident debug bundles (A = baseline, "
+                    "B = incident)")
+    parser.add_argument("bundle_a")
+    parser.add_argument("bundle_b")
+    parser.add_argument("--json", action="store_true",
+                        help="machine-readable output")
+    args = parser.parse_args(argv)
+    report = diff_bundles(args.bundle_a, args.bundle_b)
+    if args.json:
+        json.dump(report, sys.stdout, indent=1, sort_keys=True)
+        print()
+        return 0
+    print(f"A: {report['a']['id']}  (trigger {report['a']['trigger']})")
+    print(f"B: {report['b']['id']}  (trigger {report['b']['trigger']})")
+    for title, rows, fmt in (
+            ("build drift", report["buildDrift"],
+             lambda r: f"  {r['key']}: {r['a']} -> {r['b']}"),
+            ("config drift", report["configDrift"],
+             lambda r: f"  {r['key']}: {r['a']} -> {r['b']}"),
+            ("alert changes", report["alertChanges"],
+             lambda r: f"  {r['alert']}: {r['stateA']} -> "
+                       f"{r['stateB']}  (value {r['valueA']} -> "
+                       f"{r['valueB']})"),
+            ("metric deltas", report["metricDeltas"],
+             lambda r: f"  {r['metric']}: {r['a']} -> {r['b']}"
+                       + (f"  ({r['delta']:+g})"
+                          if r["delta"] is not None else ""))):
+        print(f"\n{title}: {len(rows) or 'none'}")
+        for row in rows:
+            print(fmt(row))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,582 @@
+"""Incident flight recorder (docs/OBSERVABILITY.md "Incidents &
+flight recorder") — tentpole + satellites.
+
+The recorder is tested standalone against injected collectors (no
+service layer), the trigger wiring against the real SLO watchdog and
+JobManager, and the REST surface through ``Api.dispatch`` plus one
+socket-level download. Satellite coverage: /profile auto-stop +
+retention, bare trace/timeline listings, ``lo_build_info``, and the
+event-log rotation torn-read race the bundle tail-read depends on.
+"""
+
+import json
+import io
+import os
+import tarfile
+import threading
+import time
+
+import pytest
+
+from learningorchestra_tpu.observability import export as obs_export
+from learningorchestra_tpu.observability import incidents as inc
+from learningorchestra_tpu.observability import slo as slo_mod
+
+# sections every bundle must freeze (ISSUE 13 acceptance)
+REQUIRED_SECTIONS = {"cluster.json", "alerts.json", "memory.json",
+                     "perf.json", "metrics.json", "eventlog.tail",
+                     "config.json", "versions.json", "manifest.json"}
+
+API = "/api/learningOrchestra/v1"
+
+
+@pytest.fixture(autouse=True)
+def _clear_registry():
+    inc.set_recorder(None)
+    yield
+    inc.set_recorder(None)
+
+
+@pytest.fixture()
+def recorder(tmp_config):
+    rec = inc.FlightRecorder(
+        home=tmp_config.home,
+        cluster_snapshot=lambda: {"samples": 1,
+                                  "latest": {"hostRssBytes": 123}},
+        alerts_snapshot=lambda: {"alerts": [], "firing": []},
+        stats_snapshot=lambda: {"jobLifecycle": {"retries": 0}},
+        active_names=lambda: [])
+    yield rec
+    rec.close()
+
+
+@pytest.fixture()
+def api(tmp_config):
+    """In-process Api over a real ServiceContext (sampler parked)."""
+    from learningorchestra_tpu.services.server import Api
+
+    tmp_config.monitor_interval_ms = 3_600_000.0
+    a = Api()
+    yield a
+    a.ctx.close()
+
+
+def _wait(predicate, timeout=20.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if predicate():
+            return True
+        time.sleep(0.02)
+    return predicate()
+
+
+def _bundle_files(tmp_config, iid):
+    root = os.path.join(tmp_config.home, "incidents", iid)
+    out = set()
+    for dirpath, _dirs, fnames in os.walk(root):
+        for fname in fnames:
+            out.add(os.path.relpath(
+                os.path.join(dirpath, fname), root))
+    return out
+
+
+# ----------------------------------------------------------------------
+# recorder core
+# ----------------------------------------------------------------------
+
+def test_manual_capture_freezes_every_section(tmp_config, recorder):
+    tmp_config.event_log = os.path.join(tmp_config.home, "events.jsonl")
+    obs_export.log_event("test", "before-capture")
+    manifest = recorder.capture("manual", {"reason": "unit"})
+    iid = manifest["id"]
+    on_disk = _bundle_files(tmp_config, iid)
+    assert REQUIRED_SECTIONS <= on_disk
+    assert manifest["trigger"] == "manual"
+    assert manifest["context"]["reason"] == "unit"
+    assert manifest["errors"] == {}
+    assert manifest["totalBytes"] > 0
+    assert set(manifest["buildInfo"]) == {
+        "version", "jaxVersion", "backend", "deviceKind"}
+    # the event-log tail rode in and is complete JSONL
+    tail = open(os.path.join(tmp_config.home, "incidents", iid,
+                             "eventlog.tail")).read()
+    assert any(json.loads(line)["name"] == "before-capture"
+               for line in tail.splitlines())
+    # atomic commit: no half-written tmp dir left behind
+    assert not [e for e in
+                os.listdir(os.path.join(tmp_config.home, "incidents"))
+                if e.startswith(".")]
+
+
+def test_trigger_cooldown_mutes_storm_manual_bypasses(tmp_config,
+                                                      recorder):
+    tmp_config.incident_cooldown_s = 300.0
+    assert recorder.trigger("slo:servingP99", trace="t") is True
+    # a flapping alert re-fires inside the cooldown: muted
+    assert recorder.trigger("slo:servingP99", trace="t") is False
+    # distinct triggers have independent cooldowns
+    assert recorder.trigger("job:deadLettered", job="j") is True
+    # manual captures bypass the cooldown entirely
+    recorder.capture("manual")
+    recorder.capture("manual")
+    assert _wait(lambda: recorder.stats()["captured"] >= 4)
+    by = recorder.stats()["byTrigger"]
+    assert by["slo:servingP99"] == 1 and by["manual"] == 2
+
+
+def test_retention_prunes_oldest(tmp_config, recorder):
+    tmp_config.incident_keep = 2
+    ids = [recorder.capture("manual", {"n": i})["id"]
+           for i in range(3)]
+    kept = [b["id"] for b in recorder.list()]
+    assert kept == sorted(ids)[-2:]
+    assert recorder.stats()["bundles"] == 2
+
+
+def test_manual_and_auto_captures_race_safely(tmp_config, recorder):
+    tmp_config.incident_cooldown_s = 0.0
+    tmp_config.incident_keep = 64  # retention must not eat the count
+    inc.set_recorder(recorder)
+    auto_fired = []
+
+    def storm():
+        for i in range(10):
+            auto_fired.append(inc.trigger("job:stalled", job=f"j{i}"))
+
+    threads = [threading.Thread(target=storm)] + [
+        threading.Thread(
+            target=lambda n=n: recorder.capture("manual", {"n": n}))
+        for n in range(3)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    expected = 3 + sum(1 for ok in auto_fired if ok)
+    assert _wait(
+        lambda: recorder.stats()["captured"] == expected, timeout=60)
+    # every committed bundle is complete and readable
+    bundles = recorder.list()
+    assert len(bundles) == expected
+    for b in bundles:
+        assert recorder.manifest(b["id"]) is not None
+    assert not [e for e in
+                os.listdir(os.path.join(tmp_config.home, "incidents"))
+                if e.startswith(".")]
+
+
+def test_tar_download_roundtrip(tmp_config, recorder):
+    iid = recorder.capture("manual")["id"]
+    blob = recorder.tar_bytes(iid)
+    with tarfile.open(fileobj=io.BytesIO(blob)) as tar:
+        names = tar.getnames()
+        manifest = json.load(
+            tar.extractfile(f"{iid}/manifest.json"))
+    assert manifest["id"] == iid
+    assert f"{iid}/versions.json" in names
+    assert recorder.tar_bytes("nope") is None
+    assert recorder.tar_bytes("../etc") is None
+
+
+def test_failing_collector_becomes_manifest_error(tmp_config):
+    def boom():
+        raise RuntimeError("collector down")
+
+    rec = inc.FlightRecorder(home=tmp_config.home,
+                             cluster_snapshot=boom)
+    try:
+        manifest = rec.capture("manual")
+        assert "cluster.json" in manifest["errors"]
+        assert "collector down" in manifest["errors"]["cluster.json"]
+        # the bundle still committed with every other section
+        assert "versions.json" in manifest["files"]
+    finally:
+        rec.close()
+
+
+def test_disabled_recorder_ignores_triggers(tmp_config, recorder):
+    tmp_config.incidents = False
+    inc.set_recorder(recorder)
+    assert inc.trigger("slo:servingP99") is False
+    assert recorder.stats()["captured"] == 0
+
+
+# ----------------------------------------------------------------------
+# trigger wiring: SLO watchdog, job manager, health sentinel
+# ----------------------------------------------------------------------
+
+def test_slo_firing_transition_captures_bundle(tmp_config, recorder):
+    """The watchdog fires while holding its own alert lock; the
+    recorder's alert collector re-takes that lock on the worker — the
+    capture completing at all proves the enqueue never collects
+    evidence synchronously."""
+    inc.set_recorder(recorder)
+    watchdog = slo_mod.SloWatchdog()
+    recorder._alerts = watchdog.snapshot
+    spec = {"severity": "page", "threshold": 10.0}
+    watchdog._transition("servingP99", spec, True, True, 55.0,
+                         time.time())
+    assert _wait(lambda: any(
+        b["trigger"] == "slo:servingP99" for b in recorder.list()))
+    bundle = [b for b in recorder.list()
+              if b["trigger"] == "slo:servingP99"][0]
+    manifest = recorder.manifest(bundle["id"])
+    # the firing alert context rode into the manifest
+    assert manifest["context"]["alert"]["name"] == "servingP99"
+    assert manifest["context"]["alert"]["transition"] == "firing"
+    # and the frozen alert snapshot shows it firing
+    alerts = json.load(open(os.path.join(
+        tmp_config.home, "incidents", bundle["id"], "alerts.json")))
+    assert any(a["name"] == "servingP99" and a["state"] == "firing"
+               for a in alerts["alerts"])
+
+
+def test_deadlettered_job_captures_bundle(tmp_config, recorder,
+                                          catalog):
+    from learningorchestra_tpu.services.jobs import JobManager
+
+    inc.set_recorder(recorder)
+    jobs = JobManager(catalog)
+    try:
+        catalog.create_collection("dead_job", "train/tensorflow")
+
+        def bad_user_code():
+            raise ValueError("bad hyperparameter")
+
+        jobs.submit("dead_job", bad_user_code,
+                    description="unit").result(timeout=30)
+        assert _wait(lambda: any(
+            b["trigger"] == "job:deadLettered"
+            for b in recorder.list()))
+        bundle = [b for b in recorder.list()
+                  if b["trigger"] == "job:deadLettered"][0]
+        manifest = recorder.manifest(bundle["id"])
+        assert manifest["context"]["job"] == "dead_job"
+        assert manifest["context"]["errorKind"] == "permanent"
+        # the implicated job's span tree was frozen into the bundle
+        assert "dead_job" in manifest["implicated"]["traces"]
+        assert "trace/dead_job.json" in manifest["files"]
+    finally:
+        jobs.shutdown()
+
+
+def test_health_rollback_listener_fires_recorder(tmp_config,
+                                                 recorder):
+    from learningorchestra_tpu.runtime import health as health_lib
+
+    inc.set_recorder(recorder)
+    seen = []
+
+    def listener(kind, n):
+        seen.append((kind, n))
+        if kind == "rollbacks":
+            inc.trigger("health:rollback")
+
+    health_lib.add_listener(listener)
+    try:
+        health_lib.record("rollbacks")
+        assert ("rollbacks", 1) in seen
+        assert _wait(lambda: any(
+            b["trigger"] == "health:rollback"
+            for b in recorder.list()))
+    finally:
+        health_lib.remove_listener(listener)
+        health_lib.reset_health_stats()
+
+
+# ----------------------------------------------------------------------
+# REST surface + context wiring
+# ----------------------------------------------------------------------
+
+def test_rest_incident_surface(api, tmp_config):
+    status, body, _ = api.dispatch(
+        "GET", f"{API}/observability/incidents", {}, None)
+    assert status == 200 and body == {"result": []}
+    status, manifest, _ = api.dispatch(
+        "POST", f"{API}/observability/incidents", {},
+        {"reason": "drill"})
+    assert status == 201
+    iid = manifest["id"]
+    assert REQUIRED_SECTIONS <= _bundle_files(tmp_config, iid)
+    status, body, _ = api.dispatch(
+        "GET", f"{API}/observability/incidents", {}, None)
+    assert [b["id"] for b in body["result"]] == [iid]
+    status, body, _ = api.dispatch(
+        "GET", f"{API}/observability/incidents/{iid}", {}, None)
+    assert status == 200 and body["id"] == iid
+    status, blob, ctype = api.dispatch(
+        "GET", f"{API}/observability/incidents/{iid}/download",
+        {}, None)
+    assert status == 200 and ctype == "application/x-tar"
+    with tarfile.open(fileobj=io.BytesIO(blob)) as tar:
+        assert f"{iid}/manifest.json" in tar.getnames()
+    status, _, _ = api.dispatch(
+        "GET", f"{API}/observability/incidents/nope", {}, None)
+    assert status == 404
+    # the /metrics document and prometheus exposition both carry it
+    status, m, _ = api.dispatch("GET", "/metrics", {}, None)
+    assert m["incidents"]["captured"] == 1
+    assert m["incidents"]["byTrigger"] == {"manual": 1}
+    status, text, _ = api.dispatch(
+        "GET", "/metrics", {"format": "prometheus"}, None)
+    text = text.decode()
+    assert 'lo_incidents_total{trigger="manual"} 1' in text
+    assert "lo_incident_bytes " in text
+
+
+def test_rest_incidents_disabled_404(tmp_config):
+    from learningorchestra_tpu.services.server import Api
+
+    tmp_config.monitor_interval_ms = 3_600_000.0
+    tmp_config.incidents = False
+    api = Api()
+    try:
+        assert api.ctx.incidents is None
+        status, _, _ = api.dispatch(
+            "GET", f"{API}/observability/incidents", {}, None)
+        assert status == 404
+        status, _, _ = api.dispatch(
+            "POST", f"{API}/observability/incidents", {}, {})
+        assert status == 404
+        status, m, _ = api.dispatch("GET", "/metrics", {}, None)
+        assert "incidents" not in m
+    finally:
+        api.ctx.close()
+
+
+def test_context_wires_and_unwires_registry(api):
+    assert inc.get_recorder() is api.ctx.incidents
+    # a live-context trigger lands in the context's recorder
+    assert inc.trigger("job:stalled", job="ghost") is True
+    assert _wait(
+        lambda: api.ctx.incidents.stats()["captured"] >= 1)
+
+
+def test_incident_profile_coordinates_with_manual_profile(
+        api, tmp_config, monkeypatch):
+    import jax
+
+    monkeypatch.setattr(jax.profiler, "start_trace", lambda d: None)
+    monkeypatch.setattr(jax.profiler, "stop_trace", lambda: None)
+    tmp_config.incident_profile_s = 0.05
+    # manual /profile session holds the gate: the incident window is
+    # skipped and noted, never a double-start
+    status, _, _ = api.dispatch("POST", f"{API}/profile", {},
+                                {"action": "start"})
+    assert status == 201
+    manifest = api.ctx.incidents.capture("manual", {"profile": True})
+    assert "profileSkipped" in manifest["notes"]
+    status, _, _ = api.dispatch("POST", f"{API}/profile", {},
+                                {"action": "stop"})
+    assert status == 200
+    # gate free: the window is captured into the bundle
+    manifest = api.ctx.incidents.capture("manual", {"profile": True})
+    assert manifest["notes"]["profileSeconds"] == 0.05
+    assert "profileSkipped" not in manifest["notes"]
+
+
+# ----------------------------------------------------------------------
+# satellite: /profile auto-stop watchdog + retention
+# ----------------------------------------------------------------------
+
+def test_profile_auto_stop_watchdog(api, tmp_config, monkeypatch):
+    import jax
+
+    stopped = []
+    monkeypatch.setattr(jax.profiler, "start_trace", lambda d: None)
+    monkeypatch.setattr(jax.profiler, "stop_trace",
+                        lambda: stopped.append(True))
+    tmp_config.profile_max_seconds = 0.1
+    status, _, _ = api.dispatch("POST", f"{API}/profile", {},
+                                {"action": "start"})
+    assert status == 201
+    assert _wait(lambda: bool(stopped), timeout=10)
+    status, body, _ = api.dispatch("GET", f"{API}/profile", {}, None)
+    assert body["active"] is False
+    assert body["lastAutoStop"]["dir"]
+    # startable again after the watchdog reclaimed the session
+    status, _, _ = api.dispatch("POST", f"{API}/profile", {},
+                                {"action": "start"})
+    assert status == 201
+    api.dispatch("POST", f"{API}/profile", {}, {"action": "stop"})
+
+
+def test_profile_retention_bound(api, tmp_config, monkeypatch):
+    import jax
+
+    monkeypatch.setattr(jax.profiler, "start_trace", lambda d: None)
+    monkeypatch.setattr(jax.profiler, "stop_trace", lambda: None)
+    tmp_config.profile_keep = 2
+    for _ in range(3):
+        status, _, _ = api.dispatch("POST", f"{API}/profile", {},
+                                    {"action": "start"})
+        assert status == 201
+        time.sleep(0.01)  # distinct timestamped dir names
+        status, _, _ = api.dispatch("POST", f"{API}/profile", {},
+                                    {"action": "stop"})
+        assert status == 200
+    status, body, _ = api.dispatch("GET", f"{API}/profile", {}, None)
+    assert len(body["traces"]) == 2
+
+
+# ----------------------------------------------------------------------
+# satellite: bare trace/timeline listings
+# ----------------------------------------------------------------------
+
+def test_bare_trace_and_timeline_listings(api):
+    from learningorchestra_tpu.observability import timeline as tl
+    from learningorchestra_tpu.observability import trace as tr
+
+    with tr.span("job", trace="listing_job"):
+        pass
+    tl.record("listing_job", step=1, dt=0.1,
+              examples_per_second=10.0)
+    status, body, _ = api.dispatch(
+        "GET", f"{API}/observability/trace", {}, None)
+    assert status == 200 and "listing_job" in body["result"]
+    status, body, _ = api.dispatch(
+        "GET", f"{API}/observability/timeline", {}, None)
+    assert status == 200 and "listing_job" in body["result"]
+
+
+# ----------------------------------------------------------------------
+# satellite: lo_build_info
+# ----------------------------------------------------------------------
+
+def test_build_info_gauge(api):
+    from learningorchestra_tpu import __version__
+
+    info = inc.build_info()
+    assert info["version"] == __version__
+    assert info["jaxVersion"] not in ("", None)
+    status, text, _ = api.dispatch(
+        "GET", "/metrics", {"format": "prometheus"}, None)
+    line = [ln for ln in text.decode().splitlines()
+            if ln.startswith("lo_build_info{")][0]
+    for label in ("version=", "jax_version=", "backend=",
+                  "device_kind="):
+        assert label in line
+    assert line.endswith("} 1")
+
+
+# ----------------------------------------------------------------------
+# satellite: event-log rotation vs the tail reader
+# ----------------------------------------------------------------------
+
+def test_event_log_tail_survives_concurrent_rotation(tmp_config):
+    """Writers rolling the log to ``.1`` every few KB race a reader:
+    the tail must always be complete JSONL lines — no torn line, no
+    crash on the rollover instant (ISSUE 13 satellite)."""
+    tmp_config.event_log = os.path.join(tmp_config.home, "ev.jsonl")
+    tmp_config.event_log_max_bytes = 4096
+    stop = threading.Event()
+    failures = []
+
+    def writer(wid):
+        seq = 0
+        while not stop.is_set():
+            obs_export.log_event("race", f"w{wid}", seq=seq,
+                                 pad="x" * 64)
+            seq += 1
+
+    def reader():
+        while not stop.is_set():
+            try:
+                tail = obs_export.read_tail(8192)
+                for line in tail.splitlines():
+                    json.loads(line)
+            except Exception as exc:  # noqa: BLE001
+                failures.append(repr(exc))
+                return
+
+    threads = [threading.Thread(target=writer, args=(i,))
+               for i in range(3)] + [threading.Thread(target=reader)]
+    for t in threads:
+        t.start()
+    time.sleep(1.0)
+    stop.set()
+    for t in threads:
+        t.join(timeout=10)
+    assert failures == []
+    # rotation actually happened and the splice still reads whole
+    # lines across it
+    assert os.path.exists(tmp_config.event_log + ".1")
+    tail = obs_export.read_tail(1 << 20)
+    assert tail
+    for line in tail.splitlines():
+        json.loads(line)
+
+
+def test_read_tail_off_and_missing(tmp_config):
+    tmp_config.event_log = ""
+    assert obs_export.read_tail() == ""
+    tmp_config.event_log = os.path.join(tmp_config.home, "none.jsonl")
+    assert obs_export.read_tail() == ""
+
+
+# ----------------------------------------------------------------------
+# postmortem tooling: scripts/incident_diff.py
+# ----------------------------------------------------------------------
+
+def _load_incident_diff():
+    import importlib.util
+
+    path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "scripts", "incident_diff.py")
+    spec = importlib.util.spec_from_file_location(
+        "incident_diff", path)
+    module = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(module)
+    return module
+
+
+def test_incident_diff_dirs_and_tars(tmp_config, tmp_path):
+    diff_mod = _load_incident_diff()
+    stats = {"jobLifecycle": {"retries": 0, "deadLettered": 0}}
+    rec = inc.FlightRecorder(home=tmp_config.home,
+                             stats_snapshot=lambda: dict(stats))
+    try:
+        id_a = rec.capture("manual")["id"]
+        stats["jobLifecycle"] = {"retries": 3, "deadLettered": 1}
+        tmp_config.monitor_ring = 999  # config drift between captures
+        id_b = rec.capture("manual")["id"]
+        root = os.path.join(tmp_config.home, "incidents")
+        report = diff_mod.diff_bundles(os.path.join(root, id_a),
+                                       os.path.join(root, id_b))
+        deltas = {r["metric"]: r["delta"]
+                  for r in report["metricDeltas"]}
+        assert deltas["jobLifecycle.retries"] == 3
+        assert deltas["jobLifecycle.deadLettered"] == 1
+        drift = {r["key"]: (r["a"], r["b"])
+                 for r in report["configDrift"]}
+        assert drift["monitor_ring"][1] == 999
+        assert report["buildDrift"] == []
+        # same report from the REST download tar streams
+        tar_a, tar_b = (tmp_path / "a.tar"), (tmp_path / "b.tar")
+        tar_a.write_bytes(rec.tar_bytes(id_a))
+        tar_b.write_bytes(rec.tar_bytes(id_b))
+        report2 = diff_mod.diff_bundles(str(tar_a), str(tar_b))
+        assert report2["metricDeltas"] == report["metricDeltas"]
+    finally:
+        rec.close()
+
+
+def test_incident_diff_alert_changes(tmp_path):
+    diff_mod = _load_incident_diff()
+
+    def bundle(name, alerts):
+        d = tmp_path / name
+        d.mkdir()
+        (d / "manifest.json").write_text(json.dumps(
+            {"id": name, "trigger": "manual"}))
+        (d / "alerts.json").write_text(json.dumps(
+            {"alerts": alerts}))
+        return str(d)
+
+    a = bundle("a", [{"name": "servingP99", "state": "ok",
+                      "value": 10.0}])
+    b = bundle("b", [{"name": "servingP99", "state": "firing",
+                      "value": 220.0}])
+    report = diff_mod.diff_bundles(a, b)
+    assert report["alertChanges"] == [
+        {"alert": "servingP99", "stateA": "ok", "stateB": "firing",
+         "valueA": 10.0, "valueB": 220.0}]
