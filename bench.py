@@ -102,6 +102,7 @@ PHASE_TIMEOUTS = {"cnn": 600, "lstm": 600, "tlm": 900, "proxy": 120,
                   "incident_smoke": 600,
                   "sweep_fusion": 900,
                   "ckpt_stall": 300, "migration_smoke": 600,
+                  "elastic_smoke": 600,
                   "xray_overhead": 600}
 
 # out-of-core Builder (reference config 4: 10M-row GBT via Spark)
@@ -1812,6 +1813,222 @@ def phase_migration_smoke():
             "platform": jax.devices()[0].platform}
 
 
+def phase_elastic_smoke():
+    """Elastic autoscaling end-to-end (docs/SCALING.md "Elastic
+    autoscaling"). Part 1 runs a mixed elastic/rigid workload vs a
+    rigid-only twin: an elastic holder blocks a larger rigid waiter;
+    the closed policy loop must SHRINK the holder so the waiter
+    overlaps it instead of serializing behind it (makespan
+    comparison). Part 2 injects SLO-page pressure (the stubbed
+    watchdog stands in for a serving p99 burn) and the victim must
+    shrink while it keeps training to completion. Part 3 arms the
+    ``autoscale_resize`` fault site: the failed resize must ROLL BACK
+    to the old slice and the run must stay bit-identical to an
+    untouched rigid twin."""
+    import dataclasses
+    import threading
+
+    import jax
+    import numpy as np
+
+    from learningorchestra_tpu import config as config_mod
+    from learningorchestra_tpu.catalog import Catalog
+    from learningorchestra_tpu.services import faults
+    from learningorchestra_tpu.services.autoscaler import SliceAutoscaler
+    from learningorchestra_tpu.services.jobs import JobManager
+
+    total = len(jax.devices())
+    if total < 8:
+        return {"skipped": f"needs >=8 devices, have {total}"}
+    home = tempfile.mkdtemp(prefix="lo_bench_ela_")
+    cfg = config_mod.set_config(config_mod.Config(home=home))
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(2048, 32)).astype(np.float32)
+    y = (x @ rng.normal(size=(32, 1)).astype(np.float32))[:, 0]
+
+    def fit_job(ckpt_dir, sink, epochs, batch):
+        import jax.numpy as jnp
+        import optax
+
+        from learningorchestra_tpu.runtime import data as data_lib
+        from learningorchestra_tpu.runtime import mesh as mesh_lib
+        from learningorchestra_tpu.runtime.checkpoint import (
+            Checkpointer,
+        )
+        from learningorchestra_tpu.runtime.engine import (
+            Engine, mse_loss, to_host)
+
+        def apply_fn(params, model_state, batch_, train, step_rng):
+            return batch_["x"] @ params["w"], model_state
+
+        def job():
+            eng = Engine(apply_fn=apply_fn, loss_fn=mse_loss,
+                         optimizer=optax.sgd(0.01),
+                         mesh=mesh_lib.current_mesh(),
+                         compute_dtype=jnp.float32,
+                         donate_state=False)
+            state = eng.init_state(
+                {"w": jnp.zeros((32,), jnp.float32)})
+            batcher = data_lib.ArrayBatcher(
+                {"x": x, "y": y}, batch_size=batch, seed=3)
+            ckpt = Checkpointer(ckpt_dir)
+            try:
+                state, _ = eng.fit(state, batcher, epochs=epochs,
+                                   seed=7, checkpointer=ckpt,
+                                   scan_batches=False)
+            finally:
+                ckpt.close()
+            sink.append(to_host(state))
+            return "ok"
+
+        return job
+
+    elastic_fp = {"devices": 4, "elastic": {"min": 2, "max": 4}}
+
+    # part 1: mixed elastic/rigid vs rigid-only — the waiter (6
+    # devices) cannot fit beside the 4-device holder; only a shrink
+    # lets it overlap instead of serializing behind the whole holder.
+    # The headline is the waiter's COMPLETION LATENCY (submit->done):
+    # that is what pressure relief buys; makespan is reported too but
+    # not gated (a shrunk holder trades its own throughput for it).
+    makespan = {}
+    waiter_latency = {}
+    overlapped = False
+    for mode in ("elastic", "rigid"):
+        cat = Catalog(os.path.join(home, f"cat_{mode}.db"),
+                      os.path.join(home, f"ds_{mode}"))
+        jobs = JobManager(cat, max_workers=4, mesh_leases=2,
+                          slice_aging_seconds=0.3)
+        scaler = None
+        if mode == "elastic":
+            scaler = SliceAutoscaler(jobs, interval_seconds=0.1,
+                                     backoff_seconds=0.1).start()
+        try:
+            cat.create_collection("ela_holder", "train/tensorflow")
+            cat.create_collection("ela_waiter", "train/tensorflow")
+            t0 = time.perf_counter()
+            holder_fut = jobs.submit(
+                "ela_holder",
+                fit_job(os.path.join(home, f"h_{mode}"), [], 200, 256),
+                needs_mesh=True, pool="train",
+                footprint=(dict(elastic_fp) if mode == "elastic"
+                           else {"devices": 4}))
+            time.sleep(0.2)  # holder claims its slice
+            t_waiter = time.perf_counter()
+            jobs.submit(
+                "ela_waiter",
+                fit_job(os.path.join(home, f"w_{mode}"), [], 5, 192),
+                needs_mesh=True, pool="train",
+                footprint={"devices": 6})
+            jobs.wait("ela_waiter", timeout=240)
+            waiter_latency[mode] = time.perf_counter() - t_waiter
+            if mode == "elastic":
+                overlapped = not holder_fut.done()
+                scaler_stats = scaler.stats()["counters"]
+            jobs.wait("ela_holder", timeout=240)
+            makespan[mode] = time.perf_counter() - t0
+        finally:
+            if scaler is not None:
+                scaler.stop()
+            jobs.shutdown()
+            cat.close()
+
+    # part 2: page pressure (stub watchdog = a firing serving-p99
+    # burn) must shrink the victim while it trains to completion
+    class _Paging:
+        def page_firing(self):
+            return True
+
+    cat2 = Catalog(os.path.join(home, "cat2.db"),
+                   os.path.join(home, "ds2"))
+    jobs2 = JobManager(cat2, max_workers=4, mesh_leases=2)
+    scaler2 = SliceAutoscaler(jobs2, interval_seconds=0.1,
+                              backoff_seconds=0.1,
+                              watchdog_fn=lambda: _Paging()).start()
+    pressure_shrinks = 0
+    victim_finished = False
+    try:
+        cat2.create_collection("ela_victim", "train/tensorflow")
+        jobs2.submit("ela_victim",
+                     fit_job(os.path.join(home, "victim"), [], 8, 256),
+                     needs_mesh=True, pool="train",
+                     footprint=dict(elastic_fp))
+        victim_finished = jobs2.wait("ela_victim", timeout=240) == "ok"
+        token = jobs2._job_info["ela_victim"]["token"]
+        pressure_shrinks = token.resizes
+    finally:
+        scaler2.stop()
+        jobs2.shutdown()
+        cat2.close()
+
+    # part 3: forced resize fault — rollback must keep the run
+    # bit-identical to the untouched rigid twin
+    config_mod.set_config(dataclasses.replace(
+        cfg, fault_inject="autoscale_resize:1:raise"))
+    faults.reset()
+    cat3 = Catalog(os.path.join(home, "cat3.db"),
+                   os.path.join(home, "ds3"))
+    jobs3 = JobManager(cat3, max_workers=4, mesh_leases=2)
+    results = {}
+    rollbacks = 0
+    try:
+        for tag in ("base", "chaos"):
+            name = f"ela_{tag}"
+            cat3.create_collection(name, "train/tensorflow")
+            sink = []
+            results[tag] = sink
+            jobs3.submit(name,
+                         fit_job(os.path.join(home, tag), sink, 6, 256),
+                         needs_mesh=True, pool="train",
+                         footprint=(dict(elastic_fp) if tag == "chaos"
+                                    else {"devices": 4}))
+            if tag == "chaos":
+                token = jobs3._job_info[name]["token"]
+                deadline = time.monotonic() + 60
+                while time.monotonic() < deadline:
+                    if jobs3.request_resize(name, 2):
+                        break
+                    time.sleep(0.02)
+                while time.monotonic() < deadline:
+                    if token.resize_rollbacks >= 1:
+                        break
+                    time.sleep(0.02)
+                rollbacks = token.resize_rollbacks
+            jobs3.wait(name, timeout=240)
+    finally:
+        faults.reset()
+        config_mod.set_config(cfg)
+        jobs3.shutdown()
+        cat3.close()
+    base, chaos = results["base"][0], results["chaos"][0]
+    rollback_bit_identical = bool(
+        int(base.step) == int(chaos.step)
+        and np.array_equal(np.asarray(base.params["w"]),
+                           np.asarray(chaos.params["w"])))
+
+    speedup = (round(makespan["rigid"] / makespan["elastic"], 3)
+               if makespan.get("elastic") else None)
+    waiter_speedup = (round(waiter_latency["rigid"]
+                            / waiter_latency["elastic"], 3)
+                      if waiter_latency.get("elastic") else None)
+    return {"devices_total": total,
+            "elastic_makespan_seconds": round(makespan["elastic"], 3),
+            "rigid_makespan_seconds": round(makespan["rigid"], 3),
+            "makespan_speedup": speedup,
+            "elastic_waiter_seconds": round(waiter_latency["elastic"],
+                                            3),
+            "rigid_waiter_seconds": round(waiter_latency["rigid"], 3),
+            "waiter_latency_speedup": waiter_speedup,
+            "waiter_overlapped_holder": bool(overlapped),
+            "shrinks_requested": scaler_stats["shrinksRequested"],
+            "shrinks_completed": scaler_stats["shrinksCompleted"],
+            "pressure_shrinks": int(pressure_shrinks),
+            "victim_finished": bool(victim_finished),
+            "resize_rollbacks": int(rollbacks),
+            "rollback_bit_identical": rollback_bit_identical,
+            "platform": jax.devices()[0].platform}
+
+
 def phase_perf_report():
     """Roofline perf observability end-to-end (docs/OBSERVABILITY.md
     "Roofline & perf reports") plus its cost. Three parts: (1) one
@@ -2203,6 +2420,7 @@ PHASES = {"cnn": phase_cnn, "lstm": phase_lstm, "tlm": phase_tlm,
           "sweep_fusion": phase_sweep_fusion,
           "ckpt_stall": phase_ckpt_stall,
           "migration_smoke": phase_migration_smoke,
+          "elastic_smoke": phase_elastic_smoke,
           "perf_report": phase_perf_report,
           "xray_overhead": phase_xray_overhead}
 
@@ -2525,6 +2743,7 @@ def main(argv=None):
     mig_env = env if tpu_ok else dict(
         cpu_env, XLA_FLAGS="--xla_force_host_platform_device_count=8")
     models["migration_smoke"] = _run_phase("migration_smoke", mig_env)
+    models["elastic_smoke"] = _run_phase("elastic_smoke", mig_env)
     # interpret-mode kernel timing is meaningless — flash runs on TPU only
     flash = _run_phase("flash") if tpu_ok else {
         "skipped": "TPU unreachable; interpret-mode timing is not "

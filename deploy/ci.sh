@@ -56,7 +56,8 @@ timeout -k 10 "$CHAOS_TIMEOUT" env JAX_PLATFORMS=cpu \
     LO_FAULT_INJECT="job_run:1:hang:0.2,artifact_save:1:latency:0.05" \
     LO_CKPT_ASYNC=1 \
     python -m pytest tests/test_faults.py tests/test_lifecycle.py \
-    tests/test_async_ckpt.py tests/test_migration.py -q \
+    tests/test_async_ckpt.py tests/test_migration.py \
+    tests/test_autoscaler.py -q \
     -p no:cacheprovider -p no:xdist -p no:randomly
 
 echo "== perf-smoke: warm pipeline must hit the feature-plane cache =="
@@ -206,6 +207,61 @@ print(f"migration-smoke: OK (bit-identical across "
       f"{result['defrag_picks']} pick(s))")
 EOF
 
+echo "== elastic-smoke: autoscaler must relieve pressure, roll back safely =="
+# Elastic autoscaling end-to-end (bench.py elastic_smoke;
+# docs/SCALING.md "Elastic autoscaling"). Gates:
+#  - an aged rigid waiter starved by an elastic holder lands WHILE
+#    the holder still runs (the closed loop shrank it), and its
+#    completion latency beats the rigid-only twin's
+#  - injected SLO-page pressure shrinks a training victim without
+#    killing it (it finishes on the smaller slice)
+#  - a resize killed by the armed autoscale_resize fault ROLLS BACK:
+#    the run stays bit-identical to an untouched rigid twin
+ELASTIC_TIMEOUT="${LO_CI_ELASTIC_TIMEOUT:-600}"
+ELASTIC_OUT="$(mktemp)"
+trap 'rm -rf "$PERF_CACHE" "$PERF_OUT" "$SLICE_OUT" "$CKPT_OUT" "$MIG_OUT" "$ELASTIC_OUT"' EXIT
+timeout -k 10 "$ELASTIC_TIMEOUT" env JAX_PLATFORMS=cpu \
+    XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+    JAX_COMPILATION_CACHE_DIR="$PERF_CACHE" \
+    LO_COMPUTE_DTYPE=float32 \
+    python bench.py --phase elastic_smoke | tee "$ELASTIC_OUT"
+python - "$ELASTIC_OUT" <<'EOF'
+import json, sys
+
+mark = "@@LO_BENCH_RESULT@@"
+result = None
+for line in reversed(open(sys.argv[1]).read().splitlines()):
+    if line.startswith(mark):
+        result = json.loads(line[len(mark):])
+        break
+assert result is not None, "elastic-smoke: no bench result line"
+assert "error" not in result, f"elastic-smoke: phase failed: {result}"
+result = result.get("result", result)  # unwrap the ok-envelope
+assert "skipped" not in result, f"elastic-smoke: {result['skipped']}"
+assert result["shrinks_completed"] >= 1, (
+    f"elastic-smoke: the closed loop never completed a shrink: "
+    f"{result}")
+assert result["waiter_overlapped_holder"], (
+    f"elastic-smoke: the starved waiter did not overlap the elastic "
+    f"holder: {result}")
+assert result["waiter_latency_speedup"] > 1.0, (
+    f"elastic-smoke: elastic waiter latency did not beat the "
+    f"rigid-only twin: {result}")
+assert result["pressure_shrinks"] >= 1 and result["victim_finished"], (
+    f"elastic-smoke: SLO-page pressure did not shrink a surviving "
+    f"victim: {result}")
+assert result["resize_rollbacks"] >= 1, (
+    f"elastic-smoke: armed autoscale_resize fault never rolled back "
+    f"a resize: {result}")
+assert result["rollback_bit_identical"], (
+    f"elastic-smoke: rolled-back run diverged from the rigid twin: "
+    f"{result}")
+print(f"elastic-smoke: OK (waiter {result['waiter_latency_speedup']}x "
+      f"faster, {result['shrinks_completed']} shrink(s), "
+      f"{result['resize_rollbacks']} rollback(s) bit-identical, "
+      f"makespan ratio {result['makespan_speedup']})")
+EOF
+
 echo "== sentinel-smoke: chaos train must finish via rollback =="
 # NaN'd train step + bit-rotted checkpoint write through the full REST
 # stack under healthPolicy rollback (bench.py sentinel_chaos): the job
@@ -221,7 +277,7 @@ MONITOR_OUT="$(mktemp)"
 INCIDENT_OUT="$(mktemp)"
 ROOFLINE_OUT="$(mktemp)"
 XRAY_OUT="$(mktemp)"
-trap 'rm -rf "$PERF_CACHE" "$PERF_OUT" "$SLICE_OUT" "$CKPT_OUT" "$MIG_OUT" "$CHAOS_OUT" "$OVERHEAD_OUT" "$OBS_OUT" "$SERVE_OUT" "$SWEEP_OUT" "$MONITOR_OUT" "$ROOFLINE_OUT" "$XRAY_OUT"' EXIT
+trap 'rm -rf "$PERF_CACHE" "$PERF_OUT" "$SLICE_OUT" "$CKPT_OUT" "$MIG_OUT" "$ELASTIC_OUT" "$CHAOS_OUT" "$OVERHEAD_OUT" "$OBS_OUT" "$SERVE_OUT" "$SWEEP_OUT" "$MONITOR_OUT" "$ROOFLINE_OUT" "$XRAY_OUT"' EXIT
 timeout -k 10 "$SENTINEL_TIMEOUT" env JAX_PLATFORMS=cpu \
     JAX_COMPILATION_CACHE_DIR="$PERF_CACHE" \
     LO_COMPUTE_DTYPE=float32 \

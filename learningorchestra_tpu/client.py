@@ -173,16 +173,23 @@ class Tool:
     def run(self, name: str, model_name: str, method: str,
             parameters: Optional[Dict[str, Any]] = None,
             description: str = "",
-            timeout: Optional[float] = None) -> Any:
+            timeout: Optional[float] = None,
+            slice_devices: Any = None) -> Any:
         """train/tune/evaluate/predict method execution. ``timeout``
         is the job's server-side deadline in seconds (past it the job
-        is cancelled with a terminal ``timedOut`` document)."""
+        is cancelled with a terminal ``timedOut`` document).
+        ``slice_devices`` pins the job's device footprint: an int
+        device count, or elastic bounds ``{"min": m, "max": M}`` that
+        opt the job into autoscaler resizes (docs/SCALING.md "Elastic
+        autoscaling")."""
         body = {
             "name": name, "modelName": model_name, "method": method,
             "methodParameters": parameters or {},
             "description": description}
         if timeout is not None:
             body["timeout"] = timeout
+        if slice_devices is not None:
+            body["sliceDevices"] = slice_devices
         return self.post(body)
 
     def run_class(self, name: str, module_path: str, class_name: str,
@@ -388,6 +395,15 @@ class Context:
         (docs/OBSERVABILITY.md "Cluster monitor")."""
         _, payload = self._http.request(
             "GET", f"{API_PREFIX}/observability/alerts")
+        return payload
+
+    def autoscaler(self) -> Dict[str, Any]:
+        """Elastic slice-autoscaler state: resize/rollback counters,
+        the last pressure signals it acted on, and the per-job
+        backoff/dead-letter ledger (docs/SCALING.md "Elastic
+        autoscaling")."""
+        _, payload = self._http.request(
+            "GET", f"{API_PREFIX}/observability/autoscaler")
         return payload
 
     def perf(self, name: Optional[str] = None) -> Dict[str, Any]:

@@ -89,6 +89,38 @@ class Config:
     slice_defrag: float = dataclasses.field(
         default_factory=lambda: float(os.environ.get(
             "LO_SLICE_DEFRAG", "0")))
+    # Elastic slice autoscaler (docs/SCALING.md "Elastic
+    # autoscaling"): the closed-loop policy thread that shrinks
+    # elastic jobs (sliceDevices: {min, max}) under pressure (aged
+    # waiters, SLO pages, HBM headroom) and grows them onto freed
+    # devices. A no-op while no elastic job runs.
+    autoscale: bool = dataclasses.field(
+        default_factory=lambda: os.environ.get(
+            "LO_AUTOSCALE", "1") not in ("0", "false", "no"))
+    autoscale_interval_seconds: float = dataclasses.field(
+        default_factory=lambda: float(os.environ.get(
+            "LO_AUTOSCALE_INTERVAL", "1.0")))
+    # Per-job resize retry budget: after this many consecutive failed
+    # (rolled-back) resizes the autoscaler dead-letters the job's
+    # RESIZE ledger — the job keeps training at its current size.
+    autoscale_retries: int = dataclasses.field(
+        default_factory=lambda: int(os.environ.get(
+            "LO_AUTOSCALE_RETRIES", "3")))
+    # Exponential backoff (base * 2^attempt, capped, +/-50% jitter)
+    # between a job's failed resize and the next attempt — the PR 2
+    # retry-taxonomy shape, applied to placement changes.
+    autoscale_backoff_seconds: float = dataclasses.field(
+        default_factory=lambda: float(os.environ.get(
+            "LO_AUTOSCALE_BACKOFF", "2.0")))
+    autoscale_backoff_max_seconds: float = dataclasses.field(
+        default_factory=lambda: float(os.environ.get(
+            "LO_AUTOSCALE_BACKOFF_MAX", "30")))
+    # Bounded wait for the resize re-acquire (services/scheduler.py
+    # migrate_point): past it the job rolls back to an old-size slice
+    # instead of wedging behind a lease race.
+    resize_grant_timeout: float = dataclasses.field(
+        default_factory=lambda: float(os.environ.get(
+            "LO_RESIZE_GRANT_TIMEOUT", "10")))
 
     # Device mesh defaults: axis names follow the scaling-book
     # convention. Shape 'auto' = 1D data-parallel over all devices.

@@ -933,6 +933,26 @@ class Engine:
                 model_state=jax.device_put(host_state.model_state, rep))
         return jax.device_put(host_state, mesh_lib.replicated(mesh))
 
+    def _land_on_devices(self, host_state: TrainState, devices
+                         ) -> TrainState:
+        """Swap the thread-local mesh to ``devices`` and re-place a
+        host-snapshotted state there. Jitted-step identities key on
+        the mesh, so the per-instance handles are dropped and the
+        next dispatch re-resolves through the shared cache; an
+        explicit batch sharding references the OLD mesh, so it falls
+        back to the default data-axes sharding of the new one."""
+        new_mesh = mesh_lib.mesh_for_slice(devices)
+        mesh_lib.set_current_mesh(new_mesh)
+        self._mesh = new_mesh
+        self._train_step = None
+        self._eval_step = None
+        self._predict_step = None
+        self._epoch_steps = {}
+        self._batch_sharding = None
+        state = self._place_state(host_state)
+        jax.block_until_ready(state.params)
+        return state
+
     def _maybe_migrate(self, state: TrainState, checkpointer
                        ) -> Tuple[TrainState, bool]:
         """Epoch-boundary live migration (services/migration.py):
@@ -942,48 +962,125 @@ class Engine:
         placement through the fair queue, re-point the thread-local
         mesh at the new slice, and re-place the snapshot there.
         Per-step rng derives from the host step counter, so the
-        resumed run replays bit-identically. Returns
+        resumed run replays bit-identically. A pending elastic RESIZE
+        (services/autoscaler.py) rides the same path with a new
+        device count and a failure ladder: any fault inside the
+        guarded region — injected chaos, a lease race past the grant
+        timeout, an OOM placing state on the target mesh — rolls the
+        job back to an old-size slice, keeps training, and fires an
+        ``autoscaler:rollback`` incident. Returns
         ``(state, migrated)``."""
         if not preempt.migrate_requested():
             return state, False
         t0 = time.monotonic()
-        _inject_migration_fault()
+        token = preempt.current_cancel()
+        resize_want = token.resize_want if token is not None else None
+        old_devices = token.slice_devices if token is not None else None
+        if resize_want is None:
+            _inject_migration_fault()
         if checkpointer is not None and \
                 hasattr(checkpointer, "wait_until_finished"):
             checkpointer.wait_until_finished()
         host_state = to_host(state)
-        performed, new_devices = preempt.perform_migrate()
-        if not performed:
-            return state, False
-        new_mesh = mesh_lib.mesh_for_slice(new_devices)
-        mesh_lib.set_current_mesh(new_mesh)
-        self._mesh = new_mesh
-        # jitted-step identities key on the mesh: drop the
-        # per-instance handles so the next dispatch re-resolves
-        # through the shared cache under the new mesh
-        self._train_step = None
-        self._eval_step = None
-        self._predict_step = None
-        self._epoch_steps = {}
-        # an explicit batch sharding references the OLD mesh; fall
-        # back to the default data-axes sharding of the new one
-        self._batch_sharding = None
-        state = self._place_state(host_state)
-        jax.block_until_ready(state.params)
+        if resize_want is None:
+            performed, new_devices = preempt.perform_migrate()
+            if not performed:
+                return state, False
+            state = self._land_on_devices(host_state, new_devices)
+            self._record_migration(t0, new_devices, host_state)
+            return state, True
+        # -- elastic resize: everything after this point rolls back --
+        try:
+            _inject_resize_fault()
+            performed, new_devices = preempt.perform_migrate()
+            if not performed:  # defensive: latch raced away
+                token.resize_done(False, old_devices,
+                                  error="resize latch lost")
+                return state, False
+            state = self._land_on_devices(host_state, new_devices)
+        except preempt.JobCancelled:
+            raise
+        except Exception as exc:  # noqa: BLE001 — the failure ladder
+            return self._rollback_resize(
+                host_state, state, token, old_devices, resize_want,
+                exc, t0)
+        token.resize_done(True, new_devices)
+        self._record_migration(t0, new_devices, host_state,
+                               resized_to=len(new_devices)
+                               if new_devices is not None else None)
+        return state, True
+
+    def _rollback_resize(self, host_state: TrainState,
+                         state: TrainState, token, old_devices,
+                         resize_want: int, exc: Exception,
+                         t0: float) -> Tuple[TrainState, bool]:
+        """Failed-resize ladder: restore the job onto an old-size
+        slice (or leave it untouched when nothing moved yet), report
+        the rollback on the token, and leave incident evidence. The
+        job KEEPS TRAINING — the autoscaler applies per-job backoff
+        before any retry."""
+        error = f"{type(exc).__name__}: {exc}"
+        migrated = False
+        if token.migrate_pending is not None:
+            # fault fired before the slice was released: consume the
+            # latch; the live state on the old mesh is still valid
+            token.consume_migrate()
+        else:
+            devices = token.slice_devices
+            if devices is not None and old_devices is not None \
+                    and len(devices) != len(old_devices):
+                # placement failed AFTER the resize grant landed: go
+                # back to an old-size slice through the raw migrate
+                # point (best-effort — a second race leaves us on
+                # whatever grant it restored)
+                fn = preempt.migrate_fn()
+                if fn is not None:
+                    try:
+                        fn(len(old_devices))
+                    except preempt.JobCancelled:
+                        raise
+                    except Exception:  # noqa: BLE001 — keep ladder
+                        pass
+            state = self._land_on_devices(host_state,
+                                          token.slice_devices)
+            migrated = True
+        token.resize_done(False, token.slice_devices, error=error)
+        try:
+            from learningorchestra_tpu.observability import \
+                incidents as obs_incidents
+
+            cur = obs_trace.current()
+            obs_incidents.trigger(
+                "autoscaler:rollback",
+                job=(cur[0] if cur is not None else None),
+                error=error, want=int(resize_want),
+                oldDevices=(list(old_devices)
+                            if old_devices is not None else None),
+                restoredDevices=(list(token.slice_devices)
+                                 if token.slice_devices is not None
+                                 else None),
+                step=int(host_state.step))
+        except Exception:  # noqa: BLE001 — evidence is best-effort
+            pass
+        return state, migrated
+
+    def _record_migration(self, t0: float, new_devices, host_state,
+                          resized_to=None) -> None:
         end = time.monotonic()
         health_lib.record("migrations")
         try:
             obs_hist.observe("lo_migration_seconds", end - t0)
             cur = obs_trace.current()
             if cur is not None:
+                extra = {} if resized_to is None \
+                    else {"resizedTo": resized_to}
                 obs_trace.add(
                     "migration", cur[0], t0, end, parent=cur[1],
                     devices=(list(new_devices)
                              if new_devices is not None else None),
-                    step=int(host_state.step))
+                    step=int(host_state.step), **extra)
         except Exception:  # noqa: BLE001 — observability is advisory
             pass
-        return state, True
 
     def _fit_scanned(self, state: TrainState,
                      batcher: data_lib.ArrayBatcher, epochs: int,
@@ -1816,6 +1913,19 @@ def _inject_migration_fault() -> None:
     except Exception:  # noqa: BLE001
         return
     faults.maybe_inject("migration")
+
+
+def _inject_resize_fault() -> None:
+    """Armed ``autoscale_resize:*`` chaos fault fires inside an
+    elastic resize's guarded region (before the slice is released) —
+    the engine's rollback ladder keeps the job on its old slice and
+    training continues; the autoscaler backs off before retrying
+    (docs/RELIABILITY.md "Degradation ladder")."""
+    try:
+        from learningorchestra_tpu.services import faults
+    except Exception:  # noqa: BLE001
+        return
+    faults.maybe_inject("autoscale_resize")
 
 
 def _armed_nan() -> bool:

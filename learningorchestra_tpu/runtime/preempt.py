@@ -73,6 +73,21 @@ class CancelToken:
         # request makes sense for it (sliced, single-host)
         self.slice_devices: Optional[tuple] = None
         self.migratable: bool = False
+        # -- elastic resize (services/autoscaler.py) -------------------
+        # declared (min, max) device bounds when the job's footprint
+        # is elastic; ``resize_want`` rides the migrate latch to the
+        # scheduler's migrate point, ``resize_inflight`` serializes
+        # placement changes (one per job) until the engine reports the
+        # outcome via :meth:`resize_done`
+        self.elastic: Optional[tuple] = None
+        self.resize_want: Optional[int] = None
+        self.resize_inflight: bool = False
+        self.resizes: int = 0
+        self.resize_rollbacks: int = 0
+        self.last_resize_error: Optional[str] = None
+        # placement timeline (grants, resizes, rollbacks) — surfaced
+        # as the job's ``sliceHistory`` metadata
+        self.slice_history: list = []
 
     # -- migration signal ----------------------------------------------
     def request_migrate(self, reason: str = "migrate") -> bool:
@@ -92,6 +107,68 @@ class CancelToken:
         with self._lock:
             reason, self.migrate_pending = self.migrate_pending, None
             return reason
+
+    # -- elastic resize signal -----------------------------------------
+    def request_resize(self, want: int, reason: str = "autoscale",
+                       ) -> bool:
+        """Latch a resize-via-migration request: the engine's next
+        epoch boundary releases the slice and re-acquires ``want``
+        devices. Refused (False) when the job is cancelled, another
+        migrate/resize is already in flight (one placement change per
+        job — a racing defrag or second resize coalesces), or ``want``
+        violates the declared elastic bounds (the scheduler never sees
+        a below-``min`` or above-``max`` target)."""
+        with self._lock:
+            if self.reason is not None or self._event.is_set():
+                return False
+            if self.migrate_pending is not None or self.resize_inflight:
+                return False
+            if self.elastic is not None:
+                lo, hi = self.elastic
+                if not lo <= int(want) <= hi:
+                    return False
+            self.resize_want = int(want)
+            self.resize_inflight = True
+            self.migrate_pending = f"resize:{reason}"
+            return True
+
+    def resize_done(self, ok: bool, devices=None,
+                    error: Optional[str] = None) -> None:
+        """Engine reports a consumed resize's outcome (state re-placed
+        on the new slice, or rolled back to an old-size one). Clears
+        the in-flight latch so the autoscaler may request again."""
+        with self._lock:
+            self.resize_want = None
+            self.resize_inflight = False
+            if self.migrate_pending is not None \
+                    and self.migrate_pending.startswith("resize:"):
+                # outcome reported before the engine consumed the
+                # latch (request refused downstream): drop it so the
+                # next placement change isn't wedged
+                self.migrate_pending = None
+            if ok:
+                self.resizes += 1
+            else:
+                self.resize_rollbacks += 1
+                self.last_resize_error = error
+            entry: Dict[str, Any] = {
+                "event": "resize" if ok else "rollback",
+                "devices": (list(devices)
+                            if devices is not None else None),
+                "wallTime": time.time()}
+            if error:
+                entry["error"] = error
+            self.slice_history.append(entry)
+
+    def record_placement(self, event: str, devices) -> None:
+        """Append a placement event (grant/migrate) to the job's
+        ``sliceHistory`` timeline."""
+        with self._lock:
+            self.slice_history.append({
+                "event": event,
+                "devices": (list(devices)
+                            if devices is not None else None),
+                "wallTime": time.time()})
 
     # -- cancellation --------------------------------------------------
     def cancel(self, reason: str = "cancelled") -> bool:
@@ -212,14 +289,26 @@ def perform_migrate():
     """Consume the pending request and run the installed migrate
     point. Returns ``(performed, new_devices)`` — ``(False, None)``
     when there was nothing to do. Called by the ENGINE after it has
-    snapshotted state off the devices (runtime/engine.py)."""
+    snapshotted state off the devices (runtime/engine.py). A pending
+    elastic resize threads its device-count target through to the
+    migrate point, which re-acquires at the new size."""
     token = current_cancel()
     fn = getattr(_tls, "migrate", None)
     if token is None or fn is None:
         return False, None
     if token.consume_migrate() is None:
         return False, None
+    want = token.resize_want
+    if want is not None:
+        return True, fn(want)
     return True, fn()
+
+
+def migrate_fn():
+    """The raw installed migrate point, if any. The engine's resize
+    ROLLBACK path calls it directly with the old device count after a
+    failed resize — no pending request needed."""
+    return getattr(_tls, "migrate", None)
 
 
 def snapshot():

@@ -1,0 +1,314 @@
+"""Elastic slice autoscaler (docs/SCALING.md "Elastic autoscaling").
+
+Jobs that declare ``sliceDevices: {"min": m, "max": M}`` opt into a
+closed policy loop that resizes them WHILE THEY RUN, through the same
+live-migration path defrag uses (services/migration.py): release the
+held slice at an epoch boundary, re-acquire at the new device count,
+re-shard the batch over it, resume bit-identically (per-step rng
+derives from the host step counter, so a resized run replays the same
+examples through the same fold_in keys).
+
+**Shrink** — triggered by cluster pressure, any of:
+
+- aged waiters (``agedWaiters > 0`` in the scheduler stats): a job
+  has sat past ``LO_SLICE_AGING`` and the packer still can't fit it;
+- a firing PAGE alert on the SLO watchdog (``servingP99`` burn-rate,
+  the ``hbmHeadroom`` floor) — capacity is hurting latency-sensitive
+  work, so batch elastic jobs give devices back.
+
+The policy shrinks the LARGEST elastic job by halving
+(:func:`shrink_target`), never below its declared ``min``. Shrink is
+the step BEFORE preemption on the degradation ladder
+(docs/RELIABILITY.md): an elastic job is never preempt-killed when a
+shrink suffices.
+
+**Grow** — only when the cluster is quiet (no waiters at all, no
+firing page) and free devices exist: the SMALLEST under-``max``
+elastic job doubles (:func:`grow_target`), bounded by its ``max``,
+by released-plus-free capacity, and STRICTLY below the mesh total —
+a whole-mesh request would convert the job to a gang grant the
+scheduler can't slice, so elastic jobs always leave one device of
+headroom.
+
+**Failure ladder.** A resize that fails mid-flight (lease race past
+``LO_RESIZE_GRANT_TIMEOUT``, injected ``autoscale_resize`` chaos
+fault, OOM placing state on the target mesh) is rolled back by the
+engine — the job re-lands on an old-size slice and KEEPS TRAINING —
+and fires an ``autoscaler:rollback`` incident bundle. This loop
+observes the rollback through the token's counters and applies
+per-job exponential backoff with full jitter (the PR-2 retry curve);
+after ``LO_AUTOSCALE_RETRIES`` consecutive rollbacks the job's
+resize ledger is DEAD-LETTERED — no further resizes are attempted
+for it, but the job itself is untouched and finishes normally.
+
+One placement change per job at a time: the token's
+``resize_inflight`` latch serializes this loop against defrag picks
+and double-fired policies (the loser coalesces into a refusal).
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+from typing import Any, Dict, Optional
+
+from learningorchestra_tpu.observability import export as obs_export
+
+SHRINK = "shrink"
+GROW = "grow"
+
+
+# ----------------------------------------------------------------------
+# pure policy targets (property-tested: never violate declared bounds)
+# ----------------------------------------------------------------------
+def shrink_target(current: int, min_devices: int) -> Optional[int]:
+    """The next smaller size for a job holding ``current`` devices
+    under pressure: halve, floored at the declared ``min``. None when
+    no shrink is possible (already at or below the floor)."""
+    current = int(current)
+    floor = max(1, int(min_devices))
+    if current <= floor:
+        return None
+    return max(floor, current // 2)
+
+
+def grow_target(current: int, max_devices: int, devices_free: int,
+                devices_total: int) -> Optional[int]:
+    """The next larger size for a job holding ``current`` devices on
+    a quiet cluster: double, capped by the declared ``max``, by what
+    the re-acquire can actually get (the job's own released block
+    plus the free pool), and STRICTLY below the mesh total — a
+    whole-mesh want becomes a gang grant the slice scheduler cannot
+    resize. None when no growth is possible."""
+    current = int(current)
+    ceiling = min(int(max_devices),
+                  current + max(0, int(devices_free)),
+                  int(devices_total) - 1)
+    if ceiling <= current:
+        return None
+    return min(current * 2, ceiling)
+
+
+class SliceAutoscaler:
+    """Closed-loop grow/shrink policy daemon over a JobManager's
+    elastic jobs. Owns one thread; all resize WORK happens on the job
+    threads themselves (the engine's epoch boundary), this loop only
+    latches requests and keeps the per-job backoff ledger."""
+
+    def __init__(self, jobs: Any,
+                 watchdog_fn=None,
+                 catalog: Any = None,
+                 interval_seconds: float = 1.0,
+                 retries: int = 3,
+                 backoff_seconds: float = 2.0,
+                 backoff_max_seconds: float = 30.0):
+        self._jobs = jobs
+        self._watchdog_fn = watchdog_fn or (lambda: None)
+        self._catalog = catalog
+        self._interval = max(0.05, float(interval_seconds))
+        self._retries = max(1, int(retries))
+        self._backoff = max(0.0, float(backoff_seconds))
+        self._backoff_max = max(self._backoff,
+                                float(backoff_max_seconds))
+        self._lock = threading.Lock()
+        # name -> {attempts, nextTrySeconds (monotonic), dead,
+        #          resizes, rollbacks, direction}
+        self._ledger: Dict[str, Dict[str, Any]] = {}
+        self._counters: Dict[str, int] = {
+            "shrinksRequested": 0, "growsRequested": 0,
+            "shrinksCompleted": 0, "growsCompleted": 0,
+            "rollbacks": 0, "deadLettered": 0, "ticks": 0}
+        self._last_signals: Dict[str, Any] = {}
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # ------------------------------------------------------------------
+    def start(self) -> "SliceAutoscaler":
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="lo-autoscaler")
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+
+    def _run(self) -> None:
+        while not self._stop.wait(self._interval):
+            try:
+                self.tick()
+            except Exception:  # noqa: BLE001 — policy must not die
+                import traceback
+                traceback.print_exc()
+
+    # ------------------------------------------------------------------
+    def _backoff_seconds(self, attempt: int) -> float:
+        """Exponential backoff with full jitter (PR-2 retry curve):
+        base * 2^attempt capped, scaled by uniform [0.5, 1.5)."""
+        if self._backoff <= 0:
+            return 0.0
+        base = min(self._backoff * (2 ** attempt), self._backoff_max)
+        return base * (0.5 + random.random())
+
+    def _settle_ledgers(self, candidates, now: float) -> None:
+        """Fold each token's resize counters into the per-job ledger:
+        a rollback delta burns an attempt (and arms backoff, or
+        dead-letters the job's RESIZE ledger past the budget); a
+        success delta resets the curve."""
+        for name, token in candidates:
+            led = self._ledger.setdefault(
+                name, {"attempts": 0, "nextTrySeconds": 0.0,
+                       "dead": False, "resizes": 0, "rollbacks": 0,
+                       "direction": None})
+            d_ok = token.resizes - led["resizes"]
+            d_roll = token.resize_rollbacks - led["rollbacks"]
+            led["resizes"] = token.resizes
+            led["rollbacks"] = token.resize_rollbacks
+            if d_ok > 0:
+                key = ("growsCompleted" if led["direction"] == GROW
+                       else "shrinksCompleted")
+                self._counters[key] += d_ok
+                led["attempts"] = 0
+                led["dead"] = False
+                led["nextTrySeconds"] = 0.0
+                self._stamp_history(name, token)
+            if d_roll > 0:
+                self._counters["rollbacks"] += d_roll
+                led["attempts"] += d_roll
+                self._stamp_history(name, token)
+                if led["attempts"] >= self._retries:
+                    if not led["dead"]:
+                        led["dead"] = True
+                        self._counters["deadLettered"] += 1
+                        obs_export.log_event(
+                            "autoscaler", "deadLettered",
+                            trace_id=name,
+                            attempts=led["attempts"],
+                            error=token.last_resize_error)
+                else:
+                    led["nextTrySeconds"] = now + \
+                        self._backoff_seconds(led["attempts"] - 1)
+
+    def _stamp_history(self, name: str, token) -> None:
+        """Surface the job's placement timeline on its metadata while
+        it is still RUNNING (terminal stamping happens in the job
+        manager) — best-effort, the catalog may be gone."""
+        if self._catalog is None:
+            return
+        try:
+            with token._lock:
+                history = [dict(e) for e in token.slice_history]
+            self._catalog.update_metadata(name,
+                                          {"sliceHistory": history})
+        except Exception:  # noqa: BLE001
+            pass
+
+    # ------------------------------------------------------------------
+    def tick(self) -> Optional[str]:
+        """One policy evaluation (public for deterministic tests).
+        Returns the name of the job a resize was latched on, else
+        None."""
+        with self._lock:
+            self._counters["ticks"] += 1
+        coordinator = self._jobs.migration
+        candidates = coordinator.elastic_jobs()
+        now = time.monotonic()
+        with self._lock:
+            self._settle_ledgers(candidates, now)
+        if not candidates:
+            return None
+        stats = self._jobs.scheduler_stats()
+        if not stats.get("sliced"):
+            return None  # counting mode: no device plane to resize on
+        watchdog = self._watchdog_fn()
+        page = bool(watchdog.page_firing()) if watchdog is not None \
+            else False
+        aged = int(stats.get("agedWaiters") or 0)
+        waiters = int(stats.get("waiters") or 0)
+        free = int(stats.get("devicesFree") or 0)
+        total = int(stats.get("devicesTotal") or 0)
+        with self._lock:
+            self._last_signals = {
+                "pageFiring": page, "agedWaiters": aged,
+                "waiters": waiters, "devicesFree": free,
+                "devicesTotal": total, "elasticJobs": len(candidates)}
+        if page or aged > 0:
+            return self._try_shrink(candidates, now,
+                                    reason=("sloPage" if page
+                                            else "agedWaiters"))
+        if waiters == 0 and free > 0:
+            return self._try_grow(candidates, now, free, total)
+        return None
+
+    def _eligible(self, name: str, token, now: float) -> bool:
+        with self._lock:
+            led = self._ledger.get(name) or {}
+        if led.get("dead") or now < led.get("nextTrySeconds", 0.0):
+            return False
+        return not token.resize_inflight \
+            and token.slice_devices is not None
+
+    def _try_shrink(self, candidates, now: float,
+                    reason: str) -> Optional[str]:
+        # largest holder first: one shrink frees the most devices
+        ordered = sorted(
+            [(name, token) for name, token in candidates
+             if self._eligible(name, token, now)],
+            key=lambda item: (-len(item[1].slice_devices), item[0]))
+        for name, token in ordered:
+            want = shrink_target(len(token.slice_devices),
+                                 token.elastic[0])
+            if want is None:
+                continue
+            if self._request(name, token, want, SHRINK, reason):
+                return name
+        return None
+
+    def _try_grow(self, candidates, now: float, free: int,
+                  total: int) -> Optional[str]:
+        # smallest holder first: fairness — the most-squeezed job
+        # recovers capacity before an already-large one doubles
+        ordered = sorted(
+            [(name, token) for name, token in candidates
+             if self._eligible(name, token, now)],
+            key=lambda item: (len(item[1].slice_devices), item[0]))
+        for name, token in ordered:
+            want = grow_target(len(token.slice_devices),
+                               token.elastic[1], free, total)
+            if want is None:
+                continue
+            if self._request(name, token, want, GROW, "quietCluster"):
+                return name
+        return None
+
+    def _request(self, name: str, token, want: int, direction: str,
+                 reason: str) -> bool:
+        if not self._jobs.request_resize(name, want,
+                                         reason=f"{direction}:{reason}"):
+            return False
+        with self._lock:
+            led = self._ledger.setdefault(
+                name, {"attempts": 0, "nextTrySeconds": 0.0,
+                       "dead": False, "resizes": token.resizes,
+                       "rollbacks": token.resize_rollbacks,
+                       "direction": None})
+            led["direction"] = direction
+            self._counters["shrinksRequested" if direction == SHRINK
+                           else "growsRequested"] += 1
+        return True
+
+    # ------------------------------------------------------------------
+    def stats(self) -> Dict[str, Any]:
+        """The ``GET /observability/autoscaler`` document."""
+        with self._lock:
+            counters = dict(self._counters)
+            signals = dict(self._last_signals)
+            ledger = {name: {k: v for k, v in led.items()}
+                      for name, led in self._ledger.items()}
+        return {"intervalSeconds": self._interval,
+                "retries": self._retries,
+                "counters": counters,
+                "signals": signals,
+                "jobs": ledger}

@@ -88,6 +88,10 @@ class ServiceContext:
         # & flight recorder"); LO_INCIDENTS=0 leaves it off. Must come
         # after the monitor so its snapshot collectors resolve.
         self.incidents, self._health_listener = _start_incidents(self)
+        # elastic slice autoscaler (docs/SCALING.md "Elastic
+        # autoscaling"); LO_AUTOSCALE=0 leaves it off. After the
+        # monitor so its watchdog accessor resolves.
+        self.autoscaler = _start_autoscaler(self)
 
     @property
     def draining(self) -> bool:
@@ -109,6 +113,10 @@ class ServiceContext:
 
     def close(self) -> None:
         self._draining = True
+        # policy loop first: it latches resize requests on job tokens
+        # the shutdown below is about to cancel
+        if self.autoscaler is not None:
+            self.autoscaler.stop()
         if self.incidents is not None:
             from learningorchestra_tpu.observability import \
                 incidents as obs_incidents
@@ -256,6 +264,29 @@ def _start_incidents(ctx: "ServiceContext"):
 
     health_lib.add_listener(on_health_event)
     return recorder, on_health_event
+
+
+def _start_autoscaler(ctx: "ServiceContext"):
+    """Start the elastic slice autoscaler policy loop
+    (docs/SCALING.md "Elastic autoscaling"). The watchdog accessor is
+    late-bound so LO_MONITOR=0 simply leaves the SLO pressure signal
+    out (aged-waiter pressure still drives shrinks). Returns None
+    when ``LO_AUTOSCALE=0``."""
+    if not getattr(ctx.config, "autoscale", True):
+        return None
+    from learningorchestra_tpu.services.autoscaler import \
+        SliceAutoscaler
+
+    def watchdog():
+        return getattr(ctx.monitor, "watchdog", None)
+
+    return SliceAutoscaler(
+        ctx.jobs, watchdog_fn=watchdog, catalog=ctx.catalog,
+        interval_seconds=ctx.config.autoscale_interval_seconds,
+        retries=ctx.config.autoscale_retries,
+        backoff_seconds=ctx.config.autoscale_backoff_seconds,
+        backoff_max_seconds=ctx.config.autoscale_backoff_max_seconds,
+    ).start()
 
 
 def _start_pod_guard(ctx: "ServiceContext", force: bool = False):

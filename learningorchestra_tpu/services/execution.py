@@ -168,9 +168,13 @@ class ExecutionService:
         description = body.get(DESCRIPTION_FIELD, "")
         timeout = V.valid_timeout(
             body.get(V.TIMEOUT_FIELD, meta.get(V.TIMEOUT_FIELD)))
+        stored_fp = meta.get(A.FOOTPRINT_FIELD) or {}
+        # elastic bounds outlive the re-run: a PATCH without an
+        # explicit sliceDevices keeps the stored {min, max}, not just
+        # the (possibly resized) flat device count
         slice_devices = V.valid_slice_devices(
             body.get(V.SLICE_DEVICES_FIELD,
-                     (meta.get(A.FOOTPRINT_FIELD) or {}).get("devices")))
+                     stored_fp.get("elastic") or stored_fp.get("devices")))
         health_policy = V.valid_health_policy(
             body.get(V.HEALTH_POLICY_FIELD,
                      meta.get(V.HEALTH_POLICY_FIELD)))
@@ -245,7 +249,13 @@ class ExecutionService:
                 self._ctx.catalog, root_meta, method, method_parameters)
         footprint = dict(estimate) if estimate else {}
         self._calibrate(footprint, root_meta, method)
-        if slice_devices is not None:
+        if isinstance(slice_devices, dict):
+            # elastic bounds: start at max (the job takes what it can
+            # and shrinks under pressure — services/autoscaler.py)
+            footprint["devices"] = int(slice_devices["max"])
+            footprint["elastic"] = {"min": int(slice_devices["min"]),
+                                    "max": int(slice_devices["max"])}
+        elif slice_devices is not None:
             footprint["devices"] = slice_devices
         return footprint or None
 

@@ -36,10 +36,17 @@ watchdog's ``servingP99`` alert path is testable end-to-end),
 ``ckpt_async_commit`` (runtime/async_ckpt.py, fired on the background
 commit worker — the failure must latch and re-raise on the TRAIN
 thread at its next save()/barrier, never kill or deadlock the
-worker) and ``migration`` (runtime/engine.py, fired at the top of a
+worker), ``migration`` (runtime/engine.py, fired at the top of a
 live slice migration before any state moved — surfaces as a
 transient attempt failure; the latched migrate request survives to
-the retry)."""
+the retry) and ``autoscale_resize`` (runtime/engine.py, fired inside
+an elastic resize's guarded region before the slice is released — the
+engine rolls the job back to its old slice and keeps training, the
+autoscaler backs off and retries; a transient spec (count 1) lets the
+retry succeed, a latched spec (large count) fails every attempt until
+the autoscaler's per-job retry budget dead-letters the RESIZE REQUEST
+while the job itself finishes untouched, docs/RELIABILITY.md
+"Degradation ladder")."""
 
 from __future__ import annotations
 

@@ -255,7 +255,9 @@ class JobManager:
 
     def _record_attribution(self, name: str,
                             footprint: Optional[Dict[str, Any]] = None,
-                            measure_hbm: bool = False) -> None:
+                            measure_hbm: bool = False,
+                            token: Optional[preempt.CancelToken] = None,
+                            ) -> None:
         """Roll trace-derived wall-clock attribution into the job's
         metadata (docs/LIFECYCLE.md): ``leaseWaitSeconds`` (mesh
         grant wait), ``compileSeconds`` (engine lowering/first-trace
@@ -294,6 +296,12 @@ class JobManager:
                     "mfu", "tflopsPerSecPerChip", "gbPerSecPerChip",
                     "arithmeticIntensity", "hbmBwUtil", "boundBy")
                     if k in perf_report}
+            if token is not None and token.slice_history:
+                # placement timeline (grants, resizes, rollbacks) —
+                # the "when did the autoscaler move my job" answer
+                with token._lock:
+                    meta["sliceHistory"] = \
+                        [dict(e) for e in token.slice_history]
             if meta:
                 self._catalog.update_metadata(name, meta)
         except Exception:  # noqa: BLE001 — observability is advisory
@@ -526,7 +534,8 @@ class JobManager:
                                              "attempt": attempt_no})))
                                 self._record_attribution(
                                     name, footprint,
-                                    measure_hbm=needs_mesh)
+                                    measure_hbm=needs_mesh,
+                                    token=token)
                                 obs_export.log_event(
                                     "job", "finished", trace_id=name,
                                     elapsedSeconds=round(
@@ -598,7 +607,8 @@ class JobManager:
                                             D.STATUS_DEAD_LETTERED)
                                     self._record_attribution(
                                         name, footprint,
-                                        measure_hbm=needs_mesh)
+                                        measure_hbm=needs_mesh,
+                                        token=token)
                                     obs_export.log_event(
                                         "job", "failed", trace_id=name,
                                         errorKind=kind,
@@ -729,6 +739,24 @@ class JobManager:
         Returns False when no live migratable mesh job exists under
         that name."""
         return self._migration.request(name, reason)
+
+    def request_resize(self, name: str, want: int,
+                       reason: str = "autoscale") -> bool:
+        """Latch an elastic resize on mesh job ``name`` (the
+        autoscaler's backend, services/autoscaler.py): the engine's
+        next epoch boundary re-acquires a ``want``-device slice
+        through the migrate path, rolling back to the old footprint
+        on failure. Returns False when no live elastic job exists
+        under that name, ``want`` violates its declared bounds, or a
+        placement change is already in flight."""
+        return self._migration.request_resize(name, want, reason)
+
+    @property
+    def migration(self):
+        """The shared MigrationCoordinator — the autoscaler reads its
+        ``elastic_jobs()`` candidate set and latches resizes through
+        the same serialization as defrag picks."""
+        return self._migration
 
     def migration_stats(self) -> Dict[str, int]:
         """Monotonic migration counters (requested/refused/defrag)."""

@@ -57,6 +57,8 @@ class MigrationCoordinator:
         self._requested = 0
         self._refused = 0
         self._defrag_picks = 0
+        self._resizes_requested = 0
+        self._resizes_refused = 0
 
     # ------------------------------------------------------------------
     def _live_tokens(self):
@@ -73,12 +75,12 @@ class MigrationCoordinator:
         live mesh job exists under that name, the job is not
         migratable (whole-mesh grant, counting mode, multi-host), or
         it is already cancelled / already migrating."""
-        token: Optional[preempt.CancelToken] = None
-        for job_name, job_token in self._live_tokens():
-            if job_name == name:
-                token = job_token
-                break
-        if token is None or not token.migratable or token.cancelled():
+        token = self._token_for(name)
+        if token is None or not token.migratable or token.cancelled() \
+                or token.resize_inflight:
+            # resize_inflight: one placement change per job — a
+            # defrag migrate racing an in-flight elastic resize
+            # coalesces into a refusal instead of double-moving
             with self._lock:
                 self._refused += 1
             return False
@@ -92,6 +94,45 @@ class MigrationCoordinator:
                              reason=reason)
         return True
 
+    def _token_for(self, name: str
+                   ) -> Optional[preempt.CancelToken]:
+        for job_name, job_token in self._live_tokens():
+            if job_name == name:
+                return job_token
+        return None
+
+    def request_resize(self, name: str, want: int,
+                       reason: str = "autoscale") -> bool:
+        """Latch an elastic resize on job ``name`` (the autoscaler's
+        backend): the engine's next epoch boundary re-acquires a
+        ``want``-device slice through the migrate path. Serialized
+        with plain migrates through the token's single latch — a
+        second resize or a racing defrag pick coalesces (refused)
+        while one is in flight, and the token itself rejects targets
+        outside the declared ``{min, max}`` bounds."""
+        token = self._token_for(name)
+        if token is None or not token.migratable \
+                or token.cancelled() or token.elastic is None:
+            with self._lock:
+                self._resizes_refused += 1
+            return False
+        if not token.request_resize(int(want), reason):
+            with self._lock:
+                self._resizes_refused += 1
+            return False
+        with self._lock:
+            self._resizes_requested += 1
+        obs_export.log_event("autoscaler", "resize", trace_id=name,
+                             want=int(want), reason=reason)
+        return True
+
+    def elastic_jobs(self):
+        """[(name, token)] of live migratable jobs that declared
+        elastic bounds — the autoscaler's candidate set."""
+        return [(name, token) for name, token in self._live_tokens()
+                if token.elastic is not None and token.migratable
+                and not token.cancelled()]
+
     # ------------------------------------------------------------------
     def defrag_pick(self, want: Optional[int] = None) -> Optional[str]:
         """Scheduler defrag callback (lock NOT held): ask the cheapest
@@ -104,7 +145,8 @@ class MigrationCoordinator:
             (name, token) for name, token in self._live_tokens()
             if token.migratable and not token.cancelled()
             and token.slice_devices is not None
-            and token.migrate_pending is None]
+            and token.migrate_pending is None
+            and not token.resize_inflight]
         candidates.sort(key=lambda item: (len(item[1].slice_devices),
                                           item[0]))
         for name, token in candidates:
@@ -122,4 +164,6 @@ class MigrationCoordinator:
         with self._lock:
             return {"requested": self._requested,
                     "refused": self._refused,
-                    "defragPicks": self._defrag_picks}
+                    "defragPicks": self._defrag_picks,
+                    "resizesRequested": self._resizes_requested,
+                    "resizesRefused": self._resizes_refused}

@@ -89,6 +89,13 @@ def parse_pool_weights(spec: str) -> Dict[str, float]:
 _NOFIT = object()
 
 
+class GrantTimeout(Exception):
+    """A bounded :meth:`SliceLease.acquire` expired before a grant.
+    Only raised when ``timeout=`` was passed — the elastic resize path
+    uses it so a lease race (the freed devices got claimed) rolls the
+    job back to an old-size slice instead of wedging the fit."""
+
+
 class Grant:
     """A claimed (or reserved) allocation: ``devices`` is a tuple of
     indices into the default mesh's flat device order, or ``None``
@@ -381,7 +388,8 @@ class SliceLease:
     def acquire(self, pool: str = "default",
                 cancel: Optional["preempt.CancelToken"] = None,
                 footprint: Optional[Dict[str, Any]] = None,
-                exact: Optional[Sequence[int]] = None) -> Grant:
+                exact: Optional[Sequence[int]] = None,
+                timeout: Optional[float] = None) -> Grant:
         """Block until granted; returns the :class:`Grant` (``devices``
         None = full mesh). With a ``cancel`` token the wait is
         cooperative: a cancelled/expired job raises
@@ -389,8 +397,12 @@ class SliceLease:
         a lease it can no longer use, and a grant (with its device
         reservation) that races the cancellation is handed back to the
         next waiter. ``exact`` re-acquires a specific device block
-        (post-yield: the job's arrays still live on it)."""
+        (post-yield: the job's arrays still live on it). ``timeout``
+        bounds the wait: past it the waiter is withdrawn and
+        :class:`GrantTimeout` raised (the elastic resize path — a
+        grant that never comes must not wedge the job)."""
         t0 = time.monotonic()
+        deadline = None if timeout is None else t0 + float(timeout)
         with self._cv:
             if self._sliced:
                 self._ensure_devices_locked()
@@ -407,7 +419,8 @@ class SliceLease:
             self._grant_next()
             last_defrag = 0.0
             while seq not in self._granted:
-                self._cv.wait(0.1 if cancel is not None else None)
+                self._cv.wait(0.1 if cancel is not None
+                              or deadline is not None else None)
                 if cancel is not None and cancel.cancelled():
                     grant = self._granted.pop(seq, None)
                     if grant is not None:
@@ -420,9 +433,18 @@ class SliceLease:
                     raise preempt.JobCancelled(
                         cancel.reason or "cancelled",
                         "cancelled while waiting for the mesh lease")
-                if seq not in self._granted:
-                    last_defrag = self._maybe_defrag_locked(
-                        waiter, last_defrag)
+                if seq in self._granted:
+                    break
+                if deadline is not None and \
+                        time.monotonic() >= deadline:
+                    if waiter in self._waiters:
+                        self._waiters.remove(waiter)
+                    self._grant_next()
+                    raise GrantTimeout(
+                        f"no {want or 'gang'}-device grant within "
+                        f"{timeout}s (pool {pool})")
+                last_defrag = self._maybe_defrag_locked(
+                    waiter, last_defrag)
             grant = self._granted.pop(seq)
             self._holders[seq] = grant
             grant.wait_seconds = time.monotonic() - t0
@@ -512,6 +534,11 @@ class SliceLease:
                 # capacity exists but is shredded into unusable holes
                 if free_n:
                     fragmentation = round(1.0 - largest / free_n, 6)
+            now = time.monotonic()
+            aged = sum(1 for w in self._waiters if self._aging
+                       and now - w.enqueued >= self._aging)
+            oldest = max((now - w.enqueued for w in self._waiters),
+                         default=0.0)
             return {
                 "sliced": self._sliced,
                 "capacity": self._capacity,
@@ -521,6 +548,8 @@ class SliceLease:
                 "largestFreeRun": largest,
                 "fragmentation": fragmentation,
                 "waiters": len(self._waiters),
+                "agedWaiters": aged,
+                "oldestWaitSeconds": round(oldest, 6),
                 "defrags": self._defrags,
                 "grantsByPool": dict(self._grants_by_pool),
                 "leaseWaitSum": self._wait_sum,
@@ -553,6 +582,10 @@ class SliceLease:
         current = [grant]
         start = [time.monotonic()]
         held = [True]
+        # mutable footprint holder: a successful elastic resize
+        # rewrites the size every later migrate/re-acquire uses
+        fp = [dict(footprint) if isinstance(footprint, dict)
+              else footprint]
         can_yield = _yield_enabled()
         if cancel is not None:
             # advertise migratability (services/migration.py reads
@@ -561,6 +594,12 @@ class SliceLease:
             cancel.slice_devices = grant.devices
             cancel.migratable = (can_yield and self._sliced
                                  and grant.devices is not None)
+            elastic = (footprint or {}).get("elastic") \
+                if isinstance(footprint, dict) else None
+            if isinstance(elastic, dict) and cancel.migratable:
+                cancel.elastic = (int(elastic["min"]),
+                                  int(elastic["max"]))
+            cancel.record_placement("grant", grant.devices)
 
         def yield_point() -> None:
             if not can_yield or not self.contended_by_other(pool):
@@ -578,27 +617,51 @@ class SliceLease:
             token.preempted_seconds += start[0] - t_wait
             token.yields += 1
 
-        def migrate_point() -> Optional[Tuple[int, ...]]:
+        def migrate_point(want: Optional[int] = None,
+                          ) -> Optional[Tuple[int, ...]]:
             # unlike yield_point this re-acquire is NOT exact=: the
             # job ABANDONS its device block (starved waiters may claim
             # it) and comes back wherever the packer now fits the same
             # footprint. The engine has already snapshotted state off
             # the devices before preempt.perform_migrate() lands here.
+            # ``want`` (elastic resize) re-acquires at a NEW device
+            # count instead, under a bounded wait — a lease race rolls
+            # back to an old-footprint slice, so the job always holds
+            # a valid grant when this returns OR raises GrantTimeout.
             self.release(pool, time.monotonic() - start[0],
                          grant=current[0])
             held[0] = False
             t_wait = time.monotonic()
-            current[0] = self.acquire(pool, cancel,
-                                      footprint=footprint)
+            timed_out: Optional[GrantTimeout] = None
+            if want is None:
+                new_grant = self.acquire(pool, cancel,
+                                         footprint=fp[0])
+            else:
+                from learningorchestra_tpu.config import get_config
+
+                new_fp = dict(fp[0]) if isinstance(fp[0], dict) else {}
+                new_fp["devices"] = int(want)
+                try:
+                    new_grant = self.acquire(
+                        pool, cancel, footprint=new_fp,
+                        timeout=get_config().resize_grant_timeout)
+                    fp[0] = new_fp
+                except GrantTimeout as exc:
+                    timed_out = exc
+                    new_grant = self.acquire(pool, cancel,
+                                             footprint=fp[0])
+            current[0] = new_grant
             held[0] = True
             start[0] = time.monotonic()
             token.preempted_seconds += start[0] - t_wait
             token.migrations += 1
-            token.devices = current[0].devices
+            token.devices = new_grant.devices
             if cancel is not None:
-                cancel.slice_devices = current[0].devices
+                cancel.slice_devices = new_grant.devices
                 cancel.migrations += 1
-            return current[0].devices
+            if timed_out is not None:
+                raise timed_out
+            return new_grant.devices
 
         previous = preempt.snapshot()
         preempt.install(

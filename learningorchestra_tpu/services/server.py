@@ -341,6 +341,11 @@ class Api:
         out["meshScheduler"] = self.ctx.jobs.scheduler_stats()
         # live migration between slices (docs/SCALING.md §7)
         out["migrationStats"] = self.ctx.jobs.migration_stats()
+        # elastic slice autoscaler (docs/SCALING.md "Elastic
+        # autoscaling"); absent when LO_AUTOSCALE=0
+        autoscaler = getattr(self.ctx, "autoscaler", None)
+        if autoscaler is not None:
+            out["autoscaler"] = autoscaler.stats()
         # feature-plane cache tiers (docs/PERFORMANCE.md). Lazy
         # imports: arena/engine stats never initialize a backend.
         out["featureCache"] = self.ctx.features.stats()
@@ -656,6 +661,23 @@ class Api:
                     lines.append(
                         f'lo_alert_firing{{alert="{esc(alert["name"])}"'
                         f',severity="{esc(alert["severity"])}"}} 1')
+        # elastic autoscaler counters (absent when LO_AUTOSCALE=0)
+        autoscaler = m.get("autoscaler")
+        if autoscaler is not None:
+            counters = autoscaler.get("counters") or {}
+            lines += [
+                "# TYPE lo_autoscaler_resizes_total counter",
+                f'lo_autoscaler_resizes_total{{direction="shrink"}} '
+                f"{counters.get('shrinksCompleted', 0)}",
+                f'lo_autoscaler_resizes_total{{direction="grow"}} '
+                f"{counters.get('growsCompleted', 0)}",
+                "# TYPE lo_autoscaler_rollbacks_total counter",
+                f"lo_autoscaler_rollbacks_total "
+                f"{counters.get('rollbacks', 0)}",
+                "# TYPE lo_autoscaler_dead_lettered_total counter",
+                f"lo_autoscaler_dead_lettered_total "
+                f"{counters.get('deadLettered', 0)}",
+            ]
         # incident flight recorder (absent when LO_INCIDENTS=0)
         incidents = m.get("incidents")
         if incidents is not None:
@@ -749,6 +771,10 @@ class Api:
           rings (HBM, arena, slices, queues, RSS)
         - ``GET /observability/alerts``             SLO objectives +
           firing/ resolved alert history
+        - ``GET /observability/autoscaler``         elastic-resize
+          policy state: counters, last pressure signals, per-job
+          backoff/dead-letter ledger (docs/SCALING.md "Elastic
+          autoscaling")
         - ``GET /observability/perf``               jobs with perf
           reports + platform peaks
         - ``GET /observability/perf/{name}``        roofline report
@@ -871,6 +897,15 @@ class Api:
                     V.HTTP_NOT_FOUND,
                     "SLO watchdog disabled (LO_MONITOR=0)")
             return 200, watchdog.snapshot(), "application/json"
+        if kind == "autoscaler":
+            autoscaler = getattr(self.ctx, "autoscaler", None)
+            if autoscaler is None:
+                raise V.HttpError(
+                    V.HTTP_NOT_FOUND,
+                    "elastic autoscaler disabled (LO_AUTOSCALE=0)")
+            doc = autoscaler.stats()
+            doc["migration"] = self.ctx.jobs.migration_stats()
+            return 200, doc, "application/json"
         return 404, {"result": "unknown route"}, "application/json"
 
     # ------------------------------------------------------------------

@@ -78,18 +78,45 @@ def valid_timeout(value: Any) -> Optional[float]:
 SLICE_DEVICES_FIELD = "sliceDevices"
 
 
-def valid_slice_devices(value: Any) -> Optional[int]:
+def valid_slice_devices(value: Any):
     """Optional explicit device-footprint request field: a positive
     integer count of mesh devices this job needs (the slice scheduler
-    packs it onto a sub-mesh that size), or None (footprint comes from
-    the preflight estimate, else the job gang-acquires)."""
+    packs it onto a sub-mesh that size), an ELASTIC bounds object
+    ``{"min": m, "max": M}`` (the job starts at ``max`` and the
+    autoscaler may resize it within the declared bounds,
+    docs/SCALING.md "Elastic autoscaling"), or None (footprint comes
+    from the preflight estimate, else the job gang-acquires). Returns
+    the normalized int / ``{"min", "max"}`` dict (stored on job
+    metadata for boot replay)."""
     if value is None:
         return None
+    if isinstance(value, dict):
+        unknown = set(value) - {"min", "max"}
+        if unknown:
+            raise HttpError(
+                HTTP_NOT_ACCEPTABLE,
+                f"{MESSAGE_INVALID_FIELD}: sliceDevices has unknown "
+                f"keys {sorted(unknown)} (want {{'min', 'max'}})")
+        lo, hi = value.get("min"), value.get("max")
+        for name, bound in (("min", lo), ("max", hi)):
+            if isinstance(bound, bool) or not isinstance(bound, int) \
+                    or bound <= 0:
+                raise HttpError(
+                    HTTP_NOT_ACCEPTABLE,
+                    f"{MESSAGE_INVALID_FIELD}: sliceDevices.{name} must "
+                    f"be a positive integer device count, got {bound!r}")
+        if lo > hi:
+            raise HttpError(
+                HTTP_NOT_ACCEPTABLE,
+                f"{MESSAGE_INVALID_FIELD}: sliceDevices.min ({lo}) must "
+                f"not exceed sliceDevices.max ({hi})")
+        return {"min": int(lo), "max": int(hi)}
     if isinstance(value, bool) or not isinstance(value, int) or value <= 0:
         raise HttpError(
             HTTP_NOT_ACCEPTABLE,
             f"{MESSAGE_INVALID_FIELD}: sliceDevices must be a positive "
-            f"integer device count, got {value!r}")
+            f"integer device count or {{'min', 'max'}} bounds object, "
+            f"got {value!r}")
     return int(value)
 
 

@@ -350,3 +350,29 @@ def test_boot_recovery_requeues_worker_lost(tmp_config):
         assert meta[D.FINISHED_FIELD] is True, meta
     finally:
         api2.ctx.close()
+
+
+def test_boot_replays_elastic_slice_bounds(tmp_config):
+    """A stored elastic footprint (``sliceDevices: {min, max}``) must
+    survive a boot requeue intact: the re-submitted job carries the
+    same elastic bounds into the slice scheduler — not a collapsed
+    rigid size — so the autoscaler can keep resizing it after a
+    restart (docs/SCALING.md "Elastic autoscaling")."""
+    from learningorchestra_tpu.services.server import Api
+
+    api = Api()
+    try:
+        api.ctx.catalog.create_collection(
+            "elastic_boot", "train/tensorflow", {
+                D.PARENT_NAME_FIELD: "eb_model",
+                D.METHOD_FIELD: "fit",
+                D.METHOD_PARAMETERS_FIELD: {"x": [[1.0]], "y": [0]},
+                "footprint": {"devices": 4,
+                              "elastic": {"min": 2, "max": 4}}})
+        out = api.recover_unfinished()
+        assert "elastic_boot" in out["requeued"], out
+        fp = api.ctx.jobs._job_info["elastic_boot"]["footprint"]
+        assert fp["elastic"] == {"min": 2, "max": 4}, fp
+        assert fp["devices"] == 4
+    finally:
+        api.ctx.close()
