@@ -96,7 +96,7 @@ PHASE_TIMEOUTS = {"cnn": 600, "lstm": 600, "tlm": 900, "proxy": 120,
                   "builder": 600, "builder_mesh": 600,
                   "warm_pipeline": 600, "concurrent_jobs": 600,
                   "flash": 600, "ingest": 600, "gen": 900,
-                  "serving": 900,
+                  "serving": 900, "paged_serving": 900,
                   "sentinel_overhead": 600, "sentinel_chaos": 600,
                   "obs_overhead": 600, "monitor_smoke": 600,
                   "incident_smoke": 600,
@@ -556,6 +556,210 @@ def phase_serving():
             "predict_submit_poll_p50_ms": round(poll_p50 * 1e3, 1),
             "predict_serving_p50_ms": round(serve_p50 * 1e3, 2),
             "predict_speedup": round(poll_p50 / serve_p50, 1),
+        })
+    finally:
+        api.ctx.serving.close()
+        api.ctx.jobs.shutdown()
+    return out
+
+
+def phase_paged_serving():
+    """Paged KV pool vs the contiguous slot cache at the SAME HBM
+    budget (docs/SERVING.md "Paged KV serving"). Capacity half:
+    identical short-request traffic against (a) a slot session whose
+    KV is slots x cacheLen and (b) a paged session holding exactly the
+    same page budget with lanes sized to actual token demand; the gate
+    is the measured peak of simultaneously-decoding streams (paged
+    >= 2x slot at equal memory — paged admission reserves
+    ceil(tokens/pageLen) pages, not a whole worst-case slot). QoS
+    half: an abusive tenant floods page-heavy requests while a victim
+    tenant sends small ones through the same small pool — only the
+    bully may be 429'd (its own weighted-fair quota), the victim takes
+    zero rejections and its per-tenant servingP99 objective must not
+    fire."""
+    import concurrent.futures
+    import threading
+
+    import jax
+    import numpy as np
+
+    from learningorchestra_tpu.models.transformer import LanguageModel
+
+    slots = int(os.environ.get("LO_BENCH_PAGED_SLOTS", "4"))
+    cache_len = int(os.environ.get("LO_BENCH_PAGED_CACHE", "64"))
+    page_len = int(os.environ.get("LO_BENCH_PAGED_PAGE_LEN", "16"))
+    prompt_len = int(os.environ.get("LO_BENCH_PAGED_PROMPT", "8"))
+    new = int(os.environ.get("LO_BENCH_PAGED_TOKENS", "8"))
+    reqs = int(os.environ.get("LO_BENCH_PAGED_REQS", "4"))
+    # per-tenant servingP99 objectives need a nonzero threshold to be
+    # evaluable (Config is built from env by _make_api below)
+    os.environ.setdefault(
+        "LO_SLO_SERVING_P99_MS",
+        os.environ.get("LO_BENCH_PAGED_SLO_MS", "5000"))
+    api, prefix = _make_api()
+
+    tokens_per_req = prompt_len + new
+    pages_per_req = -(-tokens_per_req // page_len)
+    # equal HBM: the paged pool gets exactly the slot cache's token
+    # budget; its lane count is what that budget admits when a stream
+    # only reserves the pages it can actually touch
+    budget_pages = slots * cache_len // page_len
+    paged_slots = budget_pages // pages_per_req
+    out = {"platform": jax.devices()[0].platform,
+           "slot_slots": slots, "paged_slots": paged_slots,
+           "cache_len": cache_len, "page_len": page_len,
+           "budget_pages": budget_pages, "prompt_len": prompt_len,
+           "new_tokens": new, "requests_per_stream": reqs}
+    try:
+        cfg = dict(TLM_CFG)
+        cfg["max_len"] = cache_len
+        lm = LanguageModel(**cfg)
+        rng = np.random.default_rng(0)
+        seed_tokens = rng.integers(
+            1, cfg["vocab_size"], size=(4, 128)).astype(np.int32)
+        lm.fit(seed_tokens, batch_size=4, epochs=1)
+        api.ctx.artifacts.save(lm, "paged_lm", "train/tensorflow")
+
+        def _drive(n_clients):
+            """n_clients concurrent streams x reqs unique-prompt
+            requests each; returns (peak simultaneous active streams,
+            wall seconds)."""
+            sess = api.ctx.serving._sessions["paged_lm"]
+            stop = threading.Event()
+            peak = [0]
+
+            def poll():
+                while not stop.is_set():
+                    active = sum(1 for r in sess._slot_req
+                                 if r is not None)
+                    if active > peak[0]:
+                        peak[0] = active
+                    time.sleep(0.0002)
+
+            def client(k):
+                for j in range(reqs):
+                    prompt = [int(t) for t in np.random.default_rng(
+                        1000 + k * 97 + j).integers(
+                        1, cfg["vocab_size"], size=prompt_len)]
+                    s2, b2, _ = api.dispatch(
+                        "POST", f"{prefix}/serve/paged_lm/predict",
+                        {}, {"prompt": prompt, "maxNewTokens": new,
+                             "seed": k * 100 + j})
+                    if s2 != 200:
+                        raise RuntimeError(f"predict failed: {s2} {b2}")
+
+            client(0)  # pay the prefill/step compile outside the clock
+            poller = threading.Thread(target=poll, daemon=True)
+            poller.start()
+            t0 = time.perf_counter()
+            with concurrent.futures.ThreadPoolExecutor(
+                    n_clients) as pool:
+                list(pool.map(client, range(1, n_clients + 1)))
+            dt = time.perf_counter() - t0
+            stop.set()
+            poller.join(timeout=5)
+            return peak[0], dt
+
+        # ---- slot baseline: slots lanes, each a cache_len reservation
+        status, body, _ = api.dispatch(
+            "POST", f"{prefix}/serve/paged_lm", {}, {
+                "maxSlots": slots, "cacheLen": cache_len,
+                "temperature": 0.8, "topK": 50})
+        _expect_created(status, body)
+        slot_bytes = api.ctx.serving._sessions["paged_lm"]._cache_bytes
+        slot_peak, slot_dt = _drive(paged_slots)
+        api.dispatch("DELETE", f"{prefix}/serve/paged_lm", {}, None)
+
+        # ---- paged: same page budget (plus the reserved trash page),
+        # lanes sized to demand
+        status, body, _ = api.dispatch(
+            "POST", f"{prefix}/serve/paged_lm", {}, {
+                "kv": "paged", "maxSlots": paged_slots,
+                "cacheLen": cache_len, "pageLen": page_len,
+                "pages": budget_pages + 1,
+                "temperature": 0.8, "topK": 50})
+        _expect_created(status, body)
+        paged_bytes = api.ctx.serving._sessions[
+            "paged_lm"]._cache_bytes
+        paged_peak, paged_dt = _drive(paged_slots)
+        _, pstats, _ = api.dispatch(
+            "GET", f"{prefix}/serve/paged_lm", {}, None)
+        total_tokens = (paged_slots * reqs) * new
+        out.update({
+            "slot_kv_bytes": slot_bytes,
+            "paged_kv_bytes": paged_bytes,
+            "slot_peak_streams": slot_peak,
+            "paged_peak_streams": paged_peak,
+            "streams_vs_slot": round(paged_peak / max(1, slot_peak), 2),
+            "slot_decode_tokens_per_sec": round(
+                total_tokens / slot_dt, 1),
+            "paged_decode_tokens_per_sec": round(
+                total_tokens / paged_dt, 1),
+            "prefix_pages_reused":
+                pstats["kv"]["prefix"]["pagesReused"],
+            "pool_alloc_failures": pstats["kv"]["allocFailures"],
+        })
+        api.dispatch("DELETE", f"{prefix}/serve/paged_lm", {}, None)
+
+        # ---- QoS chaos: a 12-usable-page pool shared by a bully
+        # (3-page requests from 6 threads) and a victim (1-page
+        # requests). Weighted-fair quota caps the bully at half the
+        # pool; the victim must never be rejected or paged.
+        status, body, _ = api.dispatch(
+            "POST", f"{prefix}/serve/paged_lm", {}, {
+                "kv": "paged", "maxSlots": 8, "cacheLen": cache_len,
+                "pageLen": page_len, "pages": 13,
+                "temperature": 0.8, "topK": 50})
+        _expect_created(status, body)
+        bully_new = 3 * page_len - prompt_len  # 3 pages per request
+        counts = {"bully": [0, 0], "victim": [0, 0]}  # [ok, rejected]
+        lock = threading.Lock()
+
+        def chaos_client(tenant, n, new_toks, k):
+            for j in range(n):
+                prompt = [int(t) for t in np.random.default_rng(
+                    5000 + k * 131 + j).integers(
+                    1, cfg["vocab_size"], size=prompt_len)]
+                s2, b2, _ = api.dispatch(
+                    "POST", f"{prefix}/serve/paged_lm/predict", {}, {
+                        "prompt": prompt, "maxNewTokens": new_toks,
+                        "seed": k * 100 + j, "tenant": tenant})
+                if s2 not in (200, 429):
+                    raise RuntimeError(f"{tenant}: {s2} {b2}")
+                with lock:
+                    counts[tenant][0 if s2 == 200 else 1] += 1
+
+        threads = [threading.Thread(
+            target=chaos_client, args=("bully", reqs, bully_new, k))
+            for k in range(6)]
+        threads += [threading.Thread(
+            target=chaos_client, args=("victim", reqs + 2, new, 10 + k))
+            for k in range(2)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=300)
+
+        _, cstats, _ = api.dispatch(
+            "GET", f"{prefix}/serve/paged_lm", {}, None)
+        tenants = cstats["kv"]["tenants"]
+
+        from learningorchestra_tpu.observability.slo import SloWatchdog
+
+        wd = SloWatchdog()
+        wd.evaluate()
+        firing = [a["name"] for a in wd.firing()]
+        out.update({
+            "bully_ok": counts["bully"][0],
+            "bully_rejected": counts["bully"][1],
+            "victim_ok": counts["victim"][0],
+            "victim_rejected": counts["victim"][1],
+            "bully_p99_ms": tenants.get("bully", {}).get(
+                "latency", {}).get("p99Ms"),
+            "victim_p99_ms": tenants.get("victim", {}).get(
+                "latency", {}).get("p99Ms"),
+            "victim_slo_fired": "servingP99:victim" in firing,
+            "slo_firing": firing,
         })
     finally:
         api.ctx.serving.close()
@@ -2412,6 +2616,7 @@ PHASES = {"cnn": phase_cnn, "lstm": phase_lstm, "tlm": phase_tlm,
           "concurrent_jobs": phase_concurrent_jobs,
           "flash": phase_flash, "ingest": phase_ingest,
           "gen": phase_gen, "serving": phase_serving,
+          "paged_serving": phase_paged_serving,
           "sentinel_overhead": phase_sentinel_overhead,
           "sentinel_chaos": phase_sentinel_chaos,
           "obs_overhead": phase_obs_overhead,
@@ -2731,6 +2936,10 @@ def main(argv=None):
         "serving", None if tpu_ok else serve_cpu_env,
         metrics=("decode_tokens_per_sec", "speedup_vs_solo", "p99_ms",
                  "predict_speedup"))
+    models["paged_serving"] = _run_phase_repeated(
+        "paged_serving", None if tpu_ok else cpu_env,
+        metrics=("streams_vs_slot", "paged_peak_streams",
+                 "paged_decode_tokens_per_sec", "victim_p99_ms"))
     models["sweep_fusion"] = _run_phase_repeated(
         "sweep_fusion", env,
         metrics=("speedup", "fused_seconds", "serial_seconds"))
@@ -2832,6 +3041,8 @@ def main(argv=None):
             tlm.get("tflops_per_sec_per_chip"),
         "serving_speedup_vs_solo":
             models.get("serving", {}).get("speedup_vs_solo"),
+        "paged_streams_vs_slot":
+            models.get("paged_serving", {}).get("streams_vs_slot"),
         "full_report": report_path,
     }
     print(json.dumps(compact))
@@ -2893,6 +3104,18 @@ def _write_md(path, report):
                 f"{stats.get('predict_serving_p50_ms')}ms "
                 f"({stats.get('predict_speedup', '—')}× vs "
                 f"submit→poll) |")
+            continue
+        if name == "paged_serving":
+            lines.append(
+                f"| {name} (paged KV vs slot, equal HBM) "
+                f"| {stats.get('platform', '?')} "
+                f"| {stats.get('paged_decode_tokens_per_sec', '—')} "
+                f"tok/s | — | — | — | — "
+                f"| peak streams {stats.get('paged_peak_streams')} vs "
+                f"{stats.get('slot_peak_streams')} slot "
+                f"({stats.get('streams_vs_slot', '—')}×), victim p99="
+                f"{stats.get('victim_p99_ms')}ms, bully 429s="
+                f"{stats.get('bully_rejected')} |")
             continue
         if name == "csv_ingest":
             lines.append(

@@ -272,12 +272,13 @@ CHAOS_OUT="$(mktemp)"
 OVERHEAD_OUT="$(mktemp)"
 OBS_OUT="$(mktemp)"
 SERVE_OUT="$(mktemp)"
+PAGED_OUT="$(mktemp)"
 SWEEP_OUT="$(mktemp)"
 MONITOR_OUT="$(mktemp)"
 INCIDENT_OUT="$(mktemp)"
 ROOFLINE_OUT="$(mktemp)"
 XRAY_OUT="$(mktemp)"
-trap 'rm -rf "$PERF_CACHE" "$PERF_OUT" "$SLICE_OUT" "$CKPT_OUT" "$MIG_OUT" "$ELASTIC_OUT" "$CHAOS_OUT" "$OVERHEAD_OUT" "$OBS_OUT" "$SERVE_OUT" "$SWEEP_OUT" "$MONITOR_OUT" "$ROOFLINE_OUT" "$XRAY_OUT"' EXIT
+trap 'rm -rf "$PERF_CACHE" "$PERF_OUT" "$SLICE_OUT" "$CKPT_OUT" "$MIG_OUT" "$ELASTIC_OUT" "$CHAOS_OUT" "$OVERHEAD_OUT" "$OBS_OUT" "$SERVE_OUT" "$PAGED_OUT" "$SWEEP_OUT" "$MONITOR_OUT" "$ROOFLINE_OUT" "$XRAY_OUT"' EXIT
 timeout -k 10 "$SENTINEL_TIMEOUT" env JAX_PLATFORMS=cpu \
     JAX_COMPILATION_CACHE_DIR="$PERF_CACHE" \
     LO_COMPUTE_DTYPE=float32 \
@@ -418,6 +419,54 @@ assert p50 <= 100, (
 print(f"serving-smoke: OK (decode {decode}x solo, "
       f"p99 {result['p99_ms']}ms over {result['streams']} streams, "
       f"clf predict {pspeed}x vs submit->poll, p50 {p50}ms)")
+EOF
+
+echo "== paged-smoke: paged KV must beat slot KV at equal HBM =="
+# Paged KV pool vs the contiguous slot cache on the SAME page budget,
+# plus an abusive-tenant chaos run through one shared pool (bench.py
+# paged_serving; docs/SERVING.md "Paged KV serving"). Gates:
+#  - peak simultaneously-decoding streams: paged >= 2x slot at equal
+#    KV memory (page-granular admission vs worst-case slot
+#    reservation). Override with LO_SMOKE_PAGED_STREAMS_FLOOR.
+#  - QoS isolation: the bully tenant is rejected at least once (its
+#    own weighted-fair quota), the victim tenant takes ZERO 429s and
+#    its per-tenant servingP99 objective must not fire.
+PAGED_TIMEOUT="${LO_CI_PAGED_TIMEOUT:-900}"
+timeout -k 10 "$PAGED_TIMEOUT" env JAX_PLATFORMS=cpu \
+    JAX_COMPILATION_CACHE_DIR="$PERF_CACHE" \
+    LO_COMPUTE_DTYPE=float32 \
+    LO_BENCH_TLM_D=128 LO_BENCH_TLM_LAYERS=2 LO_BENCH_TLM_SEQ=128 \
+    LO_BENCH_PAGED_SLO_MS=30000 \
+    python bench.py --phase paged_serving | tee "$PAGED_OUT"
+python - "$PAGED_OUT" <<'EOF'
+import json, os, sys
+
+mark = "@@LO_BENCH_RESULT@@"
+result = None
+for line in reversed(open(sys.argv[1]).read().splitlines()):
+    if line.startswith(mark):
+        result = json.loads(line[len(mark):])
+        break
+assert result is not None, "paged-smoke: no bench result line"
+assert "error" not in result, f"paged-smoke: phase failed: {result}"
+result = result.get("result", result)  # unwrap the ok-envelope
+floor = float(os.environ.get("LO_SMOKE_PAGED_STREAMS_FLOOR", "2.0"))
+ratio = result["streams_vs_slot"]
+assert ratio >= floor, (
+    f"paged-smoke: paged sustained only {ratio}x the slot streams "
+    f"at equal HBM (gate >= {floor}x): {result}")
+assert result["bully_rejected"] >= 1, (
+    f"paged-smoke: abusive tenant was never quota-rejected: {result}")
+assert result["victim_rejected"] == 0, (
+    f"paged-smoke: victim tenant took "
+    f"{result['victim_rejected']} 429s behind the bully: {result}")
+assert not result["victim_slo_fired"], (
+    f"paged-smoke: the bully paged the victim's servingP99 "
+    f"objective: {result}")
+print(f"paged-smoke: OK (peak {result['paged_peak_streams']} vs "
+      f"{result['slot_peak_streams']} slot streams = {ratio}x at "
+      f"equal HBM, bully 429s={result['bully_rejected']}, victim "
+      f"429s=0, victim p99 {result['victim_p99_ms']}ms, SLO quiet)")
 EOF
 
 echo "== sweep-smoke: fused sweep must beat serial trials =="

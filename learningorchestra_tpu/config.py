@@ -325,6 +325,32 @@ class Config:
     serve_lease_policy: str = dataclasses.field(
         default_factory=lambda: os.environ.get(
             "LO_SERVE_LEASE_POLICY", "preempt"))
+    # KV-cache layout for LM sessions (docs/SERVING.md "Paged KV"):
+    # "slot" preallocates slots x cacheLen per session (the PR-6
+    # layout, kept as fallback); "paged" carves one shared HBM page
+    # pool into page_len-token pages handed out per stream on demand,
+    # with refcounted prefix reuse and per-tenant admission.
+    serve_kv: str = dataclasses.field(
+        default_factory=lambda: os.environ.get("LO_SERVE_KV", "slot"))
+    # Tokens per KV page (paged mode). Small pages waste less memory
+    # on short tails; large pages gather fewer, wider HBM reads.
+    serve_page_len: int = dataclasses.field(
+        default_factory=lambda: int(os.environ.get(
+            "LO_SERVE_PAGE_LEN", "16")))
+    # Page-pool size per paged session. 0 = auto: the page count whose
+    # pool matches the slot cache's bytes (slots x cacheLen), so
+    # "paged vs slot at equal HBM" is the out-of-the-box comparison.
+    serve_pages: int = dataclasses.field(
+        default_factory=lambda: int(os.environ.get(
+            "LO_SERVE_PAGES", "0")))
+    # Weighted-fair tenant shares over the page budget and the decode
+    # slots ("tenantA:3,tenantB:1"; unlisted tenants weigh 1). An
+    # over-quota tenant is rejected with 429 while other tenants'
+    # pages stay untouched — one abusive tenant cannot evict or starve
+    # another's streams (per-tenant servingP99 SLOs watch the rest).
+    serve_tenant_weights: str = dataclasses.field(
+        default_factory=lambda: os.environ.get(
+            "LO_SERVE_TENANT_WEIGHTS", ""))
 
     # Gateway behaviors (KrakenD parity, krakend.json:1769-1770):
     # version-revalidated response cache for universal GETs (TTL is a

@@ -174,7 +174,8 @@ class _Attention(nn.Module):
 
     @nn.compact
     def __call__(self, x, train: bool, decode_pos=None, cache_len: int = 0,
-                 pad_offset=None, kv_len=None):
+                 pad_offset=None, kv_len=None, block_tables=None,
+                 page_len: int = 0, kv_pages: int = 0):
         d_model = x.shape[-1]
         kv = self.kv_heads
         if self.n_heads % kv:
@@ -258,15 +259,37 @@ class _Attention(nn.Module):
                     [t1 * c - t2 * si, t1 * si + t2 * c], axis=-1)
 
             q, k = rot(q), rot(k)
-            ck, cv = self._cache_vars(b, cache_len, x.dtype)
-            rows = jnp.arange(b)
-            ck.value = ck.value.at[rows, pos].set(
-                k[:, 0].astype(x.dtype))
-            cv.value = cv.value.at[rows, pos].set(
-                v[:, 0].astype(x.dtype))
-            o = attn_ops.decode_attention(
-                q, ck.value, cv.value, pos, pad_offset=pad_offset,
-                window=self.window).reshape(shape4)
+            if block_tables is not None:
+                # paged serving decode: the cache variable is the
+                # SHARED page pool, not a per-slot rectangle. Rope,
+                # the written K/V values, the grouped reduction and
+                # the visibility mask are all the slot branch's —
+                # only the storage addressing differs — so a paged
+                # stream's output bits still match a solo decode
+                # (docs/SERVING.md bit-identity contract).
+                pool_shape = (kv_pages, page_len, kv, self.head_dim)
+                ck = self.variable("cache", "k", jnp.zeros,
+                                   pool_shape, x.dtype)
+                cv = self.variable("cache", "v", jnp.zeros,
+                                   pool_shape, x.dtype)
+                ck.value = attn_ops.paged_append_token(
+                    ck.value, k[:, 0], block_tables, pos, page_len)
+                cv.value = attn_ops.paged_append_token(
+                    cv.value, v[:, 0], block_tables, pos, page_len)
+                o = attn_ops.paged_decode_attention(
+                    q, ck.value, cv.value, block_tables, pos,
+                    pad_offset=pad_offset,
+                    window=self.window).reshape(shape4)
+            else:
+                ck, cv = self._cache_vars(b, cache_len, x.dtype)
+                rows = jnp.arange(b)
+                ck.value = ck.value.at[rows, pos].set(
+                    k[:, 0].astype(x.dtype))
+                cv.value = cv.value.at[rows, pos].set(
+                    v[:, 0].astype(x.dtype))
+                o = attn_ops.decode_attention(
+                    q, ck.value, cv.value, pos, pad_offset=pad_offset,
+                    window=self.window).reshape(shape4)
         else:
             if pad_offset is None:
                 cos, sin = rope_tables(s, self.head_dim,
@@ -456,7 +479,8 @@ class _Block(nn.Module):
 
     @nn.compact
     def __call__(self, x, train: bool, decode_pos=None, cache_len: int = 0,
-                 pad_offset=None, kv_len=None):
+                 pad_offset=None, kv_len=None, block_tables=None,
+                 page_len: int = 0, kv_pages: int = 0):
         h = nn.RMSNorm(name="attn_norm")(x)
         h = _Attention(self.n_heads, self.head_dim, self.attention,
                        self.causal, self.mesh,
@@ -467,7 +491,9 @@ class _Block(nn.Module):
                        window=self.window,
                        rope_base=self.rope_base, name="attn")(
             h, train, decode_pos=decode_pos, cache_len=cache_len,
-            pad_offset=pad_offset, kv_len=kv_len)
+            pad_offset=pad_offset, kv_len=kv_len,
+            block_tables=block_tables, page_len=page_len,
+            kv_pages=kv_pages)
         if self.dropout and train:
             h = nn.Dropout(self.dropout, deterministic=False)(h)
         x = x + h
@@ -561,7 +587,9 @@ class TransformerLM(nn.Module):
 
     @nn.compact
     def __call__(self, tokens, train: bool = False, decode_pos=None,
-                 cache_len: int = 0, pad_offset=None, kv_len=None):
+                 cache_len: int = 0, pad_offset=None, kv_len=None,
+                 block_tables=None, page_len: int = 0,
+                 kv_pages: int = 0):
         if self.attention not in ATTENTION_IMPLS:
             raise ValueError(f"unknown attention impl: {self.attention!r}")
         d_ff = self.d_ff or 4 * self.d_model
@@ -587,14 +615,16 @@ class TransformerLM(nn.Module):
                 raise ValueError(
                     f"unknown remat policy {self.remat!r} "
                     f"(none|dots|full)")
-            # args: (self, x, train, decode_pos, cache_len) — the
-            # non-array flags are static
+            # args: (self, x, train, decode_pos, cache_len, ...,
+            # block_tables, page_len, kv_pages) — the non-array flags
+            # are static (the paged-decode args are always
+            # None/0 here: remat only wraps the train path)
             # prevent_cse=True: outside nn.scan, XLA's CSE can undo
             # the recomputation and keep activations live (the flax
             # docs' reason it defaults True under jit)
             block_cls = nn.remat(_Block, policy=policies[self.remat],
                                  prevent_cse=True,
-                                 static_argnums=(2, 3, 4))
+                                 static_argnums=(2, 3, 4, 7, 8, 9))
         aux_total = jnp.zeros((), jnp.float32)
         for i in range(self.n_layers):
             x, aux = block_cls(self.n_heads, head_dim, d_ff,
@@ -605,7 +635,8 @@ class TransformerLM(nn.Module):
                                self.lora_rank, self.lora_alpha,
                                self.sliding_window, self.rope_base,
                                name=f"layer_{i}")(
-                x, train, decode_pos, cache_len, pad_offset, kv_len)
+                x, train, decode_pos, cache_len, pad_offset, kv_len,
+                block_tables, page_len, kv_pages)
             aux_total = aux_total + aux
         x = nn.RMSNorm(name="final_norm")(x)
         head = _LMHead(self.vocab_size, name="lm_head")
@@ -1237,6 +1268,7 @@ class LanguageModel:
         self._gen_cache_fns = {}
         self._beam_cache_fns = {}
         self._serve_cache_fns = {}
+        self._serve_paged_fns = {}
 
     def _mesh(self):
         return self._mesh_override or mesh_lib.current_mesh()
@@ -1915,6 +1947,127 @@ class LanguageModel:
                 jnp.zeros((slots, 1), jnp.int32), train=False,
                 decode_pos=jnp.zeros((slots,), jnp.int32),
                 cache_len=cache_len)["cache"])
+        return jax.tree_util.tree_map(
+            lambda sd: jnp.zeros(sd.shape, sd.dtype), shapes)
+
+    def serve_fns_paged(self, slots: int, cache_len: int,
+                        page_len: int, n_pages: int,
+                        temperature: float,
+                        top_k: Optional[int] = None,
+                        top_p: Optional[float] = None):
+        """Paged-KV variant of :meth:`serve_fns` (docs/SERVING.md
+        "Paged KV"): the per-layer cache is one SHARED
+        ``(n_pages, page_len, kv, d)`` pool and each stream owns an
+        ordered page list (its block-table row) instead of a
+        ``cache_len`` rectangle. Returns
+        ``(step, prefill_for, join_paged, copy_page, sample_first)``:
+
+        - ``step(params, pool, tok, col, block_tables, keys)`` — one
+          continuous-batch decode step over the pool. The gather
+          width is ``block_tables.shape[1]``: the session slices the
+          table to the live-length bucket on the host, so one compile
+          per bucket and short streams never gather long-stream
+          pages. Rope/mask/sampling schedule is byte-for-byte the
+          slot step's (bit-identity contract).
+        - ``prefill_for(s)`` — per-length batch-1 prefill returning
+          ``(next_token, last_logits, pcache)``; ``last_logits``
+          feeds the prefix cache so an exact-prompt hit can resample
+          a first token without recomputing the prefill.
+        - ``join_paged(pool, pcache, page_ids, start_row)`` — write
+          prefill KV rows ``[start_row, ·)`` directly into
+          ``page_ids`` (one compile per page count; shared prefix
+          pages are excluded and never rewritten).
+        - ``copy_page(pool, src, dst)`` — clone one page (a prefix
+          hit's partially-filled tail page is copy-on-write: the new
+          stream appends into its own copy).
+        - ``sample_first(logits, key)`` — the prefill's sampling
+          epilogue alone, for prefix hits that skipped the prefill.
+        """
+        fns = self._serve_paged_fns
+        sig = (slots, cache_len, page_len, n_pages, temperature,
+               top_k, top_p)
+        if sig not in fns:
+            fns[sig] = self._build_serve_fns_paged(
+                slots, cache_len, page_len, n_pages, temperature,
+                top_k, top_p)
+        return fns[sig]
+
+    def _build_serve_fns_paged(self, slots: int, cache_len: int,
+                               page_len: int, n_pages: int,
+                               temperature: float,
+                               top_k: Optional[int],
+                               top_p: Optional[float]):
+        module = self._module_for(1)
+        sample = self._sample
+
+        @functools.partial(jax.jit, donate_argnums=(1,))
+        def step(params, pool, tok, col, block_tables, keys):
+            (logits, _), mut = module.apply(
+                {"params": params, "cache": pool}, tok, train=False,
+                decode_pos=col, cache_len=cache_len,
+                block_tables=block_tables, page_len=page_len,
+                kv_pages=n_pages, mutable=["cache"])
+            # same per-row fold_in(key, col + 1) schedule as the slot
+            # step — the whole bit-identity story rides on it
+            ks = jax.vmap(jax.random.fold_in)(keys, col + 1)
+            nxt = jax.vmap(
+                lambda lg, k: sample(lg[None], temperature, k,
+                                     top_k, top_p)[0])(logits[:, 0], ks)
+            return nxt.astype(jnp.int32), mut["cache"]
+
+        prefill_cache: Dict[int, Any] = {}
+
+        def prefill_for(s: int):
+            if s in prefill_cache:
+                return prefill_cache[s]
+            pmod = self._module_for(s)
+
+            @jax.jit
+            def prefill(params, tokens, key):
+                (logits, _), mut = pmod.apply(
+                    {"params": params}, tokens, train=False,
+                    cache_len=cache_len, mutable=["cache"])
+                nxt = sample(logits[:, -1], temperature, key,
+                             top_k, top_p)
+                return (nxt.astype(jnp.int32), logits[:, -1],
+                        mut["cache"])
+
+            prefill_cache[s] = prefill
+            return prefill
+
+        @jax.jit
+        def join_paged(pool, pcache, page_ids, start_row):
+            return jax.tree_util.tree_map(
+                lambda pl, pc: attn_ops.paged_prefill_write(
+                    pl, pc[0], page_ids, start_row), pool, pcache)
+
+        @jax.jit
+        def copy_page(pool, src, dst):
+            return jax.tree_util.tree_map(
+                lambda pl: pl.at[dst].set(pl[src]), pool)
+
+        @jax.jit
+        def sample_first(logits, key):
+            # identical floats to the prefill's own epilogue: the
+            # cached logits ARE the prefill's logits[:, -1] row
+            return sample(logits[None], temperature, key,
+                          top_k, top_p)[0].astype(jnp.int32)
+
+        return step, prefill_for, join_paged, copy_page, sample_first
+
+    def serve_cache_paged(self, n_pages: int, page_len: int):
+        """Zero-initialized shared KV page pool:
+        ``{layer: {k/v: (n_pages, page_len, kv_heads, head_dim)}}`` —
+        ONE allocation every stream's block table indexes into."""
+        module = self._module_for(1)
+        shapes = jax.eval_shape(
+            lambda: module.init(
+                jax.random.PRNGKey(0),
+                jnp.zeros((1, 1), jnp.int32), train=False,
+                decode_pos=jnp.zeros((1,), jnp.int32),
+                cache_len=page_len * n_pages,
+                block_tables=jnp.zeros((1, 1), jnp.int32),
+                page_len=page_len, kv_pages=n_pages)["cache"])
         return jax.tree_util.tree_map(
             lambda sd: jnp.zeros(sd.shape, sd.dtype), shapes)
 

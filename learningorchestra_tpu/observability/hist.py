@@ -120,6 +120,13 @@ def observe(name: str, value: float) -> None:
         pass
 
 
+def names() -> List[str]:
+    """Registered histogram names (the SLO watchdog scans these to
+    discover per-tenant serving series)."""
+    with _lock:
+        return list(_registry)
+
+
 def snapshot_all() -> Dict[str, Dict[str, object]]:
     with _lock:
         hists = list(_registry.values())

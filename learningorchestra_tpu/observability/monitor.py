@@ -40,6 +40,9 @@ _SCALAR_SERIES = (
     "arenaBytesInUse", "arenaEvictions",
     "sliceDevicesBusy", "sliceFragmentation",
     "servingQueueDepth", "servingBatchFill",
+    # paged-KV serving (services/serving.py PagedLMServingSession):
+    # pool free-page headroom and cross-stream prefix sharing
+    "servingKvPagesFree", "servingKvPagesShared",
     "jobsRunning", "jobQueueDepth", "deadLettered",
     "hostRssBytes",
     # X-ray HBM attribution (observability/xray): ledger total and the
@@ -217,6 +220,9 @@ class ClusterMonitor:
         if serving:
             scalars["servingQueueDepth"] = serving.get("queueDepth")
             scalars["servingBatchFill"] = serving.get("batchFill")
+            scalars["servingKvPagesFree"] = serving.get("kvPagesFree")
+            scalars["servingKvPagesShared"] = serving.get(
+                "kvPagesShared")
         if jobs:
             scalars["jobsRunning"] = jobs.get("running")
             scalars["jobQueueDepth"] = jobs.get("queued")

@@ -35,6 +35,12 @@ from learningorchestra_tpu.observability import incidents as obs_incidents
 
 _HISTORY = 256
 
+# per-tenant serving latency series emitted by the paged serving
+# session (services/serving.py); each discovered tenant gets its own
+# page-severity servingP99 objective so one tenant breaching cannot
+# hide behind (or be blamed on) the aggregate
+_TENANT_HIST_PREFIX = "lo_serving_request_seconds_tenant_"
+
 
 class _HistWindow:
     """Bounded ring of (ts, cumulative-bucket-snapshot) pairs for one
@@ -116,6 +122,8 @@ class SloWatchdog:
             maxlen=_HISTORY)
         self._serving = _HistWindow("lo_serving_request_seconds")
         self._lease = _HistWindow("lo_lease_wait_seconds")
+        # tenant -> window, discovered lazily from the hist registry
+        self._tenant_serving: Dict[str, _HistWindow] = {}
 
     # -- config -------------------------------------------------------
 
@@ -127,7 +135,7 @@ class SloWatchdog:
 
     def objectives(self) -> Dict[str, Dict[str, Any]]:
         cfg = self._cfg()
-        return {
+        out: Dict[str, Dict[str, Any]] = {
             "servingP99": {
                 "severity": "page",
                 "threshold": float(cfg.slo_serving_p99_ms),
@@ -154,6 +162,11 @@ class SloWatchdog:
                     cfg, "slo_unattributed_growth_bytes", 0.0)),
                 "unit": "bytes"},
         }
+        thr = float(cfg.slo_serving_p99_ms)
+        for tenant in sorted(list(self._tenant_serving)):
+            out[f"servingP99:{tenant}"] = {
+                "severity": "page", "threshold": thr, "unit": "ms"}
+        return out
 
     # -- evaluation ---------------------------------------------------
 
@@ -167,6 +180,13 @@ class SloWatchdog:
         slow = max(fast, float(cfg.slo_slow_window_s))
         self._serving.observe(now)
         self._lease.observe(now)
+        for name in obs_hist.names():
+            if name.startswith(_TENANT_HIST_PREFIX):
+                tenant = name[len(_TENANT_HIST_PREFIX):]
+                if tenant not in self._tenant_serving:
+                    self._tenant_serving[tenant] = _HistWindow(name)
+        for win in self._tenant_serving.values():
+            win.observe(now)
         objectives = self.objectives()
 
         for name, spec in objectives.items():
@@ -192,6 +212,12 @@ class SloWatchdog:
                  window: float, now: float) -> Optional[float]:
         if name == "servingP99":
             p99 = self._serving.quantile_over(0.99, window, now)
+            return None if p99 is None else p99 * 1000.0
+        if name.startswith("servingP99:"):
+            win = self._tenant_serving.get(name.split(":", 1)[1])
+            if win is None:
+                return None
+            p99 = win.quantile_over(0.99, window, now)
             return None if p99 is None else p99 * 1000.0
         if name == "queueWait":
             return self._lease.quantile_over(0.99, window, now)

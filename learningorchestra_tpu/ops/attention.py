@@ -851,7 +851,8 @@ def paged_decode_attention(q: jax.Array, k_pool: jax.Array,
                            col: jax.Array, *,
                            pad_offset: Optional[jax.Array] = None,
                            window: int = 0,
-                           scale: Optional[float] = None) -> jax.Array:
+                           scale: Optional[float] = None,
+                           max_pages: int = 0) -> jax.Array:
     """:func:`decode_attention` over a paged KV pool (vLLM layout).
 
     ``k_pool``/``v_pool`` are ``(pages, page_len, kv_heads, d)``;
@@ -860,9 +861,22 @@ def paged_decode_attention(q: jax.Array, k_pool: jax.Array,
     slot reuses whatever pages are free — no recompile, no copy of
     other requests' state. Pages are gathered into the contiguous
     ``(b, n_pages * page_len, kv, d)`` layout and fed through the
-    SAME reduction as :func:`decode_attention`, keeping the gathered
-    path bit-identical to the contiguous one (tested in
-    tests/test_ops.py / test_serving.py)."""
+    SAME reduction as :func:`decode_attention`: masked positions
+    contribute exact zeros, so padding the key axis with garbage
+    pages never changes the live positions' float sums and the
+    gathered path stays bit-identical to the contiguous one
+    (``tests/test_ops.py::test_paged_decode_*`` bit-parity suite;
+    end-to-end vs ``generate`` in tests/test_serving.py).
+
+    ``max_pages > 0`` statically clamps the gather to the first
+    ``max_pages`` table columns: every masked-softmax term past the
+    highest live ``col`` is an exact zero, so the caller (the paged
+    serving session) can bucket the gather width to the longest live
+    stream and short streams stop paying long-stream HBM reads.
+    Under ``jit`` the clamp must be a static Python int (it picks the
+    compiled gather shape)."""
+    if max_pages and max_pages < block_tables.shape[1]:
+        block_tables = block_tables[:, :max_pages]
     b = block_tables.shape[0]
     n_pages = block_tables.shape[1]
     page_len, kv, d = k_pool.shape[1], k_pool.shape[2], k_pool.shape[3]
@@ -872,3 +886,39 @@ def paged_decode_attention(q: jax.Array, k_pool: jax.Array,
         b, n_pages * page_len, kv, d)
     return decode_attention(q, k, v, col, pad_offset=pad_offset,
                             window=window, scale=scale)
+
+
+def paged_append_token(pool: jax.Array, new: jax.Array,
+                       block_tables: jax.Array,
+                       pos: jax.Array, page_len: int) -> jax.Array:
+    """Scatter one decode step's K (or V) rows into their pages.
+
+    ``pool`` is ``(pages, page_len, kv, d)``, ``new`` is
+    ``(b, kv, d)`` (this step's projected key/value per stream),
+    ``pos`` is ``(b,)`` absolute cache positions. Row ``i`` lands at
+    ``pool[block_tables[i, pos[i] // page_len], pos[i] % page_len]``
+    — the paged analog of the slot cache's ``at[rows, pos].set``."""
+    rows = jnp.arange(new.shape[0])
+    page = block_tables[rows, pos // page_len]
+    return pool.at[page, pos % page_len].set(new.astype(pool.dtype))
+
+
+def paged_prefill_write(pool: jax.Array, kv_rows: jax.Array,
+                        page_ids: jax.Array,
+                        start_row: jax.Array) -> jax.Array:
+    """Write a prefill's prompt KV directly into pages.
+
+    ``kv_rows`` is the ``(L, kv, d)`` contiguous K (or V) a prompt
+    prefill produced; rows ``[start_row, start_row + n*page_len)``
+    are reshaped into ``n = page_ids.shape[0]`` page chunks and
+    scattered to ``pool[page_ids]``. ``start_row`` (a multiple of
+    ``page_len``) is traced, so one compile per PAGE COUNT covers
+    every prefix-cache split point — shared prefix pages are simply
+    not in ``page_ids`` and never rewritten while other streams read
+    them."""
+    n = page_ids.shape[0]
+    page_len = pool.shape[1]
+    chunk = jax.lax.dynamic_slice_in_dim(
+        kv_rows, start_row, n * page_len, axis=0)
+    chunk = chunk.reshape((n, page_len) + kv_rows.shape[1:])
+    return pool.at[page_ids].set(chunk.astype(pool.dtype))

@@ -180,12 +180,18 @@ def _start_monitor(ctx: "ServiceContext"):
         depth = sum(int(v.get("queueDepth") or 0) for v in by)
         fills = [v["batchFill"] for v in by
                  if v.get("batchFill") is not None]
-        return {"queueDepth": depth,
-                "batchFill": (round(sum(fills) / len(fills), 4)
-                              if fills else None),
-                "sessions": len(by),
-                "requestsTotal": s.get("requestsTotal"),
-                "rejectedTotal": s.get("rejectedTotal")}
+        out = {"queueDepth": depth,
+               "batchFill": (round(sum(fills) / len(fills), 4)
+                             if fills else None),
+               "sessions": len(by),
+               "requestsTotal": s.get("requestsTotal"),
+               "rejectedTotal": s.get("rejectedTotal")}
+        kv = s.get("kv")
+        if kv:
+            out["kvPagesFree"] = kv.get("pagesFree")
+            out["kvPagesShared"] = kv.get("pagesShared")
+            out["kvPrefillsSkipped"] = kv.get("prefillsSkipped")
+        return out
 
     def active_trace():
         name = ctx.jobs.active_job()

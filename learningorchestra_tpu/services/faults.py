@@ -46,7 +46,12 @@ autoscaler backs off and retries; a transient spec (count 1) lets the
 retry succeed, a latched spec (large count) fails every attempt until
 the autoscaler's per-job retry budget dead-letters the RESIZE REQUEST
 while the job itself finishes untouched, docs/RELIABILITY.md
-"Degradation ladder")."""
+"Degradation ladder") and ``kv_page_alloc`` (services/serving.py,
+fired inside the paged-KV pool's page allocation: a transient spec
+surfaces as a 429 the client retries; a latched spec — three or more
+consecutive failures — degrades the session to the contiguous slot
+KV path with an incident bundle, and in-flight paged streams fail
+with 503 while later requests serve normally)."""
 
 from __future__ import annotations
 

@@ -570,6 +570,44 @@ class Api:
                 lines.append(
                     f'{metric}{{model="{esc(sess["model"])}"}} '
                     f'{value_of(sess)}')
+        # paged-KV pool state per session (services/serving.py
+        # PagedLMServingSession): free/shared pages, prefix reuse and
+        # per-tenant page holdings
+        for metric, kv_value in (
+                ("lo_serving_kv_pages_total",
+                 lambda kv: kv["pagesTotal"]),
+                ("lo_serving_kv_pages_free",
+                 lambda kv: kv["pagesFree"]),
+                ("lo_serving_kv_pages_shared",
+                 lambda kv: kv["pagesShared"]),
+                ("lo_serving_kv_alloc_failures_total",
+                 lambda kv: kv["allocFailures"]),
+                ("lo_serving_kv_prefills_skipped_total",
+                 lambda kv: kv["prefix"]["prefillsSkipped"]),
+                ("lo_serving_kv_pages_reused_total",
+                 lambda kv: kv["prefix"]["pagesReused"])):
+            rows = [s for s in serving["bySession"] if s.get("kv")]
+            if not rows:
+                break
+            kind = ("counter" if metric.endswith("_total")
+                    else "gauge")
+            lines.append(f"# TYPE {metric} {kind}")
+            for sess in rows:
+                lines.append(
+                    f'{metric}{{model="{esc(sess["model"])}"}} '
+                    f'{kv_value(sess["kv"])}')
+        lines_added_tenant = False
+        for sess in serving["bySession"]:
+            tenants = (sess.get("kv") or {}).get("tenants") or {}
+            for tenant, tstats in sorted(tenants.items()):
+                if not lines_added_tenant:
+                    lines.append(
+                        "# TYPE lo_serving_tenant_pages gauge")
+                    lines_added_tenant = True
+                lines.append(
+                    f'lo_serving_tenant_pages{{model='
+                    f'"{esc(sess["model"])}",tenant='
+                    f'"{esc(tenant)}"}} {tstats["pages"]}')
         # serving goodput (observability/perf): decode tokens/s/chip
         # per LM session — the headline serving-efficiency gauge
         lines.append("# TYPE lo_serving_tokens_per_sec_per_chip gauge")

@@ -18,6 +18,12 @@ Two session kinds (docs/SERVING.md):
   they finish. Slot reuse never recompiles (the slot index is a traced
   argument), and each slot's token stream is bit-identical to decoding
   that request alone through ``LanguageModel.generate`` (tested).
+- :class:`PagedLMServingSession` (``LO_SERVE_KV=paged``) — the same
+  batcher over a shared HBM page pool instead of a fixed slot cache:
+  per-stream block tables, page-granular admission with OOM-safe
+  429s, refcounted prompt-prefix page reuse and weighted-fair
+  per-tenant QoS over the page budget. Token streams stay
+  bit-identical to the slot path (and to a solo decode).
 - :class:`BucketServingSession` — shape-bucketed micro-batching for
   classifiers/estimators: a burst of n queued requests pads to the
   smallest precompiled bucket >= n and runs ONE ``predict`` call, so
@@ -31,14 +37,16 @@ exported through ``/metrics``.
 from __future__ import annotations
 
 import collections
+import re
 import threading
 import time
-from typing import Any, Deque, Dict, List, Optional
+from typing import Any, Deque, Dict, List, Optional, Tuple
 
 import numpy as np
 
 from learningorchestra_tpu.observability import export as obs_export
 from learningorchestra_tpu.observability import hist as obs_hist
+from learningorchestra_tpu.observability import incidents as obs_incidents
 from learningorchestra_tpu.observability import perf as obs_perf
 from learningorchestra_tpu.observability import trace as obs_trace
 from learningorchestra_tpu.observability import xray as obs_xray
@@ -324,9 +332,7 @@ class LMServingSession(_SessionBase):
         self.temperature = float(temperature)
         self.top_k = top_k
         self.top_p = top_p
-        self._step, self._prefill_for, self._join = model.serve_fns(
-            self.slots, self.cache_len, self.temperature, top_k, top_p)
-        self._cache = model.serve_cache(self.slots, self.cache_len)
+        self._init_decode_path()
         self.tokens_total = 0
         # decode-phase goodput accounting (observability/perf): every
         # compiled step advances ALL slots; only active ones emit a
@@ -342,8 +348,6 @@ class LMServingSession(_SessionBase):
         p_leaves = jax.tree_util.tree_leaves(model.params)
         self._param_count = int(sum(a.size for a in p_leaves))
         self._param_bytes = int(sum(a.nbytes for a in p_leaves))
-        self._cache_bytes = int(sum(
-            a.nbytes for a in jax.tree_util.tree_leaves(self._cache)))
         # host-side slot state (device state is the KV cache)
         self._tok = np.zeros((self.slots, 1), np.int32)
         self._col = np.zeros((self.slots,), np.int32)
@@ -359,6 +363,21 @@ class LMServingSession(_SessionBase):
         obs_xray.register("kv-cache", ("kv", self.name, id(self)),
                           self._cache_bytes, name=self.name,
                           slots=self.slots, cacheLen=self.cache_len)
+
+    def _init_decode_path(self) -> None:
+        """Build the decode-path compiles and the device KV state.
+        The contiguous slot cache lives here so the paged subclass can
+        swap in the shared page pool without inheriting a dead
+        ``slots x cache_len`` allocation."""
+        import jax
+
+        model = self._model
+        self._step, self._prefill_for, self._join = model.serve_fns(
+            self.slots, self.cache_len, self.temperature,
+            self.top_k, self.top_p)
+        self._cache = model.serve_cache(self.slots, self.cache_len)
+        self._cache_bytes = int(sum(
+            a.nbytes for a in jax.tree_util.tree_leaves(self._cache)))
 
     def _pin_params(self):
         import jax
@@ -464,9 +483,23 @@ class LMServingSession(_SessionBase):
         })
         self._slot_out[slot] = []
 
-    def _serve_once(self) -> bool:
+    def _pop_next(self) -> _Request:
+        """Pick the next queued request (caller holds ``self._cv``).
+        FIFO here; the paged session overrides with a weighted-fair
+        pick over tenant page usage."""
+        return self._queue.popleft()
+
+    def _run_step(self):
+        """One compiled continuous-batch step; returns the per-slot
+        next-token device array."""
         import jax.numpy as jnp
 
+        nxt, self._cache = self._step(
+            self._model.params, self._cache, jnp.asarray(self._tok),
+            jnp.asarray(self._col), jnp.asarray(self._keys))
+        return nxt
+
+    def _serve_once(self) -> bool:
         # (1) admit — join at the token boundary, one slot per request
         admitted = False
         while True:
@@ -475,7 +508,7 @@ class LMServingSession(_SessionBase):
                         if r is None]
                 if not free or not self._queue:
                     break
-                req = self._queue.popleft()
+                req = self._pop_next()
             req.popped_at = time.monotonic()
             try:
                 self._admit(free[0], req)
@@ -492,10 +525,8 @@ class LMServingSession(_SessionBase):
         # (2) one continuous-batch step: every active slot advances a
         # token; idle slots compute masked garbage that is discarded
         step_t0 = time.monotonic()
-        nxt, self._cache = self._step(
-            self._model.params, self._cache, jnp.asarray(self._tok),
-            jnp.asarray(self._col), jnp.asarray(self._keys))
-        nxt = np.asarray(nxt)  # the device sync — step wall time ends here
+        nxt = np.asarray(self._run_step())  # device sync — step wall
+        # time ends here
         self._decode_seconds += time.monotonic() - step_t0
         self.decode_steps += 1
         self.decode_tokens_total += len(active)
@@ -562,6 +593,626 @@ class LMServingSession(_SessionBase):
             "tokensTotal": self.tokens_total,
             "temperature": self.temperature,
         })
+        return out
+
+
+class PoolExhausted(Exception):
+    """Not enough free KV pages for an allocation (the session turns
+    this into a 429 after trying prefix-cache eviction)."""
+
+
+def _metric_tenant(tenant: str) -> str:
+    return re.sub(r"[^0-9A-Za-z_]", "_", tenant)
+
+
+def parse_tenant_weights(spec: str) -> Dict[str, float]:
+    """``LO_SERVE_TENANT_WEIGHTS="gold:3,free:1"`` → weight map.
+    Unlisted tenants weigh 1; malformed entries are skipped."""
+    out: Dict[str, float] = {}
+    for part in str(spec or "").split(","):
+        part = part.strip()
+        if not part:
+            continue
+        name, _, w = part.partition(":")
+        try:
+            out[name.strip()] = max(float(w), 0.0) if w else 1.0
+        except ValueError:
+            continue
+    return out
+
+
+class PagedKVPool:
+    """Host-side allocator over the shared device KV page pool.
+
+    Page 0 is the TRASH page: the paged decode appends every batch
+    lane's token KV unconditionally, so idle/retired lanes' block
+    tables point at page 0 and it is never handed out (garbage there
+    is masked to an exact zero by the attention, never read back).
+    Pages are refcounted — prefix-cache hits share prompt pages
+    across streams and a page returns to the free list only when its
+    last reference drops. Per-tenant charge accounting (every
+    reference a tenant's stream holds counts against that tenant, so
+    sharing cannot game the quota) backs the weighted-fair admission.
+
+    Allocation order is the worker thread's alone; ``stats`` may be
+    read from REST threads, hence the lock.
+    """
+
+    def __init__(self, n_pages: int, page_len: int):
+        if n_pages < 2:
+            raise ValueError(f"n_pages must be >= 2, got {n_pages}")
+        self.n_pages = int(n_pages)
+        self.page_len = int(page_len)
+        self._lock = threading.Lock()
+        self._free: Deque[int] = collections.deque(
+            range(1, self.n_pages))
+        self._refs: Dict[int, int] = {}
+        self._tenant_pages: Dict[str, int] = {}
+        self.alloc_total = 0
+        self.alloc_failures = 0
+        self.freed_total = 0
+
+    @property
+    def usable(self) -> int:
+        return self.n_pages - 1  # page 0 is the trash page
+
+    def free_count(self) -> int:
+        with self._lock:
+            return len(self._free)
+
+    def shared_count(self) -> int:
+        with self._lock:
+            return sum(1 for c in self._refs.values() if c > 1)
+
+    def alloc(self, n: int, tenant: Optional[str] = None) -> List[int]:
+        """Take ``n`` pages off the free list (refcount 1 each).
+        Raises :class:`PoolExhausted` (OOM-safe reject — the pool
+        never over-commits) or ``faults.InjectedFault`` (chaos site
+        ``kv_page_alloc``)."""
+        faults.maybe_inject("kv_page_alloc")
+        with self._lock:
+            if n > len(self._free):
+                self.alloc_failures += 1
+                raise PoolExhausted(
+                    f"need {n} KV pages, {len(self._free)} free "
+                    f"of {self.usable}")
+            pages = [self._free.popleft() for _ in range(n)]
+            for p in pages:
+                self._refs[p] = 1
+            self.alloc_total += n
+            if tenant is not None:
+                self._charge(tenant, n)
+        return pages
+
+    def incref(self, pages: List[int],
+               tenant: Optional[str] = None) -> None:
+        with self._lock:
+            for p in pages:
+                self._refs[p] += 1
+            if tenant is not None:
+                self._charge(tenant, len(pages))
+
+    def decref(self, pages: List[int],
+               tenant: Optional[str] = None) -> None:
+        with self._lock:
+            for p in pages:
+                c = self._refs.get(p, 0) - 1
+                if c <= 0:
+                    self._refs.pop(p, None)
+                    self._free.append(p)
+                    self.freed_total += 1
+                else:
+                    self._refs[p] = c
+            if tenant is not None:
+                self._charge(tenant, -len(pages))
+
+    def _charge(self, tenant: str, n: int) -> None:
+        cur = self._tenant_pages.get(tenant, 0) + n
+        if cur <= 0:
+            self._tenant_pages.pop(tenant, None)
+        else:
+            self._tenant_pages[tenant] = cur
+
+    def tenant_pages(self, tenant: str) -> int:
+        with self._lock:
+            return self._tenant_pages.get(tenant, 0)
+
+    def tenants(self) -> Dict[str, int]:
+        with self._lock:
+            return dict(self._tenant_pages)
+
+    def stats(self) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                "pageLen": self.page_len,
+                "pagesTotal": self.usable,
+                "pagesFree": len(self._free),
+                "pagesShared": sum(
+                    1 for c in self._refs.values() if c > 1),
+                "allocTotal": self.alloc_total,
+                "allocFailures": self.alloc_failures,
+                "freedTotal": self.freed_total,
+            }
+
+
+class PrefixCache:
+    """Page-granularity prompt-prefix cache (the serving analog of
+    the feature cache's version keys).
+
+    Two hit kinds against the refcounted pool:
+
+    - **full** (exact prompt seen before): the prefill is SKIPPED —
+      the entry holds the prompt's full pages (shared read-only: a
+      full page's positions are never written again after prefill),
+      its partially-filled tail page, and the prefill's final logit
+      row. The new stream increfs the full pages, clones the tail
+      page (copy-on-use: decode appends diverge per stream; the
+      donor's own decode rows beyond the prompt inside the clone are
+      position-masked until overwritten, so they are never read) and
+      resamples the first token from the cached logits under its own
+      key — bit-identical to running the prefill.
+    - **partial** (longest cached run of FULL pages prefixing the
+      prompt): the prefill still runs, but the shared pages are
+      increfed and the page write starts after them — HBM page reuse
+      without recomputed-KV writes. Safe because prefill KV at a
+      position depends only on tokens at or before it (verified
+      bitwise by tests/test_serving.py).
+
+    Entries hold their own page references, so donor retirement
+    never invalidates an entry; LRU entries are evicted under pool
+    pressure before the session rejects with 429.
+    """
+
+    def __init__(self, pool: PagedKVPool, page_len: int,
+                 max_entries: int = 64):
+        self._pool = pool
+        self._page_len = int(page_len)
+        self._max = int(max_entries)
+        # prompt tuple -> {fullPages, tailPage, logits, held}
+        self._entries: "collections.OrderedDict" = \
+            collections.OrderedDict()
+        self._chains: Dict[Tuple[int, ...], Tuple[int, ...]] = {}
+        self.hits_full = 0
+        self.hits_partial = 0
+        self.pages_reused = 0
+
+    def __len__(self) -> int:
+        return len(self._entries)
+
+    def lookup_full(self, prompt: List[int]) -> Optional[Dict[str, Any]]:
+        entry = self._entries.get(tuple(prompt))
+        if entry is not None:
+            self._entries.move_to_end(tuple(prompt))
+            self.hits_full += 1
+            self.pages_reused += len(entry["fullPages"])
+        return entry
+
+    def lookup_partial(
+            self, prompt: List[int]) -> Tuple[Optional[List[int]], int]:
+        """Longest cached chain of FULL pages prefixing ``prompt`` →
+        (pages, n_pages); (None, 0) on miss. No references are taken
+        here — the caller increfs once it commits to admission."""
+        pl = self._page_len
+        for k in range(len(prompt) // pl, 0, -1):
+            key = self._chains.get(tuple(prompt[:k * pl]))
+            if key is None:
+                continue
+            entry = self._entries.get(key)
+            if entry is None or len(entry["fullPages"]) < k:
+                continue
+            self._entries.move_to_end(key)
+            self.hits_partial += 1
+            self.pages_reused += k
+            return list(entry["fullPages"][:k]), k
+        return None, 0
+
+    def insert(self, prompt: List[int], full_pages: List[int],
+               tail_page: Optional[int], logits: np.ndarray) -> None:
+        key = tuple(prompt)
+        if key in self._entries:
+            self._entries.move_to_end(key)
+            return
+        held = list(full_pages)
+        if tail_page is not None:
+            held.append(tail_page)
+        self._pool.incref(held)  # the cache's own hold — no tenant
+        self._entries[key] = {
+            "fullPages": list(full_pages), "tailPage": tail_page,
+            "logits": np.asarray(logits), "held": held}
+        pl = self._page_len
+        for k in range(1, len(full_pages) + 1):
+            self._chains[key[:k * pl]] = key
+        while len(self._entries) > self._max:
+            self.evict_one()
+
+    def evict_one(self) -> bool:
+        """Drop the LRU entry and release its page references.
+        Returns False when the cache is already empty."""
+        if not self._entries:
+            return False
+        key, entry = self._entries.popitem(last=False)
+        pl = self._page_len
+        for k in range(1, len(entry["fullPages"]) + 1):
+            if self._chains.get(key[:k * pl]) == key:
+                del self._chains[key[:k * pl]]
+        self._pool.decref(entry["held"])
+        return True
+
+    def stats(self) -> Dict[str, Any]:
+        return {"entries": len(self._entries),
+                "hitsFull": self.hits_full,
+                "hitsPartial": self.hits_partial,
+                "pagesReused": self.pages_reused}
+
+
+class PagedLMServingSession(LMServingSession):
+    """vLLM-style paged-KV continuous batcher (``LO_SERVE_KV=paged``,
+    docs/SERVING.md "Paged KV serving").
+
+    Same iteration loop and bit-identical token streams as the slot
+    session, but the per-layer KV cache is ONE shared
+    ``(pages, page_len, kv, d)`` pool (arena-adjacent, X-ray-tagged
+    under the session's ``kv-cache`` claim) and each stream owns
+    exactly ``ceil((prompt+maxNew)/page_len)`` pages through its
+    block-table row — admission is page-granular, so concurrency is
+    bounded by ACTUAL token demand instead of ``slots x cache_len``
+    worst case. On top of the pool: prompt prefix caching
+    (:class:`PrefixCache`) and weighted-fair per-tenant QoS over the
+    page budget with per-tenant latency histograms feeding per-tenant
+    ``servingP99`` SLO objectives.
+
+    A latched ``kv_page_alloc`` fault (``_DEGRADE_AFTER`` consecutive
+    injected failures) degrades the session to the contiguous slot
+    path: in-flight paged streams fail with 503, an incident bundle
+    is triggered, and every later request serves through the
+    inherited slot machinery unchanged.
+    """
+
+    _DEGRADE_AFTER = 3
+
+    def __init__(self, name: str, ctx, lease: ServingLease, model,
+                 slots: int, cache_len: int, temperature: float,
+                 top_k: Optional[int], top_p: Optional[float],
+                 page_len: int, n_pages: int,
+                 tenant_weights: Optional[Dict[str, float]] = None):
+        # consumed by _init_decode_path, which the base __init__ calls
+        self.page_len = int(page_len)
+        self.n_pages = int(n_pages)
+        self._tenant_weights = dict(tenant_weights or {})
+        super().__init__(name, ctx, lease, model, slots, cache_len,
+                         temperature, top_k, top_p)
+
+    def _init_decode_path(self) -> None:
+        import jax
+
+        if self.cache_len % self.page_len:
+            raise ValueError(
+                f"cacheLen={self.cache_len} must be a multiple of "
+                f"pageLen={self.page_len}")
+        model = self._model
+        (self._pstep, self._pprefill_for, self._pjoin,
+         self._copy_page, self._sample_first) = model.serve_fns_paged(
+            self.slots, self.cache_len, self.page_len, self.n_pages,
+            self.temperature, self.top_k, self.top_p)
+        self._pool_tree = model.serve_cache_paged(
+            self.n_pages, self.page_len)
+        self._cache_bytes = int(sum(
+            a.nbytes
+            for a in jax.tree_util.tree_leaves(self._pool_tree)))
+        self.pool = PagedKVPool(self.n_pages, self.page_len)
+        self.prefix = PrefixCache(self.pool, self.page_len)
+        self._pages_per_slot = self.cache_len // self.page_len
+        self._bt = np.zeros((self.slots, self._pages_per_slot),
+                            np.int32)
+        self._slot_pages: List[List[int]] = [
+            [] for _ in range(self.slots)]
+        self._slot_tenant: List[Optional[str]] = [None] * self.slots
+        self._tenant_latency: Dict[str, LatencyTracker] = {}
+        self._tenant_requests: Dict[str, int] = {}
+        self._alloc_fault_streak = 0
+        self._degraded = False
+        self.prefills_skipped = 0
+
+    # -- tenants -------------------------------------------------------
+    @staticmethod
+    def _tenant_of(payload: Dict[str, Any]) -> str:
+        return str(payload.get("tenant") or "default")
+
+    def _weight(self, tenant: str) -> float:
+        return max(1e-6, float(self._tenant_weights.get(tenant, 1.0)))
+
+    def _tenant_tracker(self, tenant: str) -> LatencyTracker:
+        tracker = self._tenant_latency.get(tenant)
+        if tracker is None:
+            tracker = self._tenant_latency.setdefault(
+                tenant, LatencyTracker())
+        return tracker
+
+    def validate_request(self, payload: Dict[str, Any]) -> None:
+        super().validate_request(payload)
+        tenant = payload.get("tenant")
+        if tenant is not None and (
+                not isinstance(tenant, str) or not tenant
+                or len(tenant) > 64):
+            raise V.HttpError(
+                V.HTTP_NOT_ACCEPTABLE,
+                f"{V.MESSAGE_INVALID_FIELD}: tenant must be a "
+                f"non-empty string of <= 64 chars")
+
+    def submit(self, payload: Dict[str, Any],
+               timeout: Optional[float] = None) -> Dict[str, Any]:
+        tenant = self._tenant_of(payload)
+        t0 = time.monotonic()
+        result = super().submit(payload, timeout=timeout)
+        elapsed = time.monotonic() - t0
+        self._tenant_tracker(tenant).record(elapsed)
+        self._tenant_requests[tenant] = \
+            self._tenant_requests.get(tenant, 0) + 1
+        # a per-tenant histogram series feeds the watchdog's
+        # per-tenant servingP99 objective (observability/slo.py)
+        obs_hist.observe("lo_serving_request_seconds_tenant_"
+                         + _metric_tenant(tenant), elapsed)
+        return result
+
+    def _quota_check(self, tenant: str, need: int) -> None:
+        """Weighted-fair admission over the page budget: with >1 live
+        tenant, each may hold at most ``usable * w_t / sum(w)`` pages
+        — an abusive tenant exhausts its OWN quota (429) and cannot
+        starve another tenant's admissions or breach their SLO. A
+        sole tenant may use the whole pool."""
+        live = set(self.pool.tenants())
+        live.add(tenant)
+        if len(live) < 2:
+            return
+        total_w = sum(self._weight(t) for t in live)
+        quota = int(self.pool.usable * self._weight(tenant) / total_w)
+        used = self.pool.tenant_pages(tenant)
+        if used + need > quota:
+            self.rejected_total += 1
+            raise V.HttpError(
+                V.HTTP_TOO_MANY_REQUESTS,
+                f"tenant {tenant!r} over its weighted page quota "
+                f"({used}+{need} > {quota} of {self.pool.usable} "
+                f"pages) — retry with backoff")
+
+    def _pop_next(self) -> _Request:
+        # weighted-fair pick: the queued request whose tenant holds
+        # the fewest pages per unit weight goes first (FIFO within a
+        # tenant), so a heavy tenant's backlog cannot starve a light
+        # tenant behind it in the queue
+        if self._degraded or len(self._queue) <= 1:
+            return self._queue.popleft()
+        best_i = 0
+        best_key: Optional[Tuple[float, int]] = None
+        for i, req in enumerate(self._queue):
+            tenant = self._tenant_of(req.payload)
+            k = (self.pool.tenant_pages(tenant) / self._weight(tenant),
+                 i)
+            if best_key is None or k < best_key:
+                best_i, best_key = i, k
+        req = self._queue[best_i]
+        del self._queue[best_i]
+        return req
+
+    # -- paged admission ----------------------------------------------
+    def _alloc_pages(self, need: int, tenant: str) -> List[int]:
+        try:
+            pages = self.pool.alloc(need, tenant)
+            self._alloc_fault_streak = 0
+            return pages
+        except faults.InjectedFault as exc:
+            self._alloc_fault_streak += 1
+            if self._alloc_fault_streak >= self._DEGRADE_AFTER:
+                self._degrade_to_slot()
+            self.rejected_total += 1
+            raise V.HttpError(
+                V.HTTP_TOO_MANY_REQUESTS,
+                f"KV page allocation failed ({exc}) — retry with "
+                f"backoff")
+        except PoolExhausted as exc:
+            # pool pressure: prefix-cache holds are the reclaimable
+            # tier — drop LRU entries before rejecting
+            while self.prefix.evict_one():
+                try:
+                    pages = self.pool.alloc(need, tenant)
+                    self._alloc_fault_streak = 0
+                    return pages
+                except PoolExhausted as retry_exc:
+                    exc = retry_exc
+            self.rejected_total += 1
+            raise V.HttpError(
+                V.HTTP_TOO_MANY_REQUESTS,
+                f"KV page pool exhausted ({exc}) — retry with "
+                f"backoff")
+
+    def _admit(self, slot: int, req: _Request) -> None:
+        if self._degraded:
+            return super()._admit(slot, req)
+        import jax.numpy as jnp
+        import jax.random as jr
+
+        admit_t0 = time.monotonic()
+        payload = req.payload
+        prompt = list(payload["prompt"])
+        new = int(payload.get("maxNewTokens") or 32)
+        seed = int(payload.get("seed", 0))
+        tenant = self._tenant_of(payload)
+        keep = self.cache_len - new
+        if len(prompt) > keep:
+            prompt = prompt[-keep:]
+        s = len(prompt)
+        pl = self.page_len
+        # page-granular footprint: exactly the tokens this request
+        # can touch, not the slot path's cache_len worst case
+        total_pages = -(-(s + new) // pl)
+        key = jr.PRNGKey(seed)
+        key, sub_prefill = jr.split(key)
+        key, sub_decode = jr.split(key)
+
+        entry = self.prefix.lookup_full(prompt)
+        if entry is not None:
+            shared = list(entry["fullPages"])
+        else:
+            shared, _ = self.prefix.lookup_partial(prompt)
+            shared = shared or []
+        n_shared = len(shared)
+        self._quota_check(tenant, total_pages)
+        fresh = self._alloc_pages(total_pages - n_shared, tenant)
+        if shared:
+            self.pool.incref(shared, tenant)
+        row = shared + fresh
+
+        if entry is not None:
+            # FULL hit: no prefill compute at all. Clone the donor's
+            # tail page (its decode rows past the prompt are masked
+            # until this stream overwrites them) and resample the
+            # first token from the cached final logits — the same
+            # floats the prefill epilogue would produce.
+            tail = entry["tailPage"]
+            if tail is not None:
+                self._pool_tree = self._copy_page(
+                    self._pool_tree, jnp.asarray(np.int32(tail)),
+                    jnp.asarray(np.int32(fresh[0])))
+            first = int(self._sample_first(
+                jnp.asarray(entry["logits"]), sub_prefill))
+            self.prefills_skipped += 1
+            req.stages.append(
+                ("prefixHit", admit_t0, time.monotonic(),
+                 {"promptTokens": s, "slot": slot,
+                  "sharedPages": n_shared, "tenant": tenant}))
+        else:
+            prefill = self._pprefill_for(s)
+            tokens = jnp.asarray(np.asarray(prompt, np.int32)[None, :])
+            nxt, last_logits, pcache = prefill(
+                self._model.params, tokens, sub_prefill)
+            # write prompt KV straight into this stream's pages,
+            # starting after any shared prefix pages
+            n_prefill_pages = -(-s // pl)
+            write_pages = row[n_shared:n_prefill_pages]
+            if write_pages:
+                self._pool_tree = self._pjoin(
+                    self._pool_tree, pcache,
+                    jnp.asarray(np.asarray(write_pages, np.int32)),
+                    n_shared * pl)
+            first = int(nxt[0])
+            req.stages.append(
+                ("prefill", admit_t0, time.monotonic(),
+                 {"promptTokens": s, "slot": slot,
+                  "sharedPages": n_shared, "tenant": tenant}))
+            n_full = s // pl
+            tail_page = row[n_full] if s % pl else None
+            self.prefix.insert(prompt, row[:n_full], tail_page,
+                               np.asarray(last_logits[0]))
+
+        self._slot_req[slot] = req
+        self._slot_out[slot] = [first]
+        self._slot_left[slot] = new - 1
+        self._slot_t0[slot] = time.monotonic()
+        self._tok[slot, 0] = first
+        self._col[slot] = s
+        self._keys[slot] = np.asarray(sub_decode)
+        self._bt[slot, :] = 0
+        self._bt[slot, :len(row)] = row
+        self._slot_pages[slot] = row
+        self._slot_tenant[slot] = tenant
+        self.tokens_total += 1
+        if self._slot_left[slot] <= 0:
+            self._retire(slot)
+
+    def _retire(self, slot: int) -> None:
+        if not self._degraded:
+            pages = self._slot_pages[slot]
+            if pages:
+                self.pool.decref(pages, self._slot_tenant[slot])
+            self._slot_pages[slot] = []
+            self._slot_tenant[slot] = None
+            self._bt[slot, :] = 0  # lane appends go to the trash page
+        super()._retire(slot)
+
+    def _gather_width(self) -> int:
+        """Bounded paged gather: slice every block table to the
+        power-of-2 page bucket covering the longest LIVE stream, so
+        short streams never pay HBM reads for long-stream pages (and
+        the step compiles once per bucket, log2(pages/stream) total)."""
+        need = 1
+        for slot in range(self.slots):
+            if self._slot_req[slot] is not None:
+                need = max(need,
+                           int(self._col[slot]) // self.page_len + 1)
+        width = 1
+        while width < need:
+            width *= 2
+        return min(width, self._pages_per_slot)
+
+    def _run_step(self):
+        if self._degraded:
+            return super()._run_step()
+        import jax.numpy as jnp
+
+        width = self._gather_width()
+        nxt, self._pool_tree = self._pstep(
+            self._model.params, self._pool_tree,
+            jnp.asarray(self._tok), jnp.asarray(self._col),
+            jnp.asarray(self._bt[:, :width]),
+            jnp.asarray(self._keys))
+        return nxt
+
+    # -- degrade ladder ------------------------------------------------
+    def _degrade_to_slot(self) -> None:
+        """Latched ``kv_page_alloc``: fail in-flight paged streams,
+        drop the pool, build the contiguous slot path, and serve
+        every later request through the inherited machinery (one rung
+        down the degradation ladder, never an outage)."""
+        if self._degraded:
+            return
+        self._degraded = True
+        for slot in range(self.slots):
+            req = self._slot_req[slot]
+            self._slot_req[slot] = None
+            self._slot_out[slot] = []
+            self._slot_pages[slot] = []
+            self._slot_tenant[slot] = None
+            if req is not None:
+                req.fail(V.HttpError(
+                    V.HTTP_UNAVAILABLE,
+                    "session degraded to the slot KV path mid-stream "
+                    "(kv_page_alloc latched) — retry"))
+        self._pool_tree = None  # free the pool before the slot cache
+        self._tok[:] = 0
+        self._col[:] = 0
+        self._keys[:] = 0
+        self._slot_left[:] = 0
+        LMServingSession._init_decode_path(self)
+        obs_xray.release("kv-cache", ("kv", self.name, id(self)))
+        obs_xray.register("kv-cache", ("kv", self.name, id(self)),
+                          self._cache_bytes, name=self.name,
+                          slots=self.slots, cacheLen=self.cache_len,
+                          degraded=True)
+        obs_export.log_event("serving", "kv-degrade", model=self.name,
+                             streak=self._alloc_fault_streak)
+        obs_incidents.trigger("serving:kv-degrade", model=self.name,
+                              streak=self._alloc_fault_streak)
+
+    def stats(self) -> Dict[str, Any]:
+        out = super().stats()
+        tenants: Dict[str, Any] = {}
+        names = set(self.pool.tenants()) | set(self._tenant_latency)
+        for t in sorted(names):
+            tracker = self._tenant_latency.get(t)
+            tenants[t] = {
+                "weight": self._weight(t),
+                "pages": self.pool.tenant_pages(t),
+                "requests": self._tenant_requests.get(t, 0),
+                "latency": tracker.snapshot() if tracker else
+                {"count": 0, "p50Ms": 0.0, "p99Ms": 0.0},
+            }
+        kv = self.pool.stats()
+        kv["mode"] = "slot-degraded" if self._degraded else "paged"
+        prefix = self.prefix.stats()
+        prefix["prefillsSkipped"] = self.prefills_skipped
+        kv["prefix"] = prefix
+        kv["tenants"] = tenants
+        out["kv"] = kv
         return out
 
 
@@ -787,6 +1438,37 @@ class ServingManager:
             temperature, top_k, top_p = V.valid_sampling(body)
             if top_k is not None and top_k >= instance.vocab_size:
                 top_k = None
+            cfg = self._ctx.config
+            kv_mode = str(body.get("kv") or cfg.serve_kv or "slot")
+            if kv_mode not in ("slot", "paged"):
+                raise V.HttpError(
+                    V.HTTP_NOT_ACCEPTABLE,
+                    f"{V.MESSAGE_INVALID_FIELD}: kv must be 'slot' or "
+                    f"'paged', got {kv_mode!r}")
+            if kv_mode == "paged" and \
+                    hasattr(instance, "serve_fns_paged"):
+                page_len = V.valid_positive_int(
+                    body.get("pageLen"), "pageLen",
+                    default=int(cfg.serve_page_len))
+                # paged bookkeeping wants cache_len on a page
+                # boundary (block tables hold whole pages)
+                cache_len = max(
+                    page_len, (cache_len // page_len) * page_len)
+                pages_per = cache_len // page_len
+                # LO_SERVE_PAGES=0 auto-sizes the pool to the slot
+                # cache's HBM budget (slots x pages-per-stream, plus
+                # the reserved trash page) — the apples-to-apples
+                # setting the paged_serving bench gates on
+                n_pages = V.valid_positive_int(
+                    body.get("pages"), "pages",
+                    default=int(cfg.serve_pages)
+                    or slots * pages_per + 1)
+                n_pages = max(n_pages, pages_per + 1)
+                return PagedLMServingSession(
+                    model_name, self._ctx, lease, instance, slots,
+                    cache_len, temperature, top_k, top_p, page_len,
+                    n_pages,
+                    parse_tenant_weights(cfg.serve_tenant_weights))
             return LMServingSession(
                 model_name, self._ctx, lease, instance, slots,
                 cache_len, temperature, top_k, top_p)
@@ -868,6 +1550,20 @@ class ServingManager:
         }
         if any(v for v in agg.values()):
             out["perf"] = agg
+        # paged-KV roll-up for /metrics and the cluster monitor rings
+        kv_blocks = [p["kv"] for p in per if p.get("kv")]
+        if kv_blocks:
+            out["kv"] = {
+                "pagesTotal": sum(b["pagesTotal"] for b in kv_blocks),
+                "pagesFree": sum(b["pagesFree"] for b in kv_blocks),
+                "pagesShared": sum(
+                    b["pagesShared"] for b in kv_blocks),
+                "allocFailures": sum(
+                    b["allocFailures"] for b in kv_blocks),
+                "prefillsSkipped": sum(
+                    b["prefix"]["prefillsSkipped"]
+                    for b in kv_blocks),
+            }
         return out
 
     def perf_report(self, model_name: str) -> Optional[Dict[str, Any]]:

@@ -59,7 +59,13 @@ _SKIP_KEYS = {"platform", "rows", "epochs", "batch_size", "n_samples",
               "streams", "requests_per_stream", "prompt_len",
               "new_tokens", "points", "cohorts", "fused_trials",
               "best_lr", "n", "ring", "healthz_during",
-              "healthz_after"}
+              "healthz_after",
+              # paged_serving shape/chaos bookkeeping (the QoS counts
+              # are correctness-gated by ci.sh, not perf-gated here)
+              "slot_slots", "paged_slots", "cache_len", "page_len",
+              "budget_pages", "slot_kv_bytes", "paged_kv_bytes",
+              "bully_ok", "bully_rejected", "victim_ok",
+              "victim_rejected"}
 
 
 def _round_number(path: str) -> int:

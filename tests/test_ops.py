@@ -245,3 +245,143 @@ def test_gqa_grouped_kernel_matches_repeat(kvh, window):
     for a, r in zip(gf, gr):
         np.testing.assert_allclose(np.asarray(a), np.asarray(r),
                                    atol=5e-5, rtol=5e-5)
+
+
+# ------------------------------------------------- paged decode parity
+def _paged_view(k_cache, v_cache, page_len, seed, extra_pages=3):
+    """Scatter a contiguous (b, L, kv, d) cache across shuffled pages
+    of a pool whose every unreferenced row (including the reserved
+    trash page 0) is large-magnitude garbage — parity below proves
+    the garbage never leaks into a single output bit."""
+    rng = np.random.default_rng(seed)
+    b, length, kv, d = k_cache.shape
+    n_per = length // page_len
+    total = b * n_per + extra_pages + 1
+    ids = rng.permutation(total - 1)[:b * n_per] + 1  # page 0 reserved
+    bt = ids.reshape(b, n_per).astype(np.int32)
+    k_pool = rng.normal(size=(total, page_len, kv, d)) * 1e3
+    v_pool = rng.normal(size=(total, page_len, kv, d)) * 1e3
+    k_pool = k_pool.astype(np.float32)
+    v_pool = v_pool.astype(np.float32)
+    for i in range(b):
+        for p in range(n_per):
+            rows = slice(p * page_len, (p + 1) * page_len)
+            k_pool[bt[i, p]] = k_cache[i, rows]
+            v_pool[bt[i, p]] = v_cache[i, rows]
+    return k_pool, v_pool, bt
+
+
+@pytest.mark.parametrize("window", [0, 6])
+@pytest.mark.parametrize("with_pad", [False, True])
+def test_paged_decode_bit_parity(window, with_pad):
+    """paged_decode_attention == decode_attention BIT FOR BIT across
+    ragged per-row cache positions, sliding windows and left-pad
+    offsets — the contract the paged serving session's token streams
+    ride on (property-tested over random pools/tables)."""
+    from learningorchestra_tpu.ops.attention import (
+        decode_attention, paged_decode_attention)
+
+    b, length, page_len, h, kv, d = 5, 32, 8, 4, 2, 16
+    for trial in range(4):
+        rng = np.random.default_rng(200 + trial)
+        q = rng.normal(size=(b, 1, h, d)).astype(np.float32)
+        k_cache = rng.normal(size=(b, length, kv, d)).astype(np.float32)
+        v_cache = rng.normal(size=(b, length, kv, d)).astype(np.float32)
+        col = rng.integers(0, length, size=(b,)).astype(np.int32)
+        pad = (rng.integers(0, 3, size=(b,)).astype(np.int32)
+               if with_pad else None)
+        k_pool, v_pool, bt = _paged_view(
+            k_cache, v_cache, page_len, seed=300 + trial)
+        ref = decode_attention(
+            jnp.asarray(q), jnp.asarray(k_cache), jnp.asarray(v_cache),
+            jnp.asarray(col),
+            pad_offset=None if pad is None else jnp.asarray(pad),
+            window=window)
+        got = paged_decode_attention(
+            jnp.asarray(q), jnp.asarray(k_pool), jnp.asarray(v_pool),
+            jnp.asarray(bt), jnp.asarray(col),
+            pad_offset=None if pad is None else jnp.asarray(pad),
+            window=window)
+        assert np.array_equal(np.asarray(ref), np.asarray(got)), \
+            f"trial {trial}: paged decode diverged bitwise"
+
+
+def test_paged_decode_max_pages_clamp_is_bit_exact():
+    """The bounded gather (max_pages) must not change a single bit as
+    long as the clamp still covers every live col — short streams can
+    skip long-stream pages entirely."""
+    from learningorchestra_tpu.ops.attention import (
+        decode_attention, paged_decode_attention)
+
+    b, length, page_len, h, kv, d = 4, 32, 8, 4, 2, 16
+    rng = np.random.default_rng(42)
+    q = rng.normal(size=(b, 1, h, d)).astype(np.float32)
+    k_cache = rng.normal(size=(b, length, kv, d)).astype(np.float32)
+    v_cache = rng.normal(size=(b, length, kv, d)).astype(np.float32)
+    # every live col inside the first 2 pages of 4
+    col = np.asarray([3, 9, 15, 7], np.int32)
+    k_pool, v_pool, bt = _paged_view(k_cache, v_cache, page_len, seed=7)
+    ref = decode_attention(jnp.asarray(q), jnp.asarray(k_cache),
+                           jnp.asarray(v_cache), jnp.asarray(col))
+    full = paged_decode_attention(
+        jnp.asarray(q), jnp.asarray(k_pool), jnp.asarray(v_pool),
+        jnp.asarray(bt), jnp.asarray(col))
+    clamped = paged_decode_attention(
+        jnp.asarray(q), jnp.asarray(k_pool), jnp.asarray(v_pool),
+        jnp.asarray(bt), jnp.asarray(col), max_pages=2)
+    assert np.array_equal(np.asarray(ref), np.asarray(full))
+    assert np.array_equal(np.asarray(ref), np.asarray(clamped))
+    # and the clamp really shrinks the gather, not just the mask
+    sliced = paged_decode_attention(
+        jnp.asarray(q), jnp.asarray(k_pool), jnp.asarray(v_pool),
+        jnp.asarray(bt[:, :2]), jnp.asarray(col))
+    assert np.array_equal(np.asarray(clamped), np.asarray(sliced))
+
+
+def test_paged_append_token_matches_slot_scatter():
+    """One decode step's KV lands at the same logical rows whether
+    scattered into the slot cache or through block tables."""
+    from learningorchestra_tpu.ops.attention import paged_append_token
+
+    b, length, page_len, kv, d = 3, 16, 4, 2, 8
+    rng = np.random.default_rng(11)
+    cache = rng.normal(size=(b, length, kv, d)).astype(np.float32)
+    new = rng.normal(size=(b, kv, d)).astype(np.float32)
+    pos = np.asarray([0, 7, 15], np.int32)
+    k_pool, _, bt = _paged_view(cache, cache, page_len, seed=12)
+    rows = jnp.arange(b)
+    slot = jnp.asarray(cache).at[rows, jnp.asarray(pos)].set(
+        jnp.asarray(new))
+    pool = paged_append_token(jnp.asarray(k_pool), jnp.asarray(new),
+                              jnp.asarray(bt), jnp.asarray(pos),
+                              page_len)
+    gathered = np.asarray(pool)[bt].reshape(b, length, kv, d)
+    assert np.array_equal(np.asarray(slot), gathered)
+
+
+def test_paged_prefill_write_roundtrip_and_prefix_skip():
+    """Prompt KV rows written through paged_prefill_write read back
+    exactly; with a traced start_row the shared-prefix pages are
+    skipped and left untouched."""
+    from learningorchestra_tpu.ops.attention import paged_prefill_write
+
+    page_len, kv, d = 4, 2, 8
+    n_pages = 5
+    rng = np.random.default_rng(21)
+    pool = rng.normal(size=(12, page_len, kv, d)).astype(np.float32)
+    rows = rng.normal(size=(n_pages * page_len, kv, d)).astype(
+        np.float32)
+    ids = np.asarray([3, 7, 1, 9, 5], np.int32)
+    out = np.asarray(paged_prefill_write(
+        jnp.asarray(pool), jnp.asarray(rows), jnp.asarray(ids), 0))
+    got = out[ids].reshape(n_pages * page_len, kv, d)
+    assert np.array_equal(got, rows)
+    # skip the first two (shared) pages: only ids[2:] written, the
+    # shared pages' physical rows keep their prior contents
+    out2 = np.asarray(paged_prefill_write(
+        jnp.asarray(pool), jnp.asarray(rows),
+        jnp.asarray(ids[2:]), 2 * page_len))
+    assert np.array_equal(out2[ids[2:]].reshape(-1, kv, d),
+                          rows[2 * page_len:])
+    for skipped in ids[:2]:
+        assert np.array_equal(out2[skipped], pool[skipped])

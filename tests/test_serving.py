@@ -345,6 +345,273 @@ def test_hold_policy_keeps_slice_until_release():
     t.join(timeout=10)
 
 
+# ---------------------------------------------------- paged KV serving
+def _api_with(tmp_path, **overrides):
+    """An Api under a bespoke Config (fault_inject / tenant weights
+    need their own Config object, which the shared fixture can't
+    take). Pair with :func:`_close_api` in a try/finally."""
+    from learningorchestra_tpu.services import faults
+
+    config_mod.set_config(config_mod.Config(
+        home=str(tmp_path / "lo_home"), compute_dtype="float32",
+        serve_max_wait_ms=1.0, **overrides))
+    faults.reset()
+    from learningorchestra_tpu.services.server import Api
+
+    return Api()
+
+
+def _close_api(api):
+    from learningorchestra_tpu.services import faults
+
+    api.ctx.close()
+    faults.reset()
+    config_mod.reset_config()
+
+
+def _paged_session(api, **extra):
+    body = {"kv": "paged", "pageLen": 8, "maxSlots": 4, "cacheLen": 32,
+            "temperature": 0.7, "topK": 12}
+    body.update(extra)
+    status, resp, _ = api.dispatch(
+        "POST", f"{PREFIX}/serve/slm", {}, body)
+    assert status == 201, resp
+    assert resp["kv"]["mode"] == "paged"
+    return resp
+
+
+def _solo(lm, prompt, new, seed):
+    out = lm.generate(np.asarray([prompt], np.int32),
+                      max_new_tokens=new, temperature=0.7,
+                      top_k=12, seed=seed)
+    return [int(t) for t in out[0][len(prompt):]]
+
+
+def test_paged_serving_bit_identical_to_solo_decode(api):
+    """The paged pool + block-table decode must emit EXACTLY the slot
+    path's tokens: same fold_in key schedule, garbage pages masked to
+    exact zeros — bit for bit against solo ``generate``."""
+    lm = _fit_lm(api)
+    resp = _paged_session(api)
+    # auto pool size = slots x pages-per-stream (+ trash page, which
+    # pagesTotal already excludes) — the slot cache's HBM budget
+    assert resp["kv"]["pageLen"] == 8
+    assert resp["kv"]["pagesTotal"] == 4 * (32 // 8)
+
+    rng = np.random.default_rng(5)
+    specs = []
+    for i, (plen, new) in enumerate(
+            [(3, 5), (5, 8), (8, 6), (4, 9), (6, 7), (7, 5)]):
+        prompt = [int(t) for t in rng.integers(1, 48, size=plen)]
+        specs.append((prompt, new, 300 + i))
+    out = [None] * len(specs)
+
+    def client(i):
+        prompt, new, seed = specs[i]
+        time.sleep(0.03 * i)  # join mid-flight of earlier requests
+        s, b, _ = api.dispatch(
+            "POST", f"{PREFIX}/serve/slm/predict", {}, {
+                "prompt": prompt, "maxNewTokens": new, "seed": seed})
+        assert s == 200, b
+        out[i] = b["tokens"]
+
+    threads = [threading.Thread(target=client, args=(i,))
+               for i in range(len(specs))]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=120)
+    for i, (prompt, new, seed) in enumerate(specs):
+        assert out[i] == _solo(lm, prompt, new, seed), \
+            f"paged request {i} diverged from its solo decode"
+
+    stats = api.dispatch("GET", f"{PREFIX}/serve/slm", {}, None)[1]
+    assert stats["tokensTotal"] == sum(n for _, n, _ in specs)
+    assert stats["kv"]["mode"] == "paged"
+    assert stats["kv"]["allocFailures"] == 0
+    # manager roll-up + Prometheus rows exist while the session lives
+    mgr = api.ctx.serving.stats()
+    assert mgr["kv"]["pagesTotal"] == 16
+    text = api.metrics_prometheus()
+    assert b"lo_serving_kv_pages_free" in text
+    assert b"lo_serving_kv_prefills_skipped_total" in text
+    api.dispatch("DELETE", f"{PREFIX}/serve/slm", {}, None)
+
+
+def test_paged_prefix_reuse_shares_pages_and_skips_prefill(api):
+    """Prefix caching over the refcounted pool: an exact repeat skips
+    the prefill entirely, a shared-prefix prompt reuses the full
+    pages — and the pool-allocation ledger proves the sharing (fewer
+    fresh pages than a cold admit would take)."""
+    lm = _fit_lm(api)
+    _paged_session(api, maxSlots=2)
+
+    rng = np.random.default_rng(6)
+    prompt = [int(t) for t in rng.integers(1, 48, size=12)]
+    new = 6  # ceil((12+6)/8) = 3 pages cold
+
+    s, b, _ = api.dispatch(
+        "POST", f"{PREFIX}/serve/slm/predict", {},
+        {"prompt": prompt, "maxNewTokens": new, "seed": 7})
+    assert s == 200 and b["tokens"] == _solo(lm, prompt, new, 7)
+
+    # exact repeat, different seed: full hit — prefill skipped, the
+    # shared full page increfed, first token resampled bit-identically
+    # from the cached prefill logits under THIS request's key
+    s, b, _ = api.dispatch(
+        "POST", f"{PREFIX}/serve/slm/predict", {},
+        {"prompt": prompt, "maxNewTokens": new, "seed": 11})
+    assert s == 200 and b["tokens"] == _solo(lm, prompt, new, 11)
+
+    # same first page, different tail: partial chain hit — prefill
+    # runs but the shared page is reused, not re-allocated
+    prompt2 = prompt[:8] + [int(t) for t in rng.integers(1, 48, size=4)]
+    assert prompt2 != prompt
+    s, b, _ = api.dispatch(
+        "POST", f"{PREFIX}/serve/slm/predict", {},
+        {"prompt": prompt2, "maxNewTokens": new, "seed": 13})
+    assert s == 200 and b["tokens"] == _solo(lm, prompt2, new, 13)
+
+    kv = api.dispatch("GET", f"{PREFIX}/serve/slm", {}, None)[1]["kv"]
+    prefix = kv["prefix"]
+    assert prefix["hitsFull"] == 1
+    assert prefix["hitsPartial"] == 1
+    assert prefix["prefillsSkipped"] == 1
+    assert prefix["pagesReused"] == 2
+    # allocation accounting: cold 3, full hit 3-1, partial hit 3-1 —
+    # NOT 9; the shared page was never re-taken from the free list
+    assert kv["allocTotal"] == 7
+    # two cache entries hold (full, tailA) and (full again, tailC):
+    # 3 distinct pages held, the shared full page refcounted twice
+    assert kv["pagesFree"] == kv["pagesTotal"] - 3
+    assert kv["pagesShared"] == 1
+    api.dispatch("DELETE", f"{PREFIX}/serve/slm", {}, None)
+
+
+def test_paged_tenant_quota_and_weighted_qos(tmp_path):
+    """Weighted-fair page quotas: with another tenant live, a
+    weight-1 tenant over its share is 429'd while a weight-3 tenant's
+    identical demand admits; a sole tenant may use the whole pool.
+    Per-tenant latency series feed per-tenant servingP99 objectives."""
+    api = _api_with(tmp_path, serve_tenant_weights="vip:3,std:1")
+    try:
+        lm = _fit_lm(api)
+        # pages=7 -> 6 usable; a 4-page request is over a half-pool
+        # quota (3) but within a 3/4-pool quota (4)
+        _paged_session(api, maxSlots=2, pages=7)
+        session = api.ctx.serving._sessions["slm"]
+
+        # a second tenant holding pages arms the quota (deterministic
+        # stand-in for a concurrent victim stream)
+        held = session.pool.alloc(2, "victim")
+
+        rng = np.random.default_rng(8)
+        p_std = [int(t) for t in rng.integers(1, 48, size=8)]
+        p_vip = [int(t) for t in rng.integers(1, 48, size=8)]
+        big = {"maxNewTokens": 24, "seed": 21}  # ceil(32/8) = 4 pages
+
+        s, b, _ = api.dispatch(
+            "POST", f"{PREFIX}/serve/slm/predict", {},
+            dict(big, prompt=p_std, tenant="std"))
+        assert s == 429, b  # 0+4 > int(6 * 1/2)
+
+        s, b, _ = api.dispatch(
+            "POST", f"{PREFIX}/serve/slm/predict", {},
+            dict(big, prompt=p_vip, tenant="vip"))
+        assert s == 200, b  # 0+4 <= int(6 * 3/4)
+        assert b["tokens"] == _solo(lm, p_vip, 24, 21)
+
+        # victim gone -> std is the sole tenant: whole pool available
+        session.pool.decref(held, "victim")
+        s, b, _ = api.dispatch(
+            "POST", f"{PREFIX}/serve/slm/predict", {},
+            dict(big, prompt=p_std, tenant="std"))
+        assert s == 200, b
+        assert b["tokens"] == _solo(lm, p_std, 24, 21)
+
+        stats = session.stats()
+        assert stats["rejectedTotal"] >= 1
+        tenants = stats["kv"]["tenants"]
+        assert tenants["vip"]["weight"] == 3.0
+        assert tenants["vip"]["requests"] == 1
+        assert tenants["std"]["requests"] == 1
+        assert tenants["std"]["latency"]["count"] >= 1
+
+        # the per-tenant histogram series exists and the watchdog
+        # discovers a per-tenant page-severity objective from it
+        from learningorchestra_tpu.observability import hist as obs_hist
+        from learningorchestra_tpu.observability.slo import SloWatchdog
+
+        assert "lo_serving_request_seconds_tenant_vip" in \
+            obs_hist.names()
+        wd = SloWatchdog()
+        wd.evaluate()
+        objectives = wd.objectives()
+        assert "servingP99:vip" in objectives
+        assert objectives["servingP99:vip"]["severity"] == "page"
+    finally:
+        _close_api(api)
+
+
+def test_paged_kv_alloc_transient_fault_is_retryable(tmp_path):
+    """A transient kv_page_alloc fault surfaces as one 429; the
+    retry admits normally and the session stays on the paged path."""
+    api = _api_with(tmp_path, fault_inject="kv_page_alloc:1")
+    try:
+        lm = _fit_lm(api)
+        _paged_session(api)
+        rng = np.random.default_rng(9)
+        prompt = [int(t) for t in rng.integers(1, 48, size=6)]
+
+        body = {"prompt": prompt, "maxNewTokens": 5, "seed": 31}
+        s, b, _ = api.dispatch(
+            "POST", f"{PREFIX}/serve/slm/predict", {}, body)
+        assert s == 429, b
+
+        s, b, _ = api.dispatch(
+            "POST", f"{PREFIX}/serve/slm/predict", {}, body)
+        assert s == 200, b
+        assert b["tokens"] == _solo(lm, prompt, 5, 31)
+
+        stats = api.dispatch("GET", f"{PREFIX}/serve/slm", {}, None)[1]
+        assert stats["kv"]["mode"] == "paged"
+        assert stats["rejectedTotal"] == 1
+    finally:
+        _close_api(api)
+
+
+def test_paged_kv_alloc_latched_fault_degrades_to_slot(tmp_path):
+    """A latched kv_page_alloc fault (3 consecutive failures) walks
+    one rung down the degradation ladder: the session rebuilds the
+    contiguous slot path and every later request serves through it,
+    still bit-identical to solo decode."""
+    api = _api_with(tmp_path, fault_inject="kv_page_alloc:100")
+    try:
+        lm = _fit_lm(api)
+        _paged_session(api)
+        rng = np.random.default_rng(10)
+        prompt = [int(t) for t in rng.integers(1, 48, size=6)]
+
+        for _ in range(3):
+            s, b, _ = api.dispatch(
+                "POST", f"{PREFIX}/serve/slm/predict", {},
+                {"prompt": prompt, "maxNewTokens": 5, "seed": 41})
+            assert s == 429, b
+
+        stats = api.dispatch("GET", f"{PREFIX}/serve/slm", {}, None)[1]
+        assert stats["kv"]["mode"] == "slot-degraded"
+
+        # the slot path never calls kv_page_alloc: the still-armed
+        # fault budget cannot touch it
+        s, b, _ = api.dispatch(
+            "POST", f"{PREFIX}/serve/slm/predict", {},
+            {"prompt": prompt, "maxNewTokens": 5, "seed": 41})
+        assert s == 200, b
+        assert b["tokens"] == _solo(lm, prompt, 5, 41)
+    finally:
+        _close_api(api)
+
+
 def test_two_sessions_time_share_single_lease_mesh(api):
     """On the default counting mesh (LO_MESH_LEASES=1) a second
     session's create must NOT hang behind the first: sessions never
