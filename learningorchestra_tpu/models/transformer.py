@@ -2035,13 +2035,18 @@ class LanguageModel:
             prefill_cache[s] = prefill
             return prefill
 
-        @jax.jit
+        # both donate the pool like step() does: without donation
+        # every prefill join / tail clone materializes a second full
+        # copy of the page pool in HBM (transient 2x footprint per
+        # layer tree), which would break equal-HBM sizing at large
+        # pool sizes
+        @functools.partial(jax.jit, donate_argnums=(0,))
         def join_paged(pool, pcache, page_ids, start_row):
             return jax.tree_util.tree_map(
                 lambda pl, pc: attn_ops.paged_prefill_write(
                     pl, pc[0], page_ids, start_row), pool, pcache)
 
-        @jax.jit
+        @functools.partial(jax.jit, donate_argnums=(0,))
         def copy_page(pool, src, dst):
             return jax.tree_util.tree_map(
                 lambda pl: pl.at[dst].set(pl[src]), pool)

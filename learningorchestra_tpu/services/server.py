@@ -573,8 +573,10 @@ class Api:
         # paged-KV pool state per session (services/serving.py
         # PagedLMServingSession): free/shared pages, prefix reuse and
         # per-tenant page holdings
+        # NB: pool size is a gauge, so the metric must not end in
+        # _total (the suffix drives the TYPE annotation below)
         for metric, kv_value in (
-                ("lo_serving_kv_pages_total",
+                ("lo_serving_kv_pages",
                  lambda kv: kv["pagesTotal"]),
                 ("lo_serving_kv_pages_free",
                  lambda kv: kv["pagesFree"]),

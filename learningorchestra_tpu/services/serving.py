@@ -869,6 +869,7 @@ class PagedLMServingSession(LMServingSession):
     """
 
     _DEGRADE_AFTER = 3
+    _MAX_TENANT_SERIES = 32
 
     def __init__(self, name: str, ctx, lease: ServingLease, model,
                  slots: int, cache_len: int, temperature: float,
@@ -909,6 +910,7 @@ class PagedLMServingSession(LMServingSession):
         self._slot_tenant: List[Optional[str]] = [None] * self.slots
         self._tenant_latency: Dict[str, LatencyTracker] = {}
         self._tenant_requests: Dict[str, int] = {}
+        self._adhoc_tenants: set = set()
         self._alloc_fault_streak = 0
         self._degraded = False
         self.prefills_skipped = 0
@@ -928,6 +930,26 @@ class PagedLMServingSession(LMServingSession):
                 tenant, LatencyTracker())
         return tracker
 
+    def _tenant_series(self, tenant: str) -> str:
+        """Bounded observability cardinality for a client-controlled
+        field: every distinct ``tenant`` value mints a global
+        histogram series, a latency tracker, and a page-severity
+        ``servingP99:{tenant}`` watchdog objective, none of which are
+        ever pruned. Tenants named in ``LO_SERVE_TENANT_WEIGHTS``
+        always get their own series; beyond those, only the first
+        ``_MAX_TENANT_SERIES`` distinct ad-hoc values do — the rest
+        collapse into ``other`` so an untrusted client cannot drive
+        unbounded memory growth or alert-cardinality explosion.
+        Quota/fairness accounting keeps the raw tenant (the pool's
+        per-tenant charges self-prune at zero pages)."""
+        if tenant in self._tenant_weights or \
+                tenant in self._adhoc_tenants:
+            return tenant
+        if len(self._adhoc_tenants) < self._MAX_TENANT_SERIES:
+            self._adhoc_tenants.add(tenant)
+            return tenant
+        return "other"
+
     def validate_request(self, payload: Dict[str, Any]) -> None:
         super().validate_request(payload)
         tenant = payload.get("tenant")
@@ -945,13 +967,14 @@ class PagedLMServingSession(LMServingSession):
         t0 = time.monotonic()
         result = super().submit(payload, timeout=timeout)
         elapsed = time.monotonic() - t0
-        self._tenant_tracker(tenant).record(elapsed)
-        self._tenant_requests[tenant] = \
-            self._tenant_requests.get(tenant, 0) + 1
+        series = self._tenant_series(tenant)
+        self._tenant_tracker(series).record(elapsed)
+        self._tenant_requests[series] = \
+            self._tenant_requests.get(series, 0) + 1
         # a per-tenant histogram series feeds the watchdog's
         # per-tenant servingP99 objective (observability/slo.py)
         obs_hist.observe("lo_serving_request_seconds_tenant_"
-                         + _metric_tenant(tenant), elapsed)
+                         + _metric_tenant(series), elapsed)
         return result
 
     def _quota_check(self, tenant: str, need: int) -> None:
@@ -1052,57 +1075,89 @@ class PagedLMServingSession(LMServingSession):
         entry = self.prefix.lookup_full(prompt)
         if entry is not None:
             shared = list(entry["fullPages"])
+            donor_tail = entry["tailPage"]
+            donor_logits = entry["logits"]
         else:
             shared, _ = self.prefix.lookup_partial(prompt)
             shared = shared or []
+            donor_tail = None
+            donor_logits = None
         n_shared = len(shared)
-        self._quota_check(tenant, total_pages)
-        fresh = self._alloc_pages(total_pages - n_shared, tenant)
+        # Pin the looked-up pages BEFORE quota/alloc: under pool
+        # pressure _alloc_pages LRU-evicts prefix entries, which could
+        # drop the very entry backing this admission — its pages would
+        # decref to 0 and come back as `fresh` (page aliasing: the
+        # prefill/tail clone would overwrite live shared prompt KV).
+        # Our own references keep them allocated. The donor tail pin
+        # is transient (held only until the clone is dispatched) so it
+        # is not charged to the tenant.
         if shared:
             self.pool.incref(shared, tenant)
-        row = shared + fresh
+        if donor_tail is not None:
+            self.pool.incref([donor_tail])
+        fresh: List[int] = []
+        try:
+            # the shared pages are already charged to the tenant, so
+            # the quota headroom needed is only the fresh pages
+            self._quota_check(tenant, total_pages - n_shared)
+            fresh = self._alloc_pages(total_pages - n_shared, tenant)
+            row = shared + fresh
 
-        if entry is not None:
-            # FULL hit: no prefill compute at all. Clone the donor's
-            # tail page (its decode rows past the prompt are masked
-            # until this stream overwrites them) and resample the
-            # first token from the cached final logits — the same
-            # floats the prefill epilogue would produce.
-            tail = entry["tailPage"]
-            if tail is not None:
-                self._pool_tree = self._copy_page(
-                    self._pool_tree, jnp.asarray(np.int32(tail)),
-                    jnp.asarray(np.int32(fresh[0])))
-            first = int(self._sample_first(
-                jnp.asarray(entry["logits"]), sub_prefill))
-            self.prefills_skipped += 1
-            req.stages.append(
-                ("prefixHit", admit_t0, time.monotonic(),
-                 {"promptTokens": s, "slot": slot,
-                  "sharedPages": n_shared, "tenant": tenant}))
-        else:
-            prefill = self._pprefill_for(s)
-            tokens = jnp.asarray(np.asarray(prompt, np.int32)[None, :])
-            nxt, last_logits, pcache = prefill(
-                self._model.params, tokens, sub_prefill)
-            # write prompt KV straight into this stream's pages,
-            # starting after any shared prefix pages
-            n_prefill_pages = -(-s // pl)
-            write_pages = row[n_shared:n_prefill_pages]
-            if write_pages:
-                self._pool_tree = self._pjoin(
-                    self._pool_tree, pcache,
-                    jnp.asarray(np.asarray(write_pages, np.int32)),
-                    n_shared * pl)
-            first = int(nxt[0])
-            req.stages.append(
-                ("prefill", admit_t0, time.monotonic(),
-                 {"promptTokens": s, "slot": slot,
-                  "sharedPages": n_shared, "tenant": tenant}))
-            n_full = s // pl
-            tail_page = row[n_full] if s % pl else None
-            self.prefix.insert(prompt, row[:n_full], tail_page,
-                               np.asarray(last_logits[0]))
+            if entry is not None:
+                # FULL hit: no prefill compute at all. Clone the
+                # donor's tail page (its decode rows past the prompt
+                # are masked until this stream overwrites them) and
+                # resample the first token from the cached final
+                # logits — the same floats the prefill epilogue would
+                # produce.
+                if donor_tail is not None:
+                    self._pool_tree = self._copy_page(
+                        self._pool_tree,
+                        jnp.asarray(np.int32(donor_tail)),
+                        jnp.asarray(np.int32(fresh[0])))
+                first = int(self._sample_first(
+                    jnp.asarray(donor_logits), sub_prefill))
+                self.prefills_skipped += 1
+                req.stages.append(
+                    ("prefixHit", admit_t0, time.monotonic(),
+                     {"promptTokens": s, "slot": slot,
+                      "sharedPages": n_shared, "tenant": tenant}))
+            else:
+                prefill = self._pprefill_for(s)
+                tokens = jnp.asarray(
+                    np.asarray(prompt, np.int32)[None, :])
+                nxt, last_logits, pcache = prefill(
+                    self._model.params, tokens, sub_prefill)
+                # write prompt KV straight into this stream's pages,
+                # starting after any shared prefix pages
+                n_prefill_pages = -(-s // pl)
+                write_pages = row[n_shared:n_prefill_pages]
+                if write_pages:
+                    self._pool_tree = self._pjoin(
+                        self._pool_tree, pcache,
+                        jnp.asarray(np.asarray(write_pages, np.int32)),
+                        n_shared * pl)
+                first = int(nxt[0])
+                req.stages.append(
+                    ("prefill", admit_t0, time.monotonic(),
+                     {"promptTokens": s, "slot": slot,
+                      "sharedPages": n_shared, "tenant": tenant}))
+                n_full = s // pl
+                tail_page = row[n_full] if s % pl else None
+                self.prefix.insert(prompt, row[:n_full], tail_page,
+                                   np.asarray(last_logits[0]))
+        except BaseException:
+            # quota reject, alloc failure, or a prefill/clone error:
+            # release every reference this admission took, or the
+            # pages (and the tenant's quota charge) leak and the pool
+            # permanently shrinks toward starved admissions
+            if shared or fresh:
+                self.pool.decref(shared + fresh, tenant)
+            if donor_tail is not None:
+                self.pool.decref([donor_tail])
+            raise
+        if donor_tail is not None:
+            self.pool.decref([donor_tail])
 
         self._slot_req[slot] = req
         self._slot_out[slot] = [first]

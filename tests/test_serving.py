@@ -488,6 +488,128 @@ def test_paged_prefix_reuse_shares_pages_and_skips_prefill(api):
     api.dispatch("DELETE", f"{PREFIX}/serve/slm", {}, None)
 
 
+def test_paged_admission_survives_prefix_eviction_under_pressure(api):
+    """Pool pressure during a prefix-HIT admission LRU-evicts prefix
+    entries — possibly the very entry backing the hit. The admission
+    pins the looked-up pages before quota/alloc, so they can neither
+    return to the free list nor be re-handed out as `fresh` (aliasing
+    would let the tail clone overwrite shared prompt KV). With
+    nothing else reclaimable the request 429s, every reference taken
+    is released (no pool shrink, no quota inflation), and the pool
+    serves the next request normally."""
+    lm = _fit_lm(api)
+    _paged_session(api, maxSlots=2)  # 8 usable pages
+    session = api.ctx.serving._sessions["slm"]
+
+    rng = np.random.default_rng(9)
+    prompt = [int(t) for t in rng.integers(1, 48, size=12)]
+    new = 6  # 3 pages: 1 full prompt page + tail + decode
+
+    s, b, _ = api.dispatch(
+        "POST", f"{PREFIX}/serve/slm/predict", {},
+        {"prompt": prompt, "maxNewTokens": new, "seed": 17})
+    assert s == 200, b
+    assert len(session.prefix) == 1  # entry holds full + tail pages
+
+    # drain the free list: the prefix entry is the only reclaimable
+    # tier left when the repeat admission needs fresh pages
+    hog = session.pool.alloc(session.pool.free_count(), "hog")
+
+    s, b, _ = api.dispatch(
+        "POST", f"{PREFIX}/serve/slm/predict", {},
+        {"prompt": prompt, "maxNewTokens": new, "seed": 19})
+    assert s == 429, b
+    assert len(session.prefix) == 0  # the LRU entry was reclaimed
+    # the admission's shared/tail pins were released on failure, so
+    # the evicted entry's two pages are back on the free list and the
+    # tenant's quota charge is gone
+    assert session.pool.free_count() == 2
+    assert session.pool.tenant_pages("default") == 0
+
+    # pool integrity: with the pressure gone the same request admits
+    # cold, bit-identical to the solo decode
+    session.pool.decref(hog, "hog")
+    s, b, _ = api.dispatch(
+        "POST", f"{PREFIX}/serve/slm/predict", {},
+        {"prompt": prompt, "maxNewTokens": new, "seed": 19})
+    assert s == 200, b
+    assert b["tokens"] == _solo(lm, prompt, new, 19)
+    api.dispatch("DELETE", f"{PREFIX}/serve/slm", {}, None)
+
+
+def test_paged_admission_failure_releases_pages(api, monkeypatch):
+    """A failure AFTER page allocation (prefill compile/device error)
+    must decref everything the admission took — otherwise the pool
+    permanently shrinks and the tenant's quota stays inflated until
+    admissions starve. The retry then serves normally."""
+    lm = _fit_lm(api)
+    _paged_session(api)
+    session = api.ctx.serving._sessions["slm"]
+    free0 = session.pool.free_count()
+
+    real_prefill_for = session._pprefill_for
+
+    def boom(s):
+        raise RuntimeError("injected prefill failure")
+
+    monkeypatch.setattr(session, "_pprefill_for", boom)
+    rng = np.random.default_rng(10)
+    prompt = [int(t) for t in rng.integers(1, 48, size=10)]
+    s, b, _ = api.dispatch(
+        "POST", f"{PREFIX}/serve/slm/predict", {},
+        {"prompt": prompt, "maxNewTokens": 5, "seed": 23})
+    assert s == 503, b
+    assert session.pool.free_count() == free0
+    assert session.pool.tenant_pages("default") == 0
+
+    monkeypatch.setattr(session, "_pprefill_for", real_prefill_for)
+    s, b, _ = api.dispatch(
+        "POST", f"{PREFIX}/serve/slm/predict", {},
+        {"prompt": prompt, "maxNewTokens": 5, "seed": 23})
+    assert s == 200, b
+    assert b["tokens"] == _solo(lm, prompt, 5, 23)
+    api.dispatch("DELETE", f"{PREFIX}/serve/slm", {}, None)
+
+
+def test_paged_tenant_series_cardinality_is_bounded(tmp_path):
+    """The tenant tag is client-controlled: distinct values beyond the
+    configured weights plus ``_MAX_TENANT_SERIES`` ad-hoc names must
+    collapse into the ``other`` series instead of minting unbounded
+    histograms, latency trackers, and watchdog objectives."""
+    api = _api_with(tmp_path, serve_tenant_weights="vip:3")
+    try:
+        _fit_lm(api)
+        _paged_session(api)
+        session = api.ctx.serving._sessions["slm"]
+        monkeypatch_cap = 2
+        session._MAX_TENANT_SERIES = monkeypatch_cap
+
+        rng = np.random.default_rng(11)
+        for i, tenant in enumerate(
+                ["vip", "t0", "t1", "t2", "t3", "vip"]):
+            prompt = [int(t) for t in rng.integers(1, 48, size=6)]
+            s, b, _ = api.dispatch(
+                "POST", f"{PREFIX}/serve/slm/predict", {},
+                {"prompt": prompt, "maxNewTokens": 4,
+                 "seed": 31 + i, "tenant": tenant})
+            assert s == 200, b
+
+        # configured tenant + first `cap` ad-hoc tenants keep their
+        # own series; the overflow lands in `other`
+        assert set(session._tenant_requests) == \
+            {"vip", "t0", "t1", "other"}
+        assert session._tenant_requests["vip"] == 2
+        assert session._tenant_requests["other"] == 2
+        from learningorchestra_tpu.observability import hist as obs_hist
+
+        names = obs_hist.names()
+        assert "lo_serving_request_seconds_tenant_other" in names
+        assert "lo_serving_request_seconds_tenant_t2" not in names
+        assert "lo_serving_request_seconds_tenant_t3" not in names
+    finally:
+        _close_api(api)
+
+
 def test_paged_tenant_quota_and_weighted_qos(tmp_path):
     """Weighted-fair page quotas: with another tenant live, a
     weight-1 tenant over its share is 429'd while a weight-3 tenant's
