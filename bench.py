@@ -159,8 +159,8 @@ def _expect_created(status, body):
 
 def _wait(api, uri, timeout=1800.0):
     name = uri.rstrip("/").split("/")[-1]
-    deadline = time.time() + timeout
-    while time.time() < deadline:
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
         status, body, _ = api.dispatch("GET", uri, {"limit": "1"}, None)
         if status == 200 and body["metadata"].get("finished"):
             return body["metadata"]
@@ -1500,8 +1500,8 @@ def phase_monitor_smoke():
         # every predict rides a ~0.25 s injected iteration sleep; the
         # background watchdog must see a >60 ms p99 in the fast AND
         # slow windows and fire the page alert
-        deadline = time.time() + 90
-        while not fired() and time.time() < deadline:
+        deadline = time.monotonic() + 90
+        while not fired() and time.monotonic() < deadline:
             predict()
         out["alert_fired"] = fired()
         status, _, _ = api.dispatch("GET", "/healthz", {}, None)
@@ -1513,8 +1513,8 @@ def phase_monitor_smoke():
         # clear the fault and stop sending: once the fast window holds
         # no slow observations the alert resolves on its own
         api.ctx.config.fault_inject = ""
-        deadline = time.time() + 60
-        while fired() and time.time() < deadline:
+        deadline = time.monotonic() + 60
+        while fired() and time.monotonic() < deadline:
             time.sleep(0.2)
         out["alert_resolved"] = not fired()
         status, _, _ = api.dispatch("GET", "/healthz", {}, None)
@@ -1622,8 +1622,8 @@ def phase_incident_smoke():
             return [b for b in recorder.list()
                     if b["trigger"] == "slo:servingP99"]
 
-        deadline = time.time() + 90
-        while not slo_bundles() and time.time() < deadline:
+        deadline = time.monotonic() + 90
+        while not slo_bundles() and time.monotonic() < deadline:
             s2, b2, _ = api.dispatch(
                 "POST", f"{prefix}/serve/inc_clf/predict", {},
                 {"x": rows})
@@ -2437,8 +2437,8 @@ def phase_xray_overhead():
                     "epochs": 6, "batch_size": 64}})
         _expect_created(status, body)
         train_uri = body["result"]
-        deadline = time.time() + 300
-        while time.time() < deadline:
+        deadline = time.monotonic() + 300
+        while time.monotonic() < deadline:
             owners_seen |= {o for o, n in obs_xray.by_owner().items()
                             if n > 0}
             s2, b2, _ = api.dispatch(

@@ -21,6 +21,25 @@ cd "$REPO"
 echo "== selflint =="
 python scripts/selflint.py
 
+echo "== concurrency-lint: lock-order graph + witness hierarchy =="
+# The concurrency pass (analysis/concurrency.py) runs inside selflint;
+# this stage re-runs it in --json and fails on any error-severity
+# finding, so the machine-readable artifact is in the CI log
+# (docs/ANALYSIS.md "Concurrency passes").
+LINT_OUT="$(mktemp)"
+python scripts/selflint.py --json > "$LINT_OUT" || {
+  cat "$LINT_OUT"
+  echo "concurrency-lint: error-severity findings" >&2
+  exit 1
+}
+python - "$LINT_OUT" <<'EOF'
+import json, sys
+doc = json.load(open(sys.argv[1]))
+counts = doc["counts"]
+assert counts["error"] == 0, doc["findings"]
+print(f"concurrency-lint: OK ({counts['warning']} waived warning(s))")
+EOF
+
 echo "== tier-1 tests =="
 TIMEOUT="${LO_CI_TIMEOUT:-870}"
 timeout -k 10 "$TIMEOUT" env JAX_PLATFORMS=cpu \
@@ -50,11 +69,15 @@ echo "== chaos: lifecycle under fault injection =="
 # every checkpointed train through the async tiered manager, and the
 # async/migration suites ride along — they arm the
 # ckpt_async_commit / migration fault sites themselves
-# (docs/RELIABILITY.md).
+# (docs/RELIABILITY.md). LO_LOCK_WITNESS=1 arms the runtime
+# lock-order witness in raise mode for the whole stage: any
+# out-of-order acquisition under chaos fails the build
+# (docs/ANALYSIS.md "Concurrency passes").
 CHAOS_TIMEOUT="${LO_CI_CHAOS_TIMEOUT:-300}"
 timeout -k 10 "$CHAOS_TIMEOUT" env JAX_PLATFORMS=cpu \
     LO_FAULT_INJECT="job_run:1:hang:0.2,artifact_save:1:latency:0.05" \
     LO_CKPT_ASYNC=1 \
+    LO_LOCK_WITNESS=1 \
     python -m pytest tests/test_faults.py tests/test_lifecycle.py \
     tests/test_async_ckpt.py tests/test_migration.py \
     tests/test_autoscaler.py -q \

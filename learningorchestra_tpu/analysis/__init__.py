@@ -13,6 +13,12 @@ Two passes, both producing structured :class:`Finding` records:
   ``ShapeDtypeStruct``s derived from catalog metadata, so a
   shape-mismatched spec is rejected with HTTP 406 at submit time
   instead of failing inside the job.
+- :mod:`concurrency` — the framework's own lock discipline, checked
+  statically: a lock-acquisition graph from ``with`` nesting and call
+  edges validated against the declared hierarchy in
+  :mod:`learningorchestra_tpu.runtime.locks`, plus
+  blocking-under-lock and callback-under-lock rules. Run by
+  ``scripts/selflint.py`` (docs/ANALYSIS.md "Concurrency passes").
 
 Both passes are gated by ``Config.preflight`` and NEVER false-reject:
 anything the analyzer cannot model is bypassed, not failed.

@@ -37,6 +37,7 @@ import pyarrow.parquet as pq
 
 from learningorchestra_tpu.catalog import documents as D
 from learningorchestra_tpu.catalog.artifacts import validate_safe_name
+from learningorchestra_tpu.runtime import locks
 
 _SCHEMA = """
 CREATE TABLE IF NOT EXISTS collections (
@@ -75,7 +76,7 @@ class Catalog:
         os.makedirs(datasets_dir, exist_ok=True)
         os.makedirs(os.path.dirname(db_path) or ".", exist_ok=True)
         self._local = threading.local()
-        self._change_cond = threading.Condition()
+        self._change_cond = locks.make_condition("catalog.change")
         with self._conn() as conn:
             conn.executescript(_SCHEMA)
 

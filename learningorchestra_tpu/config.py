@@ -19,6 +19,7 @@ import os
 import threading
 from pathlib import Path
 from typing import Any, Optional
+from learningorchestra_tpu.runtime import locks
 
 
 @dataclasses.dataclass
@@ -545,7 +546,7 @@ class Config:
         return dataclasses.replace(self, **kwargs)
 
 
-_lock = threading.Lock()
+_lock = locks.make_lock("config.global")
 _config: Optional[Config] = None
 
 

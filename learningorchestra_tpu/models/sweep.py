@@ -42,6 +42,7 @@ import numpy as np
 
 from learningorchestra_tpu.observability import trace as obs_trace
 from learningorchestra_tpu.runtime import mesh as mesh_lib
+from learningorchestra_tpu.runtime import locks
 
 # hyperparameter names routed into the optimizer spec
 _OPTIMIZER_KEYS = {"kind", "learning_rate", "lr", "momentum",
@@ -52,7 +53,7 @@ _FIT_KEYS = {"batch_size", "epochs"}
 
 # process-wide fusion counters, exported as lo_sweep_* gauges by the
 # /metrics endpoint (services/server.py)
-_FUSION_LOCK = threading.Lock()
+_FUSION_LOCK = locks.make_lock("sweep.fusion")
 _FUSION_STATS = {"fusedTrials": 0, "cohorts": 0, "fallbackTrials": 0,
                  "earlyStopped": 0, "trialErrors": 0}
 

@@ -20,8 +20,9 @@ import subprocess
 import tempfile
 import threading
 from typing import Optional
+from learningorchestra_tpu.runtime import locks
 
-_LOCK = threading.Lock()
+_LOCK = locks.make_lock("native.registry")
 _LIB: Optional[ctypes.CDLL] = None
 _TRIED = False
 _ABI_VERSION = 2

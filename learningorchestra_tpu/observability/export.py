@@ -22,8 +22,9 @@ import time
 from typing import Any, Dict, List, Optional
 
 from learningorchestra_tpu.observability import trace as trace_lib
+from learningorchestra_tpu.runtime import locks
 
-_log_lock = threading.Lock()
+_log_lock = locks.make_lock("export.log")
 
 
 def chrome_trace(trace_id: str) -> Optional[Dict[str, Any]]:

@@ -22,13 +22,14 @@ from __future__ import annotations
 import math
 import threading
 from typing import Dict, List, Optional, Sequence, Tuple
+from learningorchestra_tpu.runtime import locks
 
 # le-style upper bounds (seconds); +Inf is implicit
 DEFAULT_BUCKETS: Tuple[float, ...] = (
     0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
     1.0, 2.5, 5.0, 10.0, 30.0, 60.0, 120.0, 300.0)
 
-_lock = threading.Lock()
+_lock = locks.make_lock("hist.registry")
 _registry: Dict[str, "Histogram"] = {}
 
 
@@ -47,7 +48,7 @@ class Histogram:
         self._counts = [0] * (len(self.buckets) + 1)  # +1 = +Inf
         self._sum = 0.0
         self._count = 0
-        self._lock = threading.Lock()
+        self._lock = locks.make_lock("hist.buckets")
 
     def observe(self, value: float) -> None:
         v = float(value)

@@ -52,6 +52,7 @@ from learningorchestra_tpu.observability import perf as obs_perf
 from learningorchestra_tpu.observability import timeline as obs_timeline
 from learningorchestra_tpu.observability import trace as obs_trace
 from learningorchestra_tpu.observability import xray as obs_xray
+from learningorchestra_tpu.runtime import locks
 
 # rings whose newest names ride along as implicated evidence even
 # when the trigger context names nothing (manual captures)
@@ -84,7 +85,7 @@ def _cfg():
 # ----------------------------------------------------------------------
 # build info: what exactly was running (versions.json + lo_build_info)
 # ----------------------------------------------------------------------
-_build_info_lock = threading.Lock()
+_build_info_lock = locks.make_lock("incidents.buildinfo")
 _build_info_cache: Optional[Dict[str, str]] = None
 
 
@@ -131,7 +132,7 @@ class ProfilerGate:
     unbounded."""
 
     def __init__(self) -> None:
-        self._lock = threading.Lock()
+        self._lock = locks.make_lock("incidents.profiler")
         self._active: Optional[str] = None
         self._timer: Optional[threading.Timer] = None
         self._last_auto_stop: Optional[Dict[str, Any]] = None
@@ -254,8 +255,8 @@ class FlightRecorder:
         self._stats = stats_snapshot
         self._active_names = active_names
         self._gate = profiler_gate or get_profiler_gate()
-        self._lock = threading.Lock()        # cooldown + counters
-        self._commit_lock = threading.Lock()  # one bundle at a time
+        self._lock = locks.make_lock("incidents.queue")        # cooldown + counters
+        self._commit_lock = locks.make_lock("incidents.commit")  # one bundle at a time
         self._last: Dict[str, float] = {}
         self._counts: Dict[str, int] = {}
         self._dropped = 0
@@ -600,7 +601,7 @@ def _jsonable(v: Any) -> Any:
 # process-wide registry: trigger sites (slo.py, jobs.py, the health
 # listener) reach the live recorder without holding a context ref
 # ----------------------------------------------------------------------
-_registry_lock = threading.Lock()
+_registry_lock = locks.make_lock("incidents.registry")
 _recorder: Optional[FlightRecorder] = None
 _profiler_gate: Optional[ProfilerGate] = None
 

@@ -32,6 +32,7 @@ import os
 import threading
 import time
 from typing import Any, Callable, Dict, List, Optional
+from learningorchestra_tpu.runtime import locks
 
 # scalar series kept as (ts, value) rings; everything else only in the
 # latest structured sample
@@ -133,7 +134,7 @@ class ClusterMonitor:
         self._arena_stats = arena_stats
         self._device_stats = device_stats
         self.watchdog = watchdog
-        self._lock = threading.Lock()
+        self._lock = locks.make_lock("monitor.rings")
         self._series: Dict[str, "collections.deque"] = {
             name: collections.deque(maxlen=self._ring)
             for name in _SCALAR_SERIES}
@@ -292,7 +293,7 @@ class ClusterMonitor:
 # the durable copy is the `peakHbmBytes` field on the job's terminal
 # metadata, which the update path reads back directly.
 
-_cal_lock = threading.Lock()
+_cal_lock = locks.make_lock("monitor.calibration")
 _measured_peaks: Dict[str, int] = {}
 
 

@@ -32,6 +32,7 @@ import os
 import threading
 import time
 from typing import Any, Dict, List, Optional
+from learningorchestra_tpu.runtime import locks
 
 # per-chip dense bf16 peak FLOP/s, public spec-sheet numbers; substring
 # matched against jax's device_kind (moved from runtime/engine.py)
@@ -60,7 +61,7 @@ PEAK_HBM_BYTES = (
 
 _MAX_JOBS = 128
 
-_lock = threading.Lock()
+_lock = locks.make_lock("perf.registry")
 _reports: "collections.OrderedDict[str, Dict[str, Any]]" = \
     collections.OrderedDict()
 

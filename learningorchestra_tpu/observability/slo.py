@@ -32,6 +32,7 @@ from typing import Any, Callable, Dict, List, Optional
 from learningorchestra_tpu.observability import export as obs_export
 from learningorchestra_tpu.observability import hist as obs_hist
 from learningorchestra_tpu.observability import incidents as obs_incidents
+from learningorchestra_tpu.runtime import locks
 
 _HISTORY = 256
 
@@ -116,7 +117,7 @@ class SloWatchdog:
                  active_trace: Optional[Callable[
                      [], Optional[str]]] = None):
         self._active_trace = active_trace
-        self._lock = threading.Lock()
+        self._lock = locks.make_lock("slo.alerts")
         self._alerts: Dict[str, Alert] = {}
         self._history: "collections.deque" = collections.deque(
             maxlen=_HISTORY)

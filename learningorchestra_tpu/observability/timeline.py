@@ -17,10 +17,11 @@ from __future__ import annotations
 import collections
 import threading
 from typing import Any, Dict, List, Optional
+from learningorchestra_tpu.runtime import locks
 
 _MAX_JOBS = 128
 
-_lock = threading.Lock()
+_lock = locks.make_lock("timeline.registry")
 _rings: "collections.OrderedDict[str, collections.deque]" = \
     collections.OrderedDict()
 

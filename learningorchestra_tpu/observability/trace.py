@@ -28,10 +28,11 @@ import collections
 import threading
 import time
 from typing import Any, Dict, List, Optional, Tuple
+from learningorchestra_tpu.runtime import locks
 
 _MAX_TRACES = 256
 
-_lock = threading.Lock()
+_lock = locks.make_lock("trace.registry")
 _traces: "collections.OrderedDict[str, _Trace]" = collections.OrderedDict()
 _tls = threading.local()
 

@@ -36,6 +36,7 @@ import re
 import threading
 import time
 from typing import Any, Callable, Dict, List, Optional, Tuple
+from learningorchestra_tpu.runtime import locks
 
 # canonical owner tags; anything else still ledgers, these are what
 # the docs table and the xray-smoke CI stage assert on
@@ -46,7 +47,7 @@ _MAX_COMPILES = 128      # per-name compiled-artifact reports (LRU)
 _MAX_EVENTS = 64         # retained retrace / transfer events
 _MAX_ENTRIES_LISTED = 256  # ledger rows returned per report
 
-_lock = threading.Lock()
+_lock = locks.make_lock("xray.ledger")
 # (owner, key) -> {"bytes": int, "owner": str, "name": str|None, ...}
 _ledger: "collections.OrderedDict[Tuple[str, Any], Dict[str, Any]]" = \
     collections.OrderedDict()

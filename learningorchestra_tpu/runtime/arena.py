@@ -36,6 +36,7 @@ from __future__ import annotations
 import collections
 import threading
 from typing import Any, Callable, Dict, Iterable, Optional, Tuple
+from learningorchestra_tpu.runtime import locks
 
 
 def _ledger(op: str, key: Any, nbytes: int = 0,
@@ -127,7 +128,7 @@ class DeviceArena:
         # under); a slice-scheduled fit budgets against its slice's
         # HBM fraction, not the whole arena
         self._group_bytes: Dict[Any, int] = {}
-        self._lock = threading.Lock()
+        self._lock = locks.make_lock("arena.entries")
         self.hits = 0
         self.misses = 0
         self.evictions = 0
@@ -294,7 +295,7 @@ class DeviceArena:
 # onto it are too); config swaps reset it like the default mesh
 # ----------------------------------------------------------------------
 _default_arena: Optional[DeviceArena] = None
-_default_lock = threading.Lock()
+_default_lock = locks.make_lock("arena.default")
 
 
 def _configured_budget() -> Optional[int]:

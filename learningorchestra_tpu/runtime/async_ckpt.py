@@ -49,6 +49,7 @@ import jax
 import numpy as np
 
 from learningorchestra_tpu.runtime.checkpoint import Checkpointer
+from learningorchestra_tpu.runtime import locks
 
 _SENTINEL = object()
 
@@ -134,7 +135,7 @@ class AsyncCheckpointManager:
         self._queue: "queue.Queue" = queue.Queue(
             maxsize=max(1, int(inflight)))
         self._error: Optional[BaseException] = None
-        self._error_lock = threading.Lock()
+        self._error_lock = locks.make_lock("async_ckpt.error")
         self._closed = False
         self._worker = threading.Thread(
             target=self._drain, daemon=True, name="lo-ckpt-commit")

@@ -33,6 +33,7 @@ import threading
 import time
 import traceback
 from typing import Any, Dict, List, Optional
+from learningorchestra_tpu.runtime import locks
 
 _initialized = False
 _monitor: Optional["HeartbeatMonitor"] = None
@@ -40,7 +41,7 @@ _sender_stop: Optional[threading.Event] = None
 # serializes the (length, payload) broadcast pair of each publish so
 # concurrent publishers (job thread vs shutdown path) cannot interleave
 # their collectives and desynchronize the workers' recv loop
-_publish_lock = threading.Lock()
+_publish_lock = locks.make_lock("distributed.publish")
 
 
 def initialize(coordinator_address: Optional[str] = None,
@@ -129,7 +130,7 @@ class HeartbeatMonitor:
         self._timeout = timeout
         now = time.monotonic()
         self._last_seen = {int(h): now for h in expected}
-        self._lock = threading.Lock()
+        self._lock = locks.make_lock("distributed.state")
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
         self._sock.bind(address)
         self._sock.settimeout(0.5)

@@ -40,6 +40,7 @@ from learningorchestra_tpu.runtime import mesh as mesh_lib
 from learningorchestra_tpu.runtime import preempt
 from learningorchestra_tpu.runtime.health import (HealthPolicy,
                                                   NumericalDivergence)
+from learningorchestra_tpu.runtime import locks
 
 # "HELT": domain-separates the post-rollback rng stream from the
 # original, so a replayed epoch does not redraw the exact dropout/
@@ -87,7 +88,7 @@ def default_grad_accum() -> int:
 # ----------------------------------------------------------------------
 _EXEC_CACHE: "collections.OrderedDict[Any, Callable]" = \
     collections.OrderedDict()
-_EXEC_LOCK = threading.Lock()
+_EXEC_LOCK = locks.make_lock("engine.executables")
 _EXEC_STATS = {"hits": 0, "misses": 0}
 _EXEC_CACHE_CAP = 64
 # measured per-step (flops, bytes accessed) by executable key: lets a

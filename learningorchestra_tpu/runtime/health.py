@@ -26,6 +26,7 @@ from __future__ import annotations
 import dataclasses
 import threading
 from typing import Any, Dict, Optional
+from learningorchestra_tpu.runtime import locks
 
 ACTIONS = ("off", "skip", "rollback", "fail")
 
@@ -131,7 +132,7 @@ def resolve_policy(request: Any, config) -> Optional[HealthPolicy]:
 # / lo_rollbacks_total / lo_loss_spikes_total /
 # lo_checkpoints_quarantined_total by the Api (/metrics)
 # ----------------------------------------------------------------------
-_lock = threading.Lock()
+_lock = locks.make_lock("health.counters")
 _counters: Dict[str, int] = {"nonfiniteSteps": 0, "lossSpikes": 0,
                              "rollbacks": 0, "quarantined": 0}
 # observers of sentinel events (the incident flight recorder

@@ -28,6 +28,7 @@ from __future__ import annotations
 import threading
 import time
 from typing import Any, Callable, Dict, Optional
+from learningorchestra_tpu.runtime import locks
 
 _tls = threading.local()
 
@@ -58,7 +59,7 @@ class CancelToken:
 
     def __init__(self, deadline: Optional[float] = None):
         self._event = threading.Event()
-        self._lock = threading.Lock()
+        self._lock = locks.make_lock("preempt.token")
         self.deadline = deadline
         self.reason: Optional[str] = None
         self.progress: Dict[str, Any] = {}

@@ -54,6 +54,7 @@ import time
 from typing import Any, Dict, Optional
 
 from learningorchestra_tpu.observability import export as obs_export
+from learningorchestra_tpu.runtime import locks
 
 SHRINK = "shrink"
 GROW = "grow"
@@ -111,7 +112,7 @@ class SliceAutoscaler:
         self._backoff = max(0.0, float(backoff_seconds))
         self._backoff_max = max(self._backoff,
                                 float(backoff_max_seconds))
-        self._lock = threading.Lock()
+        self._lock = locks.make_lock("autoscaler.policy")
         # name -> {attempts, nextTrySeconds (monotonic), dead,
         #          resizes, rollbacks, direction}
         self._ledger: Dict[str, Dict[str, Any]] = {}

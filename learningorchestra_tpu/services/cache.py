@@ -20,6 +20,7 @@ import json
 import threading
 from collections import OrderedDict
 from typing import Any, Optional, Tuple
+from learningorchestra_tpu.runtime import locks
 
 
 class ReadCache:
@@ -29,7 +30,7 @@ class ReadCache:
                  max_entries: int = 256):
         self._ttl = float(ttl_seconds)
         self._max = int(max_entries)
-        self._lock = threading.Lock()
+        self._lock = locks.make_lock("cache.lru")
         self._entries: "OrderedDict[Tuple, Tuple]" = OrderedDict()
         self.hits = 0
         self.misses = 0

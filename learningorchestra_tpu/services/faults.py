@@ -59,8 +59,9 @@ import dataclasses
 import threading
 import time
 from typing import Dict
+from learningorchestra_tpu.runtime import locks
 
-_lock = threading.Lock()
+_lock = locks.make_lock("faults.spec")
 _used: Dict[str, int] = {}
 _parsed: Dict[str, Dict[str, "FaultSpec"]] = {}
 

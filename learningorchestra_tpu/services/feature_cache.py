@@ -38,6 +38,7 @@ import threading
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 from learningorchestra_tpu.runtime import arena as arena_lib
+from learningorchestra_tpu.runtime import locks
 
 # attempts at reading a frame under one stable version before giving
 # up on caching it (the data is still returned)
@@ -56,7 +57,7 @@ class FeatureCache:
         self._entries: "collections.OrderedDict[Any, tuple]" = \
             collections.OrderedDict()  # key -> (version, value, nbytes)
         self._bytes = 0
-        self._lock = threading.Lock()
+        self._lock = locks.make_lock("feature_cache.store")
         self.hits = 0
         self.misses = 0
         self.invalidations = 0

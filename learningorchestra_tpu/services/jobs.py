@@ -64,6 +64,7 @@ from learningorchestra_tpu.observability import trace as obs_trace
 from learningorchestra_tpu.runtime import preempt
 from learningorchestra_tpu.runtime.health import NumericalDivergence
 from learningorchestra_tpu.services import faults
+from learningorchestra_tpu.runtime import locks
 
 TRANSIENT = "transient"
 PERMANENT = "permanent"
@@ -156,7 +157,7 @@ class JobManager:
         # lifecycle registry (cancel API, stall watchdog, shutdown
         # documentation, worker-lost marking)
         self._job_info: Dict[str, Dict[str, Any]] = {}
-        self._lock = threading.Lock()
+        self._lock = locks.make_lock("jobs.manager")
         # returns a failure description when the multi-host pod has
         # lost a worker (runtime.distributed.pod_failure); mesh jobs
         # are then refused instead of hanging in a collective

@@ -44,6 +44,7 @@ from typing import Any, Dict, Optional
 
 from learningorchestra_tpu.observability import export as obs_export
 from learningorchestra_tpu.runtime import preempt
+from learningorchestra_tpu.runtime import locks
 
 
 class MigrationCoordinator:
@@ -53,7 +54,7 @@ class MigrationCoordinator:
 
     def __init__(self, jobs: Any):
         self._jobs = jobs
-        self._lock = threading.Lock()
+        self._lock = locks.make_lock("migration.coordinator")
         self._requested = 0
         self._refused = 0
         self._defrag_picks = 0

@@ -66,6 +66,7 @@ import time
 from typing import Any, Dict, Iterator, Optional, Sequence, Tuple
 
 from learningorchestra_tpu.runtime import preempt
+from learningorchestra_tpu.runtime import locks
 
 
 def parse_pool_weights(spec: str) -> Dict[str, float]:
@@ -136,7 +137,7 @@ class SliceLease:
                  served_half_life_seconds: float = 600.0):
         self._capacity = max(1, int(leases))
         self._weights = dict(weights or {})
-        self._cv = threading.Condition()
+        self._cv = locks.make_condition("scheduler.fair")
         # pool -> held mesh-seconds, exponentially decayed with the
         # half-life below so fair-share order reflects RECENT usage: a
         # pool that burned the mesh last week starts even, not in debt
@@ -715,7 +716,7 @@ class ServingLease:
         self._footprint = dict(footprint) if footprint else None
         self._grant: Optional[Grant] = None
         self._acquired = 0.0
-        self._lock = threading.Lock()
+        self._lock = locks.make_lock("scheduler.servinglease")
         self.yields = 0
         self.wait_seconds = 0.0
 

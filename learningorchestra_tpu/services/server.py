@@ -72,6 +72,7 @@ from learningorchestra_tpu.services.dataset import (DatasetService,
 from learningorchestra_tpu.services.execution import ExecutionService
 from learningorchestra_tpu.services.function_service import FunctionService
 from learningorchestra_tpu.services.model_service import ModelService
+from learningorchestra_tpu.runtime import locks
 
 EXECUTION_VERBS = ("train", "tune", "evaluate", "predict")
 SERVICES = ("dataset", "model", "transform", "explore", "tune", "train",
@@ -110,7 +111,7 @@ class Api:
             ttl_seconds=self.ctx.config.get_cache_ttl_seconds)
         # gateway metrics (KrakenD exposes a metrics collector on
         # :8090, krakend.json:1752-1760; here it's first-party)
-        self._metrics_lock = threading.Lock()
+        self._metrics_lock = locks.make_lock("server.metrics")
         self._started = time.monotonic()
         self._requests: Dict[str, int] = {}
         self._statuses: Dict[str, int] = {}
@@ -119,7 +120,7 @@ class Api:
         # timed-dispatch accounting (the LO_REQUEST_TIMEOUT path in
         # _Handler._respond spawns a thread per request and abandons
         # it on 504 — without a cap N slow dispatches pile up unseen)
-        self._gateway_lock = threading.Lock()
+        self._gateway_lock = locks.make_lock("server.gateway")
         self._gateway_inflight = 0
         self._gateway_abandoned_inflight = 0
         self._gateway_abandoned_total = 0

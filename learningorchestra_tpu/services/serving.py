@@ -53,6 +53,7 @@ from learningorchestra_tpu.observability import xray as obs_xray
 from learningorchestra_tpu.services import faults
 from learningorchestra_tpu.services import validators as V
 from learningorchestra_tpu.services.scheduler import ServingLease
+from learningorchestra_tpu.runtime import locks
 
 _IDLE_TICK_SECONDS = 0.05  # lease-yield poll cadence when no traffic
 
@@ -64,7 +65,7 @@ class LatencyTracker:
 
     def __init__(self, maxlen: int = 2048):
         self._lat: Deque[float] = collections.deque(maxlen=maxlen)
-        self._lock = threading.Lock()
+        self._lock = locks.make_lock("serving.latency")
         self.count = 0
 
     def record(self, seconds: float) -> None:
@@ -126,12 +127,12 @@ class _SessionBase:
         self._lease = lease
         self._queue: Deque[_Request] = collections.deque()
         self._depth = int(ctx.config.serve_queue_depth)
-        self._cv = threading.Condition()
+        self._cv = locks.make_condition("serving.session")
         self._closed = False
         self.latency = LatencyTracker()
         self.requests_total = 0
         self.rejected_total = 0
-        self.created_at = time.time()
+        self.created_at = time.monotonic()
         self._thread = threading.Thread(
             target=self._run, name=f"serving-{name}", daemon=True)
 
@@ -300,7 +301,7 @@ class _SessionBase:
             "batchFill": self._batch_fill(),
             "requestsTotal": self.requests_total,
             "rejectedTotal": self.rejected_total,
-            "uptimeSeconds": round(time.time() - self.created_at, 3),
+            "uptimeSeconds": round(time.monotonic() - self.created_at, 3),
             "latency": self.latency.snapshot(),
             "lease": self._lease.stats(),
             "perf": self.perf_stats(),
@@ -643,7 +644,7 @@ class PagedKVPool:
             raise ValueError(f"n_pages must be >= 2, got {n_pages}")
         self.n_pages = int(n_pages)
         self.page_len = int(page_len)
-        self._lock = threading.Lock()
+        self._lock = locks.make_lock("serving.kvpool")
         self._free: Deque[int] = collections.deque(
             range(1, self.n_pages))
         self._refs: Dict[int, int] = {}
@@ -1421,7 +1422,7 @@ class ServingManager:
     def __init__(self, ctx):
         self._ctx = ctx
         self._sessions: Dict[str, _SessionBase] = {}
-        self._lock = threading.Lock()
+        self._lock = locks.make_lock("serving.manager")
 
     # -- verbs ---------------------------------------------------------
     def create(self, model_name: str, body: Dict[str, Any]) -> Dict[str, Any]:

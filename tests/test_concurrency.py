@@ -1,0 +1,386 @@
+"""Concurrency static pass + runtime lock witness (ISSUE 16).
+
+Static half (analysis/concurrency.py): seeded AB/BA deadlock fixtures
+the pass must flag, blocking-under-lock and callback-under-lock
+fixtures, waiver syntax, and the zero-error invariant over the real
+package. Runtime half (runtime/locks.py): witness violation raise /
+count modes, RLock re-entrancy, condition-wait rank release, and an
+end-to-end subprocess run of real control-plane flows with
+``LO_LOCK_WITNESS=1`` asserting zero violations.
+"""
+
+import pathlib
+import subprocess
+import sys
+import threading
+
+import pytest
+
+from learningorchestra_tpu.analysis import concurrency
+from learningorchestra_tpu.analysis.findings import SEVERITY_ERROR
+from learningorchestra_tpu.runtime import locks
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+
+# A small fixture hierarchy: a outermost, d innermost.
+H = {"fix.a": 10, "fix.b": 20, "fix.c": 30, "fix.d": 40}
+
+
+def _errors(findings, rule=None):
+    return [f for f in findings if f.severity == SEVERITY_ERROR
+            and (rule is None or f.rule == rule)]
+
+
+# ----------------------------------------------------------------------
+# static pass: lock-order / cycles
+# ----------------------------------------------------------------------
+
+def test_static_flags_seeded_ab_ba_deadlock():
+    # classic AB/BA: thread_one nests a->b, thread_two nests b->a.
+    # Whatever the declared ranks, one of the two is a rank inversion
+    # and the pair is a cycle.
+    src = (
+        "from learningorchestra_tpu.runtime import locks\n"
+        "LA = locks.make_lock('fix.a')\n"
+        "LB = locks.make_lock('fix.b')\n"
+        "def thread_one():\n"
+        "    with LA:\n"
+        "        with LB:\n"
+        "            pass\n"
+        "def thread_two():\n"
+        "    with LB:\n"
+        "        with LA:\n"
+        "            pass\n"
+    )
+    findings = concurrency.analyze_source(src, "fix", "fix.py",
+                                          hierarchy=H)
+    order = _errors(findings, concurrency.RULE_ORDER)
+    assert order, findings
+    # the BA side (b outer, a inner) is the inversion: rank(a) < rank(b)
+    assert any("fix.a" in f.message and "fix.b" in f.message
+               for f in order)
+
+
+def test_static_flags_cross_function_cycle():
+    # the nesting is split across a call edge: f holds a and calls g,
+    # which takes b; h holds b and calls k, which takes a. No single
+    # function nests both orders — only the interprocedural closure
+    # sees the cycle.
+    src = (
+        "from learningorchestra_tpu.runtime import locks\n"
+        "LA = locks.make_lock('fix.a')\n"
+        "LB = locks.make_lock('fix.b')\n"
+        "def g():\n"
+        "    with LB:\n"
+        "        pass\n"
+        "def f():\n"
+        "    with LA:\n"
+        "        g()\n"
+        "def k():\n"
+        "    with LA:\n"
+        "        pass\n"
+        "def h():\n"
+        "    with LB:\n"
+        "        k()\n"
+    )
+    findings = concurrency.analyze_source(src, "fix", "fix.py",
+                                          hierarchy=H)
+    assert _errors(findings, concurrency.RULE_ORDER), findings
+
+
+def test_static_rank_respecting_nesting_is_clean():
+    src = (
+        "from learningorchestra_tpu.runtime import locks\n"
+        "LA = locks.make_lock('fix.a')\n"
+        "LB = locks.make_lock('fix.b')\n"
+        "def fine():\n"
+        "    with LA:\n"
+        "        with LB:\n"
+        "            pass\n"
+    )
+    findings = concurrency.analyze_source(src, "fix", "fix.py",
+                                          hierarchy=H)
+    assert not _errors(findings), findings
+
+
+def test_static_flags_undeclared_and_unregistered_locks():
+    src = (
+        "import threading\n"
+        "from learningorchestra_tpu.runtime import locks\n"
+        "ANON = threading.Lock()\n"
+        "TYPO = locks.make_lock('fix.nope')\n"
+    )
+    findings = concurrency.analyze_source(src, "fix", "fix.py",
+                                          hierarchy=H)
+    assert _errors(findings, concurrency.RULE_UNDECLARED)
+    assert _errors(findings, concurrency.RULE_UNREGISTERED)
+
+
+# ----------------------------------------------------------------------
+# static pass: blocking-under-lock / callback-under-lock
+# ----------------------------------------------------------------------
+
+@pytest.mark.parametrize("stmt", [
+    "time.sleep(0.1)",
+    "fut.result()",
+    "work_queue.get()",
+    "jax.block_until_ready(x)",
+    "jax.device_put(x)",
+    "requests.get('http://x')",
+])
+def test_static_flags_blocking_under_lock(stmt):
+    src = (
+        "import time, jax, requests\n"
+        "from learningorchestra_tpu.runtime import locks\n"
+        "LA = locks.make_lock('fix.a')\n"
+        "def f(fut, work_queue, x):\n"
+        "    with LA:\n"
+        f"        {stmt}\n"
+    )
+    findings = concurrency.analyze_source(src, "fix", "fix.py",
+                                          hierarchy=H)
+    assert _errors(findings, concurrency.RULE_BLOCKING), (stmt, findings)
+
+
+def test_static_cv_wait_on_own_innermost_lock_is_legal():
+    # `with cv: cv.wait()` releases the lock it waits on — legal.
+    src = (
+        "from learningorchestra_tpu.runtime import locks\n"
+        "CV = locks.make_condition('fix.a')\n"
+        "def f():\n"
+        "    with CV:\n"
+        "        CV.wait()\n"
+    )
+    findings = concurrency.analyze_source(src, "fix", "fix.py",
+                                          hierarchy=H)
+    assert not _errors(findings), findings
+
+
+def test_static_cv_wait_with_outer_lock_held_is_flagged():
+    # wait() only releases the innermost — the outer lock is held for
+    # the whole sleep.
+    src = (
+        "from learningorchestra_tpu.runtime import locks\n"
+        "LA = locks.make_lock('fix.a')\n"
+        "CV = locks.make_condition('fix.b')\n"
+        "def f():\n"
+        "    with LA:\n"
+        "        with CV:\n"
+        "            CV.wait()\n"
+    )
+    findings = concurrency.analyze_source(src, "fix", "fix.py",
+                                          hierarchy=H)
+    assert _errors(findings, concurrency.RULE_BLOCKING), findings
+
+
+def test_static_flags_callback_under_lock():
+    src = (
+        "from learningorchestra_tpu.runtime import locks\n"
+        "LA = locks.make_lock('fix.a')\n"
+        "def f(self):\n"
+        "    with LA:\n"
+        "        for cb in self.listeners:\n"
+        "            cb()\n"
+        "def g(self):\n"
+        "    with LA:\n"
+        "        self.on_change(1)\n"
+    )
+    findings = concurrency.analyze_source(src, "fix", "fix.py",
+                                          hierarchy=H)
+    cbs = _errors(findings, concurrency.RULE_CALLBACK)
+    assert len(cbs) >= 2, findings
+
+
+def test_static_waiver_downgrades_to_warning():
+    src = (
+        "import time\n"
+        "from learningorchestra_tpu.runtime import locks\n"
+        "LA = locks.make_lock('fix.a')\n"
+        "def f():\n"
+        "    with LA:\n"
+        "        # lo-conc: waive(blocking-under-lock) — test fixture\n"
+        "        time.sleep(0.01)\n"
+    )
+    findings = concurrency.analyze_source(src, "fix", "fix.py",
+                                          hierarchy=H)
+    assert not _errors(findings), findings
+    waived = [f for f in findings
+              if f.rule == concurrency.RULE_BLOCKING]
+    assert waived and waived[0].severity == "warning"
+    assert "waived" in waived[0].message
+
+
+def test_real_package_has_zero_error_findings():
+    findings = concurrency.analyze_package()
+    assert not _errors(findings), [
+        (f.rule, f.location, f.message) for f in _errors(findings)]
+
+
+# ----------------------------------------------------------------------
+# runtime witness
+# ----------------------------------------------------------------------
+
+@pytest.fixture
+def witness(monkeypatch):
+    monkeypatch.setenv("LO_LOCK_WITNESS", "1")
+    monkeypatch.setenv("LO_LOCK_WITNESS_MODE", "raise")
+    locks.reset_witness()
+    # isolate this thread's held stack from any leftovers
+    locks._tls.held = []
+    yield locks
+    locks.reset_witness()
+    locks._tls.held = []
+
+
+def test_factories_are_plain_primitives_when_disabled(monkeypatch):
+    monkeypatch.setenv("LO_LOCK_WITNESS", "0")
+    assert type(locks.make_lock("scheduler.fair")) is \
+        type(threading.Lock())
+    assert isinstance(locks.make_condition("scheduler.fair"),
+                      threading.Condition)
+
+
+def test_factory_rejects_unregistered_name(witness):
+    with pytest.raises(KeyError):
+        locks.make_lock("no.such.lock")
+
+
+def test_witness_raises_on_rank_inversion(witness):
+    outer = locks.make_lock("scheduler.fair")        # rank 80
+    inner = locks.make_lock("jobs.manager")          # rank 30
+    with outer:
+        with pytest.raises(locks.LockOrderViolation):
+            inner.acquire()
+    # the violating acquire never took the underlying lock
+    assert not inner._lock.locked()
+    stats = locks.witness_stats()
+    assert stats["violations"] == 1
+    assert stats["samples"][0]["acquiring"] == "jobs.manager"
+
+
+def test_witness_correct_order_is_silent(witness):
+    a = locks.make_lock("jobs.manager")
+    b = locks.make_lock("scheduler.fair")
+    with a:
+        with b:
+            pass
+    assert locks.witness_stats()["violations"] == 0
+    assert ("jobs.manager", "scheduler.fair") in locks.witness_edges()
+
+
+def test_witness_count_mode_records_and_continues(witness, monkeypatch):
+    monkeypatch.setenv("LO_LOCK_WITNESS_MODE", "count")
+    outer = locks.make_lock("scheduler.fair")
+    inner = locks.make_lock("jobs.manager")
+    with outer:
+        with inner:       # inverted, but count mode: no raise
+            pass
+    stats = locks.witness_stats()
+    assert stats["violations"] == 1
+    assert stats["mode"] == "count"
+
+
+def test_witness_rlock_reentry_is_legal(witness):
+    rl = locks.make_rlock("jobs.manager")
+    with rl:
+        with rl:
+            pass
+    assert locks.witness_stats()["violations"] == 0
+
+
+def test_witness_plain_lock_reentry_is_violation(witness):
+    lk = locks.make_lock("jobs.manager")
+    with lk:
+        with pytest.raises(locks.LockOrderViolation):
+            lk.acquire()
+        # the raise fired BEFORE blocking on the primitive: a real
+        # self-deadlock turns into a diagnosable exception
+    assert locks.witness_stats()["violations"] == 1
+
+
+def test_witness_condition_wait_releases_rank(witness):
+    # While a thread waits on cv (rank 80) it holds no rank, so a
+    # helper acquiring a lower-ranked lock (rank 30) on the SAME
+    # thread after wake must not see stale held state; and another
+    # thread may do low-then-notify without inversion.
+    cv = locks.make_condition("scheduler.fair")
+    low = locks.make_lock("jobs.manager")
+    woke = []
+
+    def waiter():
+        locks._tls.held = []
+        with cv:
+            cv.wait(timeout=5)
+            woke.append(True)
+        with low:     # rank 30 AFTER releasing cv: legal
+            pass
+
+    t = threading.Thread(target=waiter)
+    t.start()
+    # during the wait, the waiter's stack must not pin rank 80
+    import time
+    time.sleep(0.1)
+    with low:         # main thread: unrelated, legal
+        pass
+    with cv:
+        cv.notify_all()
+    t.join(timeout=5)
+    assert woke
+    assert locks.witness_stats()["violations"] == 0
+
+
+def test_witness_wait_under_foreign_lock_flags_inversion(
+        witness, monkeypatch):
+    # holding a HIGHER-ranked lock while taking a lower-ranked cv:
+    # an inversion (count mode so the fixture doesn't unwind mid-hold).
+    monkeypatch.setenv("LO_LOCK_WITNESS_MODE", "count")
+    high = locks.make_lock("serving.kvpool")       # rank 90
+    cv = locks.make_condition("scheduler.fair")    # rank 80
+    with high:
+        cv.acquire()    # inversion: 80 under 90
+        cv.release()
+    assert locks.witness_stats()["violations"] >= 1
+
+
+def test_witness_nonblocking_acquire_skips_order_check(witness):
+    outer = locks.make_lock("scheduler.fair")
+    inner = locks.make_lock("jobs.manager")
+    with outer:
+        # try-lock is a legal deadlock-avoidance idiom: no order check
+        ok = inner.acquire(blocking=False)
+        assert ok
+        inner.release()
+    assert locks.witness_stats()["violations"] == 0
+
+
+# ----------------------------------------------------------------------
+# end-to-end: real control-plane flows under the armed witness
+# ----------------------------------------------------------------------
+
+def test_control_plane_flows_zero_violations_subprocess():
+    """Import the lock-heavy modules with LO_LOCK_WITNESS=1 (so every
+    factory returns a witness wrapper) and drive incident capture —
+    the flow that takes the commit lock and then freezes every other
+    subsystem — plus SLO evaluation and monitor sampling. Zero
+    violations required."""
+    code = (
+        "import os, tempfile\n"
+        "os.environ['LO_LOCK_WITNESS'] = '1'\n"
+        "os.environ['LO_LOCK_WITNESS_MODE'] = 'raise'\n"
+        "os.environ['JAX_PLATFORMS'] = 'cpu'\n"
+        "from learningorchestra_tpu.runtime import locks\n"
+        "from learningorchestra_tpu.observability import incidents\n"
+        "home = tempfile.mkdtemp()\n"
+        "rec = incidents.FlightRecorder(home=home)\n"
+        "bundle = rec.capture('witness-e2e', {'k': 'v'})\n"
+        "assert bundle, 'no bundle captured'\n"
+        "rec.close()\n"
+        "stats = locks.witness_stats()\n"
+        "assert stats['enabled'] and stats['violations'] == 0, stats\n"
+        "print('edges:', len(locks.witness_edges()))\n"
+        "print('OK')\n"
+    )
+    proc = subprocess.run([sys.executable, "-c", code], cwd=str(REPO),
+                          capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert "OK" in proc.stdout, proc.stdout
