@@ -97,6 +97,7 @@ PHASE_TIMEOUTS = {"cnn": 600, "lstm": 600, "tlm": 900, "proxy": 120,
                   "warm_pipeline": 600, "concurrent_jobs": 600,
                   "flash": 600, "ingest": 600, "gen": 900,
                   "serving": 900, "paged_serving": 900,
+                  "quant_serving": 900,
                   "sentinel_overhead": 600, "sentinel_chaos": 600,
                   "obs_overhead": 600, "monitor_smoke": 600,
                   "incident_smoke": 600,
@@ -761,6 +762,182 @@ def phase_paged_serving():
             "victim_slo_fired": "servingP99:victim" in firing,
             "slo_firing": firing,
         })
+    finally:
+        api.ctx.serving.close()
+        api.ctx.jobs.shutdown()
+    return out
+
+
+def phase_quant_serving():
+    """int8 KV pages + int8 weights vs the bf16 paged pool at the SAME
+    HBM budget (docs/SERVING.md "Quantized serving"). Capacity half:
+    the bf16 session gets the slot cache's page budget; the int8
+    session gets however many pages the SAME bytes fund once each page
+    is int8 payload + its f32 per-head scale row — near 2x, so at
+    equal memory it must hold >= 1.8x the simultaneously-decoding
+    streams (page capacity at equal bytes is platform-independent, so
+    the gate holds on the CPU fallback too). Quality half: the
+    create-time drift probe's value must sit under LO_SERVE_DRIFT_MAX.
+    Chaos half: a latched ``kv_quant`` fault must walk the degrade
+    ladder — the session rebuilds over exact bf16 pages/weights and
+    keeps serving."""
+    import concurrent.futures
+    import threading
+
+    import jax
+    import numpy as np
+
+    from learningorchestra_tpu.models.transformer import LanguageModel
+    from learningorchestra_tpu.services import faults
+
+    slots = int(os.environ.get("LO_BENCH_QUANT_SLOTS", "4"))
+    cache_len = int(os.environ.get("LO_BENCH_QUANT_CACHE", "64"))
+    page_len = int(os.environ.get("LO_BENCH_QUANT_PAGE_LEN", "16"))
+    prompt_len = int(os.environ.get("LO_BENCH_QUANT_PROMPT", "8"))
+    new = int(os.environ.get("LO_BENCH_QUANT_TOKENS", "8"))
+    reqs = int(os.environ.get("LO_BENCH_QUANT_REQS", "2"))
+    api, prefix = _make_api()
+
+    tokens_per_req = prompt_len + new
+    pages_per_req = -(-tokens_per_req // page_len)
+    budget_pages = slots * cache_len // page_len
+    n_chips = max(1, jax.device_count())
+    out = {"platform": jax.devices()[0].platform,
+           "cache_len": cache_len, "page_len": page_len,
+           "bf16_pages": budget_pages, "prompt_len": prompt_len,
+           "new_tokens": new, "requests_per_stream": reqs}
+    try:
+        cfg = dict(TLM_CFG)
+        cfg["max_len"] = cache_len
+        lm = LanguageModel(**cfg)
+        rng = np.random.default_rng(0)
+        seed_tokens = rng.integers(
+            1, cfg["vocab_size"], size=(4, 128)).astype(np.int32)
+        lm.fit(seed_tokens, batch_size=4, epochs=1)
+        api.ctx.artifacts.save(lm, "quant_lm", "train/tensorflow")
+
+        def _session(n_pages, n_slots, **extra):
+            body = {"kv": "paged", "maxSlots": n_slots,
+                    "cacheLen": cache_len, "pageLen": page_len,
+                    "pages": n_pages, "temperature": 0.8, "topK": 50}
+            body.update(extra)
+            status, body, _ = api.dispatch(
+                "POST", f"{prefix}/serve/quant_lm", {}, body)
+            _expect_created(status, body)
+            return api.ctx.serving._sessions["quant_lm"]
+
+        def _drive(n_clients):
+            """n_clients concurrent streams x reqs unique-prompt
+            requests; (peak simultaneous active streams, seconds)."""
+            sess = api.ctx.serving._sessions["quant_lm"]
+            stop = threading.Event()
+            peak = [0]
+
+            def poll():
+                while not stop.is_set():
+                    active = sum(1 for r in sess._slot_req
+                                 if r is not None)
+                    if active > peak[0]:
+                        peak[0] = active
+                    time.sleep(0.0002)
+
+            def client(k):
+                for j in range(reqs):
+                    prompt = [int(t) for t in np.random.default_rng(
+                        9000 + k * 97 + j).integers(
+                        1, cfg["vocab_size"], size=prompt_len)]
+                    s2, b2, _ = api.dispatch(
+                        "POST", f"{prefix}/serve/quant_lm/predict",
+                        {}, {"prompt": prompt, "maxNewTokens": new,
+                             "seed": k * 100 + j})
+                    if s2 != 200:
+                        raise RuntimeError(f"predict failed: {s2} {b2}")
+
+            client(0)  # pay the prefill/step compile outside the clock
+            poller = threading.Thread(target=poll, daemon=True)
+            poller.start()
+            t0 = time.perf_counter()
+            with concurrent.futures.ThreadPoolExecutor(
+                    n_clients) as pool:
+                list(pool.map(client, range(1, n_clients + 1)))
+            dt = time.perf_counter() - t0
+            stop.set()
+            poller.join(timeout=5)
+            return peak[0], dt
+
+        # ---- bf16 paged baseline at the slot cache's page budget
+        bf16_cap = budget_pages // pages_per_req
+        sess = _session(budget_pages + 1, bf16_cap)
+        bf16_bytes = sess._cache_bytes
+        bf16_peak, bf16_dt = _drive(bf16_cap)
+        api.dispatch("DELETE", f"{prefix}/serve/quant_lm", {}, None)
+
+        # ---- int8 bytes-per-page probe (payload + scale pools are
+        # funded together, so this is the TRUE quantized footprint)
+        sess = _session(budget_pages + 1, bf16_cap, kvDtype="int8")
+        int8_page_bytes = sess._cache_bytes / (budget_pages + 1)
+        api.dispatch("DELETE", f"{prefix}/serve/quant_lm", {}, None)
+
+        # ---- int8 at EQUAL HBM: same bytes, ~2x the pages
+        int8_pages = int(bf16_bytes // int8_page_bytes) - 1
+        int8_cap = int8_pages // pages_per_req
+        sess = _session(int8_pages + 1, int8_cap,
+                        kvDtype="int8", weights="int8")
+        int8_bytes = sess._cache_bytes
+        int8_peak, int8_dt = _drive(int8_cap)
+        _, qstats, _ = api.dispatch(
+            "GET", f"{prefix}/serve/quant_lm", {}, None)
+        api.dispatch("DELETE", f"{prefix}/serve/quant_lm", {}, None)
+
+        bf16_tokens = (bf16_cap * reqs) * new
+        int8_tokens = (int8_cap * reqs) * new
+        out.update({
+            "bf16_kv_bytes": bf16_bytes,
+            "int8_kv_bytes": int8_bytes,
+            "int8_pages": int8_pages,
+            "bf16_peak_streams": bf16_peak,
+            "int8_peak_streams": int8_peak,
+            "streams_vs_bf16": round(
+                int8_peak / max(1, bf16_peak), 2),
+            "bf16_decode_tokens_per_sec": round(
+                bf16_tokens / bf16_dt, 1),
+            "int8_decode_tokens_per_sec": round(
+                int8_tokens / int8_dt, 1),
+            "bf16_decode_tokens_per_sec_per_chip": round(
+                bf16_tokens / bf16_dt / n_chips, 1),
+            "int8_decode_tokens_per_sec_per_chip": round(
+                int8_tokens / int8_dt / n_chips, 1),
+            "kv_bytes_per_token": qstats["kv"].get("bytesPerToken"),
+            "weights_dtype": qstats["weights"]["dtype"],
+            "drift": (qstats.get("drift") or {}).get("value"),
+            "drift_max": (qstats.get("drift") or {}).get("max"),
+        })
+
+        # ---- chaos: latched kv_quant fault -> degrade ladder to bf16
+        api.ctx.config.fault_inject = "kv_quant:100"
+        faults.reset()
+        _session(budget_pages + 1, 4, kvDtype="int8", weights="int8")
+        prompt = [int(t) for t in np.random.default_rng(
+            31).integers(1, cfg["vocab_size"], size=prompt_len)]
+        codes = []
+        for j in range(5):
+            s2, b2, _ = api.dispatch(
+                "POST", f"{prefix}/serve/quant_lm/predict", {},
+                {"prompt": prompt, "maxNewTokens": new, "seed": j})
+            codes.append(s2)
+            if s2 == 200:
+                break
+        _, dstats, _ = api.dispatch(
+            "GET", f"{prefix}/serve/quant_lm", {}, None)
+        api.ctx.config.fault_inject = ""
+        faults.reset()
+        out.update({
+            "degrade_codes": codes,
+            "degrade_fired": (dstats["kv"]["dtype"] == "bf16"
+                              and dstats["weights"]["dtype"] == "bf16"
+                              and codes[-1] == 200),
+        })
+        api.dispatch("DELETE", f"{prefix}/serve/quant_lm", {}, None)
     finally:
         api.ctx.serving.close()
         api.ctx.jobs.shutdown()
@@ -2617,6 +2794,7 @@ PHASES = {"cnn": phase_cnn, "lstm": phase_lstm, "tlm": phase_tlm,
           "flash": phase_flash, "ingest": phase_ingest,
           "gen": phase_gen, "serving": phase_serving,
           "paged_serving": phase_paged_serving,
+          "quant_serving": phase_quant_serving,
           "sentinel_overhead": phase_sentinel_overhead,
           "sentinel_chaos": phase_sentinel_chaos,
           "obs_overhead": phase_obs_overhead,
@@ -2940,6 +3118,10 @@ def main(argv=None):
         "paged_serving", None if tpu_ok else cpu_env,
         metrics=("streams_vs_slot", "paged_peak_streams",
                  "paged_decode_tokens_per_sec", "victim_p99_ms"))
+    models["quant_serving"] = _run_phase_repeated(
+        "quant_serving", None if tpu_ok else cpu_env,
+        metrics=("streams_vs_bf16", "int8_peak_streams",
+                 "int8_decode_tokens_per_sec", "drift"))
     models["sweep_fusion"] = _run_phase_repeated(
         "sweep_fusion", env,
         metrics=("speedup", "fused_seconds", "serial_seconds"))
@@ -3043,6 +3225,8 @@ def main(argv=None):
             models.get("serving", {}).get("speedup_vs_solo"),
         "paged_streams_vs_slot":
             models.get("paged_serving", {}).get("streams_vs_slot"),
+        "quant_streams_vs_bf16":
+            models.get("quant_serving", {}).get("streams_vs_bf16"),
         "full_report": report_path,
     }
     print(json.dumps(compact))
@@ -3116,6 +3300,18 @@ def _write_md(path, report):
                 f"({stats.get('streams_vs_slot', '—')}×), victim p99="
                 f"{stats.get('victim_p99_ms')}ms, bully 429s="
                 f"{stats.get('bully_rejected')} |")
+            continue
+        if name == "quant_serving":
+            lines.append(
+                f"| {name} (int8 KV+weights vs bf16, equal HBM) "
+                f"| {stats.get('platform', '?')} "
+                f"| {stats.get('int8_decode_tokens_per_sec', '—')} "
+                f"tok/s | — | — | — | — "
+                f"| peak streams {stats.get('int8_peak_streams')} vs "
+                f"{stats.get('bf16_peak_streams')} bf16 "
+                f"({stats.get('streams_vs_bf16', '—')}×), drift="
+                f"{stats.get('drift')}, degrade ladder "
+                f"{'ok' if stats.get('degrade_fired') else 'FAILED'} |")
             continue
         if name == "csv_ingest":
             lines.append(

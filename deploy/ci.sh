@@ -12,6 +12,7 @@
 #        LO_CI_FULL_TIMEOUT   seconds for the full-suite run (default 3600)
 #        LO_CI_CHAOS_TIMEOUT  seconds for the chaos stage (default 300)
 #        LO_CI_PERF_TIMEOUT   seconds for the perf-smoke stage (default 600)
+#        LO_CI_QUANT_TIMEOUT  seconds for the quant-smoke stage (default 900)
 
 set -euo pipefail
 
@@ -296,12 +297,13 @@ OVERHEAD_OUT="$(mktemp)"
 OBS_OUT="$(mktemp)"
 SERVE_OUT="$(mktemp)"
 PAGED_OUT="$(mktemp)"
+QUANT_OUT="$(mktemp)"
 SWEEP_OUT="$(mktemp)"
 MONITOR_OUT="$(mktemp)"
 INCIDENT_OUT="$(mktemp)"
 ROOFLINE_OUT="$(mktemp)"
 XRAY_OUT="$(mktemp)"
-trap 'rm -rf "$PERF_CACHE" "$PERF_OUT" "$SLICE_OUT" "$CKPT_OUT" "$MIG_OUT" "$ELASTIC_OUT" "$CHAOS_OUT" "$OVERHEAD_OUT" "$OBS_OUT" "$SERVE_OUT" "$PAGED_OUT" "$SWEEP_OUT" "$MONITOR_OUT" "$ROOFLINE_OUT" "$XRAY_OUT"' EXIT
+trap 'rm -rf "$PERF_CACHE" "$PERF_OUT" "$SLICE_OUT" "$CKPT_OUT" "$MIG_OUT" "$ELASTIC_OUT" "$CHAOS_OUT" "$OVERHEAD_OUT" "$OBS_OUT" "$SERVE_OUT" "$PAGED_OUT" "$QUANT_OUT" "$SWEEP_OUT" "$MONITOR_OUT" "$ROOFLINE_OUT" "$XRAY_OUT"' EXIT
 timeout -k 10 "$SENTINEL_TIMEOUT" env JAX_PLATFORMS=cpu \
     JAX_COMPILATION_CACHE_DIR="$PERF_CACHE" \
     LO_COMPUTE_DTYPE=float32 \
@@ -491,6 +493,64 @@ print(f"paged-smoke: OK (peak {result['paged_peak_streams']} vs "
       f"equal HBM, bully 429s={result['bully_rejected']}, victim "
       f"429s=0, victim p99 {result['victim_p99_ms']}ms, SLO quiet)")
 EOF
+
+echo "== quant-smoke: int8 KV must beat bf16 at equal HBM, gated on quality =="
+# Quantized serving plane (bench.py quant_serving; docs/SERVING.md
+# "Quantized serving"). Gates:
+#  - peak simultaneously-decoding streams: int8 >= 1.8x bf16 at equal
+#    pool bytes (int8 payload + f32 scale rows funded together; page
+#    capacity at equal bytes holds on CPU and TPU alike). Override
+#    with LO_SMOKE_QUANT_STREAMS_FLOOR.
+#  - quality: the create-time drift probe sits under
+#    LO_SERVE_DRIFT_MAX (the quantized session would have degraded
+#    itself otherwise).
+#  - chaos: a latched kv_quant fault walks the degrade ladder — 429s
+#    then a clean 200 over exact bf16 pages/weights, never a
+#    corrupted stream.
+QUANT_TIMEOUT="${LO_CI_QUANT_TIMEOUT:-900}"
+timeout -k 10 "$QUANT_TIMEOUT" env JAX_PLATFORMS=cpu \
+    JAX_COMPILATION_CACHE_DIR="$PERF_CACHE" \
+    LO_COMPUTE_DTYPE=float32 \
+    LO_BENCH_TLM_D=128 LO_BENCH_TLM_LAYERS=2 LO_BENCH_TLM_SEQ=128 \
+    python bench.py --phase quant_serving | tee "$QUANT_OUT"
+python - "$QUANT_OUT" <<'EOF'
+import json, os, sys
+
+mark = "@@LO_BENCH_RESULT@@"
+result = None
+for line in reversed(open(sys.argv[1]).read().splitlines()):
+    if line.startswith(mark):
+        result = json.loads(line[len(mark):])
+        break
+assert result is not None, "quant-smoke: no bench result line"
+assert "error" not in result, f"quant-smoke: phase failed: {result}"
+result = result.get("result", result)  # unwrap the ok-envelope
+floor = float(os.environ.get("LO_SMOKE_QUANT_STREAMS_FLOOR", "1.8"))
+ratio = result["streams_vs_bf16"]
+assert ratio >= floor, (
+    f"quant-smoke: int8 sustained only {ratio}x the bf16 streams "
+    f"at equal HBM (gate >= {floor}x): {result}")
+drift, limit = result["drift"], result["drift_max"]
+assert drift is not None and drift <= limit, (
+    f"quant-smoke: drift probe {drift} exceeds "
+    f"LO_SERVE_DRIFT_MAX={limit}: {result}")
+assert result["degrade_fired"], (
+    f"quant-smoke: latched kv_quant fault did not degrade the "
+    f"session to bf16: {result}")
+print(f"quant-smoke: OK (peak {result['int8_peak_streams']} vs "
+      f"{result['bf16_peak_streams']} bf16 streams = {ratio}x at "
+      f"equal HBM, drift {drift} <= {limit}, degrade ladder ok)")
+EOF
+# the quantized test suite rides under the lock-order witness: the
+# degrade ladder rebuilds a live session (pool teardown + arena re-pin
+# under the session lock), exactly where an out-of-order acquisition
+# would hide (docs/ANALYSIS.md "Concurrency passes")
+timeout -k 10 "$QUANT_TIMEOUT" env JAX_PLATFORMS=cpu \
+    LO_COMPUTE_DTYPE=float32 \
+    LO_LOCK_WITNESS=1 \
+    python -m pytest tests/test_ops.py tests/test_serving.py \
+    -q -k "quant or drift or degrade" \
+    -p no:cacheprovider -p no:xdist -p no:randomly
 
 echo "== sweep-smoke: fused sweep must beat serial trials =="
 # An 8-point learning-rate grid over one MLP architecture, fused into

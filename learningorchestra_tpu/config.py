@@ -352,6 +352,32 @@ class Config:
     serve_tenant_weights: str = dataclasses.field(
         default_factory=lambda: os.environ.get(
             "LO_SERVE_TENANT_WEIGHTS", ""))
+    # Quantized serving (docs/SERVING.md "Quantized serving"). KV page
+    # dtype for paged LM sessions: "bf16" (exact — the bit-identity
+    # path) or "int8" (half the pool bytes per token, ~2x resident
+    # streams at fixed HBM; per-page-per-head scales ride in a
+    # parallel pool). Per-session override: request field "kvDtype".
+    serve_kv_dtype: str = dataclasses.field(
+        default_factory=lambda: os.environ.get(
+            "LO_SERVE_KV_DTYPE", "bf16"))
+    # Serving-weight dtype: "bf16" (serve the master params as-is),
+    # "int8" or "fp8" (quantize the session's pinned copy once at
+    # create; dequant is fused into the jitted step — master params
+    # are untouched for training). Per-session override: "weights".
+    serve_weights: str = dataclasses.field(
+        default_factory=lambda: os.environ.get(
+            "LO_SERVE_WEIGHTS", "bf16"))
+    # Quality gate for quantized sessions: max relative logit/output
+    # drift (quantized vs exact) on the held probe batch before the
+    # session degrades itself back to bf16 pages/weights and fires an
+    # incident. Probed at session create and every
+    # LO_SERVE_DRIFT_EVERY decode steps.
+    serve_drift_max: float = dataclasses.field(
+        default_factory=lambda: float(os.environ.get(
+            "LO_SERVE_DRIFT_MAX", "0.05")))
+    serve_drift_every: int = dataclasses.field(
+        default_factory=lambda: int(os.environ.get(
+            "LO_SERVE_DRIFT_EVERY", "256")))
 
     # Gateway behaviors (KrakenD parity, krakend.json:1769-1770):
     # version-revalidated response cache for universal GETs (TTL is a

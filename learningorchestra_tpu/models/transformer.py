@@ -175,7 +175,8 @@ class _Attention(nn.Module):
     @nn.compact
     def __call__(self, x, train: bool, decode_pos=None, cache_len: int = 0,
                  pad_offset=None, kv_len=None, block_tables=None,
-                 page_len: int = 0, kv_pages: int = 0):
+                 page_len: int = 0, kv_pages: int = 0,
+                 kv_quant: bool = False):
         d_model = x.shape[-1]
         kv = self.kv_heads
         if self.n_heads % kv:
@@ -268,18 +269,45 @@ class _Attention(nn.Module):
                 # stream's output bits still match a solo decode
                 # (docs/SERVING.md bit-identity contract).
                 pool_shape = (kv_pages, page_len, kv, self.head_dim)
-                ck = self.variable("cache", "k", jnp.zeros,
-                                   pool_shape, x.dtype)
-                cv = self.variable("cache", "v", jnp.zeros,
-                                   pool_shape, x.dtype)
-                ck.value = attn_ops.paged_append_token(
-                    ck.value, k[:, 0], block_tables, pos, page_len)
-                cv.value = attn_ops.paged_append_token(
-                    cv.value, v[:, 0], block_tables, pos, page_len)
-                o = attn_ops.paged_decode_attention(
-                    q, ck.value, cv.value, block_tables, pos,
-                    pad_offset=pad_offset,
-                    window=self.window).reshape(shape4)
+                if kv_quant:
+                    # int8 pool + per-page-per-head float32 scale pool
+                    # (docs/SERVING.md "Quantized serving"): append
+                    # requantizes the touched page against its live
+                    # rows; decode fuses dequant into the bounded
+                    # gather, so no bf16 pool copy ever materializes
+                    ck = self.variable("cache", "k", jnp.zeros,
+                                       pool_shape, jnp.int8)
+                    cv = self.variable("cache", "v", jnp.zeros,
+                                       pool_shape, jnp.int8)
+                    cks = self.variable("cache", "k_scale", jnp.zeros,
+                                        (kv_pages, kv), jnp.float32)
+                    cvs = self.variable("cache", "v_scale", jnp.zeros,
+                                        (kv_pages, kv), jnp.float32)
+                    ck.value, cks.value = \
+                        attn_ops.quantized_paged_append_token(
+                            ck.value, cks.value, k[:, 0], block_tables,
+                            pos, page_len)
+                    cv.value, cvs.value = \
+                        attn_ops.quantized_paged_append_token(
+                            cv.value, cvs.value, v[:, 0], block_tables,
+                            pos, page_len)
+                    o = attn_ops.quantized_paged_decode_attention(
+                        q, ck.value, cks.value, cv.value, cvs.value,
+                        block_tables, pos, pad_offset=pad_offset,
+                        window=self.window).reshape(shape4)
+                else:
+                    ck = self.variable("cache", "k", jnp.zeros,
+                                       pool_shape, x.dtype)
+                    cv = self.variable("cache", "v", jnp.zeros,
+                                       pool_shape, x.dtype)
+                    ck.value = attn_ops.paged_append_token(
+                        ck.value, k[:, 0], block_tables, pos, page_len)
+                    cv.value = attn_ops.paged_append_token(
+                        cv.value, v[:, 0], block_tables, pos, page_len)
+                    o = attn_ops.paged_decode_attention(
+                        q, ck.value, cv.value, block_tables, pos,
+                        pad_offset=pad_offset,
+                        window=self.window).reshape(shape4)
             else:
                 ck, cv = self._cache_vars(b, cache_len, x.dtype)
                 rows = jnp.arange(b)
@@ -480,7 +508,8 @@ class _Block(nn.Module):
     @nn.compact
     def __call__(self, x, train: bool, decode_pos=None, cache_len: int = 0,
                  pad_offset=None, kv_len=None, block_tables=None,
-                 page_len: int = 0, kv_pages: int = 0):
+                 page_len: int = 0, kv_pages: int = 0,
+                 kv_quant: bool = False):
         h = nn.RMSNorm(name="attn_norm")(x)
         h = _Attention(self.n_heads, self.head_dim, self.attention,
                        self.causal, self.mesh,
@@ -493,7 +522,7 @@ class _Block(nn.Module):
             h, train, decode_pos=decode_pos, cache_len=cache_len,
             pad_offset=pad_offset, kv_len=kv_len,
             block_tables=block_tables, page_len=page_len,
-            kv_pages=kv_pages)
+            kv_pages=kv_pages, kv_quant=kv_quant)
         if self.dropout and train:
             h = nn.Dropout(self.dropout, deterministic=False)(h)
         x = x + h
@@ -589,7 +618,7 @@ class TransformerLM(nn.Module):
     def __call__(self, tokens, train: bool = False, decode_pos=None,
                  cache_len: int = 0, pad_offset=None, kv_len=None,
                  block_tables=None, page_len: int = 0,
-                 kv_pages: int = 0):
+                 kv_pages: int = 0, kv_quant: bool = False):
         if self.attention not in ATTENTION_IMPLS:
             raise ValueError(f"unknown attention impl: {self.attention!r}")
         d_ff = self.d_ff or 4 * self.d_model
@@ -616,15 +645,15 @@ class TransformerLM(nn.Module):
                     f"unknown remat policy {self.remat!r} "
                     f"(none|dots|full)")
             # args: (self, x, train, decode_pos, cache_len, ...,
-            # block_tables, page_len, kv_pages) — the non-array flags
-            # are static (the paged-decode args are always
-            # None/0 here: remat only wraps the train path)
+            # block_tables, page_len, kv_pages, kv_quant) — the
+            # non-array flags are static (the paged-decode args are
+            # always None/0/False here: remat only wraps train)
             # prevent_cse=True: outside nn.scan, XLA's CSE can undo
             # the recomputation and keep activations live (the flax
             # docs' reason it defaults True under jit)
             block_cls = nn.remat(_Block, policy=policies[self.remat],
                                  prevent_cse=True,
-                                 static_argnums=(2, 3, 4, 7, 8, 9))
+                                 static_argnums=(2, 3, 4, 7, 8, 9, 10))
         aux_total = jnp.zeros((), jnp.float32)
         for i in range(self.n_layers):
             x, aux = block_cls(self.n_heads, head_dim, d_ff,
@@ -636,7 +665,7 @@ class TransformerLM(nn.Module):
                                self.sliding_window, self.rope_base,
                                name=f"layer_{i}")(
                 x, train, decode_pos, cache_len, pad_offset, kv_len,
-                block_tables, page_len, kv_pages)
+                block_tables, page_len, kv_pages, kv_quant)
             aux_total = aux_total + aux
         x = nn.RMSNorm(name="final_norm")(x)
         head = _LMHead(self.vocab_size, name="lm_head")
@@ -1179,6 +1208,86 @@ def _lora_optimizer(base):
 
     return optax.multi_transform(
         {"lora": base, "frozen": optax.set_to_zero()}, labels)
+
+
+# ----------------------------------------------------------------------
+# Quantized serving weights (docs/SERVING.md "Quantized serving").
+#
+# Serving is read-only over a pinned copy of the params, so the
+# fp32/bf16 MASTER tree stays untouched for training/LoRA — only the
+# serving pin narrows. A quantized leaf is replaced by a dict
+# {"qvalue": int8/fp8, "qscale": f32 per-output-channel,
+#  "qlike": 0-d array carrying the original dtype}; dequant runs as
+# the first op INSIDE the jitted serve step/prefill, so XLA fuses the
+# convert+scale into the consuming matmul operand and no full-width
+# copy of the weights persists in HBM. Unquantized trees pass through
+# both functions structurally unchanged, which is what keeps bf16
+# sessions bit-identical to the pre-quantization serving plane.
+# ----------------------------------------------------------------------
+
+_WEIGHT_QUANT_LEAVES = ("kernel", "embedding")
+_FP8_MAX = 448.0  # float8_e4m3fn finite max
+
+
+def quantize_serving_params(params, dtype: str):
+    """Quantize the matmul weights of a param tree for serving.
+
+    ``dtype`` is ``"bf16"`` (no-op — the tree is returned as-is),
+    ``"int8"`` (symmetric per-output-channel, scale = amax/127) or
+    ``"fp8"`` (float8_e4m3fn, scale = amax/448; raises
+    :class:`ValueError` when the installed jax lacks fp8 dtypes so
+    the platform gate fails loudly at session create, not mid-step).
+    Only ``kernel``/``embedding`` leaves with ndim >= 2 narrow; norms,
+    biases and LoRA adapters (tiny, precision-sensitive) ride along
+    unchanged."""
+    if dtype in (None, "", "bf16"):
+        return params
+    if dtype == "fp8" and not hasattr(jnp, "float8_e4m3fn"):
+        raise ValueError(
+            "fp8 serving weights need jax.numpy.float8_e4m3fn, which "
+            "this jax build does not provide — use int8 or bf16")
+    if dtype not in ("int8", "fp8"):
+        raise ValueError(
+            f"unknown serving weight dtype {dtype!r} (bf16|int8|fp8)")
+
+    def quant_leaf(a):
+        f = jnp.asarray(a).astype(jnp.float32)
+        axes = tuple(range(f.ndim - 1))
+        amax = jnp.max(jnp.abs(f), axis=axes)
+        if dtype == "int8":
+            scale = jnp.maximum(amax / 127.0, attn_ops._QUANT_EPS)
+            q = jnp.clip(jnp.round(f / scale), -127,
+                         127).astype(jnp.int8)
+        else:
+            scale = jnp.maximum(amax / _FP8_MAX, attn_ops._QUANT_EPS)
+            q = (f / scale).astype(jnp.float8_e4m3fn)
+        return {"qvalue": q, "qscale": scale,
+                "qlike": jnp.zeros((), jnp.asarray(a).dtype)}
+
+    def walk(node):
+        if isinstance(node, dict) or hasattr(node, "items"):
+            return {k: (quant_leaf(v)
+                        if k in _WEIGHT_QUANT_LEAVES
+                        and jnp.ndim(v) >= 2 else walk(v))
+                    for k, v in node.items()}
+        return node
+
+    return walk(params)
+
+
+def dequantize_serving_params(tree):
+    """Inverse of :func:`quantize_serving_params` — expand quantized
+    leaf dicts back to their original dtype. Called INSIDE the jitted
+    serve fns (fused dequant); a tree with no quantized leaves passes
+    through with identical leaves, so the bf16 path compiles to the
+    exact pre-quantization program."""
+    if isinstance(tree, dict) or hasattr(tree, "items"):
+        if "qvalue" in tree and "qscale" in tree:
+            deq = tree["qvalue"].astype(jnp.float32) * tree["qscale"]
+            return deq.astype(tree["qlike"].dtype)
+        return {k: dequantize_serving_params(v)
+                for k, v in tree.items()}
+    return tree
 
 
 class LanguageModel:
@@ -1898,6 +2007,7 @@ class LanguageModel:
 
         @functools.partial(jax.jit, donate_argnums=(1,))
         def step(params, cache, tok, col, keys):
+            params = dequantize_serving_params(params)
             (logits, _), mut = module.apply(
                 {"params": params, "cache": cache}, tok, train=False,
                 decode_pos=col, cache_len=cache_len,
@@ -1920,6 +2030,7 @@ class LanguageModel:
 
             @jax.jit
             def prefill(params, tokens, key):
+                params = dequantize_serving_params(params)
                 (logits, _), mut = pmod.apply(
                     {"params": params}, tokens, train=False,
                     cache_len=cache_len, mutable=["cache"])
@@ -1954,7 +2065,8 @@ class LanguageModel:
                         page_len: int, n_pages: int,
                         temperature: float,
                         top_k: Optional[int] = None,
-                        top_p: Optional[float] = None):
+                        top_p: Optional[float] = None,
+                        kv_dtype: str = "bf16"):
         """Paged-KV variant of :meth:`serve_fns` (docs/SERVING.md
         "Paged KV"): the per-layer cache is one SHARED
         ``(n_pages, page_len, kv, d)`` pool and each stream owns an
@@ -1982,31 +2094,40 @@ class LanguageModel:
           stream appends into its own copy).
         - ``sample_first(logits, key)`` — the prefill's sampling
           epilogue alone, for prefix hits that skipped the prefill.
+
+        ``kv_dtype="int8"`` switches the pool to int8 values + a
+        per-page-per-head scale pool ("Quantized serving"): the same
+        five functions over half the pool bytes, with dequant fused
+        into the gather/step.
         """
         fns = self._serve_paged_fns
         sig = (slots, cache_len, page_len, n_pages, temperature,
-               top_k, top_p)
+               top_k, top_p, kv_dtype)
         if sig not in fns:
             fns[sig] = self._build_serve_fns_paged(
                 slots, cache_len, page_len, n_pages, temperature,
-                top_k, top_p)
+                top_k, top_p, kv_dtype)
         return fns[sig]
 
     def _build_serve_fns_paged(self, slots: int, cache_len: int,
                                page_len: int, n_pages: int,
                                temperature: float,
                                top_k: Optional[int],
-                               top_p: Optional[float]):
+                               top_p: Optional[float],
+                               kv_dtype: str = "bf16"):
         module = self._module_for(1)
         sample = self._sample
+        kv_quant = kv_dtype == "int8"
 
         @functools.partial(jax.jit, donate_argnums=(1,))
         def step(params, pool, tok, col, block_tables, keys):
+            params = dequantize_serving_params(params)
             (logits, _), mut = module.apply(
                 {"params": params, "cache": pool}, tok, train=False,
                 decode_pos=col, cache_len=cache_len,
                 block_tables=block_tables, page_len=page_len,
-                kv_pages=n_pages, mutable=["cache"])
+                kv_pages=n_pages, kv_quant=kv_quant,
+                mutable=["cache"])
             # same per-row fold_in(key, col + 1) schedule as the slot
             # step — the whole bit-identity story rides on it
             ks = jax.vmap(jax.random.fold_in)(keys, col + 1)
@@ -2024,6 +2145,7 @@ class LanguageModel:
 
             @jax.jit
             def prefill(params, tokens, key):
+                params = dequantize_serving_params(params)
                 (logits, _), mut = pmod.apply(
                     {"params": params}, tokens, train=False,
                     cache_len=cache_len, mutable=["cache"])
@@ -2040,11 +2162,35 @@ class LanguageModel:
         # copy of the page pool in HBM (transient 2x footprint per
         # layer tree), which would break equal-HBM sizing at large
         # pool sizes
-        @functools.partial(jax.jit, donate_argnums=(0,))
-        def join_paged(pool, pcache, page_ids, start_row):
-            return jax.tree_util.tree_map(
-                lambda pl, pc: attn_ops.paged_prefill_write(
-                    pl, pc[0], page_ids, start_row), pool, pcache)
+        if kv_quant:
+            # the pool tree carries k_scale/v_scale leaves the plain
+            # prefill cache lacks, so tree_map's structure match fails;
+            # walk the dicts by hand and quantize at the k/v level
+            @functools.partial(jax.jit, donate_argnums=(0,))
+            def join_paged(pool, pcache, page_ids, start_row):
+                def walk(pl, pc):
+                    if isinstance(pl, dict) or hasattr(pl, "items"):
+                        if "k_scale" in pl:
+                            kq, ks = \
+                                attn_ops.quantized_paged_prefill_write(
+                                    pl["k"], pl["k_scale"], pc["k"][0],
+                                    page_ids, start_row)
+                            vq, vs = \
+                                attn_ops.quantized_paged_prefill_write(
+                                    pl["v"], pl["v_scale"], pc["v"][0],
+                                    page_ids, start_row)
+                            return {"k": kq, "k_scale": ks,
+                                    "v": vq, "v_scale": vs}
+                        return {k: walk(pl[k], pc[k]) for k in pl}
+                    return pl
+
+                return walk(pool, pcache)
+        else:
+            @functools.partial(jax.jit, donate_argnums=(0,))
+            def join_paged(pool, pcache, page_ids, start_row):
+                return jax.tree_util.tree_map(
+                    lambda pl, pc: attn_ops.paged_prefill_write(
+                        pl, pc[0], page_ids, start_row), pool, pcache)
 
         @functools.partial(jax.jit, donate_argnums=(0,))
         def copy_page(pool, src, dst):
@@ -2060,10 +2206,15 @@ class LanguageModel:
 
         return step, prefill_for, join_paged, copy_page, sample_first
 
-    def serve_cache_paged(self, n_pages: int, page_len: int):
+    def serve_cache_paged(self, n_pages: int, page_len: int,
+                          kv_dtype: str = "bf16"):
         """Zero-initialized shared KV page pool:
         ``{layer: {k/v: (n_pages, page_len, kv_heads, head_dim)}}`` —
-        ONE allocation every stream's block table indexes into."""
+        ONE allocation every stream's block table indexes into. Under
+        ``kv_dtype="int8"`` the k/v leaves are int8 and per-layer
+        ``k_scale``/``v_scale`` ``(n_pages, kv_heads)`` float32 leaves
+        ride along (zero scales dequantize to exact zeros, matching
+        the zero pool)."""
         module = self._module_for(1)
         shapes = jax.eval_shape(
             lambda: module.init(
@@ -2072,7 +2223,8 @@ class LanguageModel:
                 decode_pos=jnp.zeros((1,), jnp.int32),
                 cache_len=page_len * n_pages,
                 block_tables=jnp.zeros((1, 1), jnp.int32),
-                page_len=page_len, kv_pages=n_pages)["cache"])
+                page_len=page_len, kv_pages=n_pages,
+                kv_quant=kv_dtype == "int8")["cache"])
         return jax.tree_util.tree_map(
             lambda sd: jnp.zeros(sd.shape, sd.dtype), shapes)
 

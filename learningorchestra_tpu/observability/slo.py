@@ -42,6 +42,53 @@ _HISTORY = 256
 # hide behind (or be blamed on) the aggregate
 _TENANT_HIST_PREFIX = "lo_serving_request_seconds_tenant_"
 
+# ----------------------------------------------------------------------
+# producer-pushed gauges: latest value + timestamp, for signals that
+# have no histogram or sampler ring behind them (the quantized-serving
+# drift probe pushes ``servingDrift`` here). The watchdog reads them in
+# _measure with the window as a freshness bound, so a gauge whose
+# producer stopped updating (session degraded/closed) ages out and the
+# alert resolves instead of firing on stale data forever.
+# ----------------------------------------------------------------------
+_gauge_lock = locks.make_lock("slo.gauges")
+_gauges: Dict[str, tuple] = {}
+
+
+def set_gauge(name: str, value: float,
+              now: Optional[float] = None) -> None:
+    """Record the latest value of a pushed gauge (thread-safe)."""
+    with _gauge_lock:
+        _gauges[name] = (float(value),
+                         time.time() if now is None else now)
+
+
+def get_gauge(name: str, max_age: Optional[float] = None,
+              now: Optional[float] = None) -> Optional[float]:
+    """Latest value of ``name``, or None when unset or older than
+    ``max_age`` seconds."""
+    with _gauge_lock:
+        entry = _gauges.get(name)
+    if entry is None:
+        return None
+    value, ts = entry
+    if max_age is not None:
+        now = time.time() if now is None else now
+        if now - ts > max_age:
+            return None
+    return value
+
+
+def gauges() -> Dict[str, float]:
+    """All pushed gauges (latest values), for /metrics export."""
+    with _gauge_lock:
+        return {name: entry[0] for name, entry in _gauges.items()}
+
+
+def reset_gauges() -> None:
+    """Test isolation."""
+    with _gauge_lock:
+        _gauges.clear()
+
 
 class _HistWindow:
     """Bounded ring of (ts, cumulative-bucket-snapshot) pairs for one
@@ -162,6 +209,15 @@ class SloWatchdog:
                 "threshold": float(getattr(
                     cfg, "slo_unattributed_growth_bytes", 0.0)),
                 "unit": "bytes"},
+            # quantized-serving quality gate: the drift probe
+            # (services/serving.py) pushes its latest relative error
+            # here; the session degrades itself to bf16 on breach, this
+            # objective is the paper trail that it happened
+            "servingDrift": {
+                "severity": "ticket",
+                "threshold": float(getattr(
+                    cfg, "serve_drift_max", 0.0)),
+                "unit": "frac"},
         }
         thr = float(cfg.slo_serving_p99_ms)
         for tenant in sorted(list(self._tenant_serving)):
@@ -235,6 +291,9 @@ class SloWatchdog:
                 return None
             span = max(pts[-1][0] - pts[0][0], 1e-9)
             return (pts[-1][1] - pts[0][1]) / span * 60.0
+        if name == "servingDrift":
+            # pushed gauge; the window doubles as the freshness bound
+            return get_gauge("servingDrift", max_age=window, now=now)
         if name == "unattributedGrowth":
             if monitor is None:
                 return None

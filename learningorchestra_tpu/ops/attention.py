@@ -888,6 +888,24 @@ def paged_decode_attention(q: jax.Array, k_pool: jax.Array,
                             window=window, scale=scale)
 
 
+def checked_pool_cast(pool: jax.Array, values: jax.Array) -> jax.Array:
+    """Cast ``values`` to ``pool.dtype`` — REFUSING the cast when the
+    pool is an integer (quantized) pool and the values are raw floats.
+    A bare ``.astype(int8)`` silently truncates bf16 activations to
+    garbage with no scaling; every raw pool write funnels through here
+    so that mistake raises instead of corrupting a token stream. The
+    quantized write path (:func:`quantized_paged_append_token` /
+    :func:`quantized_paged_prefill_write`) scales first and never hits
+    this guard."""
+    if jnp.issubdtype(pool.dtype, jnp.integer) and \
+            jnp.issubdtype(values.dtype, jnp.inexact):
+        raise TypeError(
+            f"raw write of {values.dtype} values into a quantized "
+            f"{pool.dtype} KV pool — use the quantized_* ops, which "
+            f"scale per page/head before narrowing")
+    return values.astype(pool.dtype)
+
+
 def paged_append_token(pool: jax.Array, new: jax.Array,
                        block_tables: jax.Array,
                        pos: jax.Array, page_len: int) -> jax.Array:
@@ -900,7 +918,8 @@ def paged_append_token(pool: jax.Array, new: jax.Array,
     — the paged analog of the slot cache's ``at[rows, pos].set``."""
     rows = jnp.arange(new.shape[0])
     page = block_tables[rows, pos // page_len]
-    return pool.at[page, pos % page_len].set(new.astype(pool.dtype))
+    return pool.at[page, pos % page_len].set(
+        checked_pool_cast(pool, new))
 
 
 def paged_prefill_write(pool: jax.Array, kv_rows: jax.Array,
@@ -921,4 +940,138 @@ def paged_prefill_write(pool: jax.Array, kv_rows: jax.Array,
     chunk = jax.lax.dynamic_slice_in_dim(
         kv_rows, start_row, n * page_len, axis=0)
     chunk = chunk.reshape((n, page_len) + kv_rows.shape[1:])
-    return pool.at[page_ids].set(chunk.astype(pool.dtype))
+    return pool.at[page_ids].set(checked_pool_cast(pool, chunk))
+
+
+# ---------------------------------------------------------------------------
+# Quantized paged KV (int8 pages + per-page-per-head scales).
+#
+# Decode is bandwidth-bound on the pool read, so halving pool bytes
+# roughly doubles resident streams at fixed HBM and tokens/s/chip
+# (docs/SERVING.md "Quantized serving"). Layout: the int8 pool keeps
+# the bf16 pool's (pages, page_len, kv, d) shape; a parallel scale
+# pool (pages, kv) float32 holds one symmetric scale per page per KV
+# head — coarse enough to be ~0.4% of pool bytes, fine enough that a
+# loud head in one page never clips a quiet head. Dequant is fused
+# into the bounded paged gather: only the gathered (b, width*page_len)
+# working set is ever materialized in float, never a pool-sized bf16
+# copy. The dequantized rows then flow through the SAME
+# decode_attention reduction as the exact path, so quantization error
+# is confined to the value rounding itself (bounded by the round-trip
+# property test in tests/test_ops.py) and measured end-to-end by the
+# serving drift gate.
+
+_QUANT_EPS = 1e-8
+
+
+def quantize_kv_pages(pages: jax.Array,
+                      eps: float = _QUANT_EPS) -> Tuple[jax.Array,
+                                                        jax.Array]:
+    """Symmetric int8 quantization of a stack of KV pages.
+
+    ``pages`` is ``(n, page_len, kv, d)`` float; returns
+    ``(q (n, page_len, kv, d) int8, scales (n, kv) float32)`` with
+    ``scale = max(amax / 127, eps)`` over each page's ``(page_len, d)``
+    plane per KV head. The eps clamp keeps all-zero pages (fresh
+    allocations, masked rows) from dividing by zero — they round-trip
+    to exact zeros."""
+    amax = jnp.max(jnp.abs(pages.astype(jnp.float32)), axis=(1, 3))
+    scales = jnp.maximum(amax / 127.0, eps)
+    scaled = pages.astype(jnp.float32) / scales[:, None, :, None]
+    q = jnp.clip(jnp.round(scaled), -127, 127).astype(jnp.int8)
+    return q, scales
+
+
+def dequantize_kv_pages(q: jax.Array, scales: jax.Array) -> jax.Array:
+    """Inverse of :func:`quantize_kv_pages`: ``(n, page_len, kv, d)``
+    int8 + ``(n, kv)`` scales -> float32 pages."""
+    return q.astype(jnp.float32) * scales[:, None, :, None]
+
+
+def quantized_paged_prefill_write(pool: jax.Array, scales: jax.Array,
+                                  kv_rows: jax.Array,
+                                  page_ids: jax.Array,
+                                  start_row: jax.Array,
+                                  ) -> Tuple[jax.Array, jax.Array]:
+    """:func:`paged_prefill_write` into an int8 pool: quantize the
+    page chunks, scatter values to ``pool[page_ids]`` and their scales
+    to ``scales[page_ids]``. Rows of ``kv_rows`` past the prompt
+    length are exact zeros (the prefill cache is zero-initialized), so
+    a partial last page's scale reflects only the live rows."""
+    n = page_ids.shape[0]
+    page_len = pool.shape[1]
+    chunk = jax.lax.dynamic_slice_in_dim(
+        kv_rows, start_row, n * page_len, axis=0)
+    chunk = chunk.reshape((n, page_len) + kv_rows.shape[1:])
+    q, s = quantize_kv_pages(chunk)
+    return pool.at[page_ids].set(q), scales.at[page_ids].set(s)
+
+
+def quantized_paged_append_token(pool: jax.Array, scales: jax.Array,
+                                 new: jax.Array,
+                                 block_tables: jax.Array,
+                                 pos: jax.Array, page_len: int,
+                                 ) -> Tuple[jax.Array, jax.Array]:
+    """:func:`paged_append_token` into an int8 pool, requantizing the
+    touched page in place.
+
+    Each stream's current page is gathered, dequantized, masked to its
+    LIVE rows (``row < pos % page_len`` — a freshly allocated page may
+    carry a previous stream's stale int8 garbage, and masking kills it
+    without any host-side page reset), the new row is inserted, and
+    the page is requantized against the live maximum. While the scale
+    is unchanged the old int8 values round-trip exactly (they are
+    integer multiples of the scale); when the new row grows the amax
+    the page re-rounds once against the larger scale — the same
+    bounded per-value error as the original quantization. Duplicate
+    trash-page-0 scatters (retired streams all point at page 0) pick
+    an arbitrary winner, which is fine: page 0 is never read
+    unmasked."""
+    rows = jnp.arange(new.shape[0])
+    page = block_tables[rows, pos // page_len]
+    slot = pos % page_len
+    cur = dequantize_kv_pages(pool[page], scales[page])  # (b,pl,kv,d)
+    live = jnp.arange(page_len)[None, :, None, None] < \
+        slot[:, None, None, None]
+    cur = jnp.where(live, cur, 0.0)
+    cur = jax.vmap(lambda p, i, r: p.at[i].set(r))(
+        cur, slot, new.astype(jnp.float32))
+    q, s = quantize_kv_pages(cur)
+    return pool.at[page].set(q), scales.at[page].set(s)
+
+
+def quantized_paged_decode_attention(q: jax.Array, k_pool: jax.Array,
+                                     k_scales: jax.Array,
+                                     v_pool: jax.Array,
+                                     v_scales: jax.Array,
+                                     block_tables: jax.Array,
+                                     col: jax.Array, *,
+                                     pad_offset: Optional[jax.Array]
+                                     = None,
+                                     window: int = 0,
+                                     scale: Optional[float] = None,
+                                     max_pages: int = 0) -> jax.Array:
+    """:func:`paged_decode_attention` over int8 pools with dequant
+    fused into the bounded gather: pages and their scales are gathered
+    together, multiplied out into the ``(b, width * page_len, kv, d)``
+    float32 working set, and fed through the exact
+    :func:`decode_attention` reduction. HBM traffic is the int8 pool
+    read (+0.4% scales) — half the bf16 path's — and no pool-sized
+    float copy ever exists."""
+    if max_pages and max_pages < block_tables.shape[1]:
+        block_tables = block_tables[:, :max_pages]
+    b = block_tables.shape[0]
+    n_pages = block_tables.shape[1]
+    page_len, kv, d = (k_pool.shape[1], k_pool.shape[2],
+                       k_pool.shape[3])
+
+    def gather(pool, pool_scales):
+        pages = jnp.take(pool, block_tables, axis=0)
+        s = jnp.take(pool_scales, block_tables, axis=0)
+        deq = pages.astype(jnp.float32) * s[:, :, None, :, None]
+        return deq.reshape(b, n_pages * page_len, kv, d)
+
+    return decode_attention(q, gather(k_pool, k_scales),
+                            gather(v_pool, v_scales), col,
+                            pad_offset=pad_offset, window=window,
+                            scale=scale)

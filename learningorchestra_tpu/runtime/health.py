@@ -134,7 +134,13 @@ def resolve_policy(request: Any, config) -> Optional[HealthPolicy]:
 # ----------------------------------------------------------------------
 _lock = locks.make_lock("health.counters")
 _counters: Dict[str, int] = {"nonfiniteSteps": 0, "lossSpikes": 0,
-                             "rollbacks": 0, "quarantined": 0}
+                             "rollbacks": 0, "quarantined": 0,
+                             # quantized-serving quality gate
+                             # (services/serving.py): drift-probe
+                             # breaches and quant→bf16 degrades,
+                             # exported as lo_serving_drift_breaches
+                             # _total / lo_serving_quant_degrades_total
+                             "driftBreaches": 0, "quantDegrades": 0}
 # observers of sentinel events (the incident flight recorder
 # subscribes to rollbacks); notified OUTSIDE the counter lock so a
 # listener can read health_stats() without deadlocking, and strictly

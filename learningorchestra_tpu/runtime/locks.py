@@ -93,6 +93,9 @@ HIERARCHY: Dict[str, int] = {
     "perf.registry": 310,
     "xray.ledger": 320,
     "export.log": 330,             # event-log file lock
+    "slo.gauges": 335,             # pushed-gauge registry (leaf: set
+                                   # from serving under its session
+                                   # lock, read by the watchdog)
 
     # services / leaves ----------------------------------------------
     "server.metrics": 340,

@@ -51,7 +51,12 @@ fired inside the paged-KV pool's page allocation: a transient spec
 surfaces as a 429 the client retries; a latched spec — three or more
 consecutive failures — degrades the session to the contiguous slot
 KV path with an incident bundle, and in-flight paged streams fail
-with 503 while later requests serve normally)."""
+with 503 while later requests serve normally) and ``kv_quant``
+(services/serving.py, fired at admission into an int8-paged session:
+a transient spec is a retryable 429; a latched spec walks the
+quantization degrade ladder — the session rebuilds itself over exact
+bf16 pages/weights with an incident bundle, so a quantization fault
+degrades, never corrupts a token stream)."""
 
 from __future__ import annotations
 

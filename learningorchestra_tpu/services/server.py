@@ -528,6 +528,13 @@ class Api:
             "# TYPE lo_checkpoints_quarantined_total counter",
             f"lo_checkpoints_quarantined_total "
             f"{training_health.get('quarantined', 0)}",
+            # quantized-serving quality gate (services/serving.py)
+            "# TYPE lo_serving_drift_breaches_total counter",
+            f"lo_serving_drift_breaches_total "
+            f"{training_health.get('driftBreaches', 0)}",
+            "# TYPE lo_serving_quant_degrades_total counter",
+            f"lo_serving_quant_degrades_total "
+            f"{training_health.get('quantDegrades', 0)}",
         ]
         sweep_fusion = m["sweepFusion"]
         lines += [
@@ -621,6 +628,23 @@ class Api:
                 lines.append(
                     f'lo_serving_tokens_per_sec_per_chip'
                     f'{{model="{esc(sess["model"])}"}} {tps}')
+        # quantized serving: true KV bytes per cached token (int8 pool
+        # + scale pool funded together, so int8 shows ~2x headroom) and
+        # the latest drift-probe value per quantized session
+        lines.append("# TYPE lo_serving_kv_bytes_per_token gauge")
+        for sess in serving["bySession"]:
+            bpt = (sess.get("kv") or {}).get("bytesPerToken")
+            if bpt is not None:
+                lines.append(
+                    f'lo_serving_kv_bytes_per_token'
+                    f'{{model="{esc(sess["model"])}"}} {bpt}')
+        lines.append("# TYPE lo_serving_drift gauge")
+        for sess in serving["bySession"]:
+            drift = (sess.get("drift") or {}).get("value")
+            if drift is not None:
+                lines.append(
+                    f'lo_serving_drift'
+                    f'{{model="{esc(sess["model"])}"}} {drift}')
         # timed-dispatch gateway
         gateway = m["gateway"]
         lines += [

@@ -53,6 +53,7 @@ from learningorchestra_tpu.observability import xray as obs_xray
 from learningorchestra_tpu.services import faults
 from learningorchestra_tpu.services import validators as V
 from learningorchestra_tpu.services.scheduler import ServingLease
+from learningorchestra_tpu.runtime import health as health_lib
 from learningorchestra_tpu.runtime import locks
 
 _IDLE_TICK_SECONDS = 0.05  # lease-yield poll cadence when no traffic
@@ -325,7 +326,8 @@ class LMServingSession(_SessionBase):
 
     def __init__(self, name: str, ctx, lease: ServingLease, model,
                  slots: int, cache_len: int, temperature: float,
-                 top_k: Optional[int], top_p: Optional[float]):
+                 top_k: Optional[int], top_p: Optional[float],
+                 weights_dtype: str = "bf16"):
         super().__init__(name, ctx, lease)
         self._model = model
         self.slots = int(slots)
@@ -333,6 +335,11 @@ class LMServingSession(_SessionBase):
         self.temperature = float(temperature)
         self.top_k = top_k
         self.top_p = top_p
+        # the session serves a read-only (possibly quantized) copy of
+        # the params; the master tree stays untouched for training
+        # (docs/SERVING.md "Quantized serving")
+        self.weights_dtype = str(weights_dtype or "bf16")
+        self._serve_params = self._quantize_params(self.weights_dtype)
         self._init_decode_path()
         self.tokens_total = 0
         # decode-phase goodput accounting (observability/perf): every
@@ -343,12 +350,16 @@ class LMServingSession(_SessionBase):
         self._decode_seconds = 0.0
         # analytic decode footprint: each step reads every param and
         # the whole slot KV cache from HBM (the classic reason decode
-        # is bandwidth-bound), and costs ~2 flops per param per token
+        # is bandwidth-bound), and costs ~2 flops per param per token.
+        # Bytes come from the SERVING copy — quantized weights halve
+        # (or quarter) the per-step HBM read the roofline charges.
         import jax
 
-        p_leaves = jax.tree_util.tree_leaves(model.params)
-        self._param_count = int(sum(a.size for a in p_leaves))
-        self._param_bytes = int(sum(a.nbytes for a in p_leaves))
+        self._param_count = int(sum(
+            a.size for a in jax.tree_util.tree_leaves(model.params)))
+        self._param_bytes = int(sum(
+            a.nbytes
+            for a in jax.tree_util.tree_leaves(self._serve_params)))
         # host-side slot state (device state is the KV cache)
         self._tok = np.zeros((self.slots, 1), np.int32)
         self._col = np.zeros((self.slots,), np.int32)
@@ -380,14 +391,25 @@ class LMServingSession(_SessionBase):
         self._cache_bytes = int(sum(
             a.nbytes for a in jax.tree_util.tree_leaves(self._cache)))
 
+    def _quantize_params(self, dtype: str):
+        """The tree the serve fns consume: the master params as-is for
+        bf16, or a quantized copy (``quantize_serving_params``) whose
+        dequant fuses into the jitted step."""
+        from learningorchestra_tpu.models import transformer as tlm
+
+        return tlm.quantize_serving_params(self._model.params, dtype)
+
     def _pin_params(self):
         import jax
 
         from learningorchestra_tpu.runtime import arena as arena_lib
 
-        leaves = jax.tree_util.tree_leaves(self._model.params)
+        leaves = jax.tree_util.tree_leaves(self._serve_params)
         flat = {f"leaf{i}": a for i, a in enumerate(leaves)}
-        key = ("serving", self.name, id(self))
+        # the dtype is part of the key: a quant→bf16 degrade re-pins a
+        # DIFFERENT resident set, and a same-key get_or_put would hand
+        # the old quantized entry back
+        key = ("serving", self.name, id(self), self.weights_dtype)
         entry = arena_lib.get_default_arena().get_or_put(
             key, lambda: flat, tags=(self.name,))
         # re-tag the pin in the X-ray ledger: these bytes are THIS
@@ -395,7 +417,8 @@ class LMServingSession(_SessionBase):
         # (the arena's own registration would double-count them)
         obs_xray.release("arena", key)
         obs_xray.register("serving-params", key, entry.nbytes,
-                          name=self.name)
+                          name=self.name, dtype=self.weights_dtype)
+        self._params_pin_key = key
         return entry
 
     def _on_reacquired(self) -> None:
@@ -453,7 +476,7 @@ class LMServingSession(_SessionBase):
         key, sub_decode = jr.split(key)
         prefill = self._prefill_for(s)
         tokens = jnp.asarray(np.asarray(prompt, np.int32)[None, :])
-        nxt, pcache = prefill(self._model.params, tokens, sub_prefill)
+        nxt, pcache = prefill(self._serve_params, tokens, sub_prefill)
         self._cache = self._join(self._cache, pcache, slot)
         req.stages.append(("prefill", admit_t0, time.monotonic(),
                            {"promptTokens": s, "slot": slot}))
@@ -496,7 +519,7 @@ class LMServingSession(_SessionBase):
         import jax.numpy as jnp
 
         nxt, self._cache = self._step(
-            self._model.params, self._cache, jnp.asarray(self._tok),
+            self._serve_params, self._cache, jnp.asarray(self._tok),
             jnp.asarray(self._col), jnp.asarray(self._keys))
         return nxt
 
@@ -547,8 +570,7 @@ class LMServingSession(_SessionBase):
     def close(self) -> None:
         super().close()
         self._params_entry.release()
-        obs_xray.release("serving-params",
-                         ("serving", self.name, id(self)))
+        obs_xray.release("serving-params", self._params_pin_key)
         obs_xray.release("kv-cache", ("kv", self.name, id(self)))
 
     def _batch_fill(self) -> Optional[float]:
@@ -593,6 +615,8 @@ class LMServingSession(_SessionBase):
             "cacheLen": self.cache_len,
             "tokensTotal": self.tokens_total,
             "temperature": self.temperature,
+            "weights": {"dtype": self.weights_dtype,
+                        "bytes": self._param_bytes},
         })
         return out
 
@@ -639,11 +663,16 @@ class PagedKVPool:
     read from REST threads, hence the lock.
     """
 
-    def __init__(self, n_pages: int, page_len: int):
+    def __init__(self, n_pages: int, page_len: int,
+                 dtype: str = "bf16"):
         if n_pages < 2:
             raise ValueError(f"n_pages must be >= 2, got {n_pages}")
         self.n_pages = int(n_pages)
         self.page_len = int(page_len)
+        # value dtype of the device pool this allocator fronts
+        # ("bf16" or "int8" — int8 pages carry a parallel scale pool,
+        # docs/SERVING.md "Quantized serving")
+        self.dtype = str(dtype or "bf16")
         self._lock = locks.make_lock("serving.kvpool")
         self._free: Deque[int] = collections.deque(
             range(1, self.n_pages))
@@ -725,6 +754,7 @@ class PagedKVPool:
     def stats(self) -> Dict[str, Any]:
         with self._lock:
             return {
+                "dtype": self.dtype,
                 "pageLen": self.page_len,
                 "pagesTotal": self.usable,
                 "pagesFree": len(self._free),
@@ -876,13 +906,21 @@ class PagedLMServingSession(LMServingSession):
                  slots: int, cache_len: int, temperature: float,
                  top_k: Optional[int], top_p: Optional[float],
                  page_len: int, n_pages: int,
-                 tenant_weights: Optional[Dict[str, float]] = None):
+                 tenant_weights: Optional[Dict[str, float]] = None,
+                 kv_dtype: str = "bf16",
+                 weights_dtype: str = "bf16"):
         # consumed by _init_decode_path, which the base __init__ calls
         self.page_len = int(page_len)
         self.n_pages = int(n_pages)
+        self.kv_dtype = str(kv_dtype or "bf16")
         self._tenant_weights = dict(tenant_weights or {})
         super().__init__(name, ctx, lease, model, slots, cache_len,
-                         temperature, top_k, top_p)
+                         temperature, top_k, top_p,
+                         weights_dtype=weights_dtype)
+        # quality gate at the door: a quantized session measures its
+        # own drift before serving a single request, so a bad
+        # quantization degrades at create, not in a user's stream
+        self._maybe_probe_drift(force=True)
 
     def _init_decode_path(self) -> None:
         import jax
@@ -895,13 +933,15 @@ class PagedLMServingSession(LMServingSession):
         (self._pstep, self._pprefill_for, self._pjoin,
          self._copy_page, self._sample_first) = model.serve_fns_paged(
             self.slots, self.cache_len, self.page_len, self.n_pages,
-            self.temperature, self.top_k, self.top_p)
+            self.temperature, self.top_k, self.top_p,
+            kv_dtype=self.kv_dtype)
         self._pool_tree = model.serve_cache_paged(
-            self.n_pages, self.page_len)
+            self.n_pages, self.page_len, kv_dtype=self.kv_dtype)
         self._cache_bytes = int(sum(
             a.nbytes
             for a in jax.tree_util.tree_leaves(self._pool_tree)))
-        self.pool = PagedKVPool(self.n_pages, self.page_len)
+        self.pool = PagedKVPool(self.n_pages, self.page_len,
+                                dtype=self.kv_dtype)
         self.prefix = PrefixCache(self.pool, self.page_len)
         self._pages_per_slot = self.cache_len // self.page_len
         self._bt = np.zeros((self.slots, self._pages_per_slot),
@@ -913,8 +953,16 @@ class PagedLMServingSession(LMServingSession):
         self._tenant_requests: Dict[str, int] = {}
         self._adhoc_tenants: set = set()
         self._alloc_fault_streak = 0
+        self._quant_fault_streak = 0
         self._degraded = False
         self.prefills_skipped = 0
+        # drift gate state (quantized sessions only): last measured
+        # quantized-vs-exact relative drift, its per-component parts,
+        # and the decode-step countdown to the next periodic probe
+        self._last_drift: Optional[float] = None
+        self._drift_parts: Dict[str, float] = {}
+        self._drift_probes = 0
+        self._steps_since_probe = 0
 
     # -- tenants -------------------------------------------------------
     @staticmethod
@@ -1052,6 +1100,24 @@ class PagedLMServingSession(LMServingSession):
     def _admit(self, slot: int, req: _Request) -> None:
         if self._degraded:
             return super()._admit(slot, req)
+        if self.kv_dtype == "int8":
+            # chaos site for the quantized KV plane (services/faults.py
+            # ``kv_quant``): a transient fault is a retryable 429; a
+            # latched one walks the degrade ladder one rung — back to
+            # exact bf16 pages/weights, never a corrupted stream
+            try:
+                faults.maybe_inject("kv_quant")
+                self._quant_fault_streak = 0
+            except faults.InjectedFault as exc:
+                self._quant_fault_streak += 1
+                if self._quant_fault_streak >= self._DEGRADE_AFTER:
+                    self._degrade_to_bf16(
+                        f"kv_quant fault latched ({exc})")
+                self.rejected_total += 1
+                raise V.HttpError(
+                    V.HTTP_TOO_MANY_REQUESTS,
+                    f"quantized KV path fault ({exc}) — retry with "
+                    f"backoff")
         import jax.numpy as jnp
         import jax.random as jr
 
@@ -1128,7 +1194,7 @@ class PagedLMServingSession(LMServingSession):
                 tokens = jnp.asarray(
                     np.asarray(prompt, np.int32)[None, :])
                 nxt, last_logits, pcache = prefill(
-                    self._model.params, tokens, sub_prefill)
+                    self._serve_params, tokens, sub_prefill)
                 # write prompt KV straight into this stream's pages,
                 # starting after any shared prefix pages
                 n_prefill_pages = -(-s // pl)
@@ -1203,11 +1269,21 @@ class PagedLMServingSession(LMServingSession):
     def _run_step(self):
         if self._degraded:
             return super()._run_step()
+        # periodic quality gate BEFORE the step (worker thread): a
+        # breach degrades to bf16 here and the step below reroutes
+        # through the rebuilt exact path cleanly
+        self._steps_since_probe += 1
+        if self._steps_since_probe >= max(
+                1, int(getattr(self._ctx.config,
+                               "serve_drift_every", 256))):
+            self._maybe_probe_drift()
+            if self._degraded:
+                return super()._run_step()
         import jax.numpy as jnp
 
         width = self._gather_width()
         nxt, self._pool_tree = self._pstep(
-            self._model.params, self._pool_tree,
+            self._serve_params, self._pool_tree,
             jnp.asarray(self._tok), jnp.asarray(self._col),
             jnp.asarray(self._bt[:, :width]),
             jnp.asarray(self._keys))
@@ -1249,6 +1325,160 @@ class PagedLMServingSession(LMServingSession):
         obs_incidents.trigger("serving:kv-degrade", model=self.name,
                               streak=self._alloc_fault_streak)
 
+    def _degrade_to_bf16(self, reason: str) -> None:
+        """Latched ``kv_quant`` fault or drift-gate breach: drop the
+        quantized plane and rebuild the SAME paged machinery over
+        exact bf16 pages and weights — one rung down the quantization
+        ladder (the ``kv_page_alloc`` ladder above can still take it
+        the rest of the way to the slot path). In-flight quantized
+        streams fail with a retryable 503 and the pool, prefix cache
+        and block tables rebuild from scratch, so stale quantized
+        state can never leak into the exact path."""
+        if self.kv_dtype == "bf16" and self.weights_dtype == "bf16":
+            return
+        from_kv, from_w = self.kv_dtype, self.weights_dtype
+        for slot in range(self.slots):
+            req = self._slot_req[slot]
+            self._slot_req[slot] = None
+            self._slot_out[slot] = []
+            self._slot_pages[slot] = []
+            self._slot_tenant[slot] = None
+            if req is not None:
+                req.fail(V.HttpError(
+                    V.HTTP_UNAVAILABLE,
+                    f"session degraded to bf16 serving mid-stream "
+                    f"({reason}) — retry"))
+        self._tok[:] = 0
+        self._col[:] = 0
+        self._keys[:] = 0
+        self._slot_left[:] = 0
+        self.kv_dtype = "bf16"
+        self._pool_tree = None  # free the int8 pool before the bf16 one
+        if self.weights_dtype != "bf16":
+            import jax
+
+            self.weights_dtype = "bf16"
+            self._serve_params = self._quantize_params("bf16")
+            self._params_entry.release()
+            obs_xray.release("serving-params", self._params_pin_key)
+            self._params_entry = self._pin_params()
+            self._param_bytes = int(sum(
+                a.nbytes for a in
+                jax.tree_util.tree_leaves(self._serve_params)))
+        # rebuild the paged decode path over exact dtypes, preserving
+        # the host-side accounting the rebuild would otherwise reset
+        saved = (self._tenant_latency, self._tenant_requests,
+                 self._adhoc_tenants, self._last_drift,
+                 self._drift_parts, self._drift_probes)
+        PagedLMServingSession._init_decode_path(self)
+        (self._tenant_latency, self._tenant_requests,
+         self._adhoc_tenants, self._last_drift,
+         self._drift_parts, self._drift_probes) = saved
+        obs_xray.release("kv-cache", ("kv", self.name, id(self)))
+        obs_xray.register("kv-cache", ("kv", self.name, id(self)),
+                          self._cache_bytes, name=self.name,
+                          slots=self.slots, cacheLen=self.cache_len,
+                          pages=self.n_pages, dtype=self.kv_dtype)
+        health_lib.record("quantDegrades")
+        obs_export.log_event("serving", "quant-degrade",
+                             model=self.name, reason=reason,
+                             fromKv=from_kv, fromWeights=from_w)
+        obs_incidents.trigger("serving:quant-degrade",
+                              model=self.name, reason=reason,
+                              fromKv=from_kv, fromWeights=from_w)
+
+    # -- quantization quality gate ------------------------------------
+    def _maybe_probe_drift(self, force: bool = False) -> None:
+        """Measure quantized-vs-exact drift on the held probe batch
+        and walk the degrade ladder on breach. No-op for fully-exact
+        sessions; never raises (a broken probe must not kill the
+        worker — it logs and the next probe retries)."""
+        self._steps_since_probe = 0
+        if self._degraded or (self.kv_dtype == "bf16"
+                              and self.weights_dtype == "bf16"):
+            return
+        try:
+            drift, parts = self._measure_drift()
+        except Exception as exc:  # noqa: BLE001
+            obs_export.log_event("serving", "drift-probe-error",
+                                 model=self.name, error=str(exc))
+            return
+        self._last_drift = drift
+        self._drift_parts = parts
+        self._drift_probes += 1
+        from learningorchestra_tpu.observability import slo as obs_slo
+
+        obs_slo.set_gauge("servingDrift", drift)
+        limit = float(getattr(self._ctx.config,
+                              "serve_drift_max", 0.05) or 0.0)
+        if limit > 0 and drift > limit:
+            health_lib.record("driftBreaches")
+            self._degrade_to_bf16(
+                f"probe drift {drift:.4f} > "
+                f"LO_SERVE_DRIFT_MAX={limit:g}")
+
+    def _measure_drift(self) -> Tuple[float, Dict[str, float]]:
+        """Quantized-vs-exact relative L1 drift, per component:
+
+        - ``kv``: one paged decode-attention step over a held random
+          KV probe, int8 pools + fused dequant vs the exact bf16
+          gather (pure ops — no session state is touched);
+        - ``weights``: the session's compiled prefill over a held
+          probe prompt, quantized pinned params vs the fp32/bf16
+          master tree, compared on the final logit row.
+
+        The probe batch is deterministic (seeded) so repeated probes
+        measure quantization, not sampling noise."""
+        import jax
+        import jax.numpy as jnp
+
+        from learningorchestra_tpu.ops import attention as attn_ops
+
+        parts: Dict[str, float] = {}
+        rng = np.random.default_rng(0)
+
+        def rel(a, b):
+            a = np.asarray(a, np.float32)
+            b = np.asarray(b, np.float32)
+            return float(np.mean(np.abs(a - b)) /
+                         (np.mean(np.abs(a)) + 1e-9))
+
+        if self.kv_dtype == "int8":
+            leaf = next(a for a in
+                        jax.tree_util.tree_leaves(self._pool_tree)
+                        if getattr(a, "ndim", 0) == 4)
+            _, pl, kv, d = leaf.shape
+            heads = int(getattr(self._model, "n_heads", kv) or kv)
+            n_probe = 4
+            kp = jnp.asarray(rng.normal(
+                size=(n_probe, pl, kv, d)).astype(np.float32))
+            vp = jnp.asarray(rng.normal(
+                size=(n_probe, pl, kv, d)).astype(np.float32))
+            bt = jnp.arange(n_probe, dtype=jnp.int32)[None, :]
+            col = jnp.asarray([n_probe * pl - 1], jnp.int32)
+            q = jnp.asarray(rng.normal(
+                size=(1, 1, heads, d)).astype(np.float32))
+            exact = attn_ops.paged_decode_attention(q, kp, vp, bt, col)
+            kq, ks = attn_ops.quantize_kv_pages(kp)
+            vq, vs = attn_ops.quantize_kv_pages(vp)
+            quant = attn_ops.quantized_paged_decode_attention(
+                q, kq, ks, vq, vs, bt, col)
+            parts["kv"] = rel(exact, quant)
+        if self.weights_dtype != "bf16":
+            probe_len = max(1, min(8, self.cache_len - 1))
+            prompt = rng.integers(
+                1, int(self._model.vocab_size),
+                size=(1, probe_len)).astype(np.int32)
+            prefill = self._pprefill_for(probe_len)
+            key = jax.random.PRNGKey(0)
+            _, exact_logits, _ = prefill(
+                self._model.params, jnp.asarray(prompt), key)
+            _, quant_logits, _ = prefill(
+                self._serve_params, jnp.asarray(prompt), key)
+            parts["weights"] = rel(exact_logits, quant_logits)
+        drift = max(parts.values()) if parts else 0.0
+        return drift, parts
+
     def stats(self) -> Dict[str, Any]:
         out = super().stats()
         tenants: Dict[str, Any] = {}
@@ -1264,11 +1494,27 @@ class PagedLMServingSession(LMServingSession):
             }
         kv = self.pool.stats()
         kv["mode"] = "slot-degraded" if self._degraded else "paged"
+        # true bytes resident per token of KV capacity (int8 pages +
+        # their scale pool, or the bf16 pool) — feeds the
+        # lo_serving_kv_bytes_per_token gauge
+        denom = (self.slots * self.cache_len if self._degraded
+                 else self.n_pages * self.page_len)
+        kv["bytesPerToken"] = round(
+            self._cache_bytes / float(max(1, denom)), 3)
         prefix = self.prefix.stats()
         prefix["prefillsSkipped"] = self.prefills_skipped
         kv["prefix"] = prefix
         kv["tenants"] = tenants
         out["kv"] = kv
+        if self._last_drift is not None:
+            out["drift"] = {
+                "value": round(self._last_drift, 6),
+                "parts": {k: round(v, 6)
+                          for k, v in self._drift_parts.items()},
+                "probes": self._drift_probes,
+                "max": float(getattr(self._ctx.config,
+                                     "serve_drift_max", 0.05) or 0.0),
+            }
         return out
 
 
@@ -1501,6 +1747,25 @@ class ServingManager:
                     V.HTTP_NOT_ACCEPTABLE,
                     f"{V.MESSAGE_INVALID_FIELD}: kv must be 'slot' or "
                     f"'paged', got {kv_mode!r}")
+            # quantized serving knobs (docs/SERVING.md "Quantized
+            # serving"): per-session request fields override the
+            # config defaults; both validate at the door
+            kv_dtype = V.valid_choice(
+                body.get("kvDtype"), "kvDtype", ("bf16", "int8"),
+                default=str(getattr(cfg, "serve_kv_dtype", "bf16")
+                            or "bf16"))
+            weights_dtype = V.valid_choice(
+                body.get("weights"), "weights",
+                ("bf16", "int8", "fp8"),
+                default=str(getattr(cfg, "serve_weights", "bf16")
+                            or "bf16"))
+            if kv_mode != "paged" and kv_dtype != "bf16" and \
+                    body.get("kvDtype") is not None:
+                raise V.HttpError(
+                    V.HTTP_NOT_ACCEPTABLE,
+                    f"{V.MESSAGE_INVALID_FIELD}: kvDtype={kv_dtype!r} "
+                    f"needs the paged KV path (kv='paged') — the slot "
+                    f"cache is bf16-only")
             if kv_mode == "paged" and \
                     hasattr(instance, "serve_fns_paged"):
                 page_len = V.valid_positive_int(
@@ -1524,10 +1789,12 @@ class ServingManager:
                     model_name, self._ctx, lease, instance, slots,
                     cache_len, temperature, top_k, top_p, page_len,
                     n_pages,
-                    parse_tenant_weights(cfg.serve_tenant_weights))
+                    parse_tenant_weights(cfg.serve_tenant_weights),
+                    kv_dtype=kv_dtype, weights_dtype=weights_dtype)
             return LMServingSession(
                 model_name, self._ctx, lease, instance, slots,
-                cache_len, temperature, top_k, top_p)
+                cache_len, temperature, top_k, top_p,
+                weights_dtype=weights_dtype)
         if not hasattr(instance, "predict"):
             raise V.HttpError(
                 V.HTTP_NOT_ACCEPTABLE,
@@ -1630,13 +1897,32 @@ class ServingManager:
             session = self._sessions.get(model_name)
         if session is None:
             return None
-        return {
+        out = {
             "kind": "serving",
             "model": model_name,
             "sessionKind": session.kind,
             "batchFill": session._batch_fill(),
             "perf": session.perf_stats(),
         }
+        # quantized sessions carry their dtypes + latest drift probe
+        # so the perf report shows WHAT is being measured, not just
+        # how fast it runs
+        dtypes = {}
+        if getattr(session, "weights_dtype", "bf16") != "bf16":
+            dtypes["weights"] = session.weights_dtype
+        if getattr(session, "kv_dtype", "bf16") != "bf16":
+            dtypes["kv"] = session.kv_dtype
+        if dtypes:
+            out["quantized"] = dtypes
+        drift = getattr(session, "_last_drift", None)
+        if drift is not None:
+            out["drift"] = {
+                "value": round(drift, 6),
+                "parts": {k: round(v, 6) for k, v in
+                          getattr(session, "_drift_parts",
+                                  {}).items()},
+            }
+        return out
 
     def close(self) -> None:
         with self._lock:

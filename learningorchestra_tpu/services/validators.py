@@ -197,6 +197,21 @@ def valid_positive_int(value: Any, field: str,
     return int(value)
 
 
+def valid_choice(value: Any, field: str, allowed,
+                 default: Optional[str] = None) -> Optional[str]:
+    """Closed-enum request field (serving ``kvDtype``/``weights``): one
+    of ``allowed``, or None → ``default``. Validated at session create
+    so a typo'd dtype is a 406, not a mid-session compile error."""
+    if value is None:
+        return default
+    if not isinstance(value, str) or value not in allowed:
+        raise HttpError(
+            HTTP_NOT_ACCEPTABLE,
+            f"{MESSAGE_INVALID_FIELD}: {field} must be one of "
+            f"{sorted(allowed)}, got {value!r}")
+    return value
+
+
 def valid_sampling(body: Dict[str, Any]):
     """Serving-session sampling triple (``temperature``/``topK``/
     ``topP``) — fixed per session so every slot shares one compiled

@@ -47,13 +47,14 @@ from typing import Dict, List, Optional, Tuple
 # lower-is-better fragment
 _HIGHER_IS_BETTER = re.compile(
     r"(mfu|tokens_per_sec|samples_per_sec|rows_per_sec|per_chip"
-    r"|goodput|bw_util|speedup|accuracy|tflops)", re.IGNORECASE)
+    r"|goodput|bw_util|speedup|accuracy|tflops|streams_vs"
+    r"|peak_streams)", re.IGNORECASE)
 
 # metric-name fragments where SMALLER is better; everything matching
 # neither pattern is treated as higher-is-better (throughput-like)
 _LOWER_IS_BETTER = re.compile(
     r"(seconds|_ms$|_ms\b|p50|p99|rss|overhead|retraces|latency"
-    r"|time_to|evictions|rejected|stall_ratio)", re.IGNORECASE)
+    r"|time_to|evictions|rejected|stall_ratio|drift)", re.IGNORECASE)
 
 _SKIP_KEYS = {"platform", "rows", "epochs", "batch_size", "n_samples",
               "streams", "requests_per_stream", "prompt_len",
@@ -65,7 +66,14 @@ _SKIP_KEYS = {"platform", "rows", "epochs", "batch_size", "n_samples",
               "slot_slots", "paged_slots", "cache_len", "page_len",
               "budget_pages", "slot_kv_bytes", "paged_kv_bytes",
               "bully_ok", "bully_rejected", "victim_ok",
-              "victim_rejected"}
+              "victim_rejected",
+              # quant_serving shape/chaos bookkeeping (drift itself IS
+              # gated — lower is better — but the configured ceiling,
+              # byte accounting and degrade-ladder correctness bits are
+              # ci.sh's job, not a perf trend)
+              "bf16_pages", "int8_pages", "bf16_kv_bytes",
+              "int8_kv_bytes", "kv_bytes_per_token", "weights_dtype",
+              "drift_max", "degrade_codes", "degrade_fired"}
 
 
 def _round_number(path: str) -> int:

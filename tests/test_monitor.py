@@ -437,9 +437,12 @@ def test_objectives_reflect_config(tmp_config):
     assert objectives["servingP99"]["severity"] == "page"
     assert set(objectives) == {"servingP99", "queueWait",
                                "hbmHeadroom", "deadLetterRate",
-                               "unattributedGrowth"}
+                               "unattributedGrowth", "servingDrift"}
     # leak detector ships disabled; evaluate() retires thr<=0 objectives
     assert objectives["unattributedGrowth"]["threshold"] == 0.0
+    # quantized-serving drift objective follows the config bound
+    assert objectives["servingDrift"]["severity"] == "ticket"
+    assert objectives["servingDrift"]["threshold"] == tmp_config.serve_drift_max
 
 
 # ----------------------------------------------------------------------

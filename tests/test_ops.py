@@ -385,3 +385,181 @@ def test_paged_prefill_write_roundtrip_and_prefix_skip():
                           rows[2 * page_len:])
     for skipped in ids[:2]:
         assert np.array_equal(out2[skipped], pool[skipped])
+
+
+# ---------------------------------------------- quantized KV (int8)
+def test_checked_pool_cast_guards_raw_writes_into_int8_pool():
+    """A raw float write into an int8 pool must raise, not silently
+    truncate: the quantized path owns its own scatter helpers, and
+    the plain ones refuse to coerce inexact values into an integer
+    pool (the silent ``.astype(pool.dtype)`` coercion is gone)."""
+    from learningorchestra_tpu.ops.attention import (
+        paged_append_token, paged_prefill_write)
+
+    b, length, page_len, kv, d = 3, 16, 4, 2, 8
+    rng = np.random.default_rng(31)
+    cache = rng.normal(size=(b, length, kv, d)).astype(np.float32)
+    new = rng.normal(size=(b, kv, d)).astype(np.float32)
+    pos = np.asarray([1, 5, 9], np.int32)
+    k_pool, _, bt = _paged_view(cache, cache, page_len, seed=32)
+    int8_pool = jnp.zeros(k_pool.shape, jnp.int8)
+    with pytest.raises(TypeError, match="int8"):
+        paged_append_token(int8_pool, jnp.asarray(new),
+                           jnp.asarray(bt), jnp.asarray(pos), page_len)
+    rows = rng.normal(size=(2 * page_len, kv, d)).astype(np.float32)
+    with pytest.raises(TypeError, match="int8"):
+        paged_prefill_write(int8_pool, jnp.asarray(rows),
+                            jnp.asarray([1, 2], np.int32), 0)
+    # integer values into an integer pool still pass (the trash-page
+    # zeroing path writes int zeros)
+    paged_prefill_write(int8_pool, jnp.zeros_like(rows).astype(jnp.int8),
+                        jnp.asarray([1, 2], np.int32), 0)
+
+
+def test_quantize_kv_pages_roundtrip_error_is_bounded():
+    """Symmetric per-page-per-head int8: |x - dequant(quant(x))| is
+    bounded by half an int8 step of that (page, head)'s own scale,
+    and all-zero pages round-trip to exact zeros (the fresh-pool
+    contract the trash page rides on)."""
+    from learningorchestra_tpu.ops.attention import (
+        dequantize_kv_pages, quantize_kv_pages)
+
+    rng = np.random.default_rng(41)
+    pages = rng.normal(size=(6, 8, 2, 16)).astype(np.float32) * 3.0
+    pages[4] = 0.0  # a fresh page must stay exactly zero
+    q, scales = quantize_kv_pages(jnp.asarray(pages))
+    assert q.dtype == jnp.int8 and scales.shape == (6, 2)
+    back = np.asarray(dequantize_kv_pages(q, scales))
+    err = np.abs(back - pages)
+    bound = np.asarray(scales)[:, None, :, None] * 0.5 + 1e-6
+    assert np.all(err <= bound), float(err.max())
+    assert np.array_equal(back[4], np.zeros_like(back[4]))
+
+
+def test_quantized_paged_decode_matches_exact_within_drift_bound():
+    """int8 pools + fused-dequant gather vs the exact bf16 paged
+    decode: relative error stays well under the default
+    LO_SERVE_DRIFT_MAX (0.05) across random pools, ragged cols and
+    the bounded-gather clamp."""
+    from learningorchestra_tpu.ops.attention import (
+        paged_decode_attention, quantize_kv_pages,
+        quantized_paged_decode_attention)
+
+    b, length, page_len, h, kv, d = 5, 32, 8, 4, 2, 16
+    for trial in range(3):
+        rng = np.random.default_rng(400 + trial)
+        q = rng.normal(size=(b, 1, h, d)).astype(np.float32)
+        k_cache = rng.normal(size=(b, length, kv, d)).astype(np.float32)
+        v_cache = rng.normal(size=(b, length, kv, d)).astype(np.float32)
+        col = rng.integers(0, length, size=(b,)).astype(np.int32)
+        k_pool, v_pool, bt = _paged_view(
+            k_cache, v_cache, page_len, seed=500 + trial)
+        kq, ks = quantize_kv_pages(jnp.asarray(k_pool))
+        vq, vs = quantize_kv_pages(jnp.asarray(v_pool))
+        ref = np.asarray(paged_decode_attention(
+            jnp.asarray(q), jnp.asarray(k_pool), jnp.asarray(v_pool),
+            jnp.asarray(bt), jnp.asarray(col)))
+        got = np.asarray(quantized_paged_decode_attention(
+            jnp.asarray(q), kq, ks, vq, vs,
+            jnp.asarray(bt), jnp.asarray(col)))
+        rel = (np.abs(got - ref).mean()
+               / (np.abs(ref).mean() + 1e-9))
+        assert rel <= 0.05, f"trial {trial}: rel drift {rel}"
+        # the bounded gather must clamp identically to the exact path
+        clamped = np.asarray(quantized_paged_decode_attention(
+            jnp.asarray(q), kq, ks, vq, vs,
+            jnp.asarray(bt), jnp.asarray(col),
+            max_pages=length // page_len))
+        assert np.array_equal(got, clamped)
+
+
+def test_quantized_prefill_write_touches_exactly_its_pages():
+    """quantized_paged_prefill_write on a partial tail (start_row past
+    the shared prefix) rewrites payload AND scales for exactly the
+    touched pages; every other page's payload and scale — including a
+    partial last page's neighbours — are bit-untouched."""
+    from learningorchestra_tpu.ops.attention import (
+        dequantize_kv_pages, quantize_kv_pages,
+        quantized_paged_prefill_write)
+
+    page_len, kv, d = 4, 2, 8
+    n_pages, total = 5, 12
+    rng = np.random.default_rng(51)
+    stale = rng.normal(size=(total, page_len, kv, d)).astype(np.float32)
+    pool, scales = quantize_kv_pages(jnp.asarray(stale))
+    # prompt of 18 tokens -> 5 pages, last page only half-live (the
+    # padded tail rows are zeros, exactly what join_paged feeds in)
+    rows = np.zeros((n_pages * page_len, kv, d), np.float32)
+    rows[:18] = rng.normal(size=(18, kv, d)) * 2.0
+    ids = np.asarray([3, 7, 1, 9, 5], np.int32)
+    out_pool, out_scales = quantized_paged_prefill_write(
+        pool, scales, jnp.asarray(rows), jnp.asarray(ids), 0)
+    back = np.asarray(dequantize_kv_pages(
+        out_pool[jnp.asarray(ids)], out_scales[jnp.asarray(ids)]))
+    want = rows.reshape(n_pages, page_len, kv, d)
+    amax = np.abs(want).max(axis=(1, 3))
+    bound = np.maximum(amax / 127.0, 1e-8)[:, None, :, None] + 1e-6
+    assert np.all(np.abs(back - want) <= bound)
+    untouched = sorted(set(range(total)) - set(int(i) for i in ids))
+    assert np.array_equal(np.asarray(out_pool)[untouched],
+                          np.asarray(pool)[untouched])
+    assert np.array_equal(np.asarray(out_scales)[untouched],
+                          np.asarray(scales)[untouched])
+    # prefix skip: start_row past 2 shared pages touches only ids[2:]
+    out2, scales2 = quantized_paged_prefill_write(
+        pool, scales, jnp.asarray(rows), jnp.asarray(ids[2:]),
+        2 * page_len)
+    for skipped in ids[:2]:
+        assert np.array_equal(np.asarray(out2)[skipped],
+                              np.asarray(pool)[skipped])
+        assert np.array_equal(np.asarray(scales2)[skipped],
+                              np.asarray(scales)[skipped])
+
+
+def test_quantized_append_token_requantizes_only_live_rows():
+    """quantized_paged_append_token masks rows at/past the write slot
+    before requantizing, so stale garbage left by page reuse can
+    never inflate a page's scale — and appending into an unchanged
+    page round-trips the earlier rows within the page's own step."""
+    from learningorchestra_tpu.ops.attention import (
+        dequantize_kv_pages, quantize_kv_pages,
+        quantized_paged_append_token)
+
+    b, page_len, kv, d = 2, 8, 2, 8
+    rng = np.random.default_rng(61)
+    live = rng.normal(size=(b, page_len, kv, d)).astype(np.float32)
+    # a reused page carries a PREVIOUS stream's rows past this
+    # stream's live prefix — plausible-magnitude but wrong, and 8x
+    # hotter, so leaking them into the requant would inflate the scale
+    stale = live.copy()
+    stale[:, 5:] = rng.normal(size=(b, 3, kv, d)) * 8.0
+    pool, scales = quantize_kv_pages(jnp.asarray(stale))
+    bt = np.asarray([[1], [2]], np.int32)
+    new = rng.normal(size=(b, kv, d)).astype(np.float32)
+    pos = np.asarray([5, 5], np.int32)
+    # pool ids 1,2 hold the two pages; build a 4-page pool around them
+    full_pool = jnp.zeros((4, page_len, kv, d), jnp.int8)
+    full_scales = jnp.zeros((4, kv), jnp.float32)
+    full_pool = full_pool.at[jnp.asarray([1, 2])].set(pool)
+    full_scales = full_scales.at[jnp.asarray([1, 2])].set(scales)
+    out_pool, out_scales = quantized_paged_append_token(
+        full_pool, full_scales, jnp.asarray(new), jnp.asarray(bt),
+        jnp.asarray(pos), page_len)
+    back = np.asarray(dequantize_kv_pages(
+        out_pool[jnp.asarray([1, 2])],
+        out_scales[jnp.asarray([1, 2])]))
+    want = live.copy()
+    want[:, 5] = new
+    want[:, 6:] = 0.0  # masked stale rows requantize to exact zero
+    assert np.array_equal(back[:, 6:], want[:, 6:])
+    # carried rows survive both hops (original quant + requant):
+    # error <= half a step of each hop's own scale
+    step1 = np.asarray(scales)[:, None, :, None]
+    step2 = np.asarray(out_scales)[[1, 2]][:, None, :, None]
+    bound = 0.5 * (step1 + step2) + 1e-6
+    assert np.all(np.abs(back - want) <= bound), \
+        float(np.abs(back - want).max())
+    # and the mask kept the stale 8x rows out of the new scale
+    assert np.all(np.asarray(out_scales)[[1, 2]]
+                  < np.asarray(scales) * 0.5), \
+        "stale rows leaked into the requantized scale"

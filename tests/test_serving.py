@@ -789,3 +789,176 @@ def test_two_sessions_time_share_single_lease_mesh(api):
         status, body, _ = api.dispatch(
             "DELETE", f"{PREFIX}/serve/{name}", {}, {})
         assert status == 200, body
+
+
+# ------------------------------------------------- quantized serving
+def test_quantized_session_streams_with_drift_and_dtype_stamps(
+        tmp_path):
+    """int8 KV + int8 weights session end to end: streams serve, the
+    stats/perf surfaces stamp both dtypes, the create-time drift probe
+    sits under LO_SERVE_DRIFT_MAX, and the true quantized footprint
+    (int8 payload + f32 scales) shows up as bytes per cached token."""
+    api = _api_with(tmp_path)
+    try:
+        _fit_lm(api)
+        # a slot session must refuse an EXPLICIT quantized pool ask
+        s, b, _ = api.dispatch("POST", f"{PREFIX}/serve/slm", {}, {
+            "maxSlots": 2, "cacheLen": 32, "kvDtype": "int8"})
+        assert s == 406, b
+        # and a bad dtype is a validation error naming the choices
+        s, b, _ = api.dispatch("POST", f"{PREFIX}/serve/slm", {}, {
+            "kv": "paged", "pageLen": 8, "kvDtype": "int4"})
+        assert s == 406 and "int8" in str(b), b
+
+        resp = _paged_session(api, kvDtype="int8", weights="int8")
+        assert resp["kv"]["dtype"] == "int8"
+        rng = np.random.default_rng(70)
+        prompt = [int(t) for t in rng.integers(1, 48, size=6)]
+        s, b, _ = api.dispatch(
+            "POST", f"{PREFIX}/serve/slm/predict", {},
+            {"prompt": prompt, "maxNewTokens": 6, "seed": 4})
+        assert s == 200, b
+        assert len(b["tokens"]) == 6
+
+        stats = api.dispatch("GET", f"{PREFIX}/serve/slm", {}, None)[1]
+        assert stats["kv"]["dtype"] == "int8"
+        assert stats["weights"]["dtype"] == "int8"
+        drift = stats["drift"]
+        assert drift["probes"] >= 1
+        assert drift["value"] <= drift["max"], drift
+        assert set(drift["parts"]) == {"kv", "weights"}
+        assert stats["kv"]["bytesPerToken"] > 0
+
+        text = api.metrics_prometheus().decode()
+        assert 'lo_serving_drift{model="slm"}' in text
+        assert 'lo_serving_kv_bytes_per_token{model="slm"}' in text
+        assert "lo_serving_quant_degrades_total" in text
+
+        s, perf, _ = api.dispatch(
+            "GET", "/observability/perf", {}, None)
+        row = (perf.get("serving") or {}).get("slm") or {}
+        if row:  # steady-state window may not have closed yet
+            assert row.get("quantized", {}).get("kv") == "int8"
+        api.dispatch("DELETE", f"{PREFIX}/serve/slm", {}, None)
+    finally:
+        _close_api(api)
+
+
+def test_quantized_kv_bytes_match_xray_claim_and_release(tmp_path):
+    """Satellite accounting: the int8 session's kv-cache X-ray claim
+    is exactly the int8 payload pools PLUS their f32 scale pools —
+    computed analytically from the model shape — the Prometheus
+    lo_serving_kv_pages row reflects the pool, and the claim releases
+    on DELETE so the unattributed-growth leak detector sees nothing."""
+    from learningorchestra_tpu.observability import xray
+
+    api = _api_with(tmp_path)
+    try:
+        _fit_lm(api)
+        base = xray.by_owner().get("kv-cache", 0)
+        resp = _paged_session(api, kvDtype="int8")
+        sess = api.ctx.serving._sessions["slm"]
+        # slm: 1 layer, kv=2 heads x d=16 head dim; pool holds
+        # pagesTotal + the reserved trash page
+        pages_total = resp["kv"]["pagesTotal"] + 1
+        page_len = resp["kv"]["pageLen"]
+        kv, d = 2, 16
+        payload = 2 * pages_total * page_len * kv * d  # int8: 1 byte
+        scales = 2 * pages_total * kv * 4              # f32 per head
+        assert sess._cache_bytes == payload + scales, (
+            sess._cache_bytes, payload, scales)
+        assert xray.by_owner()["kv-cache"] - base == sess._cache_bytes
+        text = api.metrics_prometheus().decode()
+        assert (f'lo_serving_kv_pages{{model="slm"}} '
+                f'{resp["kv"]["pagesTotal"]}') in text
+        api.dispatch("DELETE", f"{PREFIX}/serve/slm", {}, None)
+        assert xray.by_owner().get("kv-cache", 0) == base
+    finally:
+        _close_api(api)
+
+
+def test_quantized_kv_transient_fault_is_retryable_429(tmp_path):
+    """A transient kv_quant fault surfaces as one 429 and the retry
+    serves through the still-quantized pool."""
+    api = _api_with(tmp_path, fault_inject="kv_quant:1")
+    try:
+        _fit_lm(api)
+        _paged_session(api, kvDtype="int8")
+        rng = np.random.default_rng(71)
+        prompt = [int(t) for t in rng.integers(1, 48, size=5)]
+        s, b, _ = api.dispatch(
+            "POST", f"{PREFIX}/serve/slm/predict", {},
+            {"prompt": prompt, "maxNewTokens": 4, "seed": 2})
+        assert s == 429, b
+        s, b, _ = api.dispatch(
+            "POST", f"{PREFIX}/serve/slm/predict", {},
+            {"prompt": prompt, "maxNewTokens": 4, "seed": 2})
+        assert s == 200, b
+        stats = api.dispatch("GET", f"{PREFIX}/serve/slm", {}, None)[1]
+        assert stats["kv"]["dtype"] == "int8"
+    finally:
+        _close_api(api)
+
+
+def test_quantized_kv_latched_fault_degrades_to_exact_bf16(tmp_path):
+    """A latched kv_quant fault walks the quantization rung of the
+    degrade ladder: three 429s, then the session rebuilds over exact
+    bf16 pages AND bf16 weights — still paged — and later requests are
+    bit-identical to solo decode (degraded means exact, never a
+    corrupted stream). The degrade is counted for /metrics."""
+    from learningorchestra_tpu.runtime import health as health_lib
+
+    api = _api_with(tmp_path, fault_inject="kv_quant:100")
+    try:
+        lm = _fit_lm(api)
+        _paged_session(api, kvDtype="int8", weights="int8")
+        before = health_lib.health_stats()["quantDegrades"]
+        rng = np.random.default_rng(72)
+        prompt = [int(t) for t in rng.integers(1, 48, size=6)]
+        for _ in range(3):
+            s, b, _ = api.dispatch(
+                "POST", f"{PREFIX}/serve/slm/predict", {},
+                {"prompt": prompt, "maxNewTokens": 5, "seed": 51})
+            assert s == 429, b
+
+        stats = api.dispatch("GET", f"{PREFIX}/serve/slm", {}, None)[1]
+        assert stats["kv"]["dtype"] == "bf16", stats["kv"]
+        assert stats["kv"]["mode"] == "paged", stats["kv"]
+        assert stats["weights"]["dtype"] == "bf16", stats["weights"]
+        assert health_lib.health_stats()["quantDegrades"] == before + 1
+
+        # the bf16 path never consults kv_quant: the still-armed
+        # budget cannot touch it, and bit-identity to solo holds
+        s, b, _ = api.dispatch(
+            "POST", f"{PREFIX}/serve/slm/predict", {},
+            {"prompt": prompt, "maxNewTokens": 5, "seed": 51})
+        assert s == 200, b
+        assert b["tokens"] == _solo(lm, prompt, 5, 51)
+    finally:
+        _close_api(api)
+
+
+def test_bf16_paged_session_is_unchanged_by_quant_plumbing(tmp_path):
+    """Quantization is opt-in: a default paged session stamps bf16,
+    carries no drift block, no scale pools in its cache bytes, and
+    stays bit-identical to solo decode (the PR-15 contract)."""
+    api = _api_with(tmp_path)
+    try:
+        lm = _fit_lm(api)
+        resp = _paged_session(api)
+        assert resp["kv"]["dtype"] == "bf16"
+        sess = api.ctx.serving._sessions["slm"]
+        pages_total = resp["kv"]["pagesTotal"] + 1
+        # f32 compute dtype in tests: plain pools only, no scales
+        assert sess._cache_bytes == 2 * pages_total * 8 * 2 * 16 * 4
+        rng = np.random.default_rng(73)
+        prompt = [int(t) for t in rng.integers(1, 48, size=7)]
+        s, b, _ = api.dispatch(
+            "POST", f"{PREFIX}/serve/slm/predict", {},
+            {"prompt": prompt, "maxNewTokens": 6, "seed": 5})
+        assert s == 200 and b["tokens"] == _solo(lm, prompt, 6, 5)
+        stats = api.dispatch("GET", f"{PREFIX}/serve/slm", {}, None)[1]
+        assert "drift" not in stats
+        assert stats["weights"]["dtype"] == "bf16"
+    finally:
+        _close_api(api)
