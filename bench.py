@@ -97,7 +97,7 @@ PHASE_TIMEOUTS = {"cnn": 600, "lstm": 600, "tlm": 900, "proxy": 120,
                   "warm_pipeline": 600, "concurrent_jobs": 600,
                   "flash": 600, "ingest": 600, "gen": 900,
                   "serving": 900, "paged_serving": 900,
-                  "quant_serving": 900,
+                  "quant_serving": 900, "disagg_serving": 900,
                   "sentinel_overhead": 600, "sentinel_chaos": 600,
                   "obs_overhead": 600, "monitor_smoke": 600,
                   "incident_smoke": 600,
@@ -938,6 +938,311 @@ def phase_quant_serving():
                               and codes[-1] == 200),
         })
         api.dispatch("DELETE", f"{prefix}/serve/quant_lm", {}, None)
+    finally:
+        api.ctx.serving.close()
+        api.ctx.jobs.shutdown()
+    return out
+
+
+def _open_loop_arrivals(submit, rate_hz, duration_s, timeout=300):
+    """Open-loop (fixed-rate) request arrivals for the serving phases:
+    one submission every 1/rate seconds ON THE WALL CLOCK, each on its
+    own thread, regardless of how many are still in flight. The
+    closed-loop ThreadPool drivers above only re-issue after a reply,
+    so a server stall slows the arrival process itself and the
+    measured p99 forgives exactly the stalls a latency gate exists to
+    catch (coordinated omission); this driver keeps the offered load
+    constant so a burst-induced decode stall surfaces as tail latency
+    instead of as a quieter clock. Returns submit()'s results in
+    completion order."""
+    import threading
+
+    results, lock, threads = [], threading.Lock(), []
+    n = max(1, int(rate_hz * duration_s))
+    t0 = time.perf_counter()
+    for i in range(n):
+        delay = t0 + i / rate_hz - time.perf_counter()
+        if delay > 0:
+            time.sleep(delay)
+
+        def _run(idx=i):
+            r = submit(idx)
+            with lock:
+                results.append(r)
+
+        th = threading.Thread(target=_run, daemon=True)
+        th.start()
+        threads.append(th)
+    for th in threads:
+        th.join(timeout=timeout)
+    return results
+
+
+def phase_disagg_serving():
+    """Disaggregated prefill/decode workers + speculative decoding
+    (docs/SERVING.md "Disaggregated serving & speculative decoding").
+    Isolation half: the same open-loop fixed-rate short-request
+    traffic is measured three ways — fused with no competing load
+    (the no-burst decode-p99 floor), fused while burst clients pump
+    long prompts through the same session (prefill runs inside the
+    serve loop, so mid-stream decodes stall behind it), and
+    disaggregated under the identical mixed load (prefill on its own
+    worker publishing finished KV pages by reference). deploy/ci.sh
+    gates disagg_burst_decode_p99_ms <= LO_SMOKE_DISAGG_P99_MULT x
+    the no-burst floor while the fused arm breaches it. Spec half:
+    greedy traffic with and without a small draft model — accepted
+    tokens/step and the tokens/s uplift land in the payload. Chaos
+    half: a latched ``kv_page_handoff`` fault must restore every page
+    reference on each 429, collapse the session to fused with an
+    incident, and keep serving through the fused path."""
+    import concurrent.futures
+    import threading
+
+    import jax
+    import numpy as np
+
+    from learningorchestra_tpu.models.transformer import LanguageModel
+    from learningorchestra_tpu.services import faults
+
+    slots = int(os.environ.get("LO_BENCH_DISAGG_SLOTS", "4"))
+    cache_len = int(os.environ.get("LO_BENCH_DISAGG_CACHE", "128"))
+    page_len = int(os.environ.get("LO_BENCH_DISAGG_PAGE_LEN", "16"))
+    prompt_len = int(os.environ.get("LO_BENCH_DISAGG_PROMPT", "8"))
+    new = int(os.environ.get("LO_BENCH_DISAGG_TOKENS", "8"))
+    rate = float(os.environ.get("LO_BENCH_DISAGG_RATE", "6"))
+    duration = float(os.environ.get("LO_BENCH_DISAGG_SECONDS", "4"))
+    burst_prompt = int(os.environ.get(
+        "LO_BENCH_DISAGG_BURST_PROMPT", "120"))
+    burst_rate = float(os.environ.get(
+        "LO_BENCH_DISAGG_BURST_RATE", "6"))
+    # bursts are PURE prefill pressure (one emitted token): the
+    # decode-p99 contrast must isolate prefill head-of-line stalls,
+    # not dilute the tail with the bursts' own long-context decodes
+    burst_new = int(os.environ.get(
+        "LO_BENCH_DISAGG_BURST_TOKENS", "1"))
+    epochs = int(os.environ.get("LO_BENCH_DISAGG_EPOCHS", "25"))
+    spec_k = int(os.environ.get("LO_BENCH_DISAGG_SPEC_K", "3"))
+    spec_new = int(os.environ.get("LO_BENCH_DISAGG_SPEC_TOKENS", "16"))
+    spec_reqs = int(os.environ.get("LO_BENCH_DISAGG_SPEC_REQS", "3"))
+    api, prefix = _make_api()
+
+    pages = slots * (cache_len // page_len)
+    out = {"platform": jax.devices()[0].platform,
+           "slots": slots, "cache_len": cache_len,
+           "page_len": page_len, "pages": pages,
+           "prompt_len": prompt_len, "burst_prompt_len": burst_prompt,
+           "burst_new_tokens": burst_new,
+           "new_tokens": new, "open_loop_rate_hz": rate,
+           "burst_rate_hz": burst_rate,
+           "open_loop_seconds": duration, "spec_k": spec_k}
+    try:
+        cfg = dict(TLM_CFG)
+        cfg["max_len"] = cache_len
+        lm = LanguageModel(**cfg)
+        # both models train on a cyclic-successor stream (token t is
+        # ALWAYS followed by t % P + 1): each learns the bigram map,
+        # so the draft's greedy proposals mostly match the target's
+        # argmax and accepted tokens/step measures real speculation
+        # instead of two noise models never agreeing
+        cyc = 16
+        rows = np.asarray(
+            [[(off + i) % cyc + 1 for i in range(16)]
+             for off in range(64)], np.int32)
+        lm.fit(rows, batch_size=16, epochs=epochs)
+        api.ctx.artifacts.save(lm, "dlm", "train/tensorflow")
+        # small draft for the speculative arm: same vocab + context,
+        # a fraction of the target's width/depth, trained on the same
+        # stream in a different order (close, not identical)
+        dcfg = dict(cfg, d_model=max(32, cfg["d_model"] // 4),
+                    n_layers=1, n_heads=2,
+                    d_ff=max(64, cfg["d_ff"] // 4))
+        draft = LanguageModel(**dcfg)
+        draft.fit(rows[::-1].copy(), batch_size=16, epochs=epochs)
+        api.ctx.artifacts.save(draft, "dlm_draft", "train/tensorflow")
+
+        def _session(**extra):
+            body = {"kv": "paged", "maxSlots": slots,
+                    "cacheLen": cache_len, "pageLen": page_len,
+                    "pages": pages + 1, "temperature": 0.0}
+            body.update(extra)
+            status, resp, _ = api.dispatch(
+                "POST", f"{prefix}/serve/dlm", {}, body)
+            _expect_created(status, resp)
+            return api.ctx.serving._sessions["dlm"]
+
+        def _predict(prompt, n_toks, seed):
+            s2, _, _ = api.dispatch(
+                "POST", f"{prefix}/serve/dlm/predict", {},
+                {"prompt": prompt, "maxNewTokens": n_toks,
+                 "seed": seed})
+            return s2
+
+        def _prompt(seed, length):
+            return [int(t) for t in np.random.default_rng(
+                seed).integers(1, cfg["vocab_size"], size=length)]
+
+        def _mixed_load(tag, burst):
+            """Open-loop short traffic (+ an optional open-loop
+            long-prompt burst stream — fixed-rate too, so the burst is
+            head-of-line pressure on the serve loop, not raw compute
+            saturation) against the live session; reads the per-role
+            decode/TTFT tail from its stats."""
+            # pay both prefill-shape compiles outside the clock
+            _predict(_prompt(1, prompt_len), new, 0)
+            if burst:
+                _predict(_prompt(2, burst_prompt), burst_new, 0)
+
+            bt = threading.Thread(
+                target=lambda: _open_loop_arrivals(
+                    lambda j: _predict(
+                        _prompt(7000 + j, burst_prompt), burst_new,
+                        j),
+                    burst_rate, duration),
+                daemon=True)
+            if burst:
+                bt.start()
+            codes = _open_loop_arrivals(
+                lambda j: _predict(_prompt(100 + j, prompt_len),
+                                   new, j),
+                rate, duration)
+            if burst:
+                bt.join(timeout=120)
+            _, st, _ = api.dispatch(
+                "GET", f"{prefix}/serve/dlm", {}, None)
+            roles = st.get("roles", {})
+            out.update({
+                f"{tag}_decode_p99_ms":
+                    roles.get("decode", {}).get("p99Ms"),
+                f"{tag}_ttft_p99_ms":
+                    (st.get("ttft") or {}).get("p99Ms"),
+                f"{tag}_ok": sum(1 for c in codes if c == 200),
+                f"{tag}_rejected": sum(1 for c in codes if c == 429),
+            })
+            return st
+
+        reps = int(os.environ.get("LO_BENCH_DISAGG_REPS", "3"))
+
+        def _arm(tag, burst, **extra):
+            """Best-of-``reps`` runs of one arm, a fresh session each
+            time. A shared/throttled CI core makes single-shot tail
+            latency swing several-fold run to run, and external
+            contamination only ever INFLATES the tail — the minimum
+            decode p99 is each arm's least-polluted measurement, so
+            the fused-breach gate stays mechanism-driven (even its
+            best run must breach) and the disagg gate is not failed
+            by a noisy neighbor."""
+            keys = (f"{tag}_decode_p99_ms", f"{tag}_ttft_p99_ms",
+                    f"{tag}_ok", f"{tag}_rejected")
+            best = None
+            for _ in range(max(1, reps)):
+                _session(**extra)
+                st = _mixed_load(tag, burst)
+                api.dispatch("DELETE", f"{prefix}/serve/dlm", {},
+                             None)
+                cur = out.get(keys[0])
+                if best is None or (cur is not None
+                                    and cur < (best[0]
+                                               or float("inf"))):
+                    best = (cur, {k: out.get(k) for k in keys}, st)
+            out.update(best[1])
+            return best[2]
+
+        # ---- fused, no competing load: the decode-p99 floor
+        _arm("no_burst", burst=False)
+
+        # ---- fused + long-prompt burst: prefill stalls decode
+        _arm("fused_burst", burst=True)
+
+        # ---- disaggregated + the identical burst
+        dst = _arm("disagg_burst", burst=True, disagg=True)
+        out.update({
+            "disagg_mode": (dst.get("disagg") or {}).get("mode"),
+            "handoffs_total":
+                (dst.get("disagg") or {}).get("handoffsTotal"),
+            "ttft_p99_ms": out.get("disagg_burst_ttft_p99_ms"),
+        })
+        api.dispatch("DELETE", f"{prefix}/serve/dlm", {}, None)
+        floor = out.get("no_burst_decode_p99_ms") or 0.0
+        if floor:
+            for tag in ("fused_burst", "disagg_burst"):
+                p99 = out.get(f"{tag}_decode_p99_ms")
+                if p99 is not None:
+                    out[f"{tag}_decode_p99_vs_no_burst"] = round(
+                        p99 / floor, 3)
+
+        # ---- speculative decoding: greedy tokens/s without/with the
+        # draft (fresh session each so per-role stats don't mix)
+        def _spec_drive(tag):
+            def client(k):
+                for j in range(spec_reqs):
+                    # on-pattern prompts (distinct phases): the draft
+                    # has a real shot at matching the target's argmax
+                    phase = (k * 3 + j) % cyc
+                    code = _predict(
+                        [(phase + i) % cyc + 1
+                         for i in range(prompt_len)],
+                        spec_new, k * 100 + j)
+                    if code != 200:
+                        raise RuntimeError(f"{tag} predict: {code}")
+
+            client(0)  # compile outside the clock
+            t0 = time.perf_counter()
+            with concurrent.futures.ThreadPoolExecutor(
+                    slots) as pool:
+                list(pool.map(client, range(1, slots + 1)))
+            dt = time.perf_counter() - t0
+            _, st, _ = api.dispatch(
+                "GET", f"{prefix}/serve/dlm", {}, None)
+            return round(slots * spec_reqs * spec_new / dt, 1), st
+
+        _session()
+        base_tps, _ = _spec_drive("base")
+        api.dispatch("DELETE", f"{prefix}/serve/dlm", {}, None)
+        _session(draft="dlm_draft", specK=spec_k)
+        spec_tps, sstats = _spec_drive("spec")
+        api.dispatch("DELETE", f"{prefix}/serve/dlm", {}, None)
+        out.update({
+            "base_tokens_per_sec": base_tps,
+            "spec_tokens_per_sec": spec_tps,
+            "spec_tokens_speedup": round(
+                spec_tps / max(1e-9, base_tps), 3),
+            "accepted_tokens_per_step": (sstats.get("spec") or {}).get(
+                "acceptedTokensPerStep"),
+        })
+
+        # ---- chaos: latched kv_page_handoff -> every 429 restores
+        # its page references, then the session collapses to fused
+        api.ctx.config.fault_inject = "kv_page_handoff:100"
+        faults.reset()
+        sess = _session(disagg=True)
+        free0 = sess.pool.free_count()
+        codes = []
+        for j in range(3):
+            codes.append(_predict(_prompt(40 + j, prompt_len), new, j))
+            time.sleep(0.05)
+        leak_free = sess.pool.free_count() == free0
+        # the latched streak defers a collapse to the decode thread;
+        # requests keep 429ing until it lands, then serve fused
+        final = None
+        for j in range(40):
+            final = _predict(_prompt(80 + j, prompt_len), new, j)
+            codes.append(final)
+            if final == 200:
+                break
+            time.sleep(0.1)
+        _, dstats, _ = api.dispatch(
+            "GET", f"{prefix}/serve/dlm", {}, None)
+        api.ctx.config.fault_inject = ""
+        faults.reset()
+        out.update({
+            "chaos_codes": codes[:8],
+            "chaos_leak_free": leak_free,
+            "chaos_degrade_fired": (
+                (dstats.get("disagg") or {}).get("mode")
+                == "fused-degraded" and final == 200
+                and all(c == 429 for c in codes[:3])),
+        })
+        api.dispatch("DELETE", f"{prefix}/serve/dlm", {}, None)
     finally:
         api.ctx.serving.close()
         api.ctx.jobs.shutdown()
@@ -2795,6 +3100,7 @@ PHASES = {"cnn": phase_cnn, "lstm": phase_lstm, "tlm": phase_tlm,
           "gen": phase_gen, "serving": phase_serving,
           "paged_serving": phase_paged_serving,
           "quant_serving": phase_quant_serving,
+          "disagg_serving": phase_disagg_serving,
           "sentinel_overhead": phase_sentinel_overhead,
           "sentinel_chaos": phase_sentinel_chaos,
           "obs_overhead": phase_obs_overhead,
@@ -3122,6 +3428,17 @@ def main(argv=None):
         "quant_serving", None if tpu_ok else cpu_env,
         metrics=("streams_vs_bf16", "int8_peak_streams",
                  "int8_decode_tokens_per_sec", "drift"))
+    # the CPU fallback measures COLOCATED disagg (prefill thread +
+    # refcount handoff): forcing host devices + LO_MESH_LEASES=2
+    # would exercise split placement, but fake host "devices" share
+    # the same cores, so the concurrent prefill forwards steal the
+    # decode arm's compute and the isolation contrast inverts —
+    # split-lease mechanics are covered by tests/test_serving.py
+    models["disagg_serving"] = _run_phase_repeated(
+        "disagg_serving", None if tpu_ok else cpu_env,
+        metrics=("disagg_burst_decode_p99_ms",
+                 "fused_burst_decode_p99_ms",
+                 "accepted_tokens_per_step", "spec_tokens_per_sec"))
     models["sweep_fusion"] = _run_phase_repeated(
         "sweep_fusion", env,
         metrics=("speedup", "fused_seconds", "serial_seconds"))
@@ -3312,6 +3629,21 @@ def _write_md(path, report):
                 f"({stats.get('streams_vs_bf16', '—')}×), drift="
                 f"{stats.get('drift')}, degrade ladder "
                 f"{'ok' if stats.get('degrade_fired') else 'FAILED'} |")
+            continue
+        if name == "disagg_serving":
+            lines.append(
+                f"| {name} (prefill/decode split + spec decode) "
+                f"| {stats.get('platform', '?')} "
+                f"| {stats.get('spec_tokens_per_sec', '—')} tok/s "
+                f"({stats.get('spec_tokens_speedup', '—')}× vs "
+                f"no-draft) | — | — | — | — "
+                f"| decode p99 burst/floor: disagg "
+                f"{stats.get('disagg_burst_decode_p99_vs_no_burst')}× "
+                f"vs fused "
+                f"{stats.get('fused_burst_decode_p99_vs_no_burst')}×, "
+                f"acc/step={stats.get('accepted_tokens_per_step')}, "
+                f"handoff chaos "
+                f"{'ok' if stats.get('chaos_degrade_fired') and stats.get('chaos_leak_free') else 'FAILED'} |")
             continue
         if name == "csv_ingest":
             lines.append(

@@ -298,12 +298,13 @@ OBS_OUT="$(mktemp)"
 SERVE_OUT="$(mktemp)"
 PAGED_OUT="$(mktemp)"
 QUANT_OUT="$(mktemp)"
+DISAGG_OUT="$(mktemp)"
 SWEEP_OUT="$(mktemp)"
 MONITOR_OUT="$(mktemp)"
 INCIDENT_OUT="$(mktemp)"
 ROOFLINE_OUT="$(mktemp)"
 XRAY_OUT="$(mktemp)"
-trap 'rm -rf "$PERF_CACHE" "$PERF_OUT" "$SLICE_OUT" "$CKPT_OUT" "$MIG_OUT" "$ELASTIC_OUT" "$CHAOS_OUT" "$OVERHEAD_OUT" "$OBS_OUT" "$SERVE_OUT" "$PAGED_OUT" "$QUANT_OUT" "$SWEEP_OUT" "$MONITOR_OUT" "$ROOFLINE_OUT" "$XRAY_OUT"' EXIT
+trap 'rm -rf "$PERF_CACHE" "$PERF_OUT" "$SLICE_OUT" "$CKPT_OUT" "$MIG_OUT" "$ELASTIC_OUT" "$CHAOS_OUT" "$OVERHEAD_OUT" "$OBS_OUT" "$SERVE_OUT" "$PAGED_OUT" "$QUANT_OUT" "$DISAGG_OUT" "$SWEEP_OUT" "$MONITOR_OUT" "$ROOFLINE_OUT" "$XRAY_OUT"' EXIT
 timeout -k 10 "$SENTINEL_TIMEOUT" env JAX_PLATFORMS=cpu \
     JAX_COMPILATION_CACHE_DIR="$PERF_CACHE" \
     LO_COMPUTE_DTYPE=float32 \
@@ -550,6 +551,80 @@ timeout -k 10 "$QUANT_TIMEOUT" env JAX_PLATFORMS=cpu \
     LO_LOCK_WITNESS=1 \
     python -m pytest tests/test_ops.py tests/test_serving.py \
     -q -k "quant or drift or degrade" \
+    -p no:cacheprovider -p no:xdist -p no:randomly
+
+echo "== disagg-smoke: disagg prefill must shield decode from bursts =="
+# Disaggregated prefill/decode + speculative decoding (bench.py
+# disagg_serving; docs/SERVING.md "Disaggregated serving &
+# speculative decoding"). Gates:
+#  - isolation: under the same open-loop mixed load (fixed-rate short
+#    requests + long-prompt burst clients), the disaggregated
+#    session's decode p99 stays <= LO_SMOKE_DISAGG_P99_MULT (default
+#    1.2) x the no-burst floor while the fused session breaches that
+#    multiple (prefill runs inside its serve loop).
+#  - speculation: accepted tokens/step >= 1 with the draft armed
+#    (every verify step emits at least the target's own token).
+#  - chaos: a latched kv_page_handoff fault restores every page
+#    reference on each 429 (no leak), collapses the session to fused
+#    with an incident, and later requests serve through that path.
+DISAGG_TIMEOUT="${LO_CI_DISAGG_TIMEOUT:-900}"
+# colocated on CPU: forced host "devices" share the same cores, so
+# split-lease placement would let burst prefills steal the decode
+# arm's compute and invert the contrast (split mechanics are covered
+# by tests/test_serving.py under the forced-8-device conftest)
+timeout -k 10 "$DISAGG_TIMEOUT" env JAX_PLATFORMS=cpu \
+    JAX_COMPILATION_CACHE_DIR="$PERF_CACHE" \
+    LO_COMPUTE_DTYPE=float32 \
+    LO_BENCH_TLM_D=128 LO_BENCH_TLM_LAYERS=2 LO_BENCH_TLM_SEQ=128 \
+    python bench.py --phase disagg_serving | tee "$DISAGG_OUT"
+python - "$DISAGG_OUT" <<'EOF'
+import json, os, sys
+
+mark = "@@LO_BENCH_RESULT@@"
+result = None
+for line in reversed(open(sys.argv[1]).read().splitlines()):
+    if line.startswith(mark):
+        result = json.loads(line[len(mark):])
+        break
+assert result is not None, "disagg-smoke: no bench result line"
+assert "error" not in result, f"disagg-smoke: phase failed: {result}"
+result = result.get("result", result)  # unwrap the ok-envelope
+mult = float(os.environ.get("LO_SMOKE_DISAGG_P99_MULT", "1.2"))
+disagg = result["disagg_burst_decode_p99_vs_no_burst"]
+fused = result["fused_burst_decode_p99_vs_no_burst"]
+assert disagg is not None and disagg <= mult, (
+    f"disagg-smoke: burst traffic inflated the disaggregated decode "
+    f"p99 to {disagg}x the no-burst floor (gate <= {mult}x): "
+    f"{result}")
+assert fused is not None and fused > mult, (
+    f"disagg-smoke: the fused contrast arm held {fused}x under the "
+    f"same burst (expected > {mult}x — the mixed load is not "
+    f"stressing prefill, so the isolation gate proves nothing): "
+    f"{result}")
+acc = result["accepted_tokens_per_step"]
+assert acc is not None and acc >= 1.0, (
+    f"disagg-smoke: accepted tokens/step {acc} (a verify step always "
+    f"emits at least the target's own token): {result}")
+assert result["chaos_leak_free"], (
+    f"disagg-smoke: 429'd handoffs leaked page references: {result}")
+assert result["chaos_degrade_fired"], (
+    f"disagg-smoke: latched kv_page_handoff fault did not collapse "
+    f"the session to fused serving: {result}")
+print(f"disagg-smoke: OK (decode p99 burst/floor: disagg {disagg}x "
+      f"vs fused {fused}x, gate {mult}x; accepted/step {acc}; "
+      f"spec {result['spec_tokens_per_sec']} tok/s vs "
+      f"{result['base_tokens_per_sec']} base; handoff chaos "
+      f"leak-free + degraded)")
+EOF
+# the disagg + spec suites ride under the lock-order witness: the
+# handoff path spans three threads (REST admit -> prefill worker ->
+# decode loop) across the handoff/prefix/pool ranks, exactly where an
+# out-of-order acquisition would hide
+timeout -k 10 "$DISAGG_TIMEOUT" env JAX_PLATFORMS=cpu \
+    LO_COMPUTE_DTYPE=float32 \
+    LO_LOCK_WITNESS=1 \
+    python -m pytest tests/test_serving.py \
+    -q -k "disagg or spec" \
     -p no:cacheprovider -p no:xdist -p no:randomly
 
 echo "== sweep-smoke: fused sweep must beat serial trials =="

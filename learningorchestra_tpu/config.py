@@ -378,6 +378,24 @@ class Config:
     serve_drift_every: int = dataclasses.field(
         default_factory=lambda: int(os.environ.get(
             "LO_SERVE_DRIFT_EVERY", "256")))
+    # Disaggregated serving (docs/SERVING.md "Disaggregated serving &
+    # speculative decoding"): run paged LM sessions as a prefill
+    # worker + decode worker, each on its own ServingLease, with
+    # finished KV pages handed off through the shared pool (refcount
+    # publish/adopt — never copied). "1" makes it the default for
+    # paged sessions; per-session override: request field "disagg".
+    serve_disagg: str = dataclasses.field(
+        default_factory=lambda: os.environ.get("LO_SERVE_DISAGG", "0"))
+    # Default draft-model artifact for speculative decoding ("" = no
+    # speculation). The draft proposes LO_SERVE_SPEC_K greedy tokens
+    # per step; the target verifies all of them in ONE paged step with
+    # exact acceptance sampling (greedy sessions stay bit-identical to
+    # solo decode). Per-session overrides: "draft" and "specK".
+    serve_draft: str = dataclasses.field(
+        default_factory=lambda: os.environ.get("LO_SERVE_DRAFT", ""))
+    serve_spec_k: int = dataclasses.field(
+        default_factory=lambda: int(os.environ.get(
+            "LO_SERVE_SPEC_K", "4")))
 
     # Gateway behaviors (KrakenD parity, krakend.json:1769-1770):
     # version-revalidated response cache for universal GETs (TTL is a

@@ -176,7 +176,7 @@ class _Attention(nn.Module):
     def __call__(self, x, train: bool, decode_pos=None, cache_len: int = 0,
                  pad_offset=None, kv_len=None, block_tables=None,
                  page_len: int = 0, kv_pages: int = 0,
-                 kv_quant: bool = False):
+                 kv_quant: bool = False, verify_limit=None):
         d_model = x.shape[-1]
         kv = self.kv_heads
         if self.n_heads % kv:
@@ -249,6 +249,71 @@ class _Attention(nn.Module):
             half = self.head_dim // 2
             freqs = 1.0 / (self.rope_base ** (
                 jnp.arange(half, dtype=jnp.float32) / half))
+            if s > 1:
+                # speculative-verify step: s consecutive positions per
+                # row (last accepted token + k drafts), query j at
+                # absolute position pos + j. Per-position rope, the
+                # sequential append order and the per-position masked
+                # reduction all match s single-token steps bit-for-bit
+                # (ops/attention.py paged_verify_attention), which is
+                # what lets greedy speculative decode inherit the
+                # bit-identity contract (docs/SERVING.md).
+                if block_tables is None:
+                    raise ValueError(
+                        "multi-position decode (speculative verify) "
+                        "requires the paged KV path (block_tables)")
+                rel2 = (rel[:, None]
+                        + jnp.arange(s)[None, :]).astype(jnp.float32)
+                angv = rel2[:, :, None] * freqs[None, None, :]
+                cosv = jnp.cos(angv)[:, :, None, :]  # (b, s, 1, half)
+                sinv = jnp.sin(angv)[:, :, None, :]
+
+                def rotv(t):
+                    t1, t2 = jnp.split(t, 2, axis=-1)
+                    c, si = cosv.astype(t.dtype), sinv.astype(t.dtype)
+                    return jnp.concatenate(
+                        [t1 * c - t2 * si, t1 * si + t2 * c], axis=-1)
+
+                q, k = rotv(q), rotv(k)
+                pool_shape = (kv_pages, page_len, kv, self.head_dim)
+                if kv_quant:
+                    ck = self.variable("cache", "k", jnp.zeros,
+                                       pool_shape, jnp.int8)
+                    cv = self.variable("cache", "v", jnp.zeros,
+                                       pool_shape, jnp.int8)
+                    cks = self.variable("cache", "k_scale", jnp.zeros,
+                                        (kv_pages, kv), jnp.float32)
+                    cvs = self.variable("cache", "v_scale", jnp.zeros,
+                                        (kv_pages, kv), jnp.float32)
+                    ck.value, cks.value = \
+                        attn_ops.quantized_paged_append_tokens(
+                            ck.value, cks.value, k, block_tables,
+                            pos, page_len, limit=verify_limit)
+                    cv.value, cvs.value = \
+                        attn_ops.quantized_paged_append_tokens(
+                            cv.value, cvs.value, v, block_tables,
+                            pos, page_len, limit=verify_limit)
+                    o = attn_ops.quantized_paged_verify_attention(
+                        q, ck.value, cks.value, cv.value, cvs.value,
+                        block_tables, pos, pad_offset=pad_offset,
+                        window=self.window).reshape(shape4)
+                else:
+                    ck = self.variable("cache", "k", jnp.zeros,
+                                       pool_shape, x.dtype)
+                    cv = self.variable("cache", "v", jnp.zeros,
+                                       pool_shape, x.dtype)
+                    ck.value = attn_ops.paged_append_tokens(
+                        ck.value, k, block_tables, pos, page_len,
+                        limit=verify_limit)
+                    cv.value = attn_ops.paged_append_tokens(
+                        cv.value, v, block_tables, pos, page_len,
+                        limit=verify_limit)
+                    o = attn_ops.paged_verify_attention(
+                        q, ck.value, cv.value, block_tables, pos,
+                        pad_offset=pad_offset,
+                        window=self.window).reshape(shape4)
+                o = o.reshape(b, s, proj)
+                return dense("o_proj", d_model)(o)
             ang = rel.astype(jnp.float32)[:, None] * freqs[None, :]
             cos = jnp.cos(ang)[:, None, None, :]       # (b, 1, 1, half)
             sin = jnp.sin(ang)[:, None, None, :]
@@ -509,7 +574,7 @@ class _Block(nn.Module):
     def __call__(self, x, train: bool, decode_pos=None, cache_len: int = 0,
                  pad_offset=None, kv_len=None, block_tables=None,
                  page_len: int = 0, kv_pages: int = 0,
-                 kv_quant: bool = False):
+                 kv_quant: bool = False, verify_limit=None):
         h = nn.RMSNorm(name="attn_norm")(x)
         h = _Attention(self.n_heads, self.head_dim, self.attention,
                        self.causal, self.mesh,
@@ -522,7 +587,8 @@ class _Block(nn.Module):
             h, train, decode_pos=decode_pos, cache_len=cache_len,
             pad_offset=pad_offset, kv_len=kv_len,
             block_tables=block_tables, page_len=page_len,
-            kv_pages=kv_pages, kv_quant=kv_quant)
+            kv_pages=kv_pages, kv_quant=kv_quant,
+            verify_limit=verify_limit)
         if self.dropout and train:
             h = nn.Dropout(self.dropout, deterministic=False)(h)
         x = x + h
@@ -618,7 +684,8 @@ class TransformerLM(nn.Module):
     def __call__(self, tokens, train: bool = False, decode_pos=None,
                  cache_len: int = 0, pad_offset=None, kv_len=None,
                  block_tables=None, page_len: int = 0,
-                 kv_pages: int = 0, kv_quant: bool = False):
+                 kv_pages: int = 0, kv_quant: bool = False,
+                 verify_limit=None):
         if self.attention not in ATTENTION_IMPLS:
             raise ValueError(f"unknown attention impl: {self.attention!r}")
         d_ff = self.d_ff or 4 * self.d_model
@@ -665,7 +732,8 @@ class TransformerLM(nn.Module):
                                self.sliding_window, self.rope_base,
                                name=f"layer_{i}")(
                 x, train, decode_pos, cache_len, pad_offset, kv_len,
-                block_tables, page_len, kv_pages, kv_quant)
+                block_tables, page_len, kv_pages, kv_quant,
+                verify_limit)
             aux_total = aux_total + aux
         x = nn.RMSNorm(name="final_norm")(x)
         head = _LMHead(self.vocab_size, name="lm_head")
@@ -1378,6 +1446,7 @@ class LanguageModel:
         self._beam_cache_fns = {}
         self._serve_cache_fns = {}
         self._serve_paged_fns = {}
+        self._serve_spec_fns = {}
 
     def _mesh(self):
         return self._mesh_override or mesh_lib.current_mesh()
@@ -1851,13 +1920,19 @@ class LanguageModel:
         return run
 
     @staticmethod
-    def _sample(last, temperature: float, key,
-                top_k: Optional[int] = None,
-                top_p: Optional[float] = None):
+    def _filter_logits(last, temperature: float,
+                       top_k: Optional[int] = None,
+                       top_p: Optional[float] = None):
+        """The sampling transform of :meth:`_sample` up to (but not
+        including) the draw: pad mask, temperature, top-k, top-p.
+        Factored out so speculative acceptance (serve_fns_spec) can
+        score draft tokens against the EXACT distribution _sample
+        draws from — ``softmax(_filter_logits(...))`` for
+        ``temperature > 0``, ``argmax`` for greedy."""
         # id 0 is the padding/loss-mask token — never emit it
         last = last.astype(jnp.float32).at[..., 0].set(ring_lib.NEG_INF)
         if temperature <= 0:
-            return jnp.argmax(last, axis=-1)
+            return last
         logits = last / temperature
         if top_k is not None and top_k < logits.shape[-1]:
             kth = jnp.sort(logits, axis=-1)[..., -top_k, None]
@@ -1872,6 +1947,16 @@ class LanguageModel:
             ranked = jnp.where(keep, ranked, ring_lib.NEG_INF)
             inv = jnp.argsort(order, axis=-1)
             logits = jnp.take_along_axis(ranked, inv, axis=-1)
+        return logits
+
+    @staticmethod
+    def _sample(last, temperature: float, key,
+                top_k: Optional[int] = None,
+                top_p: Optional[float] = None):
+        logits = LanguageModel._filter_logits(last, temperature,
+                                              top_k, top_p)
+        if temperature <= 0:
+            return jnp.argmax(logits, axis=-1)
         return jax.random.categorical(key, logits, axis=-1)
 
     def _gen_fns(self, b: int, s: int, total: int, temperature: float,
@@ -2227,6 +2312,158 @@ class LanguageModel:
                 kv_quant=kv_dtype == "int8")["cache"])
         return jax.tree_util.tree_map(
             lambda sd: jnp.zeros(sd.shape, sd.dtype), shapes)
+
+    def serve_fns_spec(self, slots: int, cache_len: int,
+                       page_len: int, n_pages: int, spec_k: int,
+                       temperature: float,
+                       top_k: Optional[int] = None,
+                       top_p: Optional[float] = None,
+                       kv_dtype: str = "bf16"):
+        """Speculative-decoding verify step for a paged serving
+        session (docs/SERVING.md "Disaggregated serving & speculative
+        decoding"): ONE jitted dispatch that scores the last accepted
+        token plus ``spec_k`` draft tokens, accepts a prefix of the
+        drafts by exact rejection sampling against this (target)
+        model's sampling distribution, and emits the correction/bonus
+        token — up to ``spec_k + 1`` tokens per step.
+
+        ``verify(params, pool, tok (slots,1), drafts (slots,k),
+        col (slots,), keys (slots,2), block_tables, limit (slots,))``
+        returns ``(emitted (slots, k+1) int32, n_acc (slots,) int32,
+        pool)``; a slot's valid emissions are
+        ``emitted[:n_acc + 1]``, continuing its stream at positions
+        ``col+1 .. col+n_acc+1``.
+
+        Exactness: the drafts are the draft model's GREEDY picks — a
+        one-hot proposal q — so the standard accept probability
+        ``min(1, p/q)`` reduces to ``p(draft)`` under the target's
+        :meth:`_filter_logits` distribution, and the rejection
+        residual ``max(p - q, 0)`` normalized is exactly p with the
+        draft token excluded: every emitted position is distributed
+        exactly as a solo :meth:`_sample` draw. For greedy sessions
+        (``temperature <= 0``) accept degenerates to
+        ``draft == argmax(target)`` and the emitted stream is
+        BIT-IDENTICAL to solo decode: the verify forward reproduces
+        sequential single-token steps float-for-float
+        (ops/attention.py paged_verify_attention) and argmax needs no
+        randomness. Per-position keys follow the solo schedule —
+        position ``pos`` folds ``fold_in(row_key, pos)``, split once
+        into (accept-uniform, residual) keys for sampled sessions.
+
+        Rejected drafts leave stale KV rows beyond the new ``col``;
+        the visibility mask hides them and the next window overwrites
+        them — no rollback. ``limit`` is each stream's last funded
+        position: past-limit appends land in trash page 0, so a
+        window overrunning a stream's pages can never corrupt a
+        neighbor (the host discards the overrun emissions).
+        """
+        fns = self._serve_spec_fns
+        sig = ("verify", slots, cache_len, page_len, n_pages, spec_k,
+               temperature, top_k, top_p, kv_dtype)
+        if sig in fns:
+            return fns[sig]
+        module = self._module_for(1)
+        filter_fn = self._filter_logits
+        kv_quant = kv_dtype == "int8"
+
+        @functools.partial(jax.jit, donate_argnums=(1,))
+        def verify(params, pool, tok, drafts, col, keys,
+                   block_tables, limit):
+            params = dequantize_serving_params(params)
+            toks = jnp.concatenate([tok, drafts], axis=1)
+            (logits, _), mut = module.apply(
+                {"params": params, "cache": pool}, toks, train=False,
+                decode_pos=col, cache_len=cache_len,
+                block_tables=block_tables, page_len=page_len,
+                kv_pages=n_pages, kv_quant=kv_quant,
+                verify_limit=limit, mutable=["cache"])
+            # logits[:, i] scores position col + i + 1 — the position
+            # draft i (or the correction after a rejection) lands at
+            filt = filter_fn(logits, temperature, top_k, top_p)
+            rows = jnp.arange(toks.shape[0])
+            if temperature <= 0:
+                choice = jnp.argmax(filt, axis=-1).astype(jnp.int32)
+                accept = drafts == choice[:, :spec_k]
+                corr = choice
+            else:
+                probs = jax.nn.softmax(filt, axis=-1)
+                acc_cols, corr_cols = [], []
+                for i in range(spec_k):
+                    kp = jax.vmap(jax.random.fold_in)(keys,
+                                                      col + i + 1)
+                    kur = jax.vmap(jax.random.split)(kp)
+                    u = jax.vmap(
+                        lambda k: jax.random.uniform(k))(kur[:, 0])
+                    p_d = probs[rows, i, drafts[:, i]]
+                    acc_cols.append(u < p_d)
+                    resid = filt[:, i].at[rows, drafts[:, i]].set(
+                        ring_lib.NEG_INF)
+                    corr_cols.append(jax.vmap(
+                        lambda lg, k: jax.random.categorical(k, lg))(
+                        resid, kur[:, 1]))
+                # bonus position (every draft accepted): a plain
+                # categorical under the solo key schedule for
+                # position col + spec_k + 1
+                kp = jax.vmap(jax.random.fold_in)(keys,
+                                                  col + spec_k + 1)
+                corr_cols.append(jax.vmap(
+                    lambda lg, k: jax.random.categorical(k, lg))(
+                    filt[:, spec_k], kp))
+                accept = jnp.stack(acc_cols, axis=1)
+                corr = jnp.stack(corr_cols, axis=1).astype(jnp.int32)
+            n_acc = jnp.sum(jnp.cumprod(
+                accept.astype(jnp.int32), axis=1), axis=1)
+            padded = jnp.concatenate(
+                [drafts, jnp.zeros((drafts.shape[0], 1), jnp.int32)],
+                axis=1)
+            idx = jnp.arange(spec_k + 1)[None, :]
+            emitted = jnp.where(
+                idx < n_acc[:, None], padded,
+                jnp.where(idx == n_acc[:, None], corr, 0))
+            return (emitted.astype(jnp.int32),
+                    n_acc.astype(jnp.int32), mut["cache"])
+
+        fns[sig] = verify
+        return fns[sig]
+
+    def serve_fns_draft(self, slots: int, cache_len: int,
+                        spec_k: int):
+        """Draft-side propose step for speculative decoding: ONE
+        jitted scan that greedily extends every slot by ``spec_k``
+        tokens over the draft model's own slot KV cache (prompt KV
+        arrives via :meth:`serve_fns`'s prefill/join, so the draft
+        shares the target's admission path). The scan runs
+        ``spec_k + 1`` forwards: the last feeds draft k purely to
+        append its KV row, so the NEXT window's propose attends a
+        complete prefix whatever the acceptance count was. Greedy
+        proposals make the proposal distribution one-hot, which is
+        what keeps acceptance sampling exact (see serve_fns_spec)."""
+        fns = self._serve_spec_fns
+        sig = ("draft", slots, cache_len, spec_k)
+        if sig in fns:
+            return fns[sig]
+        module = self._module_for(1)
+        sample = self._sample
+
+        @functools.partial(jax.jit, donate_argnums=(1,))
+        def propose(params, cache, tok, col):
+            params = dequantize_serving_params(params)
+
+            def body(carry, _):
+                cache, tok, col = carry
+                (logits, _), mut = module.apply(
+                    {"params": params, "cache": cache}, tok,
+                    train=False, decode_pos=col, cache_len=cache_len,
+                    mutable=["cache"])
+                nxt = sample(logits[:, 0], 0.0, None).astype(jnp.int32)
+                return (mut["cache"], nxt[:, None], col + 1), nxt
+
+            (cache, _, _), drafts = jax.lax.scan(
+                body, (cache, tok, col), None, length=spec_k + 1)
+            return jnp.transpose(drafts[:spec_k]), cache
+
+        fns[sig] = propose
+        return fns[sig]
 
     def _require_built(self) -> None:
         if self.params is None:

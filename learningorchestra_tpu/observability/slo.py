@@ -42,6 +42,13 @@ _HISTORY = 256
 # hide behind (or be blamed on) the aggregate
 _TENANT_HIST_PREFIX = "lo_serving_request_seconds_tenant_"
 
+# per-role serving latency series (prefill/decode/draft — a CLOSED
+# set, services/serving.py) emitted by the disaggregated/speculative
+# serving path; each role gets a ticket-severity p99 objective so a
+# prefill-side regression is attributed to the prefill worker instead
+# of smearing across the aggregate
+_ROLE_HIST_PREFIX = "lo_serving_request_seconds_role_"
+
 # ----------------------------------------------------------------------
 # producer-pushed gauges: latest value + timestamp, for signals that
 # have no histogram or sampler ring behind them (the quantized-serving
@@ -172,6 +179,8 @@ class SloWatchdog:
         self._lease = _HistWindow("lo_lease_wait_seconds")
         # tenant -> window, discovered lazily from the hist registry
         self._tenant_serving: Dict[str, _HistWindow] = {}
+        # role -> window (prefill/decode/draft), same discovery path
+        self._role_serving: Dict[str, _HistWindow] = {}
 
     # -- config -------------------------------------------------------
 
@@ -223,6 +232,9 @@ class SloWatchdog:
         for tenant in sorted(list(self._tenant_serving)):
             out[f"servingP99:{tenant}"] = {
                 "severity": "page", "threshold": thr, "unit": "ms"}
+        for role in sorted(list(self._role_serving)):
+            out[f"servingRoleP99:{role}"] = {
+                "severity": "ticket", "threshold": thr, "unit": "ms"}
         return out
 
     # -- evaluation ---------------------------------------------------
@@ -242,7 +254,13 @@ class SloWatchdog:
                 tenant = name[len(_TENANT_HIST_PREFIX):]
                 if tenant not in self._tenant_serving:
                     self._tenant_serving[tenant] = _HistWindow(name)
+            elif name.startswith(_ROLE_HIST_PREFIX):
+                role = name[len(_ROLE_HIST_PREFIX):]
+                if role not in self._role_serving:
+                    self._role_serving[role] = _HistWindow(name)
         for win in self._tenant_serving.values():
+            win.observe(now)
+        for win in self._role_serving.values():
             win.observe(now)
         objectives = self.objectives()
 
@@ -272,6 +290,12 @@ class SloWatchdog:
             return None if p99 is None else p99 * 1000.0
         if name.startswith("servingP99:"):
             win = self._tenant_serving.get(name.split(":", 1)[1])
+            if win is None:
+                return None
+            p99 = win.quantile_over(0.99, window, now)
+            return None if p99 is None else p99 * 1000.0
+        if name.startswith("servingRoleP99:"):
+            win = self._role_serving.get(name.split(":", 1)[1])
             if win is None:
                 return None
             p99 = win.quantile_over(0.99, window, now)

@@ -1040,6 +1040,142 @@ def quantized_paged_append_token(pool: jax.Array, scales: jax.Array,
     return pool.at[page].set(q), scales.at[page].set(s)
 
 
+# ---------------------------------------------------------------------------
+# Speculative-decode verify: k+1 positions per paged step.
+#
+# The draft model proposes k tokens; the target model scores all k+1
+# known positions (last accepted token + k drafts) in ONE dispatch.
+# Bit-identity is preserved by construction: the appends below are the
+# SAME per-token scatter the sequential path issues (in the same
+# order), and each query position runs the SAME decode_attention
+# reduction at its own ``col + j`` over the gathered pages — positions
+# beyond a query's col are masked to NEG_INF exactly as a not-yet-
+# written cache row would be, so query j's float sums cannot see
+# drafts j+1..k. Rejected drafts need no KV rollback for the same
+# reason: their rows sit beyond the new col, masked until the next
+# window overwrites them.
+
+
+def paged_append_tokens(pool: jax.Array, new: jax.Array,
+                        block_tables: jax.Array, pos: jax.Array,
+                        page_len: int,
+                        limit: Optional[jax.Array] = None) -> jax.Array:
+    """Scatter ``s`` consecutive decode positions' K (or V) rows.
+
+    ``new`` is ``(b, s, kv, d)``; row ``i``'s position ``j`` lands
+    where a sequential :func:`paged_append_token` at ``pos[i] + j``
+    would put it. ``limit`` (``(b,)``, optional) is each stream's last
+    fundable position: writes past it are routed to trash page 0
+    (never read unmasked), so a speculative window near the end of a
+    stream's funded pages can neither scribble on another stream's
+    pages nor fall off its block-table row."""
+    rows = jnp.arange(new.shape[0])
+    width = block_tables.shape[1]
+    for j in range(new.shape[1]):
+        p = pos + j
+        page = block_tables[rows, jnp.clip(p // page_len, 0, width - 1)]
+        if limit is not None:
+            page = jnp.where(p <= limit, page, 0)
+        pool = pool.at[page, p % page_len].set(
+            checked_pool_cast(pool, new[:, j]))
+    return pool
+
+
+def paged_verify_attention(q: jax.Array, k_pool: jax.Array,
+                           v_pool: jax.Array,
+                           block_tables: jax.Array,
+                           col: jax.Array, *,
+                           pad_offset: Optional[jax.Array] = None,
+                           window: int = 0,
+                           scale: Optional[float] = None,
+                           max_pages: int = 0) -> jax.Array:
+    """:func:`paged_decode_attention` for ``s`` query positions at
+    once: ``q`` is ``(b, s, n_heads, d)`` and query ``j`` attends
+    ``[0, col + j]``. Pages are gathered ONCE and each position runs
+    the exact single-token reduction, so position ``j``'s output bits
+    match a sequential single-token step at ``col + j`` — the
+    speculative verify step inherits the serving bit-identity
+    contract instead of re-proving it."""
+    if max_pages and max_pages < block_tables.shape[1]:
+        block_tables = block_tables[:, :max_pages]
+    b, s = q.shape[0], q.shape[1]
+    n_pages = block_tables.shape[1]
+    page_len, kv, d = k_pool.shape[1], k_pool.shape[2], k_pool.shape[3]
+    k = jnp.take(k_pool, block_tables, axis=0).reshape(
+        b, n_pages * page_len, kv, d)
+    v = jnp.take(v_pool, block_tables, axis=0).reshape(
+        b, n_pages * page_len, kv, d)
+    outs = [decode_attention(q[:, j:j + 1], k, v, col + j,
+                             pad_offset=pad_offset, window=window,
+                             scale=scale)
+            for j in range(s)]
+    return jnp.concatenate(outs, axis=1)
+
+
+def quantized_paged_append_tokens(pool: jax.Array, scales: jax.Array,
+                                  new: jax.Array,
+                                  block_tables: jax.Array,
+                                  pos: jax.Array, page_len: int,
+                                  limit: Optional[jax.Array] = None,
+                                  ) -> Tuple[jax.Array, jax.Array]:
+    """:func:`paged_append_tokens` into an int8 pool: the ``s`` rows
+    are appended SEQUENTIALLY through
+    :func:`quantized_paged_append_token` (each append requantizes its
+    page against the live rows, exactly as the one-token path would
+    have), with past-``limit`` writes routed to trash page 0."""
+    rows = jnp.arange(new.shape[0])
+    width = block_tables.shape[1]
+    for j in range(new.shape[1]):
+        p = pos + j
+        bt = block_tables.at[
+            rows, jnp.clip(p // page_len, 0, width - 1)].get()
+        if limit is not None:
+            bt = jnp.where(p <= limit, bt, 0)
+        # one-column table: quantized_paged_append_token indexes it
+        # with p // page_len — rebuild a table whose hit column IS the
+        # resolved page so the shared helper stays untouched
+        pool, scales = quantized_paged_append_token(
+            pool, scales, new[:, j],
+            jnp.broadcast_to(bt[:, None], (bt.shape[0], 1)),
+            p % page_len, page_len)
+    return pool, scales
+
+
+def quantized_paged_verify_attention(q: jax.Array, k_pool: jax.Array,
+                                     k_scales: jax.Array,
+                                     v_pool: jax.Array,
+                                     v_scales: jax.Array,
+                                     block_tables: jax.Array,
+                                     col: jax.Array, *,
+                                     pad_offset: Optional[jax.Array]
+                                     = None,
+                                     window: int = 0,
+                                     scale: Optional[float] = None,
+                                     max_pages: int = 0) -> jax.Array:
+    """:func:`paged_verify_attention` over int8 pools — one fused
+    dequant gather shared by all ``s`` query positions."""
+    if max_pages and max_pages < block_tables.shape[1]:
+        block_tables = block_tables[:, :max_pages]
+    b, s = q.shape[0], q.shape[1]
+    n_pages = block_tables.shape[1]
+    page_len, kv, d = (k_pool.shape[1], k_pool.shape[2],
+                       k_pool.shape[3])
+
+    def gather(pool, pool_scales):
+        pages = jnp.take(pool, block_tables, axis=0)
+        sc = jnp.take(pool_scales, block_tables, axis=0)
+        deq = pages.astype(jnp.float32) * sc[:, :, None, :, None]
+        return deq.reshape(b, n_pages * page_len, kv, d)
+
+    k = gather(k_pool, k_scales)
+    v = gather(v_pool, v_scales)
+    outs = [decode_attention(q[:, j:j + 1], k, v, col + j,
+                             pad_offset=pad_offset, window=window,
+                             scale=scale)
+            for j in range(s)]
+    return jnp.concatenate(outs, axis=1)
+
+
 def quantized_paged_decode_attention(q: jax.Array, k_pool: jax.Array,
                                      k_scales: jax.Array,
                                      v_pool: jax.Array,

@@ -60,8 +60,13 @@ HIERARCHY: Dict[str, int] = {
     "serving.manager": 50,         # session registry; tears sessions
                                    # down under the lock
     "serving.session": 60,         # per-session request cv
+    "serving.handoff": 65,         # disagg prefill→decode ready queue
+                                   # (popped under it, pages released
+                                   # after, so kvpool nests above)
     "scheduler.servinglease": 70,  # releases into the fair queue while
                                    # holding it (maybe_yield)
+    "serving.prefix": 75,          # prefix-cache index; eviction
+                                   # decrefs pages (kvpool) inside
     "scheduler.fair": 80,          # the SliceLease cv — the fair queue
     "serving.kvpool": 90,          # paged-KV free list / refcounts
     "serving.latency": 100,        # per-session latency ring

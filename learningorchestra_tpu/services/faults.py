@@ -56,7 +56,13 @@ with 503 while later requests serve normally) and ``kv_quant``
 a transient spec is a retryable 429; a latched spec walks the
 quantization degrade ladder — the session rebuilds itself over exact
 bf16 pages/weights with an incident bundle, so a quantization fault
-degrades, never corrupts a token stream)."""
+degrades, never corrupts a token stream) and ``kv_page_handoff``
+(services/serving.py, fired at the disaggregated session's
+prefill→decode page publish: a transient spec is a retryable 429
+with every page reference restored; a latched spec collapses the
+session to fused prefill+decode — in-flight streams fail with 503,
+unadopted handoff records drain leak-free, an incident bundle fires,
+and later requests serve through the fused path)."""
 
 from __future__ import annotations
 

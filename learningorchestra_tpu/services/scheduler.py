@@ -174,6 +174,15 @@ class SliceLease:
     def _sliced(self) -> bool:
         return self._capacity > 1
 
+    @property
+    def capacity(self) -> int:
+        """Concurrent-holder capacity (``leases``). Disaggregated
+        serving consults this at session create: a prefill/decode
+        lease split only makes sense when TWO grants can be live at
+        once — at capacity 1 the workers would ping-pong one grant
+        and serialize, so the session co-locates instead."""
+        return self._capacity
+
     def _weight(self, pool: str) -> float:
         w = float(self._weights.get(pool, 1.0))
         return w if w > 0 else 1.0
@@ -492,6 +501,16 @@ class SliceLease:
         with self._cv:
             return any(w.pool != pool for w in self._waiters)
 
+    def total_devices(self) -> int:
+        """Mesh device count (lazily resolved from the default mesh).
+        Disaggregated serving consults this to carve prefill/decode
+        footprints into DISJOINT sub-slices: a ``footprint=None``
+        grant is a full-mesh gang, and two gangs can never be live
+        at once in sliced mode."""
+        with self._cv:
+            self._ensure_devices_locked()
+            return int(self._total)
+
     def contended(self) -> bool:
         """ANY waiter is queued (waiters still queued are exactly the
         currently-ungrantable ones — ``_grant_next`` runs at every
@@ -708,12 +727,16 @@ class ServingLease:
 
     def __init__(self, slices: SliceLease, pool: str = "serving",
                  policy: str = "preempt",
-                 footprint: Optional[Dict[str, Any]] = None):
+                 footprint: Optional[Dict[str, Any]] = None,
+                 role: str = ""):
         self._slices = slices
         self._pool = pool
         self._policy = policy if policy in ("preempt", "hold") \
             else "preempt"
         self._footprint = dict(footprint) if footprint else None
+        # disaggregated serving: which worker holds this lease
+        # ("prefill"/"decode"; "" = the whole fused session)
+        self._role = str(role or "")
         self._grant: Optional[Grant] = None
         self._acquired = 0.0
         self._lock = locks.make_lock("scheduler.servinglease")
@@ -797,11 +820,40 @@ class ServingLease:
             held = time.monotonic() - self._acquired
         self._slices.release(self._pool, held, grant=grant)
 
+    def refit(self, footprint: Optional[Dict[str, Any]]) -> None:
+        """Swap the footprint and blockingly re-acquire on it.
+        Disaggregated split serving uses this at session create: the
+        decode lease shrinks from its full-mesh grant onto a
+        sub-slice BEFORE params pin, leaving the rest of the device
+        line free for the prefill worker's own grant."""
+        with self._lock:
+            grant = self._grant
+            self._footprint = dict(footprint) if footprint else None
+            self._grant = None
+            held = time.monotonic() - self._acquired
+        if grant is not None:
+            self._slices.release(self._pool, held, grant=grant)
+        grant = self._slices.acquire(self._pool,
+                                     footprint=self._footprint)
+        with self._lock:
+            self._grant = grant
+            self._acquired = time.monotonic()
+            self.wait_seconds += grant.wait_seconds
+
+    @property
+    def role(self) -> str:
+        return self._role
+
+    def set_role(self, role: str) -> None:
+        """Tag which disagg worker holds this lease (stats only)."""
+        self._role = str(role or "")
+
     def stats(self) -> Dict[str, Any]:
         with self._lock:
             return {
                 "pool": self._pool,
                 "policy": self._policy,
+                "role": self._role,
                 "held": self._grant is not None,
                 "devices": list(self._grant.devices)
                 if self._grant is not None and

@@ -645,6 +645,59 @@ class Api:
                 lines.append(
                     f'lo_serving_drift'
                     f'{{model="{esc(sess["model"])}"}} {drift}')
+        # disaggregated serving + speculative decoding
+        # (services/serving.py DisaggLMServingSession / spec path):
+        # per-role latency over a CLOSED role set
+        # (prefill/decode/draft — bounded cardinality by
+        # construction), time-to-first-token, handoff volume and the
+        # speculative acceptance rate
+        for metric, of_sess in (
+                ("lo_serving_ttft_p50_ms",
+                 lambda s: (s.get("ttft") or {}).get("p50Ms")),
+                ("lo_serving_ttft_p99_ms",
+                 lambda s: (s.get("ttft") or {}).get("p99Ms")),
+                ("lo_serving_accepted_tokens_per_step",
+                 lambda s: (s.get("spec") or {}).get(
+                     "acceptedTokensPerStep")),
+                ("lo_serving_handoff_queue",
+                 lambda s: (s.get("disagg") or {}).get(
+                     "handoffQueue"))):
+            rows = []
+            for sess in serving["bySession"]:
+                value = of_sess(sess)
+                if value is not None:
+                    rows.append((sess["model"], value))
+            if rows:
+                lines.append(f"# TYPE {metric} gauge")
+                for model, value in rows:
+                    lines.append(
+                        f'{metric}{{model="{esc(model)}"}} {value}')
+        rows = []
+        for sess in serving["bySession"]:
+            handoffs = (sess.get("disagg") or {}).get("handoffsTotal")
+            if handoffs is not None:
+                rows.append((sess["model"], handoffs))
+        if rows:
+            lines.append(
+                "# TYPE lo_serving_handoffs_total counter")
+            for model, value in rows:
+                lines.append(
+                    f'lo_serving_handoffs_total'
+                    f'{{model="{esc(model)}"}} {value}')
+        role_rows = []
+        for sess in serving["bySession"]:
+            for role, tracker in sorted(
+                    (sess.get("roles") or {}).items()):
+                role_rows.append((sess["model"], role, tracker))
+        if role_rows:
+            for metric, pkey in (
+                    ("lo_serving_role_latency_p50_ms", "p50Ms"),
+                    ("lo_serving_role_latency_p99_ms", "p99Ms")):
+                lines.append(f"# TYPE {metric} gauge")
+                for model, role, tracker in role_rows:
+                    lines.append(
+                        f'{metric}{{model="{esc(model)}",'
+                        f'role="{esc(role)}"}} {tracker[pkey]}')
         # timed-dispatch gateway
         gateway = m["gateway"]
         lines += [

@@ -348,6 +348,15 @@ class LMServingSession(_SessionBase):
         self.decode_steps = 0
         self.decode_tokens_total = 0
         self._decode_seconds = 0.0
+        # per-role latency attribution (docs/SERVING.md "Disaggregated
+        # serving & speculative decoding"): prefill = admit to first
+        # token, decode = first token to retire, draft = one
+        # speculative propose. The label set is CLOSED (_ROLES — no
+        # client influence), so unlike tenant series no cardinality
+        # cap is needed: three trackers and three histogram series,
+        # ever. TTFT rides along for the bench/SLO surface.
+        self._role_latency: Dict[str, LatencyTracker] = {}
+        self._ttft = LatencyTracker()
         # analytic decode footprint: each step reads every param and
         # the whole slot KV cache from HBM (the classic reason decode
         # is bandwidth-bound), and costs ~2 flops per param per token.
@@ -480,6 +489,8 @@ class LMServingSession(_SessionBase):
         self._cache = self._join(self._cache, pcache, slot)
         req.stages.append(("prefill", admit_t0, time.monotonic(),
                            {"promptTokens": s, "slot": slot}))
+        self._record_role("prefill", time.monotonic() - admit_t0)
+        self._ttft.record(time.monotonic() - req.queued_at)
         first = int(nxt[0])
         self._slot_req[slot] = req
         self._slot_out[slot] = [first]
@@ -492,11 +503,32 @@ class LMServingSession(_SessionBase):
         if self._slot_left[slot] <= 0:
             self._retire(slot)
 
+    _ROLES = ("prefill", "decode", "draft")
+
+    def _record_role(self, role: str, seconds: float) -> None:
+        """Per-role latency: a tracker for session stats plus a
+        role-labelled histogram series
+        (``lo_serving_request_seconds_role_<role>``) for prometheus
+        and the SLO plane. ``role`` comes from the fixed ``_ROLES``
+        set — the bounded-cardinality analog of ``_tenant_series``,
+        bounded by construction instead of by cap."""
+        if role not in self._ROLES:
+            return
+        tracker = self._role_latency.get(role)
+        if tracker is None:
+            tracker = self._role_latency.setdefault(
+                role, LatencyTracker())
+        tracker.record(seconds)
+        obs_hist.observe("lo_serving_request_seconds_role_" + role,
+                         seconds)
+
     def _retire(self, slot: int) -> None:
         req = self._slot_req[slot]
         self._slot_req[slot] = None
         if req is None:
             return
+        self._record_role("decode",
+                          time.monotonic() - self._slot_t0[slot])
         tokens = [int(t) for t in self._slot_out[slot]]
         req.stages.append(("decodeIters", self._slot_t0[slot],
                            time.monotonic(), {"tokens": len(tokens)}))
@@ -523,8 +555,12 @@ class LMServingSession(_SessionBase):
             jnp.asarray(self._col), jnp.asarray(self._keys))
         return nxt
 
-    def _serve_once(self) -> bool:
-        # (1) admit — join at the token boundary, one slot per request
+    def _admit_loop(self) -> bool:
+        """Admit queued requests into free slots (one per request);
+        returns True if anything was admitted. Split out of
+        :meth:`_serve_once` so the disaggregated session's FUSED
+        degrade rung can reuse it verbatim while its split mode moves
+        admission onto the prefill worker."""
         admitted = False
         while True:
             with self._cv:
@@ -542,19 +578,25 @@ class LMServingSession(_SessionBase):
             except Exception as exc:  # noqa: BLE001
                 req.fail(V.HttpError(V.HTTP_UNAVAILABLE,
                                      f"prefill failed: {exc}"))
-        active = [i for i, r in enumerate(self._slot_req)
-                  if r is not None]
-        if not active:
-            return admitted
-        # (2) one continuous-batch step: every active slot advances a
-        # token; idle slots compute masked garbage that is discarded
+        return admitted
+
+    def _active_slots(self) -> List[int]:
+        return [i for i, r in enumerate(self._slot_req)
+                if r is not None]
+
+    def _decode_round(self, active: List[int]) -> None:
+        """One continuous-batch step + harvest/retire over ``active``
+        slots. The speculative paged session overrides this with a
+        propose/verify window that can emit up to spec_k+1 tokens per
+        slot per round."""
+        # every active slot advances a token; idle slots compute
+        # masked garbage that is discarded
         step_t0 = time.monotonic()
         nxt = np.asarray(self._run_step())  # device sync — step wall
         # time ends here
         self._decode_seconds += time.monotonic() - step_t0
         self.decode_steps += 1
         self.decode_tokens_total += len(active)
-        # (3) harvest + retire
         for slot in active:
             tok = int(nxt[slot])
             self._slot_out[slot].append(tok)
@@ -565,6 +607,13 @@ class LMServingSession(_SessionBase):
             if self._slot_left[slot] <= 0 or \
                     self._col[slot] >= self.cache_len - 1:
                 self._retire(slot)
+
+    def _serve_once(self) -> bool:
+        admitted = self._admit_loop()
+        active = self._active_slots()
+        if not active:
+            return admitted
+        self._decode_round(active)
         return True
 
     def close(self) -> None:
@@ -617,6 +666,9 @@ class LMServingSession(_SessionBase):
             "temperature": self.temperature,
             "weights": {"dtype": self.weights_dtype,
                         "bytes": self._param_bytes},
+            "ttft": self._ttft.snapshot(),
+            "roles": {r: t.snapshot() for r, t in
+                      sorted(self._role_latency.items())},
         })
         return out
 
@@ -792,6 +844,13 @@ class PrefixCache:
     Entries hold their own page references, so donor retirement
     never invalidates an entry; LRU entries are evicted under pool
     pressure before the session rejects with 429.
+
+    Thread-safety: the disaggregated session looks prefixes up on the
+    PREFILL worker while the decode worker inserts/evicts, so every
+    mutation runs under its own ranked lock (``serving.prefix`` —
+    between the serving lease and the fair queue, below the pool
+    lock it calls into). Lookup-and-pin still composes: the caller
+    increfs the returned pages before any alloc can evict the entry.
     """
 
     def __init__(self, pool: PagedKVPool, page_len: int,
@@ -799,6 +858,7 @@ class PrefixCache:
         self._pool = pool
         self._page_len = int(page_len)
         self._max = int(max_entries)
+        self._lock = locks.make_lock("serving.prefix")
         # prompt tuple -> {fullPages, tailPage, logits, held}
         self._entries: "collections.OrderedDict" = \
             collections.OrderedDict()
@@ -808,15 +868,17 @@ class PrefixCache:
         self.pages_reused = 0
 
     def __len__(self) -> int:
-        return len(self._entries)
+        with self._lock:
+            return len(self._entries)
 
     def lookup_full(self, prompt: List[int]) -> Optional[Dict[str, Any]]:
-        entry = self._entries.get(tuple(prompt))
-        if entry is not None:
-            self._entries.move_to_end(tuple(prompt))
-            self.hits_full += 1
-            self.pages_reused += len(entry["fullPages"])
-        return entry
+        with self._lock:
+            entry = self._entries.get(tuple(prompt))
+            if entry is not None:
+                self._entries.move_to_end(tuple(prompt))
+                self.hits_full += 1
+                self.pages_reused += len(entry["fullPages"])
+            return entry
 
     def lookup_partial(
             self, prompt: List[int]) -> Tuple[Optional[List[int]], int]:
@@ -824,41 +886,41 @@ class PrefixCache:
         (pages, n_pages); (None, 0) on miss. No references are taken
         here — the caller increfs once it commits to admission."""
         pl = self._page_len
-        for k in range(len(prompt) // pl, 0, -1):
-            key = self._chains.get(tuple(prompt[:k * pl]))
-            if key is None:
-                continue
-            entry = self._entries.get(key)
-            if entry is None or len(entry["fullPages"]) < k:
-                continue
-            self._entries.move_to_end(key)
-            self.hits_partial += 1
-            self.pages_reused += k
-            return list(entry["fullPages"][:k]), k
-        return None, 0
+        with self._lock:
+            for k in range(len(prompt) // pl, 0, -1):
+                key = self._chains.get(tuple(prompt[:k * pl]))
+                if key is None:
+                    continue
+                entry = self._entries.get(key)
+                if entry is None or len(entry["fullPages"]) < k:
+                    continue
+                self._entries.move_to_end(key)
+                self.hits_partial += 1
+                self.pages_reused += k
+                return list(entry["fullPages"][:k]), k
+            return None, 0
 
     def insert(self, prompt: List[int], full_pages: List[int],
                tail_page: Optional[int], logits: np.ndarray) -> None:
         key = tuple(prompt)
-        if key in self._entries:
-            self._entries.move_to_end(key)
-            return
-        held = list(full_pages)
-        if tail_page is not None:
-            held.append(tail_page)
-        self._pool.incref(held)  # the cache's own hold — no tenant
-        self._entries[key] = {
-            "fullPages": list(full_pages), "tailPage": tail_page,
-            "logits": np.asarray(logits), "held": held}
-        pl = self._page_len
-        for k in range(1, len(full_pages) + 1):
-            self._chains[key[:k * pl]] = key
-        while len(self._entries) > self._max:
-            self.evict_one()
+        with self._lock:
+            if key in self._entries:
+                self._entries.move_to_end(key)
+                return
+            held = list(full_pages)
+            if tail_page is not None:
+                held.append(tail_page)
+            self._pool.incref(held)  # the cache's own hold — no tenant
+            self._entries[key] = {
+                "fullPages": list(full_pages), "tailPage": tail_page,
+                "logits": np.asarray(logits), "held": held}
+            pl = self._page_len
+            for k in range(1, len(full_pages) + 1):
+                self._chains[key[:k * pl]] = key
+            while len(self._entries) > self._max:
+                self._evict_one_locked()
 
-    def evict_one(self) -> bool:
-        """Drop the LRU entry and release its page references.
-        Returns False when the cache is already empty."""
+    def _evict_one_locked(self) -> bool:
         if not self._entries:
             return False
         key, entry = self._entries.popitem(last=False)
@@ -869,11 +931,18 @@ class PrefixCache:
         self._pool.decref(entry["held"])
         return True
 
+    def evict_one(self) -> bool:
+        """Drop the LRU entry and release its page references.
+        Returns False when the cache is already empty."""
+        with self._lock:
+            return self._evict_one_locked()
+
     def stats(self) -> Dict[str, Any]:
-        return {"entries": len(self._entries),
-                "hitsFull": self.hits_full,
-                "hitsPartial": self.hits_partial,
-                "pagesReused": self.pages_reused}
+        with self._lock:
+            return {"entries": len(self._entries),
+                    "hitsFull": self.hits_full,
+                    "hitsPartial": self.hits_partial,
+                    "pagesReused": self.pages_reused}
 
 
 class PagedLMServingSession(LMServingSession):
@@ -908,15 +977,33 @@ class PagedLMServingSession(LMServingSession):
                  page_len: int, n_pages: int,
                  tenant_weights: Optional[Dict[str, float]] = None,
                  kv_dtype: str = "bf16",
-                 weights_dtype: str = "bf16"):
+                 weights_dtype: str = "bf16",
+                 draft_model=None, draft_name: str = "",
+                 spec_k: int = 4):
         # consumed by _init_decode_path, which the base __init__ calls
         self.page_len = int(page_len)
         self.n_pages = int(n_pages)
         self.kv_dtype = str(kv_dtype or "bf16")
         self._tenant_weights = dict(tenant_weights or {})
+        # speculative decoding (docs/SERVING.md "Disaggregated
+        # serving & speculative decoding"): a small draft model
+        # proposes spec_k greedy tokens per round; the target
+        # verifies all of them in ONE paged step with exact
+        # acceptance sampling, so greedy sessions stay bit-identical
+        # to solo decode and sampled sessions keep the target's exact
+        # output distribution
+        self._draft = draft_model
+        self._draft_name = str(draft_name or "")
+        self._spec_k = max(1, int(spec_k or 4))
+        self.spec_steps = 0
+        self.spec_slot_steps = 0
+        self.spec_accepted_total = 0
+        self.spec_emitted_total = 0
         super().__init__(name, ctx, lease, model, slots, cache_len,
                          temperature, top_k, top_p,
                          weights_dtype=weights_dtype)
+        if self._draft is not None:
+            self._init_spec_state()
         # quality gate at the door: a quantized session measures its
         # own drift before serving a single request, so a bad
         # quantization degrades at create, not in a user's stream
@@ -937,6 +1024,15 @@ class PagedLMServingSession(LMServingSession):
             kv_dtype=self.kv_dtype)
         self._pool_tree = model.serve_cache_paged(
             self.n_pages, self.page_len, kv_dtype=self.kv_dtype)
+        # speculative verify step: k+1 tokens scored in one dispatch.
+        # Built here (not in _init_spec_state) because its compile
+        # signature includes kv_dtype — a bf16 degrade rebuilds it
+        self._verify = None
+        if self._draft is not None:
+            self._verify = model.serve_fns_spec(
+                self.slots, self.cache_len, self.page_len,
+                self.n_pages, self._spec_k, self.temperature,
+                self.top_k, self.top_p, kv_dtype=self.kv_dtype)
         self._cache_bytes = int(sum(
             a.nbytes
             for a in jax.tree_util.tree_leaves(self._pool_tree)))
@@ -963,6 +1059,79 @@ class PagedLMServingSession(LMServingSession):
         self._drift_parts: Dict[str, float] = {}
         self._drift_probes = 0
         self._steps_since_probe = 0
+
+    # -- speculative decoding ------------------------------------------
+    def _spec_on(self) -> bool:
+        return self._draft is not None and not self._degraded
+
+    def _init_spec_state(self) -> None:
+        """Draft-side state: the draft model's slot KV cache, its
+        prefill/join fns (the draft shares the target's admission
+        path) and the jitted spec_k-token greedy propose scan. The
+        draft always serves bf16 over a SLOT cache — it is small by
+        design, and keeping it exact keeps the one-hot proposal (and
+        with it the acceptance-sampling exactness proof) trivially
+        true."""
+        import jax
+
+        draft = self._draft
+        (_, self._draft_prefill_for, self._draft_join) = \
+            draft.serve_fns(self.slots, self.cache_len, 0.0,
+                            None, None)
+        self._draft_propose = draft.serve_fns_draft(
+            self.slots, self.cache_len, self._spec_k)
+        self._draft_params = draft.params
+        self._draft_cache = draft.serve_cache(self.slots,
+                                              self.cache_len)
+        self._draft_cache_bytes = int(sum(
+            a.nbytes
+            for a in jax.tree_util.tree_leaves(self._draft_cache)))
+        self._draft_param_bytes = int(sum(
+            a.nbytes
+            for a in jax.tree_util.tree_leaves(draft.params)))
+        # the draft's resident bytes are this session's claim too —
+        # X-ray rows must balance when the session (or the spec path
+        # alone) tears down
+        obs_xray.register(
+            "kv-cache", ("kv", self.name + "#draft", id(self)),
+            self._draft_cache_bytes, name=self.name, role="draft",
+            slots=self.slots, cacheLen=self.cache_len)
+        obs_xray.register(
+            "serving-params",
+            ("serving", self.name + "#draft", id(self), "bf16"),
+            self._draft_param_bytes, name=self.name, role="draft")
+
+    def _release_spec_state(self) -> None:
+        """Drop the draft model's device state and its X-ray claims
+        (idempotent — degrade-to-slot and close both call it)."""
+        if self._draft is None:
+            return
+        self._draft = None
+        self._draft_cache = None
+        self._verify = None
+        obs_xray.release("kv-cache",
+                         ("kv", self.name + "#draft", id(self)))
+        obs_xray.release(
+            "serving-params",
+            ("serving", self.name + "#draft", id(self), "bf16"))
+
+    def close(self) -> None:
+        super().close()
+        self._release_spec_state()
+
+    # -- disagg handoff hooks (overridden by the disagg session) -------
+    def _publishes(self) -> bool:
+        """Whether _prepare publishes handoff records (the extra
+        publish incref + the ``kv_page_handoff`` chaos site). The
+        fused session installs in the same thread — no window, no
+        publish hold."""
+        return False
+
+    def _note_handoff_fault(self) -> None:
+        """An injected ``kv_page_handoff`` fault was observed."""
+
+    def _note_handoff_ok(self) -> None:
+        """A publish made it past the chaos site (streak reset)."""
 
     # -- tenants -------------------------------------------------------
     @staticmethod
@@ -1100,6 +1269,24 @@ class PagedLMServingSession(LMServingSession):
     def _admit(self, slot: int, req: _Request) -> None:
         if self._degraded:
             return super()._admit(slot, req)
+        self._install(slot, self._prepare(req))
+
+    def _prepare(self, req: _Request) -> Dict[str, Any]:
+        """Funding + prefill compute for one admission, WITHOUT any
+        pool-tree mutation: quota check, prefix lookup (+ page pins),
+        page allocation, the target prefill forward and the draft
+        prefill when speculation is on. Returns a handoff record the
+        decode side consumes via :meth:`_install`. The fused session
+        runs both halves back-to-back on the worker thread; the
+        disaggregated session runs _prepare on the PREFILL worker and
+        ships the record through the handoff queue — the device pool
+        tree is only ever donated by the decode thread, so the two
+        workers can never race a donation.
+
+        On ANY failure every page reference this admission took is
+        released before the error propagates; on success the record
+        owns them until _install adopts them (or a teardown drain
+        releases them)."""
         if self.kv_dtype == "int8":
             # chaos site for the quantized KV plane (services/faults.py
             # ``kv_quant``): a transient fault is a retryable 429; a
@@ -1163,76 +1350,165 @@ class PagedLMServingSession(LMServingSession):
         if donor_tail is not None:
             self.pool.incref([donor_tail])
         fresh: List[int] = []
+        published = False
+        row: List[int] = []
         try:
             # the shared pages are already charged to the tenant, so
             # the quota headroom needed is only the fresh pages
             self._quota_check(tenant, total_pages - n_shared)
             fresh = self._alloc_pages(total_pages - n_shared, tenant)
             row = shared + fresh
-
+            rec: Dict[str, Any] = {
+                "req": req, "s": s, "new": new, "tenant": tenant,
+                "row": row, "fresh": fresh, "nShared": n_shared,
+                "donorTail": donor_tail, "donorLogits": None,
+                "admitT0": admit_t0, "first": None, "pcache": None,
+                "dpcache": None, "writePages": [], "insert": None,
+                "subPrefill": sub_prefill,
+                "subDecode": np.asarray(sub_decode),
+            }
+            tokens = jnp.asarray(np.asarray(prompt, np.int32)[None, :])
             if entry is not None:
-                # FULL hit: no prefill compute at all. Clone the
-                # donor's tail page (its decode rows past the prompt
-                # are masked until this stream overwrites them) and
-                # resample the first token from the cached final
-                # logits — the same floats the prefill epilogue would
-                # produce.
-                if donor_tail is not None:
-                    self._pool_tree = self._copy_page(
-                        self._pool_tree,
-                        jnp.asarray(np.int32(donor_tail)),
-                        jnp.asarray(np.int32(fresh[0])))
-                first = int(self._sample_first(
-                    jnp.asarray(donor_logits), sub_prefill))
+                # FULL hit: no target prefill compute at all — the
+                # pool-tree side (tail-page clone + first-token
+                # resample from the cached logits) runs in _install
+                rec["donorLogits"] = donor_logits
                 self.prefills_skipped += 1
-                req.stages.append(
-                    ("prefixHit", admit_t0, time.monotonic(),
-                     {"promptTokens": s, "slot": slot,
-                      "sharedPages": n_shared, "tenant": tenant}))
             else:
                 prefill = self._pprefill_for(s)
-                tokens = jnp.asarray(
-                    np.asarray(prompt, np.int32)[None, :])
                 nxt, last_logits, pcache = prefill(
                     self._serve_params, tokens, sub_prefill)
-                # write prompt KV straight into this stream's pages,
-                # starting after any shared prefix pages
+                # prompt KV goes straight into this stream's pages,
+                # starting after any shared prefix pages (_install)
                 n_prefill_pages = -(-s // pl)
-                write_pages = row[n_shared:n_prefill_pages]
-                if write_pages:
-                    self._pool_tree = self._pjoin(
-                        self._pool_tree, pcache,
-                        jnp.asarray(np.asarray(write_pages, np.int32)),
-                        n_shared * pl)
-                first = int(nxt[0])
-                req.stages.append(
-                    ("prefill", admit_t0, time.monotonic(),
-                     {"promptTokens": s, "slot": slot,
-                      "sharedPages": n_shared, "tenant": tenant}))
+                rec["writePages"] = row[n_shared:n_prefill_pages]
+                rec["pcache"] = pcache
+                rec["first"] = int(nxt[0])
                 n_full = s // pl
                 tail_page = row[n_full] if s % pl else None
-                self.prefix.insert(prompt, row[:n_full], tail_page,
-                                   np.asarray(last_logits[0]))
+                rec["insert"] = (prompt, row[:n_full], tail_page,
+                                 np.asarray(last_logits[0]))
+            if self._spec_on():
+                # the draft shares the target's admission path: its
+                # prompt KV comes from its own per-length prefill and
+                # joins its slot cache in _install (the draft cache
+                # is donated by propose, so only the decode thread
+                # may mutate it)
+                dprefill = self._draft_prefill_for(s)
+                _, dpcache = dprefill(self._draft_params, tokens,
+                                      sub_prefill)
+                rec["dpcache"] = dpcache
+            if self._publishes():
+                # disagg handoff point: the chaos site, then the
+                # publish hold that keeps every page alive across the
+                # push→adopt window even if the prefill worker dies
+                faults.maybe_inject("kv_page_handoff")
+                self._note_handoff_ok()
+                self.pool.incref(row)
+                published = True
+                rec["published"] = True
+            return rec
+        except faults.InjectedFault as exc:
+            # only kv_page_handoff reaches here un-wrapped (alloc
+            # faults become HttpErrors inside _alloc_pages)
+            self._note_handoff_fault()
+            if shared or fresh:
+                self.pool.decref(shared + fresh, tenant)
+            if donor_tail is not None:
+                self.pool.decref([donor_tail])
+            self.rejected_total += 1
+            raise V.HttpError(
+                V.HTTP_TOO_MANY_REQUESTS,
+                f"KV page handoff failed ({exc}) — retry with "
+                f"backoff")
         except BaseException:
-            # quota reject, alloc failure, or a prefill/clone error:
+            # quota reject, alloc failure, or a prefill error:
             # release every reference this admission took, or the
             # pages (and the tenant's quota charge) leak and the pool
             # permanently shrinks toward starved admissions
+            if published:
+                self.pool.decref(row)
             if shared or fresh:
                 self.pool.decref(shared + fresh, tenant)
             if donor_tail is not None:
                 self.pool.decref([donor_tail])
             raise
-        if donor_tail is not None:
-            self.pool.decref([donor_tail])
 
+    def _install(self, slot: int, rec: Dict[str, Any]) -> None:
+        """Decode-side half of an admission: pool-tree writes (prefix
+        join / tail-page clone), the draft-cache join, the prefix
+        insert, and slot-state installation. Only the thread that
+        owns the donated pool tree may call this."""
+        import jax.numpy as jnp
+
+        req = rec["req"]
+        row, tenant = rec["row"], rec["tenant"]
+        try:
+            if rec["donorLogits"] is not None:
+                # FULL hit: clone the donor's tail page (its decode
+                # rows past the prompt are masked until this stream
+                # overwrites them) and resample the first token from
+                # the cached final logits — the same floats the
+                # prefill epilogue would produce
+                if rec["donorTail"] is not None:
+                    self._pool_tree = self._copy_page(
+                        self._pool_tree,
+                        jnp.asarray(np.int32(rec["donorTail"])),
+                        jnp.asarray(np.int32(rec["fresh"][0])))
+                first = int(self._sample_first(
+                    jnp.asarray(rec["donorLogits"]),
+                    rec["subPrefill"]))
+                req.stages.append(
+                    ("prefixHit", rec["admitT0"], time.monotonic(),
+                     {"promptTokens": rec["s"], "slot": slot,
+                      "sharedPages": rec["nShared"],
+                      "tenant": tenant}))
+            else:
+                if rec["writePages"]:
+                    self._pool_tree = self._pjoin(
+                        self._pool_tree, rec["pcache"],
+                        jnp.asarray(np.asarray(rec["writePages"],
+                                               np.int32)),
+                        rec["nShared"] * self.page_len)
+                first = rec["first"]
+                req.stages.append(
+                    ("prefill", rec["admitT0"], time.monotonic(),
+                     {"promptTokens": rec["s"], "slot": slot,
+                      "sharedPages": rec["nShared"],
+                      "tenant": tenant}))
+                if rec["insert"] is not None:
+                    # only after the pages are WRITTEN does the entry
+                    # become shareable — inserting in _prepare would
+                    # let a concurrent lookup hit pages whose KV has
+                    # not landed yet
+                    self.prefix.insert(*rec["insert"])
+            if rec["dpcache"] is not None and self._spec_on():
+                self._draft_cache = self._draft_join(
+                    self._draft_cache, rec["dpcache"],
+                    jnp.asarray(np.int32(slot)))
+        except BaseException:
+            if rec.get("published"):
+                self.pool.decref(row)
+            self.pool.decref(row, tenant)
+            if rec["donorTail"] is not None:
+                self.pool.decref([rec["donorTail"]])
+            raise
+        if rec["donorTail"] is not None:
+            self.pool.decref([rec["donorTail"]])
+        if rec.get("published"):
+            # adopt: the decode worker now owns the stream refs — the
+            # publish hold has done its job
+            self.pool.decref(row)
+        now = time.monotonic()
+        self._record_role("prefill", now - rec["admitT0"])
+        self._ttft.record(now - req.queued_at)
         self._slot_req[slot] = req
         self._slot_out[slot] = [first]
-        self._slot_left[slot] = new - 1
-        self._slot_t0[slot] = time.monotonic()
+        self._slot_left[slot] = rec["new"] - 1
+        self._slot_t0[slot] = now
         self._tok[slot, 0] = first
-        self._col[slot] = s
-        self._keys[slot] = np.asarray(sub_decode)
+        self._col[slot] = rec["s"]
+        self._keys[slot] = rec["subDecode"]
         self._bt[slot, :] = 0
         self._bt[slot, :len(row)] = row
         self._slot_pages[slot] = row
@@ -1251,16 +1527,18 @@ class PagedLMServingSession(LMServingSession):
             self._bt[slot, :] = 0  # lane appends go to the trash page
         super()._retire(slot)
 
-    def _gather_width(self) -> int:
+    def _gather_width(self, extra: int = 0) -> int:
         """Bounded paged gather: slice every block table to the
         power-of-2 page bucket covering the longest LIVE stream, so
         short streams never pay HBM reads for long-stream pages (and
-        the step compiles once per bucket, log2(pages/stream) total)."""
+        the step compiles once per bucket, log2(pages/stream) total).
+        ``extra`` widens the bucket for a speculative verify window,
+        which appends up to spec_k tokens past each stream's col."""
         need = 1
         for slot in range(self.slots):
             if self._slot_req[slot] is not None:
-                need = max(need,
-                           int(self._col[slot]) // self.page_len + 1)
+                need = max(need, (int(self._col[slot]) + extra)
+                           // self.page_len + 1)
         width = 1
         while width < need:
             width *= 2
@@ -1289,6 +1567,64 @@ class PagedLMServingSession(LMServingSession):
             jnp.asarray(self._keys))
         return nxt
 
+    def _decode_round(self, active: List[int]) -> None:
+        if self._spec_on():
+            return self._spec_round(active)
+        return super()._decode_round(active)
+
+    def _spec_round(self, active: List[int]) -> None:
+        """One speculative decode iteration: the draft proposes
+        spec_k greedy tokens per live stream, the target scores the
+        whole window in ONE paged verify step, and exact rejection
+        sampling accepts a prefix — so each round lands 1..spec_k+1
+        tokens per stream at roughly one target step's latency. The
+        greedy path is bit-identical to solo decode by construction
+        (accept iff the draft matched the target argmax)."""
+        import jax.numpy as jnp
+
+        draft_t0 = time.monotonic()
+        tok = jnp.asarray(self._tok)
+        col = jnp.asarray(self._col)
+        drafts, self._draft_cache = self._draft_propose(
+            self._draft_params, self._draft_cache, tok, col)
+        drafts_np = np.asarray(drafts)  # sync: draft wall time
+        draft_t1 = time.monotonic()
+        self._record_role("draft", draft_t1 - draft_t0)
+        # last FUNDED position per slot: appends past it are
+        # trash-routed inside the verify kernel, and the host-side
+        # `take` clamp below discards the matching garbage emissions
+        limit = np.zeros((self.slots,), np.int32)
+        for slot in range(self.slots):
+            limit[slot] = max(
+                0, len(self._slot_pages[slot]) * self.page_len - 1)
+        width = self._gather_width(extra=self._spec_k)
+        emitted, n_acc, self._pool_tree = self._verify(
+            self._serve_params, self._pool_tree, tok,
+            jnp.asarray(drafts_np), col, jnp.asarray(self._keys),
+            jnp.asarray(self._bt[:, :width]), jnp.asarray(limit))
+        emitted = np.asarray(emitted)
+        n_acc = np.asarray(n_acc)
+        self._decode_seconds += time.monotonic() - draft_t0
+        self.decode_steps += 1
+        self.spec_steps += 1
+        self.spec_slot_steps += len(active)
+        for slot in active:
+            take = max(1, min(int(n_acc[slot]) + 1,
+                              int(self._slot_left[slot]),
+                              self.cache_len - 1 - int(self._col[slot])))
+            toks = [int(x) for x in emitted[slot, :take]]
+            self._slot_out[slot].extend(toks)
+            self._slot_left[slot] -= take
+            self.tokens_total += take
+            self.decode_tokens_total += take
+            self.spec_accepted_total += take - 1
+            self.spec_emitted_total += take
+            self._tok[slot, 0] = toks[-1]
+            self._col[slot] += take
+            if (self._slot_left[slot] <= 0
+                    or self._col[slot] >= self.cache_len - 1):
+                self._retire(slot)
+
     # -- degrade ladder ------------------------------------------------
     def _degrade_to_slot(self) -> None:
         """Latched ``kv_page_alloc``: fail in-flight paged streams,
@@ -1298,6 +1634,9 @@ class PagedLMServingSession(LMServingSession):
         if self._degraded:
             return
         self._degraded = True
+        # the slot path has no paged verify kernel — speculation ends
+        # here (the draft model and its cache are dropped with it)
+        self._release_spec_state()
         for slot in range(self.slots):
             req = self._slot_req[slot]
             self._slot_req[slot] = None
@@ -1515,6 +1854,370 @@ class PagedLMServingSession(LMServingSession):
                 "max": float(getattr(self._ctx.config,
                                      "serve_drift_max", 0.05) or 0.0),
             }
+        if self._draft_name:
+            out["spec"] = {
+                "draft": self._draft_name,
+                "specK": self._spec_k,
+                "steps": self.spec_steps,
+                "acceptedTokensPerStep": round(
+                    self.spec_accepted_total /
+                    max(1, self.spec_slot_steps), 4),
+                "acceptedTokensTotal": self.spec_accepted_total,
+                "active": self._spec_on(),
+            }
+        return out
+
+    def perf_stats(self) -> Dict[str, Any]:
+        out = super().perf_stats()
+        if out and self._draft_name and self.spec_slot_steps:
+            out["acceptedTokensPerStep"] = round(
+                self.spec_accepted_total / self.spec_slot_steps, 4)
+        return out
+
+
+class DisaggLMServingSession(PagedLMServingSession):
+    """Disaggregated prefill/decode serving (``LO_SERVE_DISAGG=1`` or
+    per-session ``disagg: true``, docs/SERVING.md "Disaggregated
+    serving & speculative decoding").
+
+    A dedicated PREFILL worker thread pops admitted prompts off the
+    queue, runs :meth:`_prepare` (quota + page funding + the prefill
+    forward) and publishes the finished handoff record — its KV pages
+    pinned by an extra publish incref — onto a ready queue. The DECODE
+    worker (the inherited session thread) adopts records into free
+    slots via :meth:`_install` between decode iterations, so a burst
+    of long prompts never stalls in-flight token streams: decode
+    iterations keep their cadence while prefill compute overlaps on
+    the other thread. Pages are handed off by reference counting,
+    never copied.
+
+    Lease placement: when the serving fleet has capacity for two
+    grants (``LO_MESH_LEASES >= 2``, the ``preempt`` policy, and a
+    mesh of >= 2 devices), the session runs split: the device line is
+    carved into DISJOINT sub-slices — prefill takes
+    ``prefillDevices`` (default half the mesh) as its OWN
+    ``ServingLease`` (role ``prefill``) through the same fair queue,
+    and the decode lease refits onto the remainder before params pin.
+    Disjointness is what lets both grants be live at once (a
+    ``footprint=None`` grant is a full-mesh gang, and two gangs can
+    only ping-pong). Otherwise the session runs "colocated": both
+    workers share the decode lease, and the overlap comes from the
+    GIL dropping during XLA compute.
+
+    Thread contract: the device pool tree (and the draft cache) are
+    DONATED buffers — only the decode thread ever mutates them.
+    _prepare touches host-side refcounts (pool, prefix cache — both
+    internally locked) and runs non-donating prefill kernels, so the
+    two workers never race a donation. Degrades latch on the decode
+    thread: the prefill worker only ever *requests* one via
+    ``_degrade_pending``.
+
+    A latched ``kv_page_handoff`` fault collapses the session to
+    FUSED mode (``disagg.mode = "fused-degraded"``): in-flight
+    streams fail with a retryable 503, published-but-unadopted
+    records are drained with every page reference restored, an
+    incident bundle fires, and all later requests serve through the
+    inherited fused machinery — one rung down, never an outage, never
+    a corrupted stream.
+    """
+
+    def __init__(self, name: str, ctx, lease: ServingLease, model,
+                 slots: int, cache_len: int, temperature: float,
+                 top_k: Optional[int], top_p: Optional[float],
+                 page_len: int, n_pages: int,
+                 tenant_weights: Optional[Dict[str, float]] = None,
+                 kv_dtype: str = "bf16",
+                 weights_dtype: str = "bf16",
+                 draft_model=None, draft_name: str = "",
+                 spec_k: int = 4,
+                 prefill_devices: Optional[int] = None):
+        # handoff state first: super().__init__ reaches _publishes()
+        # through _prepare only after start(), but keep construction
+        # order obviously safe
+        self._ready: Deque[Dict[str, Any]] = collections.deque()
+        self._handoff_cv = locks.make_condition("serving.handoff")
+        self._degrade_pending: Optional[Tuple[str, str]] = None
+        self._handoff_fault_streak = 0
+        self.handoffs_total = 0
+        self._prefill_lease: Optional[ServingLease] = None
+        self._prefill_thread: Optional[threading.Thread] = None
+        self.disagg_mode = "colocated"
+        slices = ctx.jobs.slice_lease
+        total = slices.total_devices() \
+            if getattr(slices, "capacity", 1) >= 2 else 1
+        if total >= 2 and lease.policy == "preempt":
+            # true split: carve the device line into DISJOINT
+            # sub-slices — footprint=None is a full-mesh gang grant,
+            # and two gangs can never be live at once, so a
+            # full-mesh prefill holder would wedge the decode
+            # re-acquire forever. Prefill takes prefillDevices
+            # (default: half the mesh); the decode lease refits from
+            # its create-time full-mesh grant onto the remainder
+            # BEFORE super().__init__ pins params, so placement is
+            # final by the time buffers land. The prefill lease
+            # itself is acquired lazily INSIDE the worker thread —
+            # acquiring here would serialize create behind a
+            # contended fleet.
+            pre = min(int(prefill_devices) if prefill_devices
+                      else max(1, total // 2), total - 1)
+            lease.refit({"devices": total - pre})
+            self._prefill_lease = ServingLease(
+                slices, pool="serving", policy="preempt",
+                footprint={"devices": pre}, role="prefill")
+            self.disagg_mode = "split"
+        super().__init__(name, ctx, lease, model, slots, cache_len,
+                         temperature, top_k, top_p, page_len, n_pages,
+                         tenant_weights, kv_dtype=kv_dtype,
+                         weights_dtype=weights_dtype,
+                         draft_model=draft_model,
+                         draft_name=draft_name, spec_k=spec_k)
+        lease.set_role("decode")
+        self._prefill_thread = threading.Thread(
+            target=self._prefill_run,
+            name=f"serving-{name}-prefill", daemon=True)
+
+    def start(self) -> None:
+        super().start()
+        self._prefill_thread.start()
+
+    # -- mode ----------------------------------------------------------
+    def _fused(self) -> bool:
+        return self._degraded or self.disagg_mode == "fused-degraded"
+
+    def _publishes(self) -> bool:
+        return not self._fused()
+
+    def _note_handoff_fault(self) -> None:
+        self._handoff_fault_streak += 1
+        if self._handoff_fault_streak >= self._DEGRADE_AFTER and \
+                self._degrade_pending is None and not self._fused():
+            self._degrade_pending = (
+                "fused", "kv_page_handoff fault latched")
+
+    def _note_handoff_ok(self) -> None:
+        self._handoff_fault_streak = 0
+
+    # -- prefill worker ------------------------------------------------
+    def _prefill_run(self) -> None:
+        acquired = False
+        try:
+            while True:
+                with self._cv:
+                    if self._closed or self._fused():
+                        break
+                    req = None
+                    if self._degrade_pending is None and \
+                            self._queue and \
+                            len(self._ready) < self.slots:
+                        # backpressure: at most `slots` records in
+                        # flight, so a prompt flood cannot fund pages
+                        # faster than decode retires them
+                        req = self._pop_next()
+                    if req is None:
+                        self._cv.wait(timeout=_IDLE_TICK_SECONDS)
+                if req is None:
+                    if acquired:
+                        # never camp on the slice while idle: a gang
+                        # batch job (every device) can only run once
+                        # BOTH serving workers yield, and an idle
+                        # prefill holder would block it forever
+                        self._prefill_lease.maybe_yield()
+                    continue
+                req.popped_at = time.monotonic()
+                if self._prefill_lease is not None:
+                    if not acquired:
+                        self._prefill_lease.acquire()
+                        acquired = True
+                    self._prefill_lease.maybe_yield()
+                try:
+                    rec = self._prepare(req)
+                except V.HttpError as exc:
+                    req.fail(exc)
+                    continue
+                except Exception as exc:  # noqa: BLE001
+                    req.fail(V.HttpError(
+                        V.HTTP_UNAVAILABLE,
+                        f"prefill failed: {exc}"))
+                    continue
+                publish = False
+                with self._handoff_cv:
+                    # mode is written under this lock by
+                    # _collapse_to_fused, so a record can never slip
+                    # into _ready after the drain
+                    if not self._fused():
+                        self._ready.append(rec)
+                        self.handoffs_total += 1
+                        publish = True
+                if not publish:
+                    self._discard_record(rec, V.HttpError(
+                        V.HTTP_UNAVAILABLE,
+                        "session collapsed to fused prefill+decode — "
+                        "retry"))
+                    continue
+                with self._cv:
+                    self._cv.notify_all()
+        finally:
+            if acquired:
+                self._prefill_lease.release()
+
+    # -- decode worker -------------------------------------------------
+    def _have_work(self) -> bool:
+        if self._fused():
+            return super()._have_work()
+        return (bool(self._ready)
+                or self._degrade_pending is not None
+                or any(r is not None for r in self._slot_req))
+
+    def _serve_once(self) -> bool:
+        pending = self._degrade_pending
+        if pending is not None:
+            self._degrade_pending = None
+            kind, reason = pending
+            if kind == "bf16":
+                PagedLMServingSession._degrade_to_bf16(self, reason)
+            else:
+                if not self._fused():
+                    self._collapse_to_fused(reason)
+                if kind == "slot":
+                    PagedLMServingSession._degrade_to_slot(self)
+        if self._fused():
+            return super()._serve_once()
+        did = self._adopt_ready()
+        active = self._active_slots()
+        if not active:
+            return did
+        self._decode_round(active)
+        return True
+
+    def _adopt_ready(self) -> bool:
+        """Move published handoff records into free slots (decode
+        thread). Adoption decrefs the publish hold — from here the
+        stream owns its pages exactly like a fused admission."""
+        did = False
+        while True:
+            with self._handoff_cv:
+                if not self._ready:
+                    break
+                rec = self._ready.popleft()
+            free = [i for i, r in enumerate(self._slot_req)
+                    if r is None]
+            if not free:
+                with self._handoff_cv:
+                    self._ready.appendleft(rec)
+                break
+            try:
+                self._install(free[0], rec)
+                did = True
+            except V.HttpError as exc:
+                rec["req"].fail(exc)
+            except Exception as exc:  # noqa: BLE001
+                rec["req"].fail(V.HttpError(
+                    V.HTTP_UNAVAILABLE,
+                    f"prefill install failed: {exc}"))
+        return did
+
+    # -- degrade -------------------------------------------------------
+    def _degrade_to_slot(self) -> None:
+        if threading.current_thread() is self._prefill_thread:
+            if self._degrade_pending is None:
+                self._degrade_pending = (
+                    "slot", "kv_page_alloc latched")
+            return
+        if not self._fused():
+            self._collapse_to_fused("kv_page_alloc latched")
+        super()._degrade_to_slot()
+
+    def _degrade_to_bf16(self, reason: str) -> None:
+        if threading.current_thread() is self._prefill_thread:
+            # the rebuild swaps the donated pool tree — decode-thread
+            # work; the prefill worker pauses until it lands
+            if self._degrade_pending is None:
+                self._degrade_pending = ("bf16", reason)
+            return
+        super()._degrade_to_bf16(reason)
+
+    def _collapse_to_fused(self, reason: str) -> None:
+        """Latched handoff fault (or a slot degrade beneath it): stop
+        disaggregating. Decode thread only."""
+        with self._handoff_cv:
+            if self.disagg_mode == "fused-degraded":
+                return
+            self.disagg_mode = "fused-degraded"
+        for slot in range(self.slots):
+            req = self._slot_req[slot]
+            if req is None:
+                continue
+            pages = self._slot_pages[slot]
+            if pages:
+                self.pool.decref(pages, self._slot_tenant[slot])
+            self._slot_req[slot] = None
+            self._slot_out[slot] = []
+            self._slot_left[slot] = 0
+            self._slot_pages[slot] = []
+            self._slot_tenant[slot] = None
+            self._bt[slot, :] = 0
+            req.fail(V.HttpError(
+                V.HTTP_UNAVAILABLE,
+                f"session collapsed to fused prefill+decode "
+                f"mid-stream ({reason}) — retry"))
+        self._drain_ready(V.HttpError(
+            V.HTTP_UNAVAILABLE,
+            f"prefill worker degraded ({reason}) — retry"))
+        obs_export.log_event("serving", "handoff-degrade",
+                             model=self.name, reason=reason,
+                             streak=self._handoff_fault_streak)
+        obs_incidents.trigger("serving:handoff-degrade",
+                              model=self.name, reason=reason)
+
+    def _drain_ready(self, error: V.HttpError) -> None:
+        while True:
+            with self._handoff_cv:
+                if not self._ready:
+                    return
+                rec = self._ready.popleft()
+            self._discard_record(rec, error)
+
+    def _discard_record(self, rec: Dict[str, Any],
+                        error: V.HttpError) -> None:
+        """Release every page reference a published record owns (the
+        publish hold AND the stream refs) and fail its request — the
+        free count must come back exactly to where a normal
+        admit+retire would have left it."""
+        if rec.get("published"):
+            self.pool.decref(rec["row"])
+        if rec["row"]:
+            self.pool.decref(rec["row"], rec["tenant"])
+        if rec["donorTail"] is not None:
+            self.pool.decref([rec["donorTail"]])
+        rec["req"].fail(error)
+
+    def close(self) -> None:
+        super().close()
+        thread = self._prefill_thread
+        if thread is not None and thread.is_alive():
+            with self._cv:
+                self._cv.notify_all()
+            thread.join(timeout=30.0)
+        self._drain_ready(V.HttpError(
+            V.HTTP_UNAVAILABLE,
+            f"serving session {self.name} was deleted"))
+        if self._prefill_lease is not None:
+            self._prefill_lease.release()  # idempotent
+
+    def stats(self) -> Dict[str, Any]:
+        out = super().stats()
+        with self._handoff_cv:
+            qlen = len(self._ready)
+        leases: Dict[str, Any] = {"decode": self._lease.stats()}
+        if self._prefill_lease is not None:
+            leases["prefill"] = self._prefill_lease.stats()
+        out["disagg"] = {
+            "mode": self.disagg_mode,
+            "handoffsTotal": self.handoffs_total,
+            "handoffQueue": qlen,
+            "handoffFaultStreak": self._handoff_fault_streak,
+            "leases": leases,
+        }
         return out
 
 
@@ -1785,12 +2488,38 @@ class ServingManager:
                     default=int(cfg.serve_pages)
                     or slots * pages_per + 1)
                 n_pages = max(n_pages, pages_per + 1)
+                disagg = self._want_disagg(body)
+                draft_model, draft_name, spec_k = self._load_draft(
+                    body, instance, cache_len)
+                weights = parse_tenant_weights(
+                    cfg.serve_tenant_weights)
+                if disagg:
+                    prefill_devices = V.valid_slice_devices(
+                        body.get("prefillDevices"))
+                    if isinstance(prefill_devices, dict):
+                        prefill_devices = prefill_devices.get("max")
+                    return DisaggLMServingSession(
+                        model_name, self._ctx, lease, instance,
+                        slots, cache_len, temperature, top_k, top_p,
+                        page_len, n_pages, weights,
+                        kv_dtype=kv_dtype,
+                        weights_dtype=weights_dtype,
+                        draft_model=draft_model,
+                        draft_name=draft_name, spec_k=spec_k,
+                        prefill_devices=prefill_devices)
                 return PagedLMServingSession(
                     model_name, self._ctx, lease, instance, slots,
                     cache_len, temperature, top_k, top_p, page_len,
-                    n_pages,
-                    parse_tenant_weights(cfg.serve_tenant_weights),
-                    kv_dtype=kv_dtype, weights_dtype=weights_dtype)
+                    n_pages, weights,
+                    kv_dtype=kv_dtype, weights_dtype=weights_dtype,
+                    draft_model=draft_model, draft_name=draft_name,
+                    spec_k=spec_k)
+            if body.get("disagg") or body.get("draft"):
+                raise V.HttpError(
+                    V.HTTP_NOT_ACCEPTABLE,
+                    f"{V.MESSAGE_INVALID_FIELD}: disagg/draft need "
+                    f"the paged KV path (kv='paged') — the slot "
+                    f"cache has no page handoff or verify step")
             return LMServingSession(
                 model_name, self._ctx, lease, instance, slots,
                 cache_len, temperature, top_k, top_p,
@@ -1802,6 +2531,68 @@ class ServingManager:
                 f"predict method")
         return BucketServingSession(model_name, self._ctx, lease,
                                     instance)
+
+    def _want_disagg(self, body: Dict[str, Any]) -> bool:
+        """Per-session ``disagg`` field overrides the
+        ``LO_SERVE_DISAGG`` config default; must be a JSON bool."""
+        raw = body.get("disagg")
+        if raw is None:
+            return str(getattr(self._ctx.config, "serve_disagg", "0")
+                       or "0").strip().lower() in ("1", "true",
+                                                   "yes", "on")
+        if not isinstance(raw, bool):
+            raise V.HttpError(
+                V.HTTP_NOT_ACCEPTABLE,
+                f"{V.MESSAGE_INVALID_FIELD}: disagg must be a "
+                f"boolean, got {raw!r}")
+        return raw
+
+    def _load_draft(self, body: Dict[str, Any], instance: Any,
+                    cache_len: int):
+        """Resolve the speculative-decoding draft model (per-session
+        ``draft`` field, else ``LO_SERVE_DRAFT``): a second fitted LM
+        artifact that must share the target's vocabulary and cover
+        the session's cache length. Returns
+        ``(draft_model|None, draft_name, spec_k)``."""
+        cfg = self._ctx.config
+        raw = body.get("draft")
+        if raw is None:
+            raw = str(getattr(cfg, "serve_draft", "") or "")
+        if not raw:
+            return None, "", 4
+        if not isinstance(raw, str):
+            raise V.HttpError(
+                V.HTTP_NOT_ACCEPTABLE,
+                f"{V.MESSAGE_INVALID_FIELD}: draft must be a model "
+                f"name string, got {raw!r}")
+        spec_k = V.valid_positive_int(
+            body.get("specK"), "specK",
+            default=int(getattr(cfg, "serve_spec_k", 4) or 4))
+        type_string = self._ctx.params.artifact_type(raw)
+        if type_string is None:
+            raise V.HttpError(
+                V.HTTP_NOT_FOUND,
+                f"{V.MESSAGE_NONEXISTENT_FILE}: draft model {raw}")
+        draft = self._ctx.artifacts.load(raw, type_string)
+        if not hasattr(draft, "serve_fns_draft"):
+            raise V.HttpError(
+                V.HTTP_NOT_ACCEPTABLE,
+                f"{V.MESSAGE_INVALID_FIELD}: draft {raw} is not a "
+                f"language model (no propose support)")
+        if int(draft.vocab_size) != int(instance.vocab_size):
+            raise V.HttpError(
+                V.HTTP_NOT_ACCEPTABLE,
+                f"{V.MESSAGE_INVALID_FIELD}: draft vocab "
+                f"({draft.vocab_size}) must match the target's "
+                f"({instance.vocab_size}) — acceptance sampling "
+                f"compares their distributions token-for-token")
+        if int(draft.max_len) < int(cache_len):
+            raise V.HttpError(
+                V.HTTP_NOT_ACCEPTABLE,
+                f"{V.MESSAGE_INVALID_FIELD}: draft maxLen "
+                f"({draft.max_len}) must cover cacheLen "
+                f"({cache_len})")
+        return draft, raw, spec_k
 
     def predict(self, model_name: str,
                 body: Dict[str, Any]) -> Dict[str, Any]:

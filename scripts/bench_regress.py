@@ -48,13 +48,14 @@ from typing import Dict, List, Optional, Tuple
 _HIGHER_IS_BETTER = re.compile(
     r"(mfu|tokens_per_sec|samples_per_sec|rows_per_sec|per_chip"
     r"|goodput|bw_util|speedup|accuracy|tflops|streams_vs"
-    r"|peak_streams)", re.IGNORECASE)
+    r"|peak_streams|accepted_tokens)", re.IGNORECASE)
 
 # metric-name fragments where SMALLER is better; everything matching
 # neither pattern is treated as higher-is-better (throughput-like)
 _LOWER_IS_BETTER = re.compile(
     r"(seconds|_ms$|_ms\b|p50|p99|rss|overhead|retraces|latency"
-    r"|time_to|evictions|rejected|stall_ratio|drift)", re.IGNORECASE)
+    r"|time_to|evictions|rejected|stall_ratio|drift|ttft)",
+    re.IGNORECASE)
 
 _SKIP_KEYS = {"platform", "rows", "epochs", "batch_size", "n_samples",
               "streams", "requests_per_stream", "prompt_len",
@@ -73,7 +74,21 @@ _SKIP_KEYS = {"platform", "rows", "epochs", "batch_size", "n_samples",
               # ci.sh's job, not a perf trend)
               "bf16_pages", "int8_pages", "bf16_kv_bytes",
               "int8_kv_bytes", "kv_bytes_per_token", "weights_dtype",
-              "drift_max", "degrade_codes", "degrade_fired"}
+              "drift_max", "degrade_codes", "degrade_fired",
+              # disagg_serving shape/chaos bookkeeping; the fused
+              # burst arm is the deliberately-degraded contrast, so
+              # its inflated p99 is a gate input for ci.sh, not a
+              # trend to hold flat
+              "slots", "pages", "burst_prompt_len",
+              "burst_new_tokens",
+              "open_loop_rate_hz", "open_loop_seconds", "spec_k",
+              "disagg_mode", "handoffs_total", "chaos_codes",
+              "no_burst_ok", "no_burst_rejected",
+              "fused_burst_ok", "fused_burst_rejected",
+              "disagg_burst_ok", "disagg_burst_rejected",
+              "fused_burst_decode_p99_ms",
+              "fused_burst_ttft_p99_ms",
+              "fused_burst_decode_p99_vs_no_burst"}
 
 
 def _round_number(path: str) -> int:

@@ -962,3 +962,352 @@ def test_bf16_paged_session_is_unchanged_by_quant_plumbing(tmp_path):
         assert stats["weights"]["dtype"] == "bf16"
     finally:
         _close_api(api)
+
+
+# ---------------------------- disaggregated serving + speculative decode
+_CYCLE = 16  # cycle length of the learnable successor stream
+
+
+def _fit_cycle_lms(api):
+    """Target ("slm") + draft ("sdraft") trained on the same cyclic-
+    successor stream — token t is ALWAYS followed by t % P + 1, a
+    bigram map both models actually learn — so the draft's greedy
+    proposals mostly match the target's argmax and the accepted-
+    tokens/step assertion measures real speculation. The draft sees
+    the rows in a different order (close weights, not identical), and
+    the spec tests mix in an off-pattern prompt so the rejection path
+    runs too."""
+    from learningorchestra_tpu.models.transformer import LanguageModel
+
+    tokens = np.asarray(
+        [[(off + i) % _CYCLE + 1 for i in range(16)]
+         for off in range(64)], np.int32)
+    lm = LanguageModel(vocab_size=48, d_model=32, n_layers=1,
+                       n_heads=2, d_ff=64, max_len=32, attention="dot")
+    lm.fit(tokens, batch_size=16, epochs=25)
+    api.ctx.artifacts.save(lm, "slm", "train/tensorflow")
+    draft = LanguageModel(vocab_size=48, d_model=32, n_layers=1,
+                          n_heads=2, d_ff=64, max_len=32,
+                          attention="dot")
+    draft.fit(tokens[::-1].copy(), batch_size=16, epochs=25)
+    api.ctx.artifacts.save(draft, "sdraft", "train/tensorflow")
+    return api.ctx.artifacts.load("slm", "train/tensorflow")
+
+
+def _solo_greedy(lm, prompt, new):
+    out = lm.generate(np.asarray([prompt], np.int32),
+                      max_new_tokens=new, temperature=0.0, seed=0)
+    return [int(t) for t in out[0][len(prompt):]]
+
+
+def _wait_until(cond, timeout=10.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if cond():
+            return True
+        time.sleep(0.01)
+    return cond()
+
+
+def _prefix_held(session):
+    """Pages the prefix cache legitimately retains (its own uncharged
+    increfs, dropped on evict/close) — the pool's idle free count is
+    ``pagesTotal - _prefix_held``, not ``pagesTotal``."""
+    with session.prefix._lock:
+        return sum(len(e["held"])
+                   for e in session.prefix._entries.values())
+
+
+def test_disagg_spec_greedy_bit_identical_to_solo(api):
+    """The tentpole contract: a disaggregated session with a draft
+    model — prefill worker, refcounted page handoff, spec_k-token
+    propose/verify rounds — emits EXACTLY the tokens of a solo greedy
+    ``generate``, request by request, while landing >= 1 token per
+    verify step (acceptedTokensPerStep >= 1 means speculation can
+    only add throughput, never subtract)."""
+    lm = _fit_cycle_lms(api)
+    resp = _paged_session(api, disagg=True, draft="sdraft",
+                          specK=3, temperature=0.0)
+    assert resp["disagg"]["mode"] in ("colocated", "split")
+    assert resp["spec"]["draft"] == "sdraft"
+    assert resp["spec"]["specK"] == 3
+
+    rng = np.random.default_rng(81)
+    specs = []
+    for phase, (plen, new) in enumerate(
+            [(3, 6), (5, 8), (8, 5), (4, 7), (6, 6)]):
+        specs.append(([(phase * 3 + i) % _CYCLE + 1
+                       for i in range(plen)], new))
+    # one off-pattern prompt: the draft and target disagree on junk
+    # context, so the greedy REJECTION path runs inside this batch too
+    specs.append(([int(t) for t in rng.integers(1, 48, size=6)], 6))
+    out = [None] * len(specs)
+
+    def client(i):
+        prompt, new = specs[i]
+        time.sleep(0.03 * i)
+        s, b, _ = api.dispatch(
+            "POST", f"{PREFIX}/serve/slm/predict", {},
+            {"prompt": prompt, "maxNewTokens": new, "seed": 1})
+        assert s == 200, b
+        out[i] = b["tokens"]
+
+    threads = [threading.Thread(target=client, args=(i,))
+               for i in range(len(specs))]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=120)
+    for i, (prompt, new) in enumerate(specs):
+        assert out[i] == _solo_greedy(lm, prompt, new), \
+            f"spec request {i} diverged from its solo greedy decode"
+
+    stats = api.dispatch("GET", f"{PREFIX}/serve/slm", {}, None)[1]
+    assert stats["spec"]["steps"] > 0
+    assert stats["spec"]["acceptedTokensPerStep"] >= 1.0
+    assert stats["disagg"]["handoffsTotal"] == len(specs)
+    assert stats["disagg"]["handoffQueue"] == 0
+    # per-role latency (closed prefill/decode/draft set) + TTFT
+    assert set(stats["roles"]) == {"prefill", "decode", "draft"}
+    assert stats["ttft"]["count"] == len(specs)
+    # pool drained leak-free: every handoff was adopted and retired
+    # (the prefix cache's own holds are the only resident pages)
+    session = api.ctx.serving._sessions["slm"]
+    assert session.pool.free_count() == \
+        stats["kv"]["pagesTotal"] - _prefix_held(session)
+    text = api.metrics_prometheus().decode()
+    assert 'lo_serving_accepted_tokens_per_step{model="slm"}' in text
+    assert 'lo_serving_ttft_p99_ms{model="slm"}' in text
+    assert ('lo_serving_role_latency_p99_ms{model="slm",'
+            'role="draft"}') in text
+    assert 'lo_serving_handoffs_total{model="slm"}' in text
+    perf = api.dispatch(
+        "GET", f"{PREFIX}/observability/perf/slm", {}, None)[1]
+    assert perf["perf"].get("acceptedTokensPerStep", 0) >= 1.0
+    api.dispatch("DELETE", f"{PREFIX}/serve/slm", {}, None)
+
+
+def test_spec_sampled_acceptance_keeps_target_distribution(api):
+    """Exact rejection sampling at the kernel level: over many seeds,
+    the FIRST token a sampled-mode verify emits is distributed as the
+    target's filtered softmax — whether the draft proposed the
+    likeliest token (acceptance path) or a near-impossible one
+    (residual path). Tolerance is total-variation distance with fixed
+    seeds, so the check is deterministic."""
+    import jax.numpy as jnp
+    import jax.random as jr
+
+    lm = _fit_lm(api)
+    params = lm.params
+    slots, cache_len, page_len, spec_k = 1, 32, 8, 2
+    n_pages = 1 + cache_len // page_len
+    _, prefill_for, join_paged, _, _ = lm.serve_fns_paged(
+        slots, cache_len, page_len, n_pages, 0.7, 12)
+    verify = lm.serve_fns_spec(slots, cache_len, page_len, n_pages,
+                               spec_k, 0.7, 12)
+    prompt = [3, 9, 17, 5]
+    s = len(prompt)
+    pool = lm.serve_cache_paged(n_pages, page_len)
+    nxt, _last, pcache = prefill_for(s)(
+        params, jnp.asarray(np.asarray(prompt, np.int32)[None]),
+        jr.PRNGKey(0))
+    pool = join_paged(pool, pcache, jnp.asarray(np.asarray([1],
+                                                           np.int32)),
+                      0)
+    t0 = int(nxt[0])
+
+    # exact target distribution for position s+1: prefill over
+    # prompt+[t0] yields that position's logits; apply the same
+    # temperature/topK filter the serve path uses
+    _, last_logits, _ = prefill_for(s + 1)(
+        params,
+        jnp.asarray(np.asarray(prompt + [t0], np.int32)[None]),
+        jr.PRNGKey(0))
+    z = np.asarray(last_logits[0], np.float64) / 0.7
+    kth = np.sort(z)[-12]
+    z[z < kth] = -np.inf
+    p_target = np.exp(z - z.max())
+    p_target /= p_target.sum()
+
+    bt = jnp.asarray(np.asarray([[1, 2, 3, 4]], np.int32))
+    col = jnp.asarray(np.asarray([s], np.int32))
+    tok = jnp.asarray(np.asarray([[t0]], np.int32))
+    limit = jnp.asarray(np.asarray([cache_len - 1], np.int32))
+    n_draws = 800
+    for arm, d in (("accept", int(np.argmax(p_target))),
+                   ("residual", int(np.argmin(p_target)))):
+        drafts = jnp.asarray(np.asarray([[d, 0]], np.int32))
+        counts = np.zeros(48, np.int64)
+        for i in range(n_draws):
+            keys = jnp.asarray(
+                np.asarray(jr.PRNGKey(1000 + i))[None].astype(
+                    np.uint32))
+            emitted, _n_acc, pool = verify(
+                params, pool, tok, drafts, col, keys, bt, limit)
+            counts[int(np.asarray(emitted)[0, 0])] += 1
+        freq = counts / float(n_draws)
+        tv = 0.5 * float(np.abs(freq - p_target).sum())
+        assert tv < 0.08, (arm, tv)
+
+
+def test_disagg_handoff_refcounts_publish_adopt_and_drain(api):
+    """The handoff protocol's refcount invariant: a published record
+    holds its stream refs PLUS an uncharged publish hold, so the
+    pages survive a prefill-worker teardown un-adopted (drain
+    restores the free count exactly) and an adopted record's pages
+    are freed exactly once when the stream retires."""
+    from learningorchestra_tpu.services import serving as serving_mod
+    from learningorchestra_tpu.services import validators as V
+
+    lm = _fit_lm(api)
+    resp = _paged_session(api, disagg=True)
+    session = api.ctx.serving._sessions["slm"]
+    assert isinstance(session, serving_mod.DisaggLMServingSession)
+    pages_total = resp["kv"]["pagesTotal"]
+    assert session.pool.free_count() == pages_total
+
+    # e2e through the prefill worker first: bit-identity holds and
+    # the pool drains back to full after retire
+    rng = np.random.default_rng(91)
+    prompt = [int(t) for t in rng.integers(1, 48, size=6)]
+    s, b, _ = api.dispatch(
+        "POST", f"{PREFIX}/serve/slm/predict", {},
+        {"prompt": prompt, "maxNewTokens": 5, "seed": 13})
+    assert s == 200 and b["tokens"] == _solo(lm, prompt, 5, 13)
+    # idle floor: everything free except the prefix cache's own holds
+    assert _wait_until(
+        lambda: session.pool.free_count()
+        == pages_total - _prefix_held(session))
+    base = session.pool.free_count()
+
+    # publish without adoption: ceil((6+5)/8) = 2 pages funded, held
+    # by stream refs + the publish hold
+    req = serving_mod._Request(
+        {"prompt": prompt, "maxNewTokens": 5, "seed": 17})
+    rec = session._prepare(req)
+    assert rec["published"] is True
+    assert session.pool.free_count() == base - 2
+    # prefill-worker teardown path: drain restores every reference
+    session._discard_record(rec, V.HttpError(
+        V.HTTP_UNAVAILABLE, "prefill worker torn down"))
+    assert session.pool.free_count() == base
+    assert session.pool.tenant_pages("default") == 0
+    assert req.error is not None and req.error.status == 503
+
+    # publish + adopt: the decode worker picks the record up, the
+    # stream serves, and retire frees the pages exactly once
+    req2 = serving_mod._Request(
+        {"prompt": prompt, "maxNewTokens": 5, "seed": 19})
+    rec2 = session._prepare(req2)
+    with session._handoff_cv:
+        session._ready.append(rec2)
+        session.handoffs_total += 1
+    with session._cv:
+        session._cv.notify_all()
+    assert req2.event.wait(30), "adopted stream never finished"
+    assert req2.error is None
+    assert req2.result["tokens"] == _solo(lm, prompt, 5, 19)
+    assert _wait_until(
+        lambda: session.pool.free_count() == base)
+    assert session.pool.tenant_pages("default") == 0
+    api.dispatch("DELETE", f"{PREFIX}/serve/slm", {}, None)
+
+
+def test_disagg_handoff_latched_fault_collapses_to_fused(tmp_path):
+    """Chaos at the kv_page_handoff site: three consecutive injected
+    faults are three retryable 429s with every page reference
+    restored, then the session collapses to fused prefill+decode —
+    disagg.mode stamps fused-degraded, an incident fires, and later
+    requests serve bit-identically through the fused path (the ladder
+    degrades, never corrupts)."""
+    from learningorchestra_tpu.observability import (
+        incidents as obs_incidents)
+
+    api = _api_with(tmp_path, fault_inject="kv_page_handoff:100")
+    try:
+        lm = _fit_lm(api)
+        resp = _paged_session(api, disagg=True)
+        pages_total = resp["kv"]["pagesTotal"]
+        session = api.ctx.serving._sessions["slm"]
+        rng = np.random.default_rng(92)
+        prompt = [int(t) for t in rng.integers(1, 48, size=6)]
+
+        for _ in range(3):
+            s, b, _ = api.dispatch(
+                "POST", f"{PREFIX}/serve/slm/predict", {},
+                {"prompt": prompt, "maxNewTokens": 5, "seed": 43})
+            assert s == 429, b
+            assert session.pool.free_count() == pages_total
+
+        assert _wait_until(
+            lambda: api.dispatch(
+                "GET", f"{PREFIX}/serve/slm", {},
+                None)[1]["disagg"]["mode"] == "fused-degraded")
+
+        # fused mode never reaches the handoff site: the still-armed
+        # budget cannot touch it, and bit-identity to solo holds
+        s, b, _ = api.dispatch(
+            "POST", f"{PREFIX}/serve/slm/predict", {},
+            {"prompt": prompt, "maxNewTokens": 5, "seed": 43})
+        assert s == 200, b
+        assert b["tokens"] == _solo(lm, prompt, 5, 43)
+        assert session.pool.free_count() == \
+            pages_total - _prefix_held(session)
+
+        stats = api.dispatch("GET", f"{PREFIX}/serve/slm", {}, None)[1]
+        assert stats["kv"]["mode"] == "paged"  # still paged, just fused
+        recorder = obs_incidents.get_recorder()
+        if recorder is not None:
+            assert "serving:handoff-degrade" in \
+                recorder.stats()["byTrigger"]
+    finally:
+        _close_api(api)
+
+
+def test_disagg_split_mode_takes_two_leases(tmp_path):
+    """With fleet capacity for two grants (LO_MESH_LEASES=2) the
+    disaggregated session runs split: the decode lease is tagged
+    ``decode``, the prefill worker queues for its OWN lease tagged
+    ``prefill``, and requests stream through the handoff end to
+    end."""
+    api = _api_with(tmp_path, mesh_leases=2)
+    try:
+        lm = _fit_lm(api)
+        resp = _paged_session(api, disagg=True)
+        assert resp["disagg"]["mode"] == "split"
+        leases = resp["disagg"]["leases"]
+        assert leases["decode"]["role"] == "decode"
+        assert leases["prefill"]["role"] == "prefill"
+
+        rng = np.random.default_rng(93)
+        prompt = [int(t) for t in rng.integers(1, 48, size=5)]
+        s, b, _ = api.dispatch(
+            "POST", f"{PREFIX}/serve/slm/predict", {},
+            {"prompt": prompt, "maxNewTokens": 6, "seed": 29})
+        assert s == 200, b
+        assert b["tokens"] == _solo(lm, prompt, 6, 29)
+        stats = api.dispatch("GET", f"{PREFIX}/serve/slm", {}, None)[1]
+        assert stats["disagg"]["handoffsTotal"] >= 1
+        # the prefill worker actually acquired its own grant
+        assert stats["disagg"]["leases"]["prefill"]["held"] is True
+    finally:
+        _close_api(api)
+
+
+def test_disagg_and_draft_rejected_on_slot_path(api):
+    """The slot cache has no page handoff and no paged verify step:
+    asking for disagg/draft without kv='paged' is a 406 at the door,
+    not a silent downgrade."""
+    _fit_lm(api)
+    s, b, _ = api.dispatch(
+        "POST", f"{PREFIX}/serve/slm", {},
+        {"kv": "slot", "disagg": True})
+    assert s == 406, b
+    s, b, _ = api.dispatch(
+        "POST", f"{PREFIX}/serve/slm", {},
+        {"kv": "paged", "disagg": "yes"})
+    assert s == 406, b
+    s, b, _ = api.dispatch(
+        "POST", f"{PREFIX}/serve/slm", {},
+        {"kv": "paged", "draft": "nonexistent-draft"})
+    assert s == 404, b
